@@ -1,0 +1,12 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up rebuild of the capability surface of PredictionIO 0.9.3
+(reference at `/root/reference`): pluggable engines
+(DataSource -> Preparator -> Algorithm(s) -> Serving), a REST event server
+with an embedded event store, train/deploy/eval workflows, and an
+evaluation/sweep subsystem — with all distributed compute re-expressed as
+JAX/XLA over TPU device meshes (pjit/shard_map + Pallas kernels) instead of
+Apache Spark RDDs.
+"""
+
+__version__ = "0.1.0"

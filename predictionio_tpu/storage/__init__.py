@@ -1,0 +1,69 @@
+"""Storage layer: event data model, event stores, metadata store, id maps.
+
+TPU-native replacement for the reference `data` module
+(`/root/reference/data/src/main/scala/io/prediction/data/storage/`):
+embedded SQLite/in-memory backends instead of HBase/Elasticsearch, and a
+columnar batch read path (struct-of-arrays -> ``jax.Array``) instead of
+Spark RDDs.
+"""
+
+from .aggregate import aggregate_properties, aggregate_properties_single
+from .bimap import BiMap, StringIndex
+from .columnar import EventFrame, Ratings, events_to_frame
+from .event import (
+    DataMap,
+    Event,
+    EventValidationError,
+    PropertyMap,
+    format_time,
+    now_utc,
+    parse_time,
+    validate_event,
+)
+from .levents import NO_TARGET, EventStore, MemoryEventStore
+from .metadata import (
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    MetadataStore,
+    Model,
+)
+from .registry import Storage, StorageError, get_storage, reset_storage
+from .sqlite_events import SQLiteEventStore
+
+__all__ = [
+    "aggregate_properties",
+    "aggregate_properties_single",
+    "BiMap",
+    "StringIndex",
+    "EventFrame",
+    "Ratings",
+    "events_to_frame",
+    "DataMap",
+    "Event",
+    "EventValidationError",
+    "PropertyMap",
+    "format_time",
+    "now_utc",
+    "parse_time",
+    "validate_event",
+    "NO_TARGET",
+    "EventStore",
+    "MemoryEventStore",
+    "SQLiteEventStore",
+    "AccessKey",
+    "App",
+    "Channel",
+    "EngineInstance",
+    "EngineManifest",
+    "EvaluationInstance",
+    "MetadataStore",
+    "Model",
+    "Storage",
+    "StorageError",
+    "get_storage",
+    "reset_storage",
+]

@@ -1,0 +1,121 @@
+"""Bidirectional id maps: string entity ids <-> contiguous device-friendly
+integer indices.
+
+Re-expression of reference `BiMap.scala:25-164` (``BiMap.stringInt`` /
+``stringLong``) built for the TPU path: the forward map is a Python dict for
+O(1) host lookups at serving time, the inverse is a NumPy object array so
+batched top-k results coming back from the device can be decoded with a
+single fancy-index instead of a Python loop.  Index assignment is by first
+appearance when built incrementally, or sorted-unique when built from bulk
+arrays (deterministic either way — SURVEY §7 hard-part 3).
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Iterable, Mapping, Sequence, TypeVar
+
+import numpy as np
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+__all__ = ["BiMap", "StringIndex"]
+
+
+class BiMap(Generic[K, V]):
+    """Immutable bidirectional map (reference `BiMap.scala:25-110`)."""
+
+    def __init__(self, forward: Mapping[K, V]):
+        self._f = dict(forward)
+        self._i = {v: k for k, v in self._f.items()}
+        if len(self._i) != len(self._f):
+            raise ValueError("BiMap values must be unique")
+
+    def __getitem__(self, k: K) -> V:
+        return self._f[k]
+
+    def get(self, k: K, default=None):
+        return self._f.get(k, default)
+
+    def contains(self, k: K) -> bool:
+        return k in self._f
+
+    __contains__ = contains
+
+    def inverse(self) -> "BiMap[V, K]":
+        return BiMap(self._i)
+
+    def inv_get(self, v: V, default=None):
+        return self._i.get(v, default)
+
+    def keys(self):
+        return self._f.keys()
+
+    def values(self):
+        return self._f.values()
+
+    def items(self):
+        return self._f.items()
+
+    def __len__(self) -> int:
+        return len(self._f)
+
+    def to_dict(self) -> dict:
+        return dict(self._f)
+
+    # -- constructors matching BiMap.stringInt/stringLong ----------------
+    @staticmethod
+    def string_int(keys: Iterable[str]) -> "BiMap[str, int]":
+        uniq = sorted(set(keys))
+        return BiMap({k: i for i, k in enumerate(uniq)})
+
+
+class StringIndex:
+    """Contiguous index over string ids with a vectorized decode path.
+
+    The TPU-facing counterpart of ``BiMap.stringInt``: ``encode`` maps id
+    arrays to int32 (unknowns -> -1), ``decode`` maps device index arrays
+    back to ids via one NumPy gather.
+    """
+
+    __slots__ = ("_to_ix", "_ids")
+
+    def __init__(self, ids: Sequence[str]):
+        arr = np.asarray(list(ids), dtype=object)
+        if len(set(arr.tolist())) != len(arr):
+            raise ValueError("StringIndex ids must be unique")
+        self._ids = arr
+        self._to_ix = {s: i for i, s in enumerate(arr.tolist())}
+
+    @staticmethod
+    def from_values(values: Iterable[str]) -> "StringIndex":
+        """Deterministic build: sorted unique (bulk-array path)."""
+        return StringIndex(sorted(set(values)))
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_ix
+
+    def get(self, s: str, default: int = -1) -> int:
+        return self._to_ix.get(s, default)
+
+    def __getitem__(self, s: str) -> int:
+        return self._to_ix[s]
+
+    def id_of(self, ix: int) -> str:
+        return self._ids[ix]
+
+    @property
+    def ids(self) -> np.ndarray:
+        return self._ids
+
+    def encode(self, values: Iterable[str]) -> np.ndarray:
+        """ids -> int32 indices; unknown ids become -1."""
+        g = self._to_ix.get
+        return np.fromiter((g(v, -1) for v in values), dtype=np.int32)
+
+    def decode(self, ixs: np.ndarray) -> np.ndarray:
+        """int indices -> id object array (single gather)."""
+        return self._ids[np.asarray(ixs)]

@@ -1,0 +1,345 @@
+"""SQLite event store backend.
+
+Replaces the reference's HBase event store
+(`/root/reference/data/src/main/scala/io/prediction/data/storage/hbase/`)
+for single-host deployments: one SQLite file per storage source, one table
+per (app, channel) — mirroring the reference's table-per-app/channel layout
+(`HBEventsUtil.scala:51-57`).  The HBase row-key design
+(md5(entity) ++ time ++ uuid, `HBEventsUtil.scala:74-129`) exists to make
+entity-scoped time-range scans cheap; the SQLite equivalents are the
+composite indexes below.  WAL mode + a per-store write lock give concurrent
+reader / single-writer semantics adequate for the event server.
+
+The batch read path (:meth:`SQLiteEventStore.find_columnar`) bypasses Event
+object construction and reads straight into NumPy arrays — the `PEvents`
+analogue (`HBPEvents.scala:66-199`), where the reference instead parallel-scans
+HBase regions into RDDs.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import itertools
+import json
+import sqlite3
+import threading
+from pathlib import Path
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from .columnar import EventFrame
+from .event import (
+    DataMap,
+    Event,
+    from_millis,
+    new_event_id,
+    time_millis,
+    validate_event,
+)
+from .levents import NO_TARGET, EventStore, TargetFilter
+
+__all__ = ["SQLiteEventStore"]
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS {table} (
+  event_id TEXT PRIMARY KEY,
+  event TEXT NOT NULL,
+  entity_type TEXT NOT NULL,
+  entity_id TEXT NOT NULL,
+  target_entity_type TEXT,
+  target_entity_id TEXT,
+  properties TEXT NOT NULL,
+  event_time INTEGER NOT NULL,
+  tags TEXT NOT NULL,
+  pr_id TEXT,
+  creation_time INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS {table}_time ON {table} (event_time);
+CREATE INDEX IF NOT EXISTS {table}_entity
+  ON {table} (entity_type, entity_id, event_time);
+CREATE INDEX IF NOT EXISTS {table}_name ON {table} (event, event_time);
+"""
+
+
+def _table_name(app_id: int, channel_id: int) -> str:
+    # mirrors events_<appId>[_<channelId>] (HBEventsUtil.scala:51-57)
+    return f"events_{app_id}" if channel_id == 0 else f"events_{app_id}_{channel_id}"
+
+
+class SQLiteEventStore(EventStore):
+    def __init__(self, path: str | Path = ":memory:"):
+        self._path = str(path)
+        self._lock = threading.RLock()
+        self._local = threading.local()
+        self._known_tables: set[str] = set()
+        # :memory: must share one connection across threads
+        self._shared = self._path == ":memory:"
+        if self._shared:
+            self._conn_shared = self._connect()
+
+    def _connect(self) -> sqlite3.Connection:
+        conn = sqlite3.connect(self._path, check_same_thread=False)
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=NORMAL")
+        return conn
+
+    @property
+    def _conn(self) -> sqlite3.Connection:
+        if self._shared:
+            return self._conn_shared
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._connect()
+            self._local.conn = conn
+        return conn
+
+    def _ensure_table(self, app_id: int, channel_id: int) -> str:
+        t = _table_name(app_id, channel_id)
+        if t not in self._known_tables:
+            with self._lock:
+                self._conn.executescript(_SCHEMA.format(table=t))
+                self._conn.commit()
+                self._known_tables.add(t)
+        return t
+
+    # -- lifecycle --------------------------------------------------------
+    def init_channel(self, app_id: int, channel_id: int = 0) -> bool:
+        self._ensure_table(app_id, channel_id)
+        return True
+
+    def remove_channel(self, app_id: int, channel_id: int = 0) -> bool:
+        t = _table_name(app_id, channel_id)
+        with self._lock:
+            self._conn.execute(f"DROP TABLE IF EXISTS {t}")
+            self._conn.commit()
+            self._known_tables.discard(t)
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._shared:
+                self._conn_shared.close()
+            else:
+                conn = getattr(self._local, "conn", None)
+                if conn is not None:
+                    conn.close()
+                    self._local.conn = None
+
+    # -- writes -----------------------------------------------------------
+    def _row(self, event: Event, eid: str) -> tuple:
+        return (
+            eid,
+            event.event,
+            event.entity_type,
+            event.entity_id,
+            event.target_entity_type,
+            event.target_entity_id,
+            json.dumps(event.properties.to_json(), separators=(",", ":")),
+            time_millis(event.event_time),
+            json.dumps(list(event.tags)),
+            event.pr_id,
+            time_millis(event.creation_time),
+        )
+
+    def insert(self, event: Event, app_id: int, channel_id: int = 0) -> str:
+        validate_event(event)
+        t = self._ensure_table(app_id, channel_id)
+        eid = event.event_id or new_event_id()
+        with self._lock:
+            self._conn.execute(
+                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                self._row(event, eid),
+            )
+            self._conn.commit()
+        return eid
+
+    def insert_batch(
+        self, events, app_id: int, channel_id: int = 0
+    ) -> list[str]:
+        t = self._ensure_table(app_id, channel_id)
+        rows, ids = [], []
+        for e in events:
+            validate_event(e)
+            eid = e.event_id or new_event_id()
+            ids.append(eid)
+            rows.append(self._row(e, eid))
+        with self._lock:
+            self._conn.executemany(
+                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows
+            )
+            self._conn.commit()
+        return ids
+
+    # -- point reads ------------------------------------------------------
+    @staticmethod
+    def _event_from_row(r: tuple) -> Event:
+        return Event(
+            event_id=r[0],
+            event=r[1],
+            entity_type=r[2],
+            entity_id=r[3],
+            target_entity_type=r[4],
+            target_entity_id=r[5],
+            properties=DataMap(json.loads(r[6])),
+            event_time=from_millis(r[7]),
+            tags=tuple(json.loads(r[8])),
+            pr_id=r[9],
+            creation_time=from_millis(r[10]),
+        )
+
+    def get(self, event_id: str, app_id: int, channel_id: int = 0) -> Optional[Event]:
+        t = self._ensure_table(app_id, channel_id)
+        cur = self._conn.execute(f"SELECT * FROM {t} WHERE event_id=?", (event_id,))
+        row = cur.fetchone()
+        return self._event_from_row(row) if row else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: int = 0) -> bool:
+        t = self._ensure_table(app_id, channel_id)
+        with self._lock:
+            cur = self._conn.execute(
+                f"DELETE FROM {t} WHERE event_id=?", (event_id,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    # -- scans ------------------------------------------------------------
+    def _query(
+        self,
+        table: str,
+        start_time,
+        until_time,
+        entity_type,
+        entity_id,
+        event_names,
+        target_entity_type: TargetFilter,
+        target_entity_id: TargetFilter,
+        limit,
+        reversed: bool,
+        columns: str = "*",
+    ) -> tuple[str, list]:
+        where, params = [], []
+        if start_time is not None:
+            where.append("event_time >= ?")
+            params.append(time_millis(start_time))
+        if until_time is not None:
+            where.append("event_time < ?")
+            params.append(time_millis(until_time))
+        if entity_type is not None:
+            where.append("entity_type = ?")
+            params.append(entity_type)
+        if entity_id is not None:
+            where.append("entity_id = ?")
+            params.append(entity_id)
+        if event_names is not None:
+            qs = ",".join("?" * len(event_names))
+            where.append(f"event IN ({qs})")
+            params.extend(event_names)
+        for col, filt in (
+            ("target_entity_type", target_entity_type),
+            ("target_entity_id", target_entity_id),
+        ):
+            if filt is None:
+                continue
+            if filt is NO_TARGET:
+                where.append(f"{col} IS NULL")
+            else:
+                where.append(f"{col} = ?")
+                params.append(filt)
+        sql = f"SELECT {columns} FROM {table}"
+        if where:
+            sql += " WHERE " + " AND ".join(where)
+        sql += f" ORDER BY event_time {'DESC' if reversed else 'ASC'}, event_id"
+        if limit is not None and limit >= 0:
+            sql += " LIMIT ?"
+            params.append(limit)
+        return sql, params
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: TargetFilter = None,
+        target_entity_id: TargetFilter = None,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        t = self._ensure_table(app_id, channel_id)
+        sql, params = self._query(
+            t, start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, limit, reversed,
+        )
+        cur = self._conn.execute(sql, params)
+        return (self._event_from_row(r) for r in iter(cur.fetchone, None))
+
+    # -- columnar batch read (PEvents analogue) ---------------------------
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: TargetFilter = None,
+        target_entity_id: TargetFilter = None,
+        float_property: Optional[str] = None,
+        float_default: float = np.nan,
+    ) -> EventFrame:
+        """Bulk scan straight into column arrays.
+
+        When ``float_property`` is given, that property is extracted per event
+        into a float64 column (missing -> ``float_default``) with a cheap JSON
+        peek, skipping full property parsing — this is the training-data hot
+        path (ratings, weights).
+        """
+        t = self._ensure_table(app_id, channel_id)
+        sql, params = self._query(
+            t, start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, None, False,
+            columns="event, entity_type, entity_id, target_entity_type, "
+            "target_entity_id, event_time, properties",
+        )
+        rows = self._conn.execute(sql, params).fetchall()
+        n = len(rows)
+        names = np.empty(n, dtype=object)
+        etypes = np.empty(n, dtype=object)
+        eids = np.empty(n, dtype=object)
+        ttypes = np.empty(n, dtype=object)
+        tids = np.empty(n, dtype=object)
+        times = np.empty(n, dtype=np.int64)
+        props: Optional[np.ndarray] = None
+        values = np.full(n, float_default, dtype=np.float64) if float_property else None
+        keep_props = float_property is None
+        if keep_props:
+            props = np.empty(n, dtype=object)
+        for i, r in enumerate(rows):
+            names[i] = r[0]
+            etypes[i] = r[1]
+            eids[i] = r[2]
+            ttypes[i] = r[3]
+            tids[i] = r[4]
+            times[i] = r[5]
+            if float_property is not None:
+                if r[6] != "{}":
+                    v = json.loads(r[6]).get(float_property)
+                    if v is not None:
+                        values[i] = float(v)
+            else:
+                props[i] = json.loads(r[6])
+        return EventFrame(
+            event=names,
+            entity_type=etypes,
+            entity_id=eids,
+            target_entity_type=ttypes,
+            target_entity_id=tids,
+            event_time_ms=times,
+            properties=props,
+            value=values,
+        )

@@ -1,0 +1,32 @@
+"""Test env: force an 8-device virtual CPU mesh before jax is imported.
+
+Stands in for a TPU pod the way the reference's `local[4]` Spark master
+stands in for a cluster (reference `core/src/test/.../BaseTest.scala:14-74`).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def storage_memory():
+    """Process-global Storage wired to hermetic in-memory backends."""
+    from predictionio_tpu.storage import Storage, reset_storage
+
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEMDB",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_MEMDB_TYPE": "memory",
+    })
+    reset_storage(s)
+    yield s
+    reset_storage(None)

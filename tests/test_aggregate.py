@@ -1,0 +1,96 @@
+"""$set/$unset/$delete folding tests (reference `LEventAggregatorSpec`)."""
+
+import datetime as dt
+
+from predictionio_tpu.storage import (
+    DataMap,
+    Event,
+    aggregate_properties,
+    aggregate_properties_single,
+)
+
+UTC = dt.timezone.utc
+
+
+def _t(m):
+    return dt.datetime(2020, 1, 1, 0, m, tzinfo=UTC)
+
+
+def _set(eid, props, m):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=_t(m))
+
+
+def _unset(eid, keys, m):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=_t(m))
+
+
+def _delete(eid, m):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=_t(m))
+
+
+def test_set_merges_later_wins():
+    out = aggregate_properties(
+        [_set("u1", {"a": 1, "b": 2}, 1), _set("u1", {"b": 9, "c": 3}, 2)]
+    )
+    assert out["u1"].fields == {"a": 1, "b": 9, "c": 3}
+    assert out["u1"].first_updated == _t(1)
+    assert out["u1"].last_updated == _t(2)
+
+
+def test_order_independent_of_input_order():
+    # events arrive out of order; fold must sort by event_time
+    out = aggregate_properties(
+        [_set("u1", {"b": 9}, 2), _set("u1", {"a": 1, "b": 2}, 1)]
+    )
+    assert out["u1"].fields == {"a": 1, "b": 9}
+
+
+def test_unset_removes_keys():
+    out = aggregate_properties(
+        [_set("u1", {"a": 1, "b": 2}, 1), _unset("u1", ["a"], 2)]
+    )
+    assert out["u1"].fields == {"b": 2}
+
+
+def test_delete_drops_entity():
+    out = aggregate_properties([_set("u1", {"a": 1}, 1), _delete("u1", 2)])
+    assert "u1" not in out
+
+
+def test_delete_then_set_recreates():
+    out = aggregate_properties(
+        [_set("u1", {"a": 1}, 1), _delete("u1", 2), _set("u1", {"z": 9}, 3)]
+    )
+    assert out["u1"].fields == {"z": 9}
+    # first/last updated span all special events (reference propAggregator)
+    assert out["u1"].first_updated == _t(1)
+    assert out["u1"].last_updated == _t(3)
+
+
+def test_non_special_events_ignored():
+    rate = Event(event="rate", entity_type="user", entity_id="u1",
+                 properties=DataMap({"rating": 5}), event_time=_t(5))
+    out = aggregate_properties([_set("u1", {"a": 1}, 1), rate])
+    assert out["u1"].fields == {"a": 1}
+    assert out["u1"].last_updated == _t(1)
+
+
+def test_unset_before_any_set():
+    out = aggregate_properties([_unset("u1", ["a"], 1)])
+    assert "u1" not in out
+
+
+def test_multiple_entities():
+    out = aggregate_properties([_set("u1", {"a": 1}, 1), _set("u2", {"b": 2}, 1)])
+    assert set(out) == {"u1", "u2"}
+
+
+def test_single_entity_variant():
+    pm = aggregate_properties_single(
+        [_set("u1", {"a": 1}, 1), _set("u1", {"b": 2}, 2)]
+    )
+    assert pm is not None and pm.fields == {"a": 1, "b": 2}
+    assert aggregate_properties_single([_delete("u1", 1)]) is None

@@ -1,0 +1,43 @@
+"""BiMap/StringIndex tests (reference `BiMapSpec`)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.storage import BiMap, StringIndex
+
+
+def test_bimap_basic():
+    m = BiMap({"a": 1, "b": 2})
+    assert m["a"] == 1
+    assert m.inverse()[2] == "b"
+    assert m.inv_get(1) == "a"
+    assert "a" in m and len(m) == 2
+    assert m.get("z") is None
+
+
+def test_bimap_rejects_dup_values():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_bimap_string_int_contiguous_sorted():
+    m = BiMap.string_int(["z", "a", "m", "a"])
+    assert sorted(m.values()) == [0, 1, 2]
+    assert m["a"] == 0 and m["m"] == 1 and m["z"] == 2
+
+
+def test_string_index_encode_decode():
+    ix = StringIndex.from_values(["u3", "u1", "u2", "u1"])
+    assert len(ix) == 3
+    enc = ix.encode(["u1", "u2", "unknown", "u3"])
+    assert enc.dtype == np.int32
+    assert enc.tolist() == [0, 1, -1, 2]
+    dec = ix.decode(np.array([2, 0]))
+    assert dec.tolist() == ["u3", "u1"]
+    assert ix["u1"] == 0 and ix.get("nope") == -1
+    assert "u2" in ix
+
+
+def test_string_index_unique_required():
+    with pytest.raises(ValueError):
+        StringIndex(["a", "a"])

@@ -1,0 +1,86 @@
+"""Columnar batch path tests: frames -> COO ratings (PEvents analogue)."""
+
+import datetime as dt
+
+import numpy as np
+
+from predictionio_tpu.storage import (
+    DataMap,
+    Event,
+    StringIndex,
+    events_to_frame,
+)
+
+UTC = dt.timezone.utc
+
+
+def _rate(u, i, r, m):
+    return Event(event="rate", entity_type="user", entity_id=u,
+                 target_entity_type="item", target_entity_id=i,
+                 properties=DataMap({"rating": r}),
+                 event_time=dt.datetime(2020, 1, 1, 0, m, tzinfo=UTC))
+
+
+def _view(u, i, m):
+    return Event(event="view", entity_type="user", entity_id=u,
+                 target_entity_type="item", target_entity_id=i,
+                 event_time=dt.datetime(2020, 1, 1, 0, m, tzinfo=UTC))
+
+
+def test_events_to_frame():
+    f = events_to_frame([_rate("u1", "i1", 4.0, 0), _view("u2", "i2", 1)])
+    assert len(f) == 2
+    assert f.event.tolist() == ["rate", "view"]
+    assert f.properties[0] == {"rating": 4.0}
+    sub = f.with_event_names(["view"])
+    assert len(sub) == 1 and sub.entity_id[0] == "u2"
+
+
+def test_to_ratings_explicit():
+    f = events_to_frame(
+        [_rate("u1", "i1", 4.0, 0), _rate("u2", "i2", 2.0, 1),
+         _rate("u1", "i2", 5.0, 2)]
+    )
+    r = f.to_ratings(rating_property="rating")
+    assert r.n_users == 2 and r.n_items == 2 and len(r) == 3
+    # reconstruct (user, item, rating) triples via the indexes
+    triples = {
+        (r.users.id_of(u), r.items.id_of(i), v)
+        for u, i, v in zip(r.user_ix, r.item_ix, r.rating)
+    }
+    assert triples == {("u1", "i1", 4.0), ("u2", "i2", 2.0), ("u1", "i2", 5.0)}
+
+
+def test_to_ratings_dedup_last():
+    # same (user, item) rated twice -> latest wins (reference template intent)
+    f = events_to_frame([_rate("u1", "i1", 1.0, 0), _rate("u1", "i1", 5.0, 9)])
+    r = f.to_ratings(rating_property="rating", dedup="last")
+    assert len(r) == 1 and r.rating[0] == 5.0
+
+
+def test_to_ratings_implicit_sum():
+    f = events_to_frame([_view("u1", "i1", 0), _view("u1", "i1", 1),
+                         _view("u1", "i2", 2)])
+    r = f.to_ratings(dedup="sum")
+    d = {(r.users.id_of(u), r.items.id_of(i)): v
+         for u, i, v in zip(r.user_ix, r.item_ix, r.rating)}
+    assert d == {("u1", "i1"): 2.0, ("u1", "i2"): 1.0}
+
+
+def test_to_ratings_with_fixed_index_drops_unknowns():
+    f = events_to_frame([_rate("u1", "i1", 4.0, 0), _rate("uX", "i1", 1.0, 1)])
+    users = StringIndex(["u1"])
+    r = f.to_ratings(rating_property="rating", user_index=users)
+    assert len(r) == 1 and r.users.id_of(r.user_ix[0]) == "u1"
+
+
+def test_to_ratings_skips_nan_values():
+    f = events_to_frame([_rate("u1", "i1", 4.0, 0), _view("u1", "i2", 1)])
+    r = f.to_ratings(rating_property="rating")  # view has no rating -> dropped
+    assert len(r) == 1
+
+
+def test_property_column_from_dicts():
+    f = events_to_frame([_rate("u1", "i1", 3.5, 0), _view("u1", "i2", 1)])
+    col = f.property_column("rating")
+    assert col[0] == 3.5 and np.isnan(col[1])

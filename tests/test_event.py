@@ -1,0 +1,122 @@
+"""Event model + DataMap tests (reference `DataMapSpec`, `Event.scala:57-115`)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.storage import (
+    DataMap,
+    Event,
+    EventValidationError,
+    format_time,
+    parse_time,
+    validate_event,
+)
+from predictionio_tpu.storage.event import DataMapError
+
+
+def test_datamap_typed_getters():
+    dm = DataMap({"a": 1, "b": 2.5, "c": "x", "d": [1, 2], "e": None})
+    assert dm.get_int("a") == 1
+    assert dm.get_float("b") == 2.5
+    assert dm.get_string("c") == "x"
+    assert dm.get("d") == [1, 2]
+    with pytest.raises(DataMapError):
+        dm.get("missing")
+    with pytest.raises(DataMapError):
+        dm.get("e")  # null counts as missing, like reference JNothing/JNull
+    assert dm.get_opt("missing") is None
+    assert dm.get_or_else("e", 7) == 7
+
+
+def test_datamap_merge_and_without():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert a.merged(b).fields == {"x": 1, "y": 3, "z": 4}
+    assert a.without(["x"]).fields == {"y": 2}
+    assert a.fields == {"x": 1, "y": 2}  # immutable
+
+
+def test_datamap_string_list():
+    dm = DataMap({"l": ["a", "b"]})
+    assert dm.get_string_list("l") == ["a", "b"]
+    with pytest.raises(DataMapError):
+        DataMap({"l": "nope"}).get_string_list("l")
+
+
+def _ok(**kw):
+    e = Event(**{"event": "rate", "entity_type": "user", "entity_id": "u1", **kw})
+    validate_event(e)
+    return e
+
+
+def test_validate_basic_ok():
+    _ok()
+    _ok(target_entity_type="item", target_entity_id="i1")
+    _ok(event="$set", properties=DataMap({"a": 1}))
+    _ok(event="$delete")
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(event=""),
+        dict(entity_type=""),
+        dict(entity_id=""),
+        dict(target_entity_type="item"),  # target type without id
+        dict(target_entity_id="i1"),  # target id without type
+        dict(event="$unset"),  # $unset with empty properties
+        dict(event="$reserved"),
+        dict(event="pio_custom"),
+        dict(event="$set", target_entity_type="item", target_entity_id="i1"),
+        dict(entity_type="pio_user"),
+        dict(target_entity_type="pio_item", target_entity_id="i1"),
+        dict(properties=DataMap({"pio_x": 1})),
+    ],
+)
+def test_validate_rejects(kw):
+    with pytest.raises(EventValidationError):
+        e = Event(**{"event": "rate", "entity_type": "user", "entity_id": "u1", **kw})
+        validate_event(e)
+
+
+def test_builtin_entity_type_allowed():
+    _ok(entity_type="pio_pr")
+
+
+def test_json_roundtrip():
+    t = dt.datetime(2020, 1, 2, 3, 4, 5, 123000, tzinfo=dt.timezone.utc)
+    e = Event(
+        event="buy",
+        entity_type="user",
+        entity_id="u1",
+        target_entity_type="item",
+        target_entity_id="i9",
+        properties=DataMap({"price": 3.5}),
+        event_time=t,
+        pr_id="pr-1",
+    )
+    d = e.to_json()
+    assert d["eventTime"] == "2020-01-02T03:04:05.123Z"
+    e2 = Event.from_json(d)
+    assert e2.event == "buy"
+    assert e2.entity_id == "u1"
+    assert e2.target_entity_id == "i9"
+    assert e2.properties.get_float("price") == 3.5
+    assert e2.event_time == t
+    assert e2.pr_id == "pr-1"
+
+
+def test_from_json_requires_fields():
+    with pytest.raises(EventValidationError):
+        Event.from_json({"event": "x", "entityType": "user"})
+
+
+def test_time_parse_formats():
+    assert parse_time("2020-01-01T00:00:00Z") == dt.datetime(
+        2020, 1, 1, tzinfo=dt.timezone.utc
+    )
+    # offset form normalises to UTC
+    t = parse_time("2020-01-01T01:00:00+01:00")
+    assert t == dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+    assert format_time(t).endswith("Z")

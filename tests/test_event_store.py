@@ -1,0 +1,174 @@
+"""EventStore contract suite — runs hermetically against every backend
+(reference `LEventsSpec.scala` behavioral contract, which needed live HBase;
+SURVEY §4 asks this build to improve on that)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.storage import (
+    NO_TARGET,
+    DataMap,
+    Event,
+    EventValidationError,
+    MemoryEventStore,
+    SQLiteEventStore,
+)
+
+UTC = dt.timezone.utc
+
+
+def _t(m):
+    return dt.datetime(2021, 6, 1, 0, m, tzinfo=UTC)
+
+
+@pytest.fixture(params=["memory", "sqlite", "sqlite_file"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        s = MemoryEventStore()
+    elif request.param == "sqlite":
+        s = SQLiteEventStore(":memory:")
+    else:
+        s = SQLiteEventStore(tmp_path / "events.db")
+    s.init_channel(1)
+    yield s
+    s.close()
+
+
+EVENTS = [
+    Event(event="$set", entity_type="user", entity_id="u1",
+          properties=DataMap({"a": 1}), event_time=_t(0)),
+    Event(event="rate", entity_type="user", entity_id="u1",
+          target_entity_type="item", target_entity_id="i1",
+          properties=DataMap({"rating": 4.0}), event_time=_t(1)),
+    Event(event="rate", entity_type="user", entity_id="u2",
+          target_entity_type="item", target_entity_id="i2",
+          properties=DataMap({"rating": 2.0}), event_time=_t(2)),
+    Event(event="buy", entity_type="user", entity_id="u1",
+          target_entity_type="item", target_entity_id="i2", event_time=_t(3)),
+    Event(event="$set", entity_type="item", entity_id="i1",
+          properties=DataMap({"category": ["c1"]}), event_time=_t(4)),
+]
+
+
+def _load(store):
+    return store.insert_batch(EVENTS, app_id=1)
+
+
+def test_insert_get_delete(store):
+    eid = store.insert(EVENTS[0], app_id=1)
+    got = store.get(eid, app_id=1)
+    assert got is not None
+    assert got.event == "$set"
+    assert got.properties.get_int("a") == 1
+    assert got.event_id == eid
+    assert store.delete(eid, app_id=1)
+    assert store.get(eid, app_id=1) is None
+    assert not store.delete(eid, app_id=1)
+
+
+def test_insert_validates(store):
+    with pytest.raises(EventValidationError):
+        store.insert(Event(event="", entity_type="u", entity_id="x"), app_id=1)
+
+
+def test_find_all_ordered(store):
+    _load(store)
+    evs = list(store.find(app_id=1))
+    assert [e.event for e in evs] == ["$set", "rate", "rate", "buy", "$set"]
+    rev = list(store.find(app_id=1, reversed=True))
+    assert [e.event for e in rev] == ["$set", "buy", "rate", "rate", "$set"]
+    assert rev[0].entity_id == "i1"
+
+
+def test_find_filters(store):
+    _load(store)
+    assert len(list(store.find(app_id=1, entity_type="user"))) == 4
+    assert len(list(store.find(app_id=1, entity_type="user", entity_id="u1"))) == 3
+    assert len(list(store.find(app_id=1, event_names=["rate", "buy"]))) == 3
+    assert len(list(store.find(app_id=1, start_time=_t(2)))) == 3
+    assert len(list(store.find(app_id=1, until_time=_t(2)))) == 2
+    assert len(list(store.find(app_id=1, start_time=_t(1), until_time=_t(3)))) == 2
+    assert len(list(store.find(app_id=1, limit=2))) == 2
+    assert len(list(store.find(app_id=1, limit=-1))) == 5
+
+
+def test_find_target_tristate(store):
+    _load(store)
+    # unrestricted
+    assert len(list(store.find(app_id=1))) == 5
+    # must have no target
+    no_target = list(store.find(app_id=1, target_entity_type=NO_TARGET))
+    assert all(e.target_entity_type is None for e in no_target)
+    assert len(no_target) == 2
+    # must match
+    i2 = list(store.find(app_id=1, target_entity_id="i2"))
+    assert {e.event for e in i2} == {"rate", "buy"}
+
+
+def test_channels_isolated(store):
+    store.init_channel(1, channel_id=7)
+    store.insert(EVENTS[0], app_id=1, channel_id=7)
+    assert len(list(store.find(app_id=1))) == 0
+    assert len(list(store.find(app_id=1, channel_id=7))) == 1
+    assert store.remove_channel(1, channel_id=7)
+    store.init_channel(1, channel_id=7)
+    assert len(list(store.find(app_id=1, channel_id=7))) == 0
+
+
+def test_apps_isolated(store):
+    store.init_channel(2)
+    store.insert(EVENTS[0], app_id=2)
+    assert len(list(store.find(app_id=1))) == 0
+    assert len(list(store.find(app_id=2))) == 1
+
+
+def test_aggregate_properties_of(store):
+    _load(store)
+    props = store.aggregate_properties_of(app_id=1, entity_type="user")
+    assert set(props) == {"u1"}
+    assert props["u1"].fields == {"a": 1}
+    items = store.aggregate_properties_of(app_id=1, entity_type="item")
+    assert items["i1"].get_string_list("category") == ["c1"]
+    # required filter
+    assert store.aggregate_properties_of(
+        app_id=1, entity_type="user", required=["missing"]
+    ) == {}
+
+
+def test_aggregate_single_entity(store):
+    _load(store)
+    pm = store.aggregate_properties_single_entity(
+        app_id=1, entity_type="user", entity_id="u1"
+    )
+    assert pm is not None and pm.fields == {"a": 1}
+    assert (
+        store.aggregate_properties_single_entity(
+            app_id=1, entity_type="user", entity_id="nope"
+        )
+        is None
+    )
+
+
+def test_sqlite_persistence(tmp_path):
+    path = tmp_path / "p.db"
+    s = SQLiteEventStore(path)
+    s.init_channel(1)
+    s.insert(EVENTS[0], app_id=1)
+    s.close()
+    s2 = SQLiteEventStore(path)
+    assert len(list(s2.find(app_id=1))) == 1
+    s2.close()
+
+
+def test_sqlite_columnar(store):
+    if not isinstance(store, SQLiteEventStore):
+        pytest.skip("columnar fast path is sqlite-only")
+    _load(store)
+    frame = store.find_columnar(
+        app_id=1, entity_type="user", event_names=["rate"], float_property="rating"
+    )
+    assert len(frame) == 2
+    assert frame.value.tolist() == [4.0, 2.0]
+    assert frame.entity_id.tolist() == ["u1", "u2"]
+    assert frame.target_entity_id.tolist() == ["i1", "i2"]

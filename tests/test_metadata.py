@@ -1,0 +1,119 @@
+"""MetadataStore DAO tests (reference ES DAOs + record specs)."""
+
+import pytest
+
+from predictionio_tpu.storage import (
+    AccessKey,
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    MetadataStore,
+    Model,
+)
+
+
+@pytest.fixture()
+def md(tmp_path):
+    m = MetadataStore(tmp_path / "meta.db")
+    yield m
+    m.close()
+
+
+def test_apps_crud(md):
+    a = md.app_insert("myapp", "desc")
+    assert a.id >= 1
+    assert md.app_get(a.id).name == "myapp"
+    assert md.app_get_by_name("myapp").id == a.id
+    b = md.app_insert("other")
+    assert {x.name for x in md.app_get_all()} == {"myapp", "other"}
+    a.description = "new"
+    md.app_update(a)
+    assert md.app_get(a.id).description == "new"
+    md.app_delete(b.id)
+    assert md.app_get(b.id) is None
+
+
+def test_app_name_unique(md):
+    md.app_insert("x")
+    with pytest.raises(Exception):
+        md.app_insert("x")
+
+
+def test_access_keys(md):
+    a = md.app_insert("app")
+    k = md.access_key_insert(AccessKey(key="", appid=a.id, events=["rate"]))
+    assert len(k) > 20
+    got = md.access_key_get(k)
+    assert got.appid == a.id and got.events == ["rate"]
+    k2 = md.access_key_insert(AccessKey(key="fixed", appid=a.id))
+    assert k2 == "fixed"
+    assert len(md.access_key_get_by_app(a.id)) == 2
+    md.access_key_delete(k2)
+    assert md.access_key_get(k2) is None
+
+
+def test_channels(md):
+    a = md.app_insert("app")
+    c = md.channel_insert("mobile", a.id)
+    assert md.channel_get(c.id).name == "mobile"
+    assert [x.name for x in md.channel_get_by_app(a.id)] == ["mobile"]
+    with pytest.raises(ValueError):
+        md.channel_insert("bad name!", a.id)  # regex ^[a-zA-Z0-9-]{1,16}$
+    with pytest.raises(ValueError):
+        md.channel_insert("a" * 17, a.id)
+    md.channel_delete(c.id)
+    assert md.channel_get(c.id) is None
+
+
+def test_manifests(md):
+    m = EngineManifest(id="e1", version="v1", name="engine",
+                       engine_factory="pkg.Factory")
+    md.manifest_upsert(m)
+    assert md.manifest_get("e1", "v1").engine_factory == "pkg.Factory"
+    assert md.manifest_get("e1", "v2") is None
+    assert len(md.manifest_get_all()) == 1
+    md.manifest_delete("e1", "v1")
+    assert md.manifest_get("e1", "v1") is None
+
+
+def _ei(id, status, start, variant="engine.json"):
+    return EngineInstance(
+        id=id, status=status, start_time=start, end_time=start,
+        engine_id="eng", engine_version="1", engine_variant=variant,
+        engine_factory="f", algorithms_params="[]",
+    )
+
+
+def test_engine_instances_latest_completed(md):
+    md.engine_instance_insert(_ei("a", "INIT", "2020-01-01T00:00:00Z"))
+    md.engine_instance_insert(_ei("b", "COMPLETED", "2020-01-02T00:00:00Z"))
+    md.engine_instance_insert(_ei("c", "COMPLETED", "2020-01-03T00:00:00Z"))
+    md.engine_instance_insert(_ei("d", "COMPLETED", "2020-01-01T00:00:00Z", "other"))
+    latest = md.engine_instance_get_latest_completed("eng", "1", "engine.json")
+    assert latest.id == "c"
+    completed = md.engine_instance_get_completed("eng", "1", "engine.json")
+    assert [e.id for e in completed] == ["c", "b"]
+    ei = md.engine_instance_get("a")
+    ei.status = "COMPLETED"
+    md.engine_instance_update(ei)
+    assert md.engine_instance_get("a").status == "COMPLETED"
+    md.engine_instance_delete("a")
+    assert md.engine_instance_get("a") is None
+
+
+def test_evaluation_instances(md):
+    ev = EvaluationInstance(
+        id="x", status="EVALCOMPLETED", start_time="2020-01-01T00:00:00Z",
+        end_time="", evaluation_class="MyEval", engine_params_generator_class="G",
+        evaluator_results="metric=1.0",
+    )
+    md.evaluation_instance_insert(ev)
+    assert md.evaluation_instance_get("x").evaluator_results == "metric=1.0"
+    assert [e.id for e in md.evaluation_instance_get_completed()] == ["x"]
+
+
+def test_models_blob(md):
+    md.model_insert(Model(id="i1", models=b"\x00\x01bytes"))
+    assert md.model_get("i1").models == b"\x00\x01bytes"
+    md.model_delete("i1")
+    assert md.model_get("i1") is None

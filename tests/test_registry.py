@@ -1,0 +1,53 @@
+"""Storage registry tests (reference `Storage.scala:40-296` env-var wiring)."""
+
+import pytest
+
+from predictionio_tpu.storage import (
+    MemoryEventStore,
+    SQLiteEventStore,
+    Storage,
+    StorageError,
+)
+
+
+def test_default_sqlite_under_home(tmp_path):
+    s = Storage(env={"PIO_TPU_HOME": str(tmp_path)})
+    es = s.get_event_store()
+    assert isinstance(es, SQLiteEventStore)
+    s.verify_all_data_objects()
+    assert (tmp_path / "eventdata.db").exists()
+    assert (tmp_path / "metadata.db").exists()
+    assert (tmp_path / "models").is_dir()
+    s.close()
+
+
+def test_env_var_source_mapping(tmp_path):
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_TPU_HOME": str(tmp_path),
+    })
+    assert isinstance(s.get_event_store(), MemoryEventStore)
+    s.close()
+
+
+def test_env_var_sqlite_path(tmp_path):
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "ev.db"),
+    })
+    es = s.get_event_store()
+    es.init_channel(1)
+    assert (tmp_path / "ev.db").exists()
+    s.close()
+
+
+def test_missing_source_type_errors():
+    s = Storage(env={"PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NOPE"})
+    with pytest.raises(StorageError):
+        s.get_event_store()
+
+
+def test_storage_fixture(storage_memory):
+    storage_memory.verify_all_data_objects()

@@ -1,0 +1,21 @@
+"""Device-mesh parallelism utilities (the Spark-substrate replacement)."""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    distributed_init,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "distributed_init",
+    "make_mesh",
+    "pad_to_multiple",
+    "replicated",
+]

@@ -1,0 +1,89 @@
+"""Device mesh construction + sharding helpers.
+
+The TPU-native replacement for the reference's SparkContext factory
+(`/root/reference/core/src/main/scala/io/prediction/workflow/WorkflowContext.scala:25-44`):
+where every reference workflow entered distribution by constructing a
+SparkContext, every workflow here enters it by constructing a
+`jax.sharding.Mesh` over the visible devices.  Single-chip runs get a 1-device
+mesh and the same code path (XLA elides trivial collectives).
+
+Multi-host: call :func:`distributed_init` once per process before
+:func:`make_mesh`; `jax.devices()` then spans all hosts and collectives ride
+ICI within a slice / DCN across slices — the NCCL/MPI-free equivalent of the
+reference's Spark executor fabric (SURVEY §2.7).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "distributed_init",
+    "data_sharding",
+    "replicated",
+    "pad_to_multiple",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up (`jax.distributed.initialize`); no-op when args
+    are absent and the env provides no cluster spec."""
+    if coordinator_address is None and num_processes is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a mesh over up to ``n_devices`` visible devices.
+
+    Default: 1-D mesh named ``data`` over all devices.  ``shape`` gives an
+    explicit per-axis split (product must divide the device count).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if shape is None:
+        shape = [n] + [1] * (len(axis_names) - 1)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard leading dim over the data axis, replicate the rest."""
+    spec = P(axis, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` >= ``n`` (static-shape padding budgets)."""
+    return ((n + m - 1) // m) * m

@@ -1,0 +1,16 @@
+"""Workflow orchestration: train/eval/deploy drivers
+(reference `/root/reference/core/src/main/scala/io/prediction/workflow/`)."""
+
+from .model_io import NotPersisted, load_models, save_models
+from .params import WorkflowParams
+from .train import new_instance_id, prepare_deploy, run_train
+
+__all__ = [
+    "NotPersisted",
+    "load_models",
+    "save_models",
+    "WorkflowParams",
+    "new_instance_id",
+    "prepare_deploy",
+    "run_train",
+]

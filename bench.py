@@ -1,0 +1,107 @@
+"""North-star benchmark: MovieLens-20M-scale ALS, rank=64, 20 iterations.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is train wall-clock seconds on the available accelerator and vs_baseline is
+baseline_seconds / value (>1 means faster than the 60 s v5e-8 target,
+BASELINE.md).  The dataset is synthetic with ML-20M marginals (138,493 users,
+26,744 items, 20M ratings, power-law user activity) because the container
+has no network egress to fetch the real set; shapes and sparsity structure —
+what determines ALS cost — match.
+
+Flags: --scale 0.05 for a quick small run, --iters/--rank to override.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+BASELINE_SECONDS = 60.0  # north star: < 60 s on v5e-8 (BASELINE.md)
+
+N_USERS = 138_493
+N_ITEMS = 26_744
+N_RATINGS = 20_000_263
+
+
+def synth_ml20m(scale: float = 1.0, seed: int = 0):
+    """Synthetic ratings with ML-20M-like power-law user activity."""
+    rng = np.random.default_rng(seed)
+    n_users = max(64, int(N_USERS * scale))
+    n_items = max(32, int(N_ITEMS * scale))
+    n_ratings = max(1024, int(N_RATINGS * scale))
+    # user activity ~ Zipf-ish: weights 1/(rank^0.8), min 20 ratings in full set
+    w_u = (1.0 / np.arange(1, n_users + 1) ** 0.8)
+    w_u /= w_u.sum()
+    u = rng.choice(n_users, size=n_ratings, p=w_u).astype(np.int32)
+    # item popularity also power-law
+    w_i = (1.0 / np.arange(1, n_items + 1) ** 1.0)
+    w_i /= w_i.sum()
+    i = rng.choice(n_items, size=n_ratings, p=w_i).astype(np.int32)
+    # half-star ratings 0.5..5.0
+    v = (rng.integers(1, 11, size=n_ratings) * 0.5).astype(np.float32)
+    return u, i, v, n_users, n_items
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from predictionio_tpu.models.als import ALSConfig, rmse, train_als
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    u, i, v, n_users, n_items = synth_ml20m(args.scale)
+    if args.verbose:
+        print(
+            f"# {len(v):,} ratings, {n_users:,} users x {n_items:,} items, "
+            f"devices={jax.devices()}",
+            file=sys.stderr,
+        )
+
+    mesh = make_mesh()
+    cfg = ALSConfig(
+        rank=args.rank, num_iterations=args.iters, lam=0.01, seed=args.seed
+    )
+
+    # warmup: compile all bucket shapes with a 1-iteration run
+    warm = ALSConfig(rank=args.rank, num_iterations=1, lam=0.01, seed=args.seed)
+    train_als((u, i, v), n_users, n_items, warm,
+              mesh=mesh if mesh.size > 1 else None)
+
+    t0 = time.time()
+    factors = train_als(
+        (u, i, v), n_users, n_items, cfg, mesh=mesh if mesh.size > 1 else None
+    )
+    dt = time.time() - t0
+
+    if args.verbose:
+        err = rmse(factors, u, i, v)
+        print(f"# train RMSE {err:.4f}, wall {dt:.2f}s", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ml20m_als_rank64_20iter_train_seconds",
+                "value": round(dt, 3),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_SECONDS / dt, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,6 @@ from .base import (
     PD,
     Preparator,
     Q,
-    SanityCheck,
     Serving,
     StopAfterPrepareInterruption,
     StopAfterReadInterruption,
@@ -318,6 +317,9 @@ class EngineFactory:
 
 
 def _sanity(obj: Any, what: str) -> None:
-    if isinstance(obj, SanityCheck):
+    # duck-typed: anything exposing sanity_check() participates
+    # (SanityCheck subclassing is optional, unlike the reference trait)
+    check = getattr(obj, "sanity_check", None)
+    if callable(check):
         logger.info("sanity check on %s", what)
-        obj.sanity_check()
+        check()

@@ -87,17 +87,48 @@ def _convert(value: Any, typ: Any, path: str) -> Any:
     return value
 
 
+def _snake(s: str) -> str:
+    """camelCase -> snake_case, inserting '_' only at lower/digit->upper
+    boundaries so acronym runs survive ('appURL' -> 'app_url')."""
+    out = []
+    for i, ch in enumerate(s):
+        if ch.isupper():
+            prev_lower = i > 0 and (s[i - 1].islower() or s[i - 1].isdigit())
+            next_lower = i + 1 < len(s) and s[i + 1].islower()
+            if prev_lower or (i > 0 and s[i - 1].isupper() and next_lower):
+                out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
 def extract_params(
     cls: Type[P], json_dict: Optional[Mapping[str, Any]], _path: str = "params"
 ) -> P:
     """Build a params dataclass from a JSON dict (engine.json ``params`` key).
 
     Missing fields use dataclass defaults; missing required fields and unknown
-    keys raise :class:`ParamsError`.
+    keys raise :class:`ParamsError`.  Reference engine.json files use
+    camelCase keys (and reserved words like ``lambda``): camelCase is
+    auto-converted to snake_case, and classes may declare
+    ``__param_aliases__ = {"lambda": "lam"}`` for the rest.
     """
-    json_dict = dict(json_dict or {})
     if not dataclasses.is_dataclass(cls):
         raise ParamsError(f"{cls!r} is not a params dataclass")
+    json_dict = dict(json_dict or {})
+    aliases = getattr(cls, "__param_aliases__", {})
+    field_names = {f.name for f in dataclasses.fields(cls) if f.init}
+    renamed = {}
+    for k, v in json_dict.items():
+        if k in aliases:
+            k = aliases[k]
+        elif k not in field_names and _snake(k) in field_names:
+            k = _snake(k)
+        if k in renamed:
+            raise ParamsError(f"{_path}: duplicate key '{k}' after aliasing")
+        renamed[k] = v
+    json_dict = renamed
     hints = typing.get_type_hints(cls)
     kwargs: dict[str, Any] = {}
     fields = {f.name: f for f in dataclasses.fields(cls) if f.init}

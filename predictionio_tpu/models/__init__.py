@@ -1,0 +1,5 @@
+"""Model-family implementations (the MLlib-replacement compute layer)."""
+
+from .als import ALSConfig, ALSFactors, rmse, train_als
+
+__all__ = ["ALSConfig", "ALSFactors", "rmse", "train_als"]

@@ -1,0 +1,5 @@
+"""TPU compute ops: batched scoring, top-k, segment reductions, kernels."""
+
+from .topk import batch_topk_scores, cosine_topk, topk_scores
+
+__all__ = ["batch_topk_scores", "cosine_topk", "topk_scores"]

@@ -1,0 +1,46 @@
+"""Batched scoring + top-k ops (the serving hot path).
+
+Replaces the reference's predict-time cosine scan over the
+``productFeatures`` RDD (`/root/reference/examples/scala-parallel-
+recommendation/custom-query/src/main/scala/ALSAlgorithm.scala` predict) with
+one fused XLA matmul + ``lax.top_k`` per (batch of) queries — MXU work with
+a static ``k`` so the compiled executable is reused across requests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["topk_scores", "batch_topk_scores", "cosine_topk"]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_scores(query_vec: jax.Array, table: jax.Array, k: int,
+                bias: jax.Array | None = None):
+    """scores = table @ query_vec (+bias); returns (values, indices) top-k."""
+    scores = table @ query_vec
+    if bias is not None:
+        scores = scores + bias
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def batch_topk_scores(query_vecs: jax.Array, table: jax.Array, k: int,
+                      mask: jax.Array | None = None):
+    """[B, R] x [M, R] -> top-k per row; ``mask`` (additive, [B, M] or [M])
+    suppresses entries (use -inf)."""
+    scores = query_vecs @ table.T
+    if mask is not None:
+        scores = scores + mask
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def cosine_topk(query_vec: jax.Array, table: jax.Array, k: int):
+    """Cosine similarity top-k (similarproduct template scoring)."""
+    qn = query_vec / (jnp.linalg.norm(query_vec) + 1e-9)
+    tn = table / (jnp.linalg.norm(table, axis=-1, keepdims=True) + 1e-9)
+    return jax.lax.top_k(tn @ qn, k)

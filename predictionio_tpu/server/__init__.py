@@ -1,0 +1,6 @@
+"""HTTP servers: engine deployment (serving), event ingestion, admin,
+dashboard (reference L3/L8/L9 surfaces)."""
+
+from .serving import EngineServer, ServerConfig
+
+__all__ = ["EngineServer", "ServerConfig"]

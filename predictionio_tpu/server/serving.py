@@ -1,0 +1,287 @@
+"""Engine deployment server: answers ``/queries.json`` with predictions.
+
+Re-expression of reference `workflow/CreateServer.scala` (`ServerActor`
+routes `:433-612`, `MasterActor` lifecycle `:255-377`) on the stdlib
+threading HTTP server — no spray/akka.  Routes:
+
+* ``GET  /``             — status JSON: engine info, request count, latency
+  (``avgServingSec``/``lastServingSec`` parity, `CreateServer.scala:552-559`)
+* ``POST /queries.json`` — score a query (the hot path)
+* ``GET  /reload``       — hot-swap to the latest COMPLETED engine instance
+  without restarting the process (`:315-336,592-599`)
+* ``POST /stop``         — graceful shutdown (`:600-607`)
+
+Query/result JSON mapping: the engine's first algorithm may declare
+``query_class`` (with ``from_json``) and results may expose ``to_json`` —
+the serving-layer analogue of the reference's json4s ``Extraction.extract``
+(`:470-471`).  Scoring runs a precompiled batched XLA call per request;
+feedback-loop event injection (prId) is wired when an event server URL is
+configured.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from dataclasses import is_dataclass, asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+from ..controller.base import WorkflowContext
+from ..controller.engine import Engine, EngineParams
+from ..workflow.train import prepare_deploy
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EngineServer", "ServerConfig"]
+
+
+class ServerConfig:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 feedback: bool = False, event_server_url: Optional[str] = None,
+                 access_key: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.feedback = feedback
+        self.event_server_url = event_server_url
+        self.access_key = access_key
+
+
+def _default_query_decoder(engine: Engine, engine_params: EngineParams):
+    name, _ = engine_params.algorithms[0]
+    cls = engine._lookup(engine.algorithm_class_map, name, "algorithm")
+    qcls = getattr(cls, "query_class", None)
+    if qcls is None:
+        # try the template convention: module-level Query with from_json
+        import sys
+
+        mod = sys.modules.get(cls.__module__)
+        qcls = getattr(mod, "Query", None) if mod else None
+    if qcls is not None and hasattr(qcls, "from_json"):
+        return qcls.from_json
+    return lambda d: d
+
+
+def _result_to_json(r: Any) -> Any:
+    if hasattr(r, "to_json"):
+        return r.to_json()
+    if is_dataclass(r) and not isinstance(r, type):
+        return asdict(r)
+    return r
+
+
+class EngineServer:
+    """One deployed engine instance behind an HTTP server."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        engine_params: EngineParams,
+        instance_id: str,
+        ctx: Optional[WorkflowContext] = None,
+        config: Optional[ServerConfig] = None,
+        query_decoder: Optional[Callable[[dict], Any]] = None,
+        engine_id: str = "default",
+        engine_version: str = "1",
+        engine_variant: str = "engine.json",
+    ):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.ctx = ctx or WorkflowContext(mode="Serving")
+        self.config = config or ServerConfig()
+        self.instance_id = instance_id
+        self.engine_id = engine_id
+        self.engine_version = engine_version
+        self.engine_variant = engine_variant
+        self.query_decoder = query_decoder or _default_query_decoder(
+            engine, engine_params
+        )
+        self._lock = threading.RLock()
+        self._load(instance_id)
+        # serving stats (CreateServer.scala:396-398)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self.start_time = time.time()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def _load(self, instance_id: str) -> None:
+        models = prepare_deploy(
+            self.engine, self.engine_params, instance_id, ctx=self.ctx
+        )
+        algorithms = self.engine._algorithms(self.engine_params)
+        serving = self.engine._serving(self.engine_params)
+        with self._lock:
+            self.models = models
+            self.algorithms = algorithms
+            self.serving = serving
+            self.instance_id = instance_id
+
+    def reload(self) -> str:
+        """Swap in the latest COMPLETED instance (GET /reload)."""
+        md = self.ctx.storage.get_metadata()
+        latest = md.engine_instance_get_latest_completed(
+            self.engine_id, self.engine_version, self.engine_variant
+        )
+        if latest is None:
+            raise LookupError("no completed engine instance found")
+        self._load(latest.id)
+        return latest.id
+
+    # -- query path -------------------------------------------------------
+    def predict_json(self, query_json: dict) -> Any:
+        t0 = time.time()
+        query = self.query_decoder(query_json)
+        with self._lock:
+            algorithms, models, serving = (
+                self.algorithms, self.models, self.serving,
+            )
+        predictions = [
+            algo.predict(model, query)
+            for algo, model in zip(algorithms, models)
+        ]
+        result = serving.serve(query, predictions)
+        dt = time.time() - t0
+        with self._lock:
+            self.request_count += 1
+            self.last_serving_sec = dt
+            self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        out = _result_to_json(result)
+        if self.config.feedback and self.config.event_server_url:
+            out = self._send_feedback(query_json, out)
+        return out
+
+    def _send_feedback(self, query_json: dict, result_json: Any) -> Any:
+        """POST a pio_pr feedback event with prId injection, off the hot
+        path (reference `CreateServer.scala:480-550` does this async too)."""
+        pr_id = (
+            result_json.get("prId") if isinstance(result_json, dict) else None
+        ) or uuid.uuid4().hex
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {"query": query_json, "prediction": result_json},
+        }
+        url = (
+            f"{self.config.event_server_url}/events.json"
+            f"?accessKey={self.config.access_key or ''}"
+        )
+
+        def post():
+            import urllib.request
+
+            try:
+                req = urllib.request.Request(
+                    url,
+                    data=json.dumps(event).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=2)
+            except Exception as e:  # fire-and-forget
+                logger.warning("feedback event POST failed: %s", e)
+
+        threading.Thread(target=post, daemon=True).start()
+        if isinstance(result_json, dict):
+            result_json = {**result_json, "prId": pr_id}
+        return result_json
+
+    def status_json(self) -> dict:
+        return {
+            "status": "alive",
+            "engineInstanceId": self.instance_id,
+            "engineId": self.engine_id,
+            "engineVersion": self.engine_version,
+            "engineVariant": self.engine_variant,
+            "requestCount": self.request_count,
+            "avgServingSec": self.avg_serving_sec,
+            "lastServingSec": self.last_serving_sec,
+            "startTime": self.start_time,
+        }
+
+    # -- http --------------------------------------------------------------
+    def _make_handler(server: "EngineServer"):
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("serving: " + fmt, *args)
+
+            def _reply(self, code: int, payload: Any) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/" or self.path.startswith("/?"):
+                    self._reply(200, server.status_json())
+                elif self.path.startswith("/reload"):
+                    try:
+                        iid = server.reload()
+                        self._reply(200, {"reloaded": iid})
+                    except LookupError as e:
+                        self._reply(404, {"message": str(e)})
+                    except Exception as e:
+                        logger.exception("reload failed")
+                        self._reply(500, {"message": f"reload failed: {e}"})
+                else:
+                    self._reply(404, {"message": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b"{}"
+                if self.path.startswith("/queries.json"):
+                    try:
+                        query_json = json.loads(raw.decode() or "{}")
+                    except json.JSONDecodeError as e:
+                        self._reply(400, {"message": f"invalid JSON: {e}"})
+                        return
+                    try:
+                        self._reply(200, server.predict_json(query_json))
+                    except (KeyError, ValueError, TypeError) as e:
+                        self._reply(400, {"message": f"bad query: {e}"})
+                    except Exception as e:
+                        logger.exception("query failed")
+                        self._reply(500, {"message": str(e)})
+                elif self.path.startswith("/stop"):
+                    self._reply(200, {"message": "stopping"})
+                    threading.Thread(target=server.stop, daemon=True).start()
+                else:
+                    self._reply(404, {"message": "not found"})
+
+        return Handler
+
+    def _bind(self) -> None:
+        self._httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), self._make_handler()
+        )
+        self.config.port = self._httpd.server_address[1]
+        logger.info(
+            "engine server listening on %s:%d",
+            self.config.host, self.config.port,
+        )
+
+    def serve_forever(self) -> None:
+        if self._httpd is None:
+            self._bind()
+        self._httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        self._bind()  # bind in the caller so OSError (port in use) surfaces
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,1 @@
+"""Engine templates — capability parity with `/root/reference/examples/`."""

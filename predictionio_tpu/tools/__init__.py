@@ -1,0 +1,5 @@
+"""Ops tooling: import/export, CLI (reference `tools` module)."""
+
+from .import_export import export_events, import_events, import_ratings_csv
+
+__all__ = ["export_events", "import_events", "import_ratings_csv"]

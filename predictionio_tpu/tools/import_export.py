@@ -1,0 +1,105 @@
+"""Event export/import: JSON-lines files <-> event store.
+
+Parity with reference `tools/export/EventsToFile.scala:30-104` (JSON output;
+the Parquet variant is out of scope for an embedded store) and
+`tools/imprt/FileToEvents.scala:30-95`.  The reference runs these as Spark
+jobs; here they are streaming host loops over the embedded store with
+batched inserts.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+from ..storage.event import DataMap, Event
+from ..storage.levents import EventStore
+
+__all__ = ["import_events", "export_events", "import_ratings_csv"]
+
+_BATCH = 5000
+
+
+def import_events(
+    path: str | Path,
+    store: EventStore,
+    app_id: int,
+    channel_id: int = 0,
+) -> int:
+    """JSON-lines file -> event store; returns number imported."""
+    n = 0
+    batch: list[Event] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            batch.append(Event.from_json(json.loads(line)))
+            if len(batch) >= _BATCH:
+                store.insert_batch(batch, app_id, channel_id)
+                n += len(batch)
+                batch = []
+    if batch:
+        store.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    return n
+
+
+def export_events(
+    path: str | Path,
+    store: EventStore,
+    app_id: int,
+    channel_id: int = 0,
+) -> int:
+    """Event store -> JSON-lines file; returns number exported."""
+    n = 0
+    with open(path, "w") as f:
+        for e in store.find(app_id=app_id, channel_id=channel_id):
+            f.write(json.dumps(e.to_json(), separators=(",", ":")))
+            f.write("\n")
+            n += 1
+    return n
+
+
+def import_ratings_csv(
+    path: str | Path,
+    store: EventStore,
+    app_id: int,
+    channel_id: int = 0,
+    event: str = "rate",
+    delimiter: str = "::",
+    has_header: bool = False,
+) -> int:
+    """MovieLens-style ratings file (user<delim>item<delim>rating[...]) ->
+    rate events — the quickstart data-import path of the recommendation
+    template."""
+    n = 0
+    batch: list[Event] = []
+    with open(path) as f:
+        if has_header:
+            next(f, None)
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(delimiter)
+            u, i, r = parts[0], parts[1], float(parts[2])
+            batch.append(
+                Event(
+                    event=event,
+                    entity_type="user",
+                    entity_id=u,
+                    target_entity_type="item",
+                    target_entity_id=i,
+                    properties=DataMap({"rating": r}),
+                )
+            )
+            if len(batch) >= _BATCH:
+                store.insert_batch(batch, app_id, channel_id)
+                n += len(batch)
+                batch = []
+    if batch:
+        store.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    return n

@@ -6,12 +6,19 @@ stands in for a cluster (reference `core/src/test/.../BaseTest.scala:14-74`).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set (not setdefault): the axon TPU plugin exports JAX_PLATFORMS=axon
+# and registers itself in sitecustomize, so we must override both the env var
+# and the jax config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,210 @@
+"""Block-ALS tests: numeric parity with a dense NumPy reference solver,
+bucketing correctness, implicit mode, and mesh execution.
+
+The NumPy reference implements the same normal equations MLlib solves
+(ALS-WR weighted-λ for explicit, Hu-Koren-Volinsky for implicit), so
+matching it is the RMSE-parity contract of BASELINE.md.
+"""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.als import (
+    ALSConfig,
+    ALSFactors,
+    build_buckets,
+    rmse,
+    train_als,
+)
+
+
+def _toy(n_users=30, n_items=20, rank_true=3, density=0.4, seed=0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n_users, rank_true))
+    V = rng.normal(size=(n_items, rank_true))
+    R = U @ V.T
+    mask = rng.random((n_users, n_items)) < density
+    u, i = np.nonzero(mask)
+    v = R[u, i].astype(np.float32)
+    return u.astype(np.int32), i.astype(np.int32), v, n_users, n_items
+
+
+def _reference_als_explicit(u, i, v, n_users, n_items, cfg: ALSConfig):
+    """Dense NumPy ALS with identical init (uses jax PRNG to match)."""
+    import jax
+
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, ki = jax.random.split(key)
+    U = np.asarray(
+        jax.random.normal(ku, (n_users, cfg.rank), "float32")
+    ) / np.sqrt(cfg.rank)
+    V = np.asarray(
+        jax.random.normal(ki, (n_items, cfg.rank), "float32")
+    ) / np.sqrt(cfg.rank)
+
+    def solve_side(X, Y, rows, cols, vals, n_rows):
+        for r in range(n_rows):
+            sel = rows == r
+            n = sel.sum()
+            if n == 0:
+                continue
+            Yr = Y[cols[sel]]
+            A = Yr.T @ Yr + cfg.lam * (n if cfg.weighted_lambda else 1.0) * np.eye(
+                cfg.rank
+            )
+            b = Yr.T @ vals[sel]
+            X[r] = np.linalg.solve(A, b)
+        return X
+
+    for _ in range(cfg.num_iterations):
+        U = solve_side(U, V, u, i, v, n_users)
+        V = solve_side(V, U, i, u, v, n_items)
+    return ALSFactors(user_factors=U, item_factors=V)
+
+
+def test_buckets_cover_all_ratings():
+    u, i, v, nu, ni = _toy()
+    bk = build_buckets(u, i, v, nu, min_k=4)
+    seen = 0
+    for b in bk.buckets:
+        assert b.idx.shape == b.val.shape == b.mask.shape
+        assert b.idx.shape[1] >= 4
+        seen += int(b.mask.sum())
+        # every row appears once and padded entries are masked out
+        assert (b.mask.sum(axis=1) > 0).all()
+    assert seen == len(v)
+    all_rows = np.concatenate([b.rows for b in bk.buckets])
+    assert len(np.unique(all_rows)) == len(all_rows)
+
+
+def test_buckets_pow2_widths():
+    u, i, v, nu, ni = _toy()
+    bk = build_buckets(u, i, v, nu, min_k=4)
+    for b in bk.buckets:
+        k = b.idx.shape[1]
+        assert k & (k - 1) == 0  # power of two
+
+
+def test_buckets_cap_truncates():
+    u = np.zeros(100, dtype=np.int32)
+    i = np.arange(100, dtype=np.int32)
+    v = np.ones(100, dtype=np.float32)
+    bk = build_buckets(u, i, v, 1, min_k=4, max_per_row=16)
+    assert bk.buckets[0].idx.shape == (1, 16)
+
+
+def test_explicit_matches_numpy_reference():
+    # float32 device solves vs float64 NumPy reference: tolerance covers
+    # precision drift over iterations, and the prediction matrix (the
+    # quantity RMSE parity actually depends on) must agree tightly.
+    u, i, v, nu, ni = _toy()
+    cfg = ALSConfig(rank=4, num_iterations=5, lam=0.1, seed=7)
+    ours = train_als((u, i, v), nu, ni, cfg)
+    ref = _reference_als_explicit(u, i, v, nu, ni, cfg)
+    np.testing.assert_allclose(
+        ours.user_factors, ref.user_factors, rtol=2e-2, atol=2e-2
+    )
+    np.testing.assert_allclose(
+        ours.item_factors, ref.item_factors, rtol=2e-2, atol=2e-2
+    )
+    pred_ours = ours.user_factors @ ours.item_factors.T
+    pred_ref = ref.user_factors @ ref.item_factors.T
+    np.testing.assert_allclose(pred_ours, pred_ref, atol=2e-2)
+
+
+def test_explicit_single_halfstep_exact():
+    """One user-side solve against the NumPy normal equations — tight
+    tolerance isolates algorithmic correctness from iteration drift."""
+    u, i, v, nu, ni = _toy(seed=5)
+    cfg = ALSConfig(rank=4, num_iterations=1, lam=0.1, seed=7)
+    ours = train_als((u, i, v), nu, ni, cfg)
+    ref = _reference_als_explicit(u, i, v, nu, ni, cfg)
+    np.testing.assert_allclose(
+        ours.user_factors, ref.user_factors, rtol=3e-4, atol=3e-4
+    )
+    np.testing.assert_allclose(
+        ours.item_factors, ref.item_factors, rtol=3e-4, atol=3e-4
+    )
+
+
+def test_explicit_plain_lambda_matches_reference():
+    u, i, v, nu, ni = _toy(seed=3)
+    cfg = ALSConfig(rank=4, num_iterations=4, lam=0.5, weighted_lambda=False)
+    ours = train_als((u, i, v), nu, ni, cfg)
+    ref = _reference_als_explicit(u, i, v, nu, ni, cfg)
+    np.testing.assert_allclose(
+        ours.user_factors, ref.user_factors, rtol=2e-2, atol=2e-2
+    )
+
+
+def test_fits_training_data():
+    u, i, v, nu, ni = _toy(density=0.6)
+    cfg = ALSConfig(rank=6, num_iterations=10, lam=0.01)
+    f = train_als((u, i, v), nu, ni, cfg)
+    err = rmse(f, u, i, v)
+    assert err < 0.15, f"train RMSE too high: {err}"
+
+
+def test_implicit_mode_ranks_observed_higher():
+    rng = np.random.default_rng(0)
+    nu, ni = 20, 15
+    # block structure: users 0-9 interact with items 0-7, users 10-19 with 8-14
+    us, its = [], []
+    for u_ in range(nu):
+        lo, hi = (0, 8) if u_ < 10 else (8, 15)
+        for i_ in rng.choice(np.arange(lo, hi), size=5, replace=False):
+            us.append(u_)
+            its.append(i_)
+    u = np.array(us, dtype=np.int32)
+    i = np.array(its, dtype=np.int32)
+    v = np.ones(len(u), dtype=np.float32)
+    cfg = ALSConfig(rank=8, num_iterations=10, lam=0.1, implicit=True, alpha=40.0)
+    f = train_als((u, i, v), nu, ni, cfg)
+    scores = f.user_factors @ f.item_factors.T
+    in_block = scores[:10, :8].mean() + scores[10:, 8:].mean()
+    out_block = scores[:10, 8:].mean() + scores[10:, :8].mean()
+    assert in_block > out_block + 0.3
+
+
+def test_zero_rating_rows_stay_at_init():
+    # user 3 has no ratings: factors must remain at init, not NaN
+    u = np.array([0, 1, 2], dtype=np.int32)
+    i = np.array([0, 1, 0], dtype=np.int32)
+    v = np.ones(3, dtype=np.float32)
+    f = train_als((u, i, v), 5, 2, ALSConfig(rank=3, num_iterations=2))
+    assert np.isfinite(f.user_factors).all()
+    assert np.isfinite(f.item_factors).all()
+
+
+def test_runs_on_8_device_mesh():
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy()
+    mesh = make_mesh()  # 8 virtual CPU devices from conftest
+    assert mesh.size == 8
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1)
+    sharded = train_als((u, i, v), nu, ni, cfg, mesh=mesh)
+    single = train_als((u, i, v), nu, ni, cfg, mesh=None)
+    np.testing.assert_allclose(
+        sharded.user_factors, single.user_factors, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_bucket_splitting_matches_unsplit():
+    """Capping max entries per device call must not change results."""
+    from predictionio_tpu.models import als as als_mod
+
+    u, i, v, nu, ni = _toy()
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1)
+    full = train_als((u, i, v), nu, ni, cfg)
+    orig = als_mod._stage_buckets
+    try:
+        als_mod._stage_buckets = lambda b, m, max_entries_per_call=64: orig(
+            b, m, max_entries_per_call=64
+        )
+        split = train_als((u, i, v), nu, ni, cfg)
+    finally:
+        als_mod._stage_buckets = orig
+    np.testing.assert_allclose(
+        split.user_factors, full.user_factors, rtol=1e-5, atol=1e-5
+    )

@@ -85,3 +85,22 @@ def test_float_rejects_non_numeric():
         extract_params(AlgoParams, {"lam": "not-a-number"})
     with pytest.raises(ParamsError):
         extract_params(AlgoParams, {"lam": True})
+
+
+def test_camel_case_and_acronym_keys():
+    @dataclass(frozen=True)
+    class Cfg(Params):
+        num_iterations: int = 1
+        app_url: str = ""
+
+    p = extract_params(Cfg, {"numIterations": 5, "appURL": "http://x"})
+    assert p.num_iterations == 5
+    assert p.app_url == "http://x"
+
+
+def test_non_dataclass_params_class_raises_params_error():
+    class Plain:
+        pass
+
+    with pytest.raises(ParamsError, match="not a params dataclass"):
+        extract_params(Plain, {"x": 1})

@@ -1,0 +1,223 @@
+"""End-to-end recommendation template test: events -> engine.json -> train ->
+persist -> deploy -> predict (the Phase-2 slice of SURVEY §7)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.storage import DataMap, Event
+from predictionio_tpu.templates.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    Query,
+    recommendation_engine,
+)
+from predictionio_tpu.workflow import prepare_deploy, run_train
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def ctx(storage_memory):
+    md = storage_memory.get_metadata()
+    app = md.app_insert("recapp")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(0)
+    # 12 users x 10 items block structure so recommendations are predictable:
+    # users like items of their own group much more
+    events = []
+    for u in range(12):
+        group = u % 2
+        for i in range(10):
+            in_group = (i % 2) == group
+            if rng.random() < (0.8 if in_group else 0.3):
+                r = 5.0 if in_group else 1.0
+                events.append(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap({"rating": r}),
+                        event_time=dt.datetime(2020, 1, 1, tzinfo=UTC),
+                    )
+                )
+    # item properties for category filtering
+    for i in range(10):
+        events.append(
+            Event(
+                event="$set",
+                entity_type="item",
+                entity_id=f"i{i}",
+                properties=DataMap({"categories": ["even" if i % 2 == 0 else "odd"]}),
+                event_time=dt.datetime(2020, 1, 1, tzinfo=UTC),
+            )
+        )
+    es.insert_batch(events, app_id=app.id)
+    return WorkflowContext(storage=storage_memory, mode="Training")
+
+
+VARIANT = {
+    "id": "default",
+    "engineFactory": "predictionio_tpu.templates.recommendation.recommendation_engine",
+    "datasource": {
+        "params": {"appName": "recapp", "eventNames": ["rate"]}
+    },
+    "algorithms": [
+        {
+            "name": "als",
+            "params": {"rank": 8, "numIterations": 10, "lambda": 0.05, "seed": 3},
+        }
+    ],
+}
+
+
+def test_engine_json_camel_case_and_lambda_alias():
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    ds = ep.data_source[1]
+    assert isinstance(ds, DataSourceParams)
+    assert ds.app_name == "recapp"
+    algo = ep.algorithms[0][1]
+    assert isinstance(algo, ALSAlgorithmParams)
+    assert algo.num_iterations == 10
+    assert algo.lam == 0.05
+
+
+def test_train_and_predict_end_to_end(ctx):
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    iid = run_train(e, ep, ctx=ctx, engine_variant="rec.json")
+    models = prepare_deploy(e, ep, iid, ctx=ctx)
+    algos = e._algorithms(ep)
+    model = models[0]
+    # group-0 user should prefer even items
+    res = algos[0].predict(model, Query(user="u0", num=3))
+    assert len(res.item_scores) == 3
+    top_items = [s.item for s in res.item_scores]
+    evens = sum(1 for it in top_items if int(it[1:]) % 2 == 0)
+    assert evens >= 2, f"expected mostly even items for u0, got {top_items}"
+    # scores descending
+    scores = [s.score for s in res.item_scores]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_unknown_user_returns_empty(ctx):
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    res = e._algorithms(ep)[0].predict(models[0], Query(user="ghost", num=3))
+    assert res.item_scores == ()
+
+
+def test_category_filter(ctx):
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    algo = e._algorithms(ep)[0]
+    res = algo.predict(
+        models[0], Query(user="u0", num=4, categories=("odd",))
+    )
+    assert res.item_scores
+    for s in res.item_scores:
+        assert int(s.item[1:]) % 2 == 1, f"category filter leaked: {s.item}"
+
+
+def test_whitelist_blacklist(ctx):
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    algo = e._algorithms(ep)[0]
+    res = algo.predict(
+        models[0], Query(user="u0", num=5, whitelist=("i0", "i1"))
+    )
+    assert {s.item for s in res.item_scores} <= {"i0", "i1"}
+    res = algo.predict(models[0], Query(user="u0", num=10, blacklist=("i0",)))
+    assert "i0" not in {s.item for s in res.item_scores}
+
+
+def test_batch_predict_matches_single(ctx):
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    algo = e._algorithms(ep)[0]
+    queries = [Query(user=f"u{u}", num=3) for u in range(4)] + [
+        Query(user="ghost", num=3)
+    ]
+    batch = algo.batch_predict(models[0], queries)
+    for q, b in zip(queries, batch):
+        single = algo.predict(models[0], q)
+        assert [s.item for s in b.item_scores] == [
+            s.item for s in single.item_scores
+        ]
+    assert batch[-1].item_scores == ()
+
+
+def test_query_wire_format():
+    q = Query.from_json({"user": "u1", "num": 4, "categories": ["a"]})
+    assert q.user == "u1" and q.num == 4 and q.categories == ("a",)
+    from predictionio_tpu.templates.recommendation import (
+        ItemScore,
+        PredictedResult,
+    )
+
+    r = PredictedResult(item_scores=(ItemScore("i1", 1.5),))
+    assert r.to_json() == {"itemScores": [{"item": "i1", "score": 1.5}]}
+
+
+def test_read_eval_kfold(ctx):
+    e = recommendation_engine()
+    variant = {
+        **VARIANT,
+        "datasource": {
+            "params": {"appName": "recapp", "evalK": 3}
+        },
+    }
+    ep = e.params_from_variant(variant)
+    ds = e._data_source(ep)
+    sets = ds.read_eval(ctx)
+    assert len(sets) == 3
+    total_test = sum(len(qa) for _, _, qa in sets)
+    total_train = len(sets[0][0].ratings) + len(sets[0][2])
+    # folds partition the data
+    all_ratings = ds.read_training(ctx).ratings
+    assert total_test == len(all_ratings)
+    assert total_train == len(all_ratings)
+
+
+def test_empty_app_fails_sanity(storage_memory):
+    md = storage_memory.get_metadata()
+    md.app_insert("emptyapp")
+    ctx = WorkflowContext(storage=storage_memory)
+    e = recommendation_engine()
+    ep = e.params_from_variant(
+        {**VARIANT, "datasource": {"params": {"appName": "emptyapp"}}}
+    )
+    with pytest.raises(ValueError, match="no rating events"):
+        e.train(ctx, ep)
+
+
+def test_batch_predict_honors_filters(ctx):
+    """batch_predict must apply the same filters as predict (blacklist)."""
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    algo = e._algorithms(ep)[0]
+    queries = [
+        Query(user="u0", num=5, blacklist=("i0", "i2")),
+        Query(user="u1", num=3, categories=("odd",)),
+        Query(user="u2", num=3),
+    ]
+    batch = algo.batch_predict(models[0], queries)
+    assert not {"i0", "i2"} & {s.item for s in batch[0].item_scores}
+    for s in batch[1].item_scores:
+        assert int(s.item[1:]) % 2 == 1
+    for q, b in zip(queries, batch):
+        single = algo.predict(models[0], q)
+        assert [s.item for s in b.item_scores] == [
+            s.item for s in single.item_scores
+        ]

@@ -1,0 +1,148 @@
+"""Deployment server tests: /queries.json, status, reload, stop
+(reference `CreateServer.scala` routes)."""
+
+import datetime as dt
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.server import EngineServer, ServerConfig
+from predictionio_tpu.storage import DataMap, Event
+from predictionio_tpu.templates.recommendation import recommendation_engine
+from predictionio_tpu.workflow import run_train
+
+UTC = dt.timezone.utc
+
+VARIANT = {
+    "datasource": {"params": {"appName": "srvapp"}},
+    "algorithms": [
+        {"name": "als", "params": {"rank": 4, "numIterations": 3, "lambda": 0.1}}
+    ],
+}
+
+
+@pytest.fixture()
+def deployed(storage_memory):
+    md = storage_memory.get_metadata()
+    app = md.app_insert("srvapp")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(1)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{u}",
+              target_entity_type="item", target_entity_id=f"i{i}",
+              properties=DataMap({"rating": float(rng.integers(1, 6))}),
+              event_time=dt.datetime(2020, 1, 1, tzinfo=UTC))
+        for u in range(8) for i in rng.choice(12, size=6, replace=False)
+    ]
+    es.insert_batch(evs, app_id=app.id)
+    ctx = WorkflowContext(storage=storage_memory)
+    engine = recommendation_engine()
+    ep = engine.params_from_variant(VARIANT)
+    iid = run_train(engine, ep, ctx=ctx, engine_variant="srv.json")
+    server = EngineServer(
+        engine, ep, iid, ctx=ctx,
+        config=ServerConfig(port=0),  # ephemeral port
+        engine_variant="srv.json",
+    )
+    server.start_background()
+    yield server, ctx, engine, ep
+    server.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def test_queries_json(deployed):
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    status, body = _post(f"{base}/queries.json", {"user": "u1", "num": 3})
+    assert status == 200
+    assert len(body["itemScores"]) == 3
+    scores = [s["score"] for s in body["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_unknown_user_empty_scores(deployed):
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    _, body = _post(f"{base}/queries.json", {"user": "ghost", "num": 3})
+    assert body == {"itemScores": []}
+
+
+def test_malformed_query_400(deployed):
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/queries.json", {"num": 3})  # missing "user"
+    assert exc.value.code == 400
+
+
+def test_invalid_json_400(deployed):
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    req = urllib.request.Request(
+        f"{base}/queries.json", data=b"{not json",
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=10)
+    assert exc.value.code == 400
+
+
+def test_status_page_latency_bookkeeping(deployed):
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    _post(f"{base}/queries.json", {"user": "u1", "num": 2})
+    status, body = _get(f"{base}/")
+    assert status == 200
+    assert body["status"] == "alive"
+    assert body["requestCount"] >= 1
+    assert body["avgServingSec"] > 0
+    assert body["engineInstanceId"] == server.instance_id
+
+
+def test_reload_swaps_to_latest(deployed):
+    server, ctx, engine, ep = deployed
+    old_iid = server.instance_id
+    new_iid = run_train(engine, ep, ctx=ctx, engine_variant="srv.json")
+    base = f"http://127.0.0.1:{server.config.port}"
+    status, body = _get(f"{base}/reload")
+    assert status == 200
+    assert body["reloaded"] == new_iid != old_iid
+    assert server.instance_id == new_iid
+
+
+def test_unknown_route_404(deployed):
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(f"{base}/nope")
+    assert exc.value.code == 404
+
+
+def test_port_in_use_raises(deployed):
+    """Binding a second server on a busy port must raise, not hang."""
+    server, ctx, engine, ep = deployed
+    dup = EngineServer(
+        engine, ep, server.instance_id, ctx=ctx,
+        config=ServerConfig(port=server.config.port),
+        engine_variant="srv.json",
+    )
+    with pytest.raises(OSError):
+        dup.start_background()

@@ -1,0 +1,7 @@
+"""`python -m predictionio_tpu` -> the pio-tpu console."""
+
+import sys
+
+from .cli.main import main
+
+sys.exit(main())

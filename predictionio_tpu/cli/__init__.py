@@ -1,0 +1,5 @@
+"""CLI console (reference `tools/console/`)."""
+
+from .main import load_engine_from_variant, main, resolve_attr
+
+__all__ = ["load_engine_from_variant", "main", "resolve_attr"]

@@ -1,0 +1,487 @@
+"""`pio`-equivalent CLI console.
+
+Re-expression of reference `tools/console/Console.scala:128-737` +
+`console/App.scala` + `console/AccessKey.scala` on argparse.  Subcommands:
+
+  app new|list|show|delete|data-delete|channel-new|channel-delete
+  accesskey new|list|delete
+  train | deploy | eval | eventserver | adminserver | dashboard
+  import | export | status | version
+
+`build`/`unregister` have no analogue (no sbt); engine factories are Python
+callables resolved by dotted path (`WorkflowUtils.getEngine` reflection
+analogue, `workflow/WorkflowUtils.scala:60-77`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+from pathlib import Path
+from typing import Any, Optional
+
+from .. import __version__
+from ..storage.metadata import AccessKey
+from ..storage.registry import Storage, get_storage
+
+__all__ = ["main", "resolve_attr", "load_engine_from_variant"]
+
+
+def resolve_attr(path: str) -> Any:
+    """'package.module.attr' -> attr (the reflection-loader analogue)."""
+    mod_name, _, attr = path.rpartition(".")
+    if not mod_name:
+        raise ValueError(f"invalid dotted path: {path!r}")
+    mod = importlib.import_module(mod_name)
+    try:
+        return getattr(mod, attr)
+    except AttributeError as e:
+        raise ValueError(f"{attr!r} not found in module {mod_name}") from e
+
+
+def load_engine_from_variant(
+    variant_path: str | Path, engine_factory: Optional[str] = None
+):
+    """engine.json -> (engine, engine_params, variant dict)."""
+    variant = json.loads(Path(variant_path).read_text())
+    factory_path = engine_factory or variant.get("engineFactory")
+    if not factory_path:
+        raise ValueError(
+            "engine.json must declare 'engineFactory' "
+            "(or pass --engine-factory)"
+        )
+    factory = resolve_attr(factory_path)
+    engine = factory() if callable(factory) else factory
+    if hasattr(engine, "apply"):  # EngineFactory object
+        engine = engine.apply()
+    return engine, engine.params_from_variant(variant), variant
+
+
+def _out(msg: str) -> None:
+    print(msg)
+
+
+# --------------------------------------------------------------------------
+# app / accesskey ops (console/App.scala:34-498, console/AccessKey.scala)
+# --------------------------------------------------------------------------
+
+
+def cmd_app(args, storage: Storage) -> int:
+    md = storage.get_metadata()
+    es = storage.get_event_store()
+    if args.app_command == "new":
+        if md.app_get_by_name(args.name):
+            _out(f"Error: app '{args.name}' already exists.")
+            return 1
+        app = md.app_insert(args.name, args.description)
+        es.init_channel(app.id)
+        key = md.access_key_insert(
+            AccessKey(key=args.access_key or "", appid=app.id)
+        )
+        _out(f"Created app '{app.name}' (id {app.id}).")
+        _out(f"Access key: {key}")
+        return 0
+    if args.app_command == "list":
+        for app in md.app_get_all():
+            keys = md.access_key_get_by_app(app.id)
+            _out(f"{app.id:>6}  {app.name}  keys={len(keys)}")
+        return 0
+    if args.app_command == "show":
+        app = md.app_get_by_name(args.name)
+        if app is None:
+            _out(f"Error: app '{args.name}' not found.")
+            return 1
+        _out(f"App: {app.name} (id {app.id})")
+        _out(f"Description: {app.description or ''}")
+        for k in md.access_key_get_by_app(app.id):
+            events = ",".join(k.events) if k.events else "(all)"
+            _out(f"Access key: {k.key} events={events}")
+        for c in md.channel_get_by_app(app.id):
+            _out(f"Channel: {c.name} (id {c.id})")
+        return 0
+    if args.app_command == "delete":
+        app = md.app_get_by_name(args.name)
+        if app is None:
+            _out(f"Error: app '{args.name}' not found.")
+            return 1
+        for c in md.channel_get_by_app(app.id):
+            es.remove_channel(app.id, c.id)
+            md.channel_delete(c.id)
+        es.remove_channel(app.id)
+        for k in md.access_key_get_by_app(app.id):
+            md.access_key_delete(k.key)
+        md.app_delete(app.id)
+        _out(f"Deleted app '{args.name}'.")
+        return 0
+    if args.app_command == "data-delete":
+        app = md.app_get_by_name(args.name)
+        if app is None:
+            _out(f"Error: app '{args.name}' not found.")
+            return 1
+        if args.channel:
+            chans = [
+                c for c in md.channel_get_by_app(app.id) if c.name == args.channel
+            ]
+            if not chans:
+                _out(f"Error: channel '{args.channel}' not found.")
+                return 1
+            es.remove_channel(app.id, chans[0].id)
+            es.init_channel(app.id, chans[0].id)
+        else:
+            es.remove_channel(app.id)
+            es.init_channel(app.id)
+        _out(f"Deleted event data of app '{args.name}'.")
+        return 0
+    if args.app_command == "channel-new":
+        app = md.app_get_by_name(args.name)
+        if app is None:
+            _out(f"Error: app '{args.name}' not found.")
+            return 1
+        try:
+            c = md.channel_insert(args.channel, app.id)
+        except ValueError as e:
+            _out(f"Error: {e}")
+            return 1
+        es.init_channel(app.id, c.id)
+        _out(f"Created channel '{c.name}' (id {c.id}).")
+        return 0
+    if args.app_command == "channel-delete":
+        app = md.app_get_by_name(args.name)
+        if app is None:
+            _out(f"Error: app '{args.name}' not found.")
+            return 1
+        chans = [
+            c for c in md.channel_get_by_app(app.id) if c.name == args.channel
+        ]
+        if not chans:
+            _out(f"Error: channel '{args.channel}' not found.")
+            return 1
+        es.remove_channel(app.id, chans[0].id)
+        md.channel_delete(chans[0].id)
+        _out(f"Deleted channel '{args.channel}'.")
+        return 0
+    raise AssertionError(args.app_command)
+
+
+def cmd_accesskey(args, storage: Storage) -> int:
+    md = storage.get_metadata()
+    if args.ak_command == "new":
+        app = md.app_get_by_name(args.app_name)
+        if app is None:
+            _out(f"Error: app '{args.app_name}' not found.")
+            return 1
+        key = md.access_key_insert(
+            AccessKey(key="", appid=app.id, events=args.events or [])
+        )
+        _out(f"Access key: {key}")
+        return 0
+    if args.ak_command == "list":
+        keys = md.access_key_get_all()
+        if args.app_name:
+            app = md.app_get_by_name(args.app_name)
+            if app is None:
+                _out(f"Error: app '{args.app_name}' not found.")
+                return 1
+            keys = [k for k in keys if k.appid == app.id]
+        for k in keys:
+            events = ",".join(k.events) if k.events else "(all)"
+            _out(f"{k.key}  appid={k.appid}  events={events}")
+        return 0
+    if args.ak_command == "delete":
+        md.access_key_delete(args.key)
+        _out(f"Deleted access key {args.key}.")
+        return 0
+    raise AssertionError(args.ak_command)
+
+
+# --------------------------------------------------------------------------
+# train / deploy / eval / servers
+# --------------------------------------------------------------------------
+
+
+def cmd_train(args, storage: Storage) -> int:
+    from ..controller.base import WorkflowContext
+    from ..workflow.params import WorkflowParams
+    from ..workflow.train import run_train
+
+    engine, ep, variant = load_engine_from_variant(
+        args.engine_json, args.engine_factory
+    )
+    ctx = WorkflowContext(storage=storage, mode="Training", batch=args.batch)
+    wp = WorkflowParams(
+        batch=args.batch,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+    )
+    iid = run_train(
+        engine, ep, ctx=ctx, workflow_params=wp,
+        engine_id=variant.get("id", "default"),
+        engine_variant=str(args.engine_json),
+        engine_factory=args.engine_factory or variant.get("engineFactory", ""),
+    )
+    _out(f"Training completed. Engine instance id: {iid}")
+    return 0
+
+
+def cmd_deploy(args, storage: Storage) -> int:
+    from ..controller.base import WorkflowContext
+    from ..server.serving import EngineServer, ServerConfig
+
+    engine, ep, variant = load_engine_from_variant(
+        args.engine_json, args.engine_factory
+    )
+    md = storage.get_metadata()
+    engine_id = variant.get("id", "default")
+    if args.engine_instance_id:
+        iid = args.engine_instance_id
+        if md.engine_instance_get(iid) is None:
+            _out(f"Error: engine instance '{iid}' not found.")
+            return 1
+    else:
+        latest = md.engine_instance_get_latest_completed(
+            engine_id, "1", str(args.engine_json)
+        )
+        if latest is None:
+            _out("Error: no completed engine instance found; run train first.")
+            return 1
+        iid = latest.id
+    ctx = WorkflowContext(storage=storage, mode="Serving")
+    server = EngineServer(
+        engine, ep, iid, ctx=ctx,
+        config=ServerConfig(
+            host=args.ip, port=args.port,
+            feedback=args.feedback,
+            event_server_url=args.event_server_url,
+            access_key=args.accesskey,
+        ),
+        engine_id=engine_id,
+        engine_variant=str(args.engine_json),
+    )
+    _out(f"Deploying engine instance {iid} on {args.ip}:{args.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_eval(args, storage: Storage) -> int:
+    from ..controller.base import WorkflowContext
+    from ..workflow.evaluate import run_evaluation
+
+    evaluation = resolve_attr(args.evaluation)
+    if callable(evaluation) and not hasattr(evaluation, "engine"):
+        evaluation = evaluation()
+    params_list = None
+    if args.engine_params_generator:
+        gen = resolve_attr(args.engine_params_generator)
+        if callable(gen) and not hasattr(gen, "engine_params_list"):
+            gen = gen()
+        params_list = list(gen.engine_params_list)
+    ctx = WorkflowContext(storage=storage, mode="Evaluation", batch=args.batch)
+    eval_id, result = run_evaluation(
+        evaluation, params_list, ctx=ctx,
+        evaluation_class=args.evaluation,
+        engine_params_generator_class=args.engine_params_generator or "",
+    )
+    _out(result.to_one_liner())
+    _out(f"Evaluation completed. Instance id: {eval_id}")
+    return 0
+
+
+def cmd_eventserver(args, storage: Storage) -> int:
+    from ..server.event_server import EventServer, EventServerConfig
+
+    server = EventServer(
+        storage, EventServerConfig(host=args.ip, port=args.port,
+                                   stats=args.stats)
+    )
+    _out(f"Event server running on {args.ip}:{args.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_adminserver(args, storage: Storage) -> int:
+    from ..server.admin import AdminServer
+
+    server = AdminServer(storage, host=args.ip, port=args.port)
+    _out(f"Admin server running on {args.ip}:{args.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_dashboard(args, storage: Storage) -> int:
+    from ..server.dashboard import DashboardServer
+
+    server = DashboardServer(storage, host=args.ip, port=args.port)
+    _out(f"Dashboard running on {args.ip}:{args.port}")
+    server.serve_forever()
+    return 0
+
+
+def cmd_import(args, storage: Storage) -> int:
+    from ..tools.import_export import import_events
+
+    es = storage.get_event_store()
+    es.init_channel(args.appid, args.channel)
+    n = import_events(args.input, es, args.appid, args.channel)
+    _out(f"Imported {n} events.")
+    return 0
+
+
+def cmd_export(args, storage: Storage) -> int:
+    from ..tools.import_export import export_events
+
+    es = storage.get_event_store()
+    es.init_channel(args.appid, args.channel)
+    n = export_events(args.output, es, args.appid, args.channel)
+    _out(f"Exported {n} events.")
+    return 0
+
+
+def cmd_status(args, storage: Storage) -> int:
+    """Sanity-check env + storage (console/Console.scala:1028-1085)."""
+    _out(f"predictionio_tpu {__version__}")
+    try:
+        import jax
+
+        devices = jax.devices()
+        _out(f"JAX devices: {devices}")
+    except Exception as e:
+        _out(f"Warning: JAX backend unavailable: {e}")
+    try:
+        storage.verify_all_data_objects()
+        _out("Storage: OK (metadata, event store, model data verified)")
+    except Exception as e:
+        _out(f"Error: storage verification failed: {e}")
+        return 1
+    _out("Ready.")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# parser
+# --------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio-tpu",
+        description="predictionio_tpu console "
+        "(the `pio` command, rebuilt TPU-native)",
+    )
+    p.add_argument("--version", action="version",
+                   version=f"pio-tpu {__version__}")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    ap = sub.add_parser("app", help="manage apps")
+    aps = ap.add_subparsers(dest="app_command", required=True)
+    x = aps.add_parser("new")
+    x.add_argument("name")
+    x.add_argument("--description")
+    x.add_argument("--access-key")
+    aps.add_parser("list")
+    x = aps.add_parser("show")
+    x.add_argument("name")
+    x = aps.add_parser("delete")
+    x.add_argument("name")
+    x = aps.add_parser("data-delete")
+    x.add_argument("name")
+    x.add_argument("--channel")
+    x = aps.add_parser("channel-new")
+    x.add_argument("name")
+    x.add_argument("channel")
+    x = aps.add_parser("channel-delete")
+    x.add_argument("name")
+    x.add_argument("channel")
+
+    ak = sub.add_parser("accesskey", help="manage access keys")
+    aks = ak.add_subparsers(dest="ak_command", required=True)
+    x = aks.add_parser("new")
+    x.add_argument("app_name")
+    x.add_argument("events", nargs="*")
+    x = aks.add_parser("list")
+    x.add_argument("app_name", nargs="?")
+    x = aks.add_parser("delete")
+    x.add_argument("key")
+
+    t = sub.add_parser("train", help="train an engine")
+    t.add_argument("--engine-json", default="engine.json")
+    t.add_argument("--engine-factory")
+    t.add_argument("--batch", default="")
+    t.add_argument("--skip-sanity-check", action="store_true")
+    t.add_argument("--stop-after-read", action="store_true")
+    t.add_argument("--stop-after-prepare", action="store_true")
+
+    d = sub.add_parser("deploy", help="deploy an engine server")
+    d.add_argument("--engine-json", default="engine.json")
+    d.add_argument("--engine-factory")
+    d.add_argument("--engine-instance-id")
+    d.add_argument("--ip", default="0.0.0.0")
+    d.add_argument("--port", type=int, default=8000)
+    d.add_argument("--feedback", action="store_true")
+    d.add_argument("--event-server-url")
+    d.add_argument("--accesskey")
+
+    e = sub.add_parser("eval", help="run an evaluation sweep")
+    e.add_argument("evaluation",
+                   help="dotted path to an Evaluation (or factory)")
+    e.add_argument("engine_params_generator", nargs="?",
+                   help="dotted path to an EngineParamsGenerator")
+    e.add_argument("--batch", default="")
+
+    ev = sub.add_parser("eventserver", help="run the event server")
+    ev.add_argument("--ip", default="0.0.0.0")
+    ev.add_argument("--port", type=int, default=7070)
+    ev.add_argument("--stats", action="store_true", default=True)
+
+    ad = sub.add_parser("adminserver", help="run the admin API server")
+    ad.add_argument("--ip", default="127.0.0.1")
+    ad.add_argument("--port", type=int, default=7071)
+
+    db = sub.add_parser("dashboard", help="run the evaluation dashboard")
+    db.add_argument("--ip", default="127.0.0.1")
+    db.add_argument("--port", type=int, default=9000)
+
+    im = sub.add_parser("import", help="import events from JSON-lines file")
+    im.add_argument("--appid", type=int, required=True)
+    im.add_argument("--channel", type=int, default=0)
+    im.add_argument("--input", required=True)
+
+    ex = sub.add_parser("export", help="export events to JSON-lines file")
+    ex.add_argument("--appid", type=int, required=True)
+    ex.add_argument("--channel", type=int, default=0)
+    ex.add_argument("--output", required=True)
+
+    sub.add_parser("status", help="check environment and storage")
+    sub.add_parser("version")
+    return p
+
+
+_DISPATCH = {
+    "app": cmd_app,
+    "accesskey": cmd_accesskey,
+    "train": cmd_train,
+    "deploy": cmd_deploy,
+    "eval": cmd_eval,
+    "eventserver": cmd_eventserver,
+    "adminserver": cmd_adminserver,
+    "dashboard": cmd_dashboard,
+    "import": cmd_import,
+    "export": cmd_export,
+    "status": cmd_status,
+}
+
+
+def main(argv: Optional[list[str]] = None,
+         storage: Optional[Storage] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "version":
+        _out(f"pio-tpu {__version__}")
+        return 0
+    storage = storage or get_storage()
+    return _DISPATCH[args.command](args, storage)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,22 @@ from .base import (
     instantiate,
 )
 from .engine import Engine, EngineFactory, EngineParams, SimpleEngine
+from .evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from .fast_eval import FastEvalEngine
+from .metrics import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
 from .params import EmptyParams, Params, ParamsError, extract_params, params_to_json
 
 __all__ = [
@@ -36,6 +52,18 @@ __all__ = [
     "WorkflowContext",
     "instantiate",
     "Engine",
+    "EngineParamsGenerator",
+    "Evaluation",
+    "MetricEvaluator",
+    "MetricEvaluatorResult",
+    "FastEvalEngine",
+    "AverageMetric",
+    "Metric",
+    "OptionAverageMetric",
+    "OptionStdevMetric",
+    "StdevMetric",
+    "SumMetric",
+    "ZeroMetric",
     "EngineFactory",
     "EngineParams",
     "SimpleEngine",

@@ -1,0 +1,112 @@
+"""FastEvalEngine: prefix-memoized evaluation across a params sweep.
+
+Re-expression of reference `controller/FastEvalEngine.scala:45-330`: during
+``batch_eval`` over many EngineParams candidates, pipeline stages whose
+*params prefix* matches a previous candidate reuse its results instead of
+recomputing — a sweep varying only algorithm params re-reads and re-prepares
+nothing.  Cache keys mirror the reference's ``DataSourcePrefix`` /
+``PreparatorPrefix`` / ``AlgorithmsPrefix`` / ``ServingPrefix``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence, Tuple
+
+from .base import WorkflowContext, instantiate
+from .engine import Engine, EngineParams
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FastEvalEngine"]
+
+
+def _key(named_params) -> Any:
+    """Hashable key for a (name, Params) pair or list thereof."""
+    if isinstance(named_params, list):
+        return tuple(_key(x) for x in named_params)
+    name, params = named_params
+    return (name, repr(params))
+
+
+class FastEvalEngine(Engine):
+    """Evaluation-only engine with pipeline-prefix caching.
+
+    Not for training/deploy (reference restricts it the same way:
+    `FastEvalEngine.scala:297-330`).
+    """
+
+    def __init__(self, *args, **kwargs):
+        if args and isinstance(args[0], Engine) and len(args) == 1 and not kwargs:
+            e = args[0]
+            super().__init__(
+                e.data_source_class_map,
+                e.preparator_class_map,
+                e.algorithm_class_map,
+                e.serving_class_map,
+            )
+        else:
+            super().__init__(*args, **kwargs)
+        self._ds_cache: dict = {}
+        self._prep_cache: dict = {}
+        self._algo_cache: dict = {}
+        # hit/miss counters (FastEvalEngineTest asserts on these)
+        self.stats = {"ds": 0, "prep": 0, "algo": 0}
+
+    # -- cached stages ----------------------------------------------------
+    def _get_eval_sets(self, ctx, ep: EngineParams):
+        key = _key(ep.data_source)
+        if key not in self._ds_cache:
+            self.stats["ds"] += 1
+            ds = self._data_source(ep)
+            self._ds_cache[key] = ds.read_eval(ctx)
+        return self._ds_cache[key]
+
+    def _get_prepared(self, ctx, ep: EngineParams):
+        key = (_key(ep.data_source), _key(ep.preparator))
+        if key not in self._prep_cache:
+            self.stats["prep"] += 1
+            prep = self._preparator(ep)
+            eval_sets = self._get_eval_sets(ctx, ep)
+            self._prep_cache[key] = [
+                (prep.prepare(ctx, td), ei, qa) for td, ei, qa in eval_sets
+            ]
+        return self._prep_cache[key]
+
+    def _get_models(self, ctx, ep: EngineParams):
+        key = (
+            _key(ep.data_source),
+            _key(ep.preparator),
+            _key(list(ep.algorithms)),
+        )
+        if key not in self._algo_cache:
+            self.stats["algo"] += 1
+            algorithms = self._algorithms(ep)
+            prepared = self._get_prepared(ctx, ep)
+            self._algo_cache[key] = (
+                algorithms,
+                [
+                    [algo.train(ctx, pd) for algo in algorithms]
+                    for pd, _, _ in prepared
+                ],
+            )
+        return self._algo_cache[key]
+
+    # -- eval using the caches --------------------------------------------
+    def eval(self, ctx: WorkflowContext, engine_params: EngineParams,
+             workflow_params=None):
+        serving = self._serving(engine_params)
+        prepared = self._get_prepared(ctx, engine_params)
+        algorithms, per_set_models = self._get_models(ctx, engine_params)
+        results = []
+        for (pd, ei, qa), models in zip(prepared, per_set_models):
+            results.append(
+                (ei, self._batch_serve(algorithms, models, serving, qa))
+            )
+        return results
+
+    def clear_cache(self) -> None:
+        self._ds_cache.clear()
+        self._prep_cache.clear()
+        self._algo_cache.clear()
+        self.stats = {"ds": 0, "prep": 0, "algo": 0}

@@ -52,6 +52,22 @@ def distributed_init(
     )
 
 
+def _visible_devices():
+    """jax.devices() with CPU fallback: when the accelerator cannot
+    initialize (e.g. the single TPU chip is held by another process), ops
+    workflows still run on host instead of crashing."""
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "accelerator backend unavailable (%s); falling back to CPU", e
+        )
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Sequence[str] = (DATA_AXIS,),
@@ -62,7 +78,7 @@ def make_mesh(
     Default: 1-D mesh named ``data`` over all devices.  ``shape`` gives an
     explicit per-axis split (product must divide the device count).
     """
-    devices = jax.devices()
+    devices = _visible_devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)

@@ -1,6 +1,18 @@
 """HTTP servers: engine deployment (serving), event ingestion, admin,
 dashboard (reference L3/L8/L9 surfaces)."""
 
+from .admin import AdminServer
+from .dashboard import DashboardServer
+from .event_server import EventServer, EventServerConfig
 from .serving import EngineServer, ServerConfig
+from .stats import StatsCollector
 
-__all__ = ["EngineServer", "ServerConfig"]
+__all__ = [
+    "AdminServer",
+    "DashboardServer",
+    "EventServer",
+    "EventServerConfig",
+    "EngineServer",
+    "ServerConfig",
+    "StatsCollector",
+]

@@ -1,0 +1,97 @@
+"""Evaluation dashboard (port 9000).
+
+Re-expression of reference `tools/dashboard/Dashboard.scala:30-141`: an HTML
+index of completed evaluation instances with drill-down to
+``evaluator_results.{txt,html,json}`` per instance, plus CORS headers
+(`dashboard/CorsSupport.scala`).
+"""
+
+from __future__ import annotations
+
+import html as _html
+import json
+import logging
+import threading
+import urllib.parse
+from typing import Optional
+
+from ..storage.registry import Storage
+from .http_base import HTTPServerBase, JsonRequestHandler
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DashboardServer"]
+
+
+class DashboardServer(HTTPServerBase):
+    def __init__(self, storage: Storage, host: str = "127.0.0.1",
+                 port: int = 9000):
+        self.storage = storage
+        self.host = host
+        self.port = port
+
+    def index_html(self) -> str:
+        md = self.storage.get_metadata()
+        rows = []
+        for ev in md.evaluation_instance_get_completed():
+            rows.append(
+                "<tr><td>{id}</td><td>{cls}</td><td>{start}</td>"
+                "<td>{end}</td><td>{res}</td>"
+                "<td><a href='/engine_instances/{id}/evaluator_results.txt'>txt</a> "
+                "<a href='/engine_instances/{id}/evaluator_results.html'>html</a> "
+                "<a href='/engine_instances/{id}/evaluator_results.json'>json</a>"
+                "</td></tr>".format(
+                    id=_html.escape(ev.id),
+                    cls=_html.escape(ev.evaluation_class),
+                    start=_html.escape(ev.start_time),
+                    end=_html.escape(ev.end_time),
+                    res=_html.escape(ev.evaluator_results),
+                )
+            )
+        return (
+            "<html><head><title>predictionio_tpu dashboard</title></head>"
+            "<body><h1>Completed evaluations</h1>"
+            "<table border='1'><tr><th>id</th><th>evaluation</th>"
+            "<th>start</th><th>end</th><th>result</th><th>details</th></tr>"
+            + "\n".join(rows)
+            + "</table></body></html>"
+        )
+
+    def _make_handler(server: "DashboardServer"):
+        class Handler(JsonRequestHandler):
+            server_logger = logger
+            # CORS (reference CorsSupport.scala)
+            extra_headers = (("Access-Control-Allow-Origin", "*"),)
+
+            def do_GET(self):
+                path = urllib.parse.urlparse(self.path).path
+                if path == "/":
+                    self._reply(200, server.index_html().encode(), "text/html")
+                    return
+                parts = [x for x in path.split("/") if x]
+                if len(parts) == 2 and parts[0] == "engine_instances":
+                    # also accept bare ids -> json
+                    parts = [parts[0], parts[1], "evaluator_results.json"]
+                if len(parts) == 3 and parts[0] == "engine_instances":
+                    ev = server.storage.get_metadata().evaluation_instance_get(
+                        parts[1]
+                    )
+                    if ev is None:
+                        self._reply(404, b"not found", "text/plain")
+                        return
+                    which = parts[2]
+                    if which == "evaluator_results.txt":
+                        self._reply(200, ev.evaluator_results.encode(),
+                                    "text/plain")
+                    elif which == "evaluator_results.html":
+                        self._reply(200, ev.evaluator_results_html.encode(),
+                                    "text/html")
+                    elif which == "evaluator_results.json":
+                        self._reply(200, ev.evaluator_results_json.encode(),
+                                    "application/json")
+                    else:
+                        self._reply(404, b"not found", "text/plain")
+                else:
+                    self._reply(404, b"not found", "text/plain")
+
+        return Handler

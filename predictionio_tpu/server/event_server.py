@@ -1,0 +1,330 @@
+"""REST Event Server (ingestion API, default port 7070).
+
+Re-expression of reference `data/api/EventAPI.scala:90-469` on the stdlib
+threading HTTP server.  Routes + semantics parity:
+
+* ``POST /events.json?accessKey=K[&channel=C]``  -> 201 ``{"eventId": ...}``
+* ``POST /batch/events.json``                    -> per-event status list
+* ``GET  /events.json?accessKey=K&...filters``   -> event list (find filters:
+  startTime, untilTime, entityType, entityId, event, targetEntityType,
+  targetEntityId, limit, reversed)
+* ``GET|DELETE /events/<id>.json?accessKey=K``
+* ``GET  /stats.json?accessKey=K``               (when stats enabled)
+* ``POST /webhooks/<name>.json`` / ``.form``, ``GET`` probes
+* ``GET  /``                                      -> server info
+
+Auth: accessKey (query param) -> (appId, channelId); keys may whitelist
+event names (`AccessKeys.scala:27-54`).  401 on bad key, 400 on invalid
+payloads, 404 on unknown ids/channels — matching the reference's
+rejection handler (`api/Common.scala`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from typing import Any, Optional
+
+from ..storage.event import Event, EventValidationError, parse_time
+from ..storage.levents import NO_TARGET
+from ..storage.registry import Storage, get_storage
+from .http_base import HTTPServerBase, JsonRequestHandler
+from .stats import StatsCollector
+from .webhooks import (
+    FORM_CONNECTORS,
+    JSON_CONNECTORS,
+    ConnectorError,
+    to_event,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EventServer", "EventServerConfig"]
+
+
+class EventServerConfig:
+    def __init__(self, host: str = "127.0.0.1", port: int = 7070,
+                 stats: bool = True):
+        self.host = host
+        self.port = port
+        self.stats = stats
+
+
+class AuthError(Exception):
+    pass
+
+
+class EventServer(HTTPServerBase):
+    def __init__(self, storage: Optional[Storage] = None,
+                 config: Optional[EventServerConfig] = None):
+        self.storage = storage or get_storage()
+        self.config = config or EventServerConfig()
+        self.stats = StatsCollector() if self.config.stats else None
+
+    @property
+    def host(self) -> str:
+        return self.config.host
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+    @port.setter
+    def port(self, v: int) -> None:
+        self.config.port = v
+
+    # -- auth (EventAPI.scala:90-116) -------------------------------------
+    def authenticate(self, params: dict[str, list[str]]) -> tuple[int, int, list[str]]:
+        """accessKey [+ channel] -> (app_id, channel_id, allowed_events)."""
+        keys = params.get("accessKey")
+        if not keys or not keys[0]:
+            raise AuthError("missing accessKey")
+        md = self.storage.get_metadata()
+        ak = md.access_key_get(keys[0])
+        if ak is None:
+            raise AuthError("invalid accessKey")
+        channel_id = 0
+        channels = params.get("channel")
+        if channels and channels[0]:
+            chans = md.channel_get_by_app(ak.appid)
+            match = [c for c in chans if c.name == channels[0]]
+            if not match:
+                raise AuthError(f"invalid channel {channels[0]!r}")
+            channel_id = match[0].id
+        return ak.appid, channel_id, ak.events
+
+    # -- handlers ----------------------------------------------------------
+    def insert_event(self, event: Event, app_id: int, channel_id: int,
+                     allowed: list[str]) -> str:
+        if allowed and event.event not in allowed:
+            raise AuthError(
+                f"accessKey is not allowed to write event {event.event!r}"
+            )
+        es = self.storage.get_event_store()
+        es.init_channel(app_id, channel_id)
+        return es.insert(event, app_id, channel_id)
+
+    @staticmethod
+    def _find_kwargs(params: dict[str, list[str]]) -> dict[str, Any]:
+        def one(name):
+            v = params.get(name)
+            return v[0] if v else None
+
+        kw: dict[str, Any] = {}
+        if one("startTime"):
+            kw["start_time"] = parse_time(one("startTime"))
+        if one("untilTime"):
+            kw["until_time"] = parse_time(one("untilTime"))
+        if one("entityType"):
+            kw["entity_type"] = one("entityType")
+        if one("entityId"):
+            kw["entity_id"] = one("entityId")
+        if params.get("event"):
+            kw["event_names"] = params["event"]
+        tet, tei = one("targetEntityType"), one("targetEntityId")
+        if tet:
+            kw["target_entity_type"] = NO_TARGET if tet == "none" else tet
+        if tei:
+            kw["target_entity_id"] = NO_TARGET if tei == "none" else tei
+        if one("limit"):
+            kw["limit"] = int(one("limit"))
+        if one("reversed"):
+            kw["reversed"] = one("reversed").lower() == "true"
+        return kw
+
+    # -- http ---------------------------------------------------------------
+    def _make_handler(server: "EventServer"):
+        class Handler(JsonRequestHandler):
+            server_logger = logger
+
+            def _params(self) -> dict[str, list[str]]:
+                q = urllib.parse.urlparse(self.path).query
+                return urllib.parse.parse_qs(q)
+
+            def _route(self) -> str:
+                return urllib.parse.urlparse(self.path).path
+
+            def _auth(self):
+                return server.authenticate(self._params())
+
+            def _book(self, app_id: int, status: int, event=None):
+                if server.stats is not None:
+                    server.stats.bookkeeping(app_id, status, event)
+
+            # ---- POST ----
+            def do_POST(self):
+                path = self._route()
+                try:
+                    if path == "/events.json":
+                        self._post_event()
+                    elif path == "/batch/events.json":
+                        self._post_batch()
+                    elif path.startswith("/webhooks/"):
+                        self._post_webhook(path)
+                    else:
+                        self._reply(404, {"message": "not found"})
+                except AuthError as e:
+                    self._reply(401, {"message": str(e)})
+                except (EventValidationError, ConnectorError,
+                        json.JSONDecodeError, ValueError) as e:
+                    self._reply(400, {"message": str(e)})
+                except Exception as e:
+                    logger.exception("event server error")
+                    self._reply(500, {"message": str(e)})
+
+            def _post_event(self):
+                app_id, channel_id, allowed = self._auth()
+                try:
+                    event = Event.from_json(json.loads(self._body().decode()))
+                except (EventValidationError, json.JSONDecodeError,
+                        ValueError) as e:
+                    self._book(app_id, 400)
+                    self._reply(400, {"message": str(e)})
+                    return
+                try:
+                    eid = server.insert_event(event, app_id, channel_id, allowed)
+                except AuthError as e:
+                    self._book(app_id, 401)
+                    self._reply(401, {"message": str(e)})
+                    return
+                self._book(app_id, 201, event)
+                self._reply(201, {"eventId": eid})
+
+            def _post_batch(self):
+                """Batch insert: per-event status
+                (reference EventAPI batch route)."""
+                app_id, channel_id, allowed = self._auth()
+                items = json.loads(self._body().decode())
+                if not isinstance(items, list):
+                    raise ValueError("batch body must be a JSON array")
+                if len(items) > 50:
+                    raise ValueError("batch limited to 50 events")
+                results = []
+                for item in items:
+                    try:
+                        event = Event.from_json(item)
+                        eid = server.insert_event(
+                            event, app_id, channel_id, allowed
+                        )
+                        self._book(app_id, 201, event)
+                        results.append({"status": 201, "eventId": eid})
+                    except AuthError as e:
+                        self._book(app_id, 401)
+                        results.append({"status": 401, "message": str(e)})
+                    except (EventValidationError, ValueError) as e:
+                        self._book(app_id, 400)
+                        results.append({"status": 400, "message": str(e)})
+                self._reply(200, results)
+
+            def _post_webhook(self, path: str):
+                app_id, channel_id, allowed = self._auth()
+                name = path[len("/webhooks/"):]
+                if name.endswith(".json"):
+                    connector = JSON_CONNECTORS.get(name[: -len(".json")])
+                    if connector is None:
+                        self._reply(404, {"message": f"webhook {name} not found"})
+                        return
+                    data = json.loads(self._body().decode() or "{}")
+                elif name.endswith(".form"):
+                    connector = FORM_CONNECTORS.get(name[: -len(".form")])
+                    if connector is None:
+                        self._reply(404, {"message": f"webhook {name} not found"})
+                        return
+                    form = urllib.parse.parse_qs(
+                        self._body().decode(), keep_blank_values=True
+                    )
+                    data = {k: v[0] for k, v in form.items()}
+                else:
+                    self._reply(404, {"message": "unknown webhook format"})
+                    return
+                event = to_event(connector, data)
+                eid = server.insert_event(event, app_id, channel_id, allowed)
+                self._book(app_id, 201, event)
+                self._reply(201, {"eventId": eid})
+
+            # ---- GET ----
+            def do_GET(self):
+                path = self._route()
+                try:
+                    if path == "/":
+                        self._reply(200, {
+                            "status": "alive",
+                            "description": "predictionio_tpu event server",
+                        })
+                    elif path == "/events.json":
+                        self._get_events()
+                    elif path.startswith("/events/") and path.endswith(".json"):
+                        self._get_event(path[len("/events/"):-len(".json")])
+                    elif path == "/stats.json":
+                        self._get_stats()
+                    elif path.startswith("/webhooks/"):
+                        name = path[len("/webhooks/"):]
+                        base = name.rsplit(".", 1)[0]
+                        if base in JSON_CONNECTORS or base in FORM_CONNECTORS:
+                            self._auth()
+                            self._reply(200, {"message": f"webhook {base} connected"})
+                        else:
+                            self._reply(404, {"message": f"webhook {name} not found"})
+                    else:
+                        self._reply(404, {"message": "not found"})
+                except AuthError as e:
+                    self._reply(401, {"message": str(e)})
+                except ValueError as e:
+                    self._reply(400, {"message": str(e)})
+                except Exception as e:
+                    logger.exception("event server error")
+                    self._reply(500, {"message": str(e)})
+
+            def _get_events(self):
+                app_id, channel_id, _ = self._auth()
+                kw = server._find_kwargs(self._params())
+                es = server.storage.get_event_store()
+                es.init_channel(app_id, channel_id)
+                events = list(es.find(app_id=app_id, channel_id=channel_id, **kw))
+                self._book(app_id, 200)
+                if not events:
+                    self._reply(404, {"message": "Not Found"})
+                else:
+                    self._reply(200, [e.to_json() for e in events])
+
+            def _get_event(self, event_id: str):
+                app_id, channel_id, _ = self._auth()
+                es = server.storage.get_event_store()
+                es.init_channel(app_id, channel_id)
+                e = es.get(event_id, app_id, channel_id)
+                if e is None:
+                    self._reply(404, {"message": "Not Found"})
+                else:
+                    self._reply(200, e.to_json())
+
+            def _get_stats(self):
+                app_id, _, _ = self._auth()
+                if server.stats is None:
+                    self._reply(404, {"message": "stats disabled"})
+                else:
+                    self._reply(200, server.stats.to_json(app_id))
+
+            # ---- DELETE ----
+            def do_DELETE(self):
+                path = self._route()
+                try:
+                    if path.startswith("/events/") and path.endswith(".json"):
+                        app_id, channel_id, _ = self._auth()
+                        eid = path[len("/events/"):-len(".json")]
+                        es = server.storage.get_event_store()
+                        es.init_channel(app_id, channel_id)
+                        if es.delete(eid, app_id, channel_id):
+                            self._reply(200, {"message": "Found"})
+                        else:
+                            self._reply(404, {"message": "Not Found"})
+                    else:
+                        self._reply(404, {"message": "not found"})
+                except AuthError as e:
+                    self._reply(401, {"message": str(e)})
+                except Exception as e:
+                    logger.exception("event server error")
+                    self._reply(500, {"message": str(e)})
+
+        return Handler

@@ -1,0 +1,79 @@
+"""Shared HTTP server plumbing for all four servers (event, serving, admin,
+dashboard): bind/serve/stop lifecycle and a JSON reply helper."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Base handler: HTTP/1.1 keep-alive + JSON/body helpers."""
+
+    protocol_version = "HTTP/1.1"
+    server_logger = None  # subclasses set a logging.Logger
+
+    def log_message(self, fmt, *args):
+        if self.server_logger is not None:
+            self.server_logger.debug(fmt, *args)
+
+    def _reply(self, code: int, payload: Any,
+               ctype: str = "application/json") -> None:
+        body = (
+            payload
+            if isinstance(payload, (bytes, bytearray))
+            else json.dumps(payload).encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in getattr(self, "extra_headers", ()):
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+
+class HTTPServerBase:
+    """Mixin providing the bind/serve/background/stop lifecycle.
+
+    Subclasses implement ``_make_handler()`` and expose ``host``/``port``
+    attributes (port 0 -> ephemeral, re-read after bind).  Binding happens
+    in the caller's thread so bind errors (port in use) surface as
+    exceptions instead of hanging a background thread.
+    """
+
+    host: str
+    port: int
+    _httpd: Optional[ThreadingHTTPServer] = None
+
+    def _make_handler(self):
+        raise NotImplementedError
+
+    def _bind(self) -> None:
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), self._make_handler()
+        )
+        self.port = self._httpd.server_address[1]
+
+    def serve_forever(self) -> None:
+        if self._httpd is None:
+            self._bind()
+        self._httpd.serve_forever()
+
+    def start_background(self) -> threading.Thread:
+        self._bind()
+        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -27,10 +27,11 @@ import threading
 import time
 import uuid
 from dataclasses import is_dataclass, asdict
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
 from ..controller.base import WorkflowContext
+from .http_base import HTTPServerBase, JsonRequestHandler
 from ..controller.engine import Engine, EngineParams
 from ..workflow.train import prepare_deploy
 
@@ -73,7 +74,7 @@ def _result_to_json(r: Any) -> Any:
     return r
 
 
-class EngineServer:
+class EngineServer(HTTPServerBase):
     """One deployed engine instance behind an HTTP server."""
 
     def __init__(
@@ -205,20 +206,21 @@ class EngineServer:
         }
 
     # -- http --------------------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self.config.host
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+    @port.setter
+    def port(self, v: int) -> None:
+        self.config.port = v
+
     def _make_handler(server: "EngineServer"):
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):
-                logger.debug("serving: " + fmt, *args)
-
-            def _reply(self, code: int, payload: Any) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+        class Handler(JsonRequestHandler):
+            server_logger = logger
 
             def do_GET(self):
                 if self.path == "/" or self.path.startswith("/?"):
@@ -259,29 +261,3 @@ class EngineServer:
 
         return Handler
 
-    def _bind(self) -> None:
-        self._httpd = ThreadingHTTPServer(
-            (self.config.host, self.config.port), self._make_handler()
-        )
-        self.config.port = self._httpd.server_address[1]
-        logger.info(
-            "engine server listening on %s:%d",
-            self.config.host, self.config.port,
-        )
-
-    def serve_forever(self) -> None:
-        if self._httpd is None:
-            self._bind()
-        self._httpd.serve_forever()
-
-    def start_background(self) -> threading.Thread:
-        self._bind()  # bind in the caller so OSError (port in use) surfaces
-        t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
-        t.start()
-        return t
-
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None

@@ -1,0 +1,114 @@
+"""Event-server stats: lifetime + hourly counters
+(reference `data/api/StatsActor.scala:29-74`, `data/api/Stats.scala:27-79`).
+
+Counters by (appId, status-code) and (appId, event, entityType,
+targetEntityType); the actor model collapses to a lock-guarded aggregate fed
+fire-and-forget from the request handlers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Stats", "StatsCollector", "KindedEvent"]
+
+
+@dataclass(frozen=True)
+class KindedEvent:
+    app_id: int
+    event: str
+    entity_type: str
+    target_entity_type: Optional[str]
+
+
+@dataclass
+class Stats:
+    start_time: float = field(default_factory=time.time)
+    status_count: Counter = field(default_factory=Counter)  # (appId, status)
+    event_count: Counter = field(default_factory=Counter)   # KindedEvent
+
+    def update(self, app_id: int, status: int, kinded: Optional[KindedEvent]):
+        self.status_count[(app_id, status)] += 1
+        if kinded is not None:
+            self.event_count[kinded] += 1
+
+    def to_json(self, app_id: Optional[int] = None) -> dict:
+        def keep_app(a):
+            return app_id is None or a == app_id
+
+        return {
+            "startTime": self.start_time,
+            "statusCount": [
+                {"appId": a, "status": s, "count": c}
+                for (a, s), c in sorted(self.status_count.items())
+                if keep_app(a)
+            ],
+            "eventCount": [
+                {
+                    "appId": k.app_id,
+                    "event": k.event,
+                    "entityType": k.entity_type,
+                    "targetEntityType": k.target_entity_type,
+                    "count": c,
+                }
+                for k, c in sorted(
+                    self.event_count.items(),
+                    key=lambda kv: (kv[0].app_id, kv[0].event),
+                )
+                if keep_app(k.app_id)
+            ],
+        }
+
+
+class StatsCollector:
+    """Long-lived + current-hour + previous-hour windows
+    (reference `StatsActor`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.lifetime = Stats()
+        self.current = Stats()
+        self.previous: Optional[Stats] = None
+        self._hour = self._hour_now()
+
+    @staticmethod
+    def _hour_now() -> int:
+        return int(time.time() // 3600)
+
+    def _roll(self) -> None:
+        h = self._hour_now()
+        if h != self._hour:
+            self.previous = self.current
+            self.current = Stats()
+            self._hour = h
+
+    def bookkeeping(self, app_id: int, status: int, event=None) -> None:
+        kinded = (
+            KindedEvent(
+                app_id=app_id,
+                event=event.event,
+                entity_type=event.entity_type,
+                target_entity_type=event.target_entity_type,
+            )
+            if event is not None
+            else None
+        )
+        with self._lock:
+            self._roll()
+            self.lifetime.update(app_id, status, kinded)
+            self.current.update(app_id, status, kinded)
+
+    def to_json(self, app_id: Optional[int] = None) -> dict:
+        with self._lock:
+            self._roll()
+            return {
+                "lifetime": self.lifetime.to_json(app_id),
+                "currentHour": self.current.to_json(app_id),
+                "previousHour": (
+                    self.previous.to_json(app_id) if self.previous else None
+                ),
+            }

@@ -254,6 +254,10 @@ class Event:
     @staticmethod
     def from_json(d: Mapping[str, Any]) -> "Event":
         """Parse the API wire format; raises on missing required fields."""
+        if not isinstance(d, Mapping):
+            raise EventValidationError(
+                f"event must be a JSON object, got {type(d).__name__}"
+            )
         try:
             name = d["event"]
             etype = d["entityType"]

@@ -241,7 +241,12 @@ class MetadataStore:
 
     # ---------------- access keys (AccessKeys.scala) ----------------
     def access_key_insert(self, key: AccessKey) -> str:
-        k = key.key or secrets.token_urlsafe(48)
+        k = key.key
+        if not k:
+            # strip leading -/_ so generated keys are always CLI-argument-safe
+            k = secrets.token_urlsafe(48).lstrip("-_")
+            while len(k) < 24:  # extremely unlikely
+                k = secrets.token_urlsafe(48).lstrip("-_")
         with self._lock:
             self._conn.execute(
                 "INSERT INTO access_keys (key, appid, events) VALUES (?,?,?)",

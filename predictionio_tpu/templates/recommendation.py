@@ -398,3 +398,80 @@ def recommendation_engine() -> Engine:
         {"als": ALSAlgorithm, "": ALSAlgorithm},
         RecommendationServing,
     )
+
+
+# --------------------------------------------------------------------------
+# Evaluation (the BASELINE.json "e2 evaluation workflow" config:
+# k-fold MetricEvaluator over the recommendation engine)
+# --------------------------------------------------------------------------
+
+
+class RatingAlgorithm(ALSAlgorithm):
+    """ALS variant whose predictions are point rating estimates — used by the
+    RMSE evaluation where queries carry ``num=0`` and the actual is an
+    :class:`ActualRating`."""
+
+    def batch_predict(self, model: ALSModel, queries: Sequence[Query]):
+        # during eval the actuals carry the item; the prediction for (user,
+        # item) is the factor dot product.  We return the full user vector
+        # index per query; the metric resolves the item side.
+        return [RatingPrediction(model=model, user=q.user) for q in queries]
+
+    def predict(self, model: ALSModel, query: Query):
+        return RatingPrediction(model=model, user=query.user)
+
+
+@dataclass
+class RatingPrediction:
+    model: ALSModel
+    user: str
+
+
+class RMSEMetric:
+    """Root-mean-squared error over held-out ratings (lower is better).
+
+    Works with :class:`RatingAlgorithm` predictions + :class:`ActualRating`
+    actuals from ``read_eval``."""
+
+    header = "RMSE"
+
+    def calculate(self, ctx, data) -> float:
+        sq, n = 0.0, 0
+        for _, qpa in data:
+            if not qpa:
+                continue
+            # one model per eval set: vectorize the gathers + dot products
+            model = qpa[0][1].model
+            u = model.users.encode([p.user for _, p, _ in qpa])
+            i = model.items.encode([a.item for _, _, a in qpa])
+            r = np.asarray([a.rating for _, _, a in qpa], dtype=np.float64)
+            ok = (u >= 0) & (i >= 0)
+            if not ok.any():
+                continue
+            pred = np.einsum(
+                "nr,nr->n",
+                model.user_factors[u[ok]],
+                model.item_factors[i[ok]],
+            )
+            sq += float(((pred - r[ok]) ** 2).sum())
+            n += int(ok.sum())
+        return float(np.sqrt(sq / n)) if n else float("nan")
+
+    def compare(self, a: float, b: float) -> int:
+        if a == b:
+            return 0
+        return 1 if a < b else -1  # lower RMSE wins
+
+
+def recommendation_evaluation():
+    """Evaluation binding for sweeps over ALS hyperparameters.  Fold count
+    comes from each candidate's ``DataSourceParams.eval_k``."""
+    from ..controller import Evaluation
+
+    engine = Engine(
+        RecommendationDataSource,
+        IdentityPreparator,
+        {"als": RatingAlgorithm, "": RatingAlgorithm},
+        RecommendationServing,
+    )
+    return Evaluation(engine, RMSEMetric())

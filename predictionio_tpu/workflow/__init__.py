@@ -3,6 +3,7 @@
 
 from .model_io import NotPersisted, load_models, save_models
 from .params import WorkflowParams
+from .evaluate import run_evaluation
 from .train import new_instance_id, prepare_deploy, run_train
 
 __all__ = [
@@ -13,4 +14,5 @@ __all__ = [
     "new_instance_id",
     "prepare_deploy",
     "run_train",
+    "run_evaluation",
 ]

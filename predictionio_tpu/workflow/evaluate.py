@@ -1,0 +1,89 @@
+"""Evaluation workflow driver.
+
+`CoreWorkflow.runEvaluation` semantics
+(`/root/reference/core/src/main/scala/io/prediction/workflow/CoreWorkflow.scala:96-150`
++ `EvaluationWorkflow.scala:29-42`): insert an EvaluationInstance, run the
+sweep, record one-liner/HTML/JSON renderings for the dashboard, mark
+EVALCOMPLETED.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from ..controller.base import WorkflowContext
+from ..controller.engine import EngineParams
+from ..controller.evaluation import Evaluation, MetricEvaluatorResult
+from ..controller.fast_eval import FastEvalEngine
+from ..storage.event import format_time, now_utc
+from ..storage.metadata import EvaluationInstance
+from .params import WorkflowParams
+from .train import new_instance_id
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["run_evaluation"]
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    engine_params_list: Optional[Sequence[EngineParams]] = None,
+    ctx: Optional[WorkflowContext] = None,
+    workflow_params: Optional[WorkflowParams] = None,
+    evaluation_class: str = "",
+    engine_params_generator_class: str = "",
+    fast_eval: bool = True,
+) -> tuple[str, MetricEvaluatorResult]:
+    """Run the sweep; returns (evaluation instance id, result)."""
+    ctx = ctx or WorkflowContext(mode="Evaluation")
+    wp = workflow_params or WorkflowParams()
+    md = ctx.storage.get_metadata()
+
+    if engine_params_list is None:
+        # resolve BEFORE inserting the instance record so a missing candidate
+        # list fails cleanly instead of leaving a stuck INIT record
+        candidates = getattr(evaluation, "engine_params_list", None)
+        if candidates is None:
+            raise ValueError(
+                "no engine params candidates: pass engine_params_list, set "
+                ".engine_params_list on the Evaluation, or supply an "
+                "EngineParamsGenerator"
+            )
+        engine_params_list = list(candidates)
+
+    eval_id = new_instance_id()
+    rec = EvaluationInstance(
+        id=eval_id,
+        status="INIT",
+        start_time=format_time(now_utc()),
+        end_time="",
+        evaluation_class=evaluation_class or type(evaluation).__name__,
+        engine_params_generator_class=engine_params_generator_class,
+        batch=wp.batch,
+    )
+    md.evaluation_instance_insert(rec)
+
+    try:
+        rec.status = "EVALUATING"
+        md.evaluation_instance_update(rec)
+        engine = evaluation.engine
+        if fast_eval and not isinstance(engine, FastEvalEngine):
+            engine = FastEvalEngine(engine)
+            evaluation = Evaluation(
+                engine, evaluation.metric, evaluation.metrics,
+                evaluation.output_path,
+            )
+        result = evaluation.run(ctx, engine_params_list, wp)
+        rec.status = "EVALCOMPLETED"
+        rec.end_time = format_time(now_utc())
+        rec.evaluator_results = result.to_one_liner()
+        rec.evaluator_results_html = result.to_html()
+        rec.evaluator_results_json = result.to_json()
+        md.evaluation_instance_update(rec)
+        return eval_id, result
+    except Exception:
+        rec.status = "EVALFAILED"
+        rec.end_time = format_time(now_utc())
+        md.evaluation_instance_update(rec)
+        raise

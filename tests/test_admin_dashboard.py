@@ -1,0 +1,128 @@
+"""Admin API + dashboard tests (reference `AdminAPISpec`, `Dashboard.scala`)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.server import AdminServer, DashboardServer
+from predictionio_tpu.storage import EvaluationInstance
+
+
+def _get(url, raw=False):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        body = r.read().decode()
+        return r.status, body if raw else json.loads(body)
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _delete(url):
+    req = urllib.request.Request(url, method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+@pytest.fixture()
+def admin(storage_memory):
+    s = AdminServer(storage_memory, port=0)
+    s.start_background()
+    yield f"http://127.0.0.1:{s.port}", storage_memory
+    s.stop()
+
+
+def test_admin_root(admin):
+    base, _ = admin
+    status, body = _get(f"{base}/")
+    assert status == 200 and body["status"] == "alive"
+
+
+def test_admin_app_crud(admin):
+    base, storage = admin
+    status, body = _post(f"{base}/cmd/app", {"name": "adminapp"})
+    assert status == 201
+    assert body["name"] == "adminapp" and body["accessKey"]
+    status, apps = _get(f"{base}/cmd/app")
+    assert [a["name"] for a in apps] == ["adminapp"]
+    # duplicate -> 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/cmd/app", {"name": "adminapp"})
+    assert e.value.code == 400
+    # data delete then app delete
+    status, _ = _delete(f"{base}/cmd/app/adminapp/data")
+    assert status == 200
+    status, _ = _delete(f"{base}/cmd/app/adminapp")
+    assert status == 200
+    _, apps = _get(f"{base}/cmd/app")
+    assert apps == []
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _delete(f"{base}/cmd/app/ghost")
+    assert e.value.code == 404
+
+
+def test_admin_missing_name_400(admin):
+    base, _ = admin
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/cmd/app", {})
+    assert e.value.code == 400
+
+
+@pytest.fixture()
+def dashboard(storage_memory):
+    md = storage_memory.get_metadata()
+    md.evaluation_instance_insert(
+        EvaluationInstance(
+            id="ev1", status="EVALCOMPLETED",
+            start_time="2020-01-01T00:00:00Z", end_time="2020-01-01T01:00:00Z",
+            evaluation_class="MyEval", engine_params_generator_class="Gen",
+            evaluator_results="[0.5] RMSE",
+            evaluator_results_html="<html><body>RMSE</body></html>",
+            evaluator_results_json='{"bestScore": 0.5}',
+        )
+    )
+    s = DashboardServer(storage_memory, port=0)
+    s.start_background()
+    yield f"http://127.0.0.1:{s.port}"
+    s.stop()
+
+
+def test_dashboard_index(dashboard):
+    status, body = _get(f"{dashboard}/", raw=True)
+    assert status == 200
+    assert "ev1" in body and "MyEval" in body and "[0.5] RMSE" in body
+
+
+def test_dashboard_drilldown(dashboard):
+    status, txt = _get(
+        f"{dashboard}/engine_instances/ev1/evaluator_results.txt", raw=True
+    )
+    assert status == 200 and txt == "[0.5] RMSE"
+    _, html = _get(
+        f"{dashboard}/engine_instances/ev1/evaluator_results.html", raw=True
+    )
+    assert html.startswith("<html>")
+    _, js = _get(f"{dashboard}/engine_instances/ev1/evaluator_results.json")
+    assert js == {"bestScore": 0.5}
+
+
+def test_dashboard_unknown_404(dashboard):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{dashboard}/engine_instances/nope/evaluator_results.txt")
+    assert e.value.code == 404
+
+
+def test_admin_url_encoded_app_name(admin):
+    base, _ = admin
+    _post(f"{base}/cmd/app", {"name": "my app"})
+    status, _ = _delete(f"{base}/cmd/app/my%20app")
+    assert status == 200
+    _, apps = _get(f"{base}/cmd/app")
+    assert apps == []

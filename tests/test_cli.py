@@ -1,0 +1,195 @@
+"""CLI console tests (reference `console/Console.scala` command surface)."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.cli.main import main
+from predictionio_tpu.storage import DataMap, Event, Storage, reset_storage
+
+
+@pytest.fixture()
+def cli(tmp_path, capsys):
+    s = Storage(env={"PIO_TPU_HOME": str(tmp_path)})
+    reset_storage(s)
+
+    def run(*argv):
+        code = main(list(argv), storage=s)
+        return code, capsys.readouterr().out
+
+    yield run, s, tmp_path
+    reset_storage(None)
+
+
+def test_version(cli):
+    run, *_ = cli
+    code, out = run("version")
+    assert code == 0 and "pio-tpu" in out
+
+
+def test_app_lifecycle(cli):
+    run, s, _ = cli
+    code, out = run("app", "new", "myapp", "--description", "test app")
+    assert code == 0
+    assert "Created app 'myapp'" in out
+    assert "Access key: " in out
+    key = out.split("Access key: ")[1].strip()
+
+    code, out = run("app", "list")
+    assert "myapp" in out
+
+    code, out = run("app", "show", "myapp")
+    assert "myapp" in out and key in out
+
+    # duplicate rejected with a friendly error
+    code, out = run("app", "new", "myapp")
+    assert code == 1 and "already exists" in out
+
+    code, out = run("app", "delete", "myapp")
+    assert code == 0
+    code, out = run("app", "show", "myapp")
+    assert code == 1 and "not found" in out
+
+
+def test_channels(cli):
+    run, s, _ = cli
+    run("app", "new", "capp")
+    code, out = run("app", "channel-new", "capp", "mobile")
+    assert code == 0 and "Created channel" in out
+    code, out = run("app", "show", "capp")
+    assert "mobile" in out
+    code, out = run("app", "channel-new", "capp", "bad name!")
+    assert code == 1
+    code, out = run("app", "channel-delete", "capp", "mobile")
+    assert code == 0
+
+
+def test_accesskey_commands(cli):
+    run, s, _ = cli
+    run("app", "new", "akapp")
+    code, out = run("accesskey", "new", "akapp", "rate", "buy")
+    assert code == 0
+    key = out.split("Access key: ")[1].strip()
+    code, out = run("accesskey", "list", "akapp")
+    assert key in out and "rate,buy" in out
+    code, out = run("accesskey", "delete", key)
+    assert code == 0
+    code, out = run("accesskey", "list", "akapp")
+    assert key not in out
+
+
+def test_data_delete(cli):
+    run, s, _ = cli
+    run("app", "new", "dapp")
+    app = s.get_metadata().app_get_by_name("dapp")
+    es = s.get_event_store()
+    es.insert(Event(event="rate", entity_type="u", entity_id="1",
+                    target_entity_type="i", target_entity_id="2"),
+              app_id=app.id)
+    assert len(list(es.find(app_id=app.id))) == 1
+    code, out = run("app", "data-delete", "dapp")
+    assert code == 0
+    assert len(list(es.find(app_id=app.id))) == 0
+
+
+def test_import_export_roundtrip(cli):
+    run, s, tmp = cli
+    run("app", "new", "ioapp")
+    app = s.get_metadata().app_get_by_name("ioapp")
+    src = tmp / "events.jsonl"
+    events = [
+        {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": i},
+         "eventTime": f"2020-01-0{i+1}T00:00:00.000Z"}
+        for i in range(3)
+    ]
+    src.write_text("\n".join(json.dumps(e) for e in events))
+    code, out = run("import", "--appid", str(app.id), "--input", str(src))
+    assert code == 0 and "Imported 3 events" in out
+    dst = tmp / "out.jsonl"
+    code, out = run("export", "--appid", str(app.id), "--output", str(dst))
+    assert code == 0 and "Exported 3 events" in out
+    lines = [json.loads(line) for line in dst.read_text().splitlines()]
+    assert [e["entityId"] for e in lines] == ["u0", "u1", "u2"]
+
+
+def test_status(cli):
+    run, *_ = cli
+    code, out = run("status")
+    assert code == 0
+    assert "Storage: OK" in out
+
+
+def test_train_and_deploy_via_cli(cli, monkeypatch):
+    run, s, tmp = cli
+    run("app", "new", "cliapp")
+    app = s.get_metadata().app_get_by_name("cliapp")
+    es = s.get_event_store()
+    rng = np.random.default_rng(0)
+    for u in range(6):
+        for i in rng.choice(8, size=4, replace=False):
+            es.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                      target_entity_type="item", target_entity_id=f"i{i}",
+                      properties=DataMap({"rating": float(rng.integers(1, 6))})),
+                app_id=app.id,
+            )
+    variant = {
+        "id": "cli-test",
+        "engineFactory":
+            "predictionio_tpu.templates.recommendation.recommendation_engine",
+        "datasource": {"params": {"appName": "cliapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 4, "numIterations": 2, "lambda": 0.1}}
+        ],
+    }
+    ej = tmp / "engine.json"
+    ej.write_text(json.dumps(variant))
+    code, out = run("train", "--engine-json", str(ej))
+    assert code == 0 and "Training completed" in out
+    iid = out.strip().split()[-1]
+    rec = s.get_metadata().engine_instance_get(iid)
+    assert rec.status == "COMPLETED"
+    assert rec.engine_id == "cli-test"
+
+
+def test_train_missing_factory_errors(cli, tmp_path):
+    run, s, tmp = cli
+    ej = tmp / "bad.json"
+    ej.write_text(json.dumps({"datasource": {}}))
+    with pytest.raises(ValueError, match="engineFactory"):
+        run("train", "--engine-json", str(ej))
+
+
+def test_eval_via_cli(cli, tmp_path, monkeypatch):
+    run, s, tmp = cli
+    monkeypatch.chdir(tmp)
+    # build a tiny evaluation module on the fly
+    mod = tmp / "cli_eval_mod.py"
+    mod.write_text(
+        "from predictionio_tpu.controller import (Engine, EngineParams,\n"
+        "    Evaluation, AverageMetric)\n"
+        "import sys, os\n"
+        "sys.path.insert(0, os.path.dirname(__file__))\n"
+        "sys.path.insert(0, '/root/repo/tests')\n"
+        "from fixtures import DataSource0, Preparator0, Algo0, Serving0, IdParams\n"
+        "class M(AverageMetric):\n"
+        "    def calculate_point(self, q, p, a):\n"
+        "        return float(p.algo_id)\n"
+        "def make_eval():\n"
+        "    e = Engine(DataSource0, Preparator0, {'a0': Algo0}, Serving0)\n"
+        "    return Evaluation(e, M(), output_path=None)\n"
+        "class Gen:\n"
+        "    engine_params_list = [\n"
+        "        EngineParams(algorithms=[('a0', IdParams(id=i))])\n"
+        "        for i in (2, 7)]\n"
+    )
+    monkeypatch.syspath_prepend(str(tmp))
+    code, out = run("eval", "cli_eval_mod.make_eval", "cli_eval_mod.Gen")
+    assert code == 0
+    assert "[7.0]" in out
+    assert "Evaluation completed" in out

@@ -1,0 +1,292 @@
+"""Metric / MetricEvaluator / FastEvalEngine / run_evaluation tests
+(reference `MetricTest`, `MetricEvaluatorTest`, `FastEvalEngineTest`,
+`EvaluationWorkflowTest`)."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    Engine,
+    EngineParams,
+    Evaluation,
+    FastEvalEngine,
+    MetricEvaluator,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    WorkflowContext,
+    ZeroMetric,
+)
+from predictionio_tpu.workflow import run_evaluation
+
+from fixtures import (
+    Algo0,
+    DataSource0,
+    IdParams,
+    Preparator0,
+    Serving0,
+)
+
+
+@pytest.fixture()
+def ctx(storage_memory):
+    return WorkflowContext(storage=storage_memory, mode="Evaluation")
+
+
+def _data(*vals_per_set):
+    """Build EvalData from per-set point values (q=p=a=value)."""
+    return [
+        (None, [(v, v, v) for v in vals])
+        for vals in vals_per_set
+    ]
+
+
+class QMetric(AverageMetric):
+    def calculate_point(self, q, p, a):
+        return float(q)
+
+
+class OptQMetric(OptionAverageMetric):
+    def calculate_point(self, q, p, a):
+        return float(q) if q is not None and q >= 0 else None
+
+
+def test_average_metric(ctx):
+    m = QMetric()
+    assert m.calculate(ctx, _data([1, 2, 3], [4])) == 2.5
+
+
+def test_average_metric_rejects_none(ctx):
+    class BadMetric(AverageMetric):
+        def calculate_point(self, q, p, a):
+            return None
+
+    with pytest.raises(ValueError, match="Option"):
+        BadMetric().calculate(ctx, _data([1]))
+
+
+def test_option_average_skips_none(ctx):
+    m = OptQMetric()
+    assert m.calculate(ctx, _data([1, -5, 3])) == 2.0
+    assert math.isnan(m.calculate(ctx, _data([-1, -2])))
+
+
+def test_stdev_metric(ctx):
+    class SM(StdevMetric):
+        def calculate_point(self, q, p, a):
+            return float(q)
+
+    vals = [1.0, 2.0, 3.0, 4.0]
+    assert SM().calculate(ctx, _data(vals)) == pytest.approx(np.std(vals))
+
+
+def test_option_stdev(ctx):
+    class SM(OptionStdevMetric):
+        def calculate_point(self, q, p, a):
+            return float(q) if q > 0 else None
+
+    assert SM().calculate(ctx, _data([1.0, -9, 3.0])) == pytest.approx(1.0)
+
+
+def test_sum_metric(ctx):
+    class S(SumMetric):
+        def calculate_point(self, q, p, a):
+            return float(q)
+
+    assert S().calculate(ctx, _data([1, 2], [3])) == 6.0
+
+
+def test_zero_metric(ctx):
+    assert ZeroMetric().calculate(ctx, _data([1, 2])) == 0.0
+
+
+def test_compare_default_larger_better():
+    m = QMetric()
+    assert m.compare(2.0, 1.0) > 0
+    assert m.compare(1.0, 2.0) < 0
+    assert m.compare(1.0, 1.0) == 0
+
+
+# ---------------------------------------------------------------------------
+# MetricEvaluator argmax (EvaluationWorkflowTest.scala:10,36)
+# ---------------------------------------------------------------------------
+
+
+class AlgoIdMetric(AverageMetric):
+    """Scores candidates by the algo id stamped into predictions."""
+
+    def calculate_point(self, q, p, a):
+        return float(p.algo_id)
+
+
+def _engine():
+    return Engine(DataSource0, Preparator0, {"a0": Algo0}, Serving0)
+
+
+def _params(algo_id):
+    return EngineParams(
+        data_source=("", IdParams(id=1)),
+        preparator=("", IdParams(id=2)),
+        algorithms=[("a0", IdParams(id=algo_id))],
+        serving=("", IdParams(id=4)),
+    )
+
+
+def test_metric_evaluator_argmax(ctx, tmp_path):
+    candidates = [_params(i) for i in (3, 9, 5)]
+    ev = MetricEvaluator(AlgoIdMetric(), [ZeroMetric()],
+                         output_path=str(tmp_path / "best.json"))
+    result = ev.evaluate(ctx, _engine(), candidates)
+    assert result.best_score == 9.0
+    assert result.best_index == 1
+    assert result.best_engine_params.algorithms[0][1].id == 9
+    assert len(result.results) == 3
+    assert result.other_metric_headers == ["ZeroMetric"]
+    # best.json written as an engine-variant-shaped doc
+    doc = json.loads((tmp_path / "best.json").read_text())
+    assert doc["algorithms"][0]["params"]["id"] == 9
+    # renderings
+    assert "9.0" in result.to_one_liner()
+    assert "AlgoIdMetric" in result.to_html()
+    assert json.loads(result.to_json())["bestScore"] == 9.0
+
+
+def test_metric_evaluator_loss_ordering(ctx):
+    class Loss(AlgoIdMetric):
+        def compare(self, a, b):
+            return -super().compare(a, b)  # smaller is better
+
+    ev = MetricEvaluator(Loss(), output_path=None)
+    result = ev.evaluate(ctx, _engine(), [_params(i) for i in (3, 9, 5)])
+    assert result.best_score == 3.0
+
+
+def test_metric_evaluator_empty_candidates(ctx):
+    with pytest.raises(ValueError):
+        MetricEvaluator(AlgoIdMetric(), output_path=None).evaluate(
+            ctx, _engine(), []
+        )
+
+
+# ---------------------------------------------------------------------------
+# FastEvalEngine prefix caching (FastEvalEngineTest.scala:15,79,131)
+# ---------------------------------------------------------------------------
+
+
+def test_fast_eval_reuses_prefixes(ctx):
+    e = FastEvalEngine(_engine())
+    # 3 candidates sharing ds+prep, differing only in algo params
+    candidates = [_params(i) for i in (1, 2, 3)]
+    for ep in candidates:
+        e.eval(ctx, ep)
+    assert e.stats == {"ds": 1, "prep": 1, "algo": 3}
+
+
+def test_fast_eval_distinct_ds(ctx):
+    e = FastEvalEngine(_engine())
+    a = _params(1)
+    b = EngineParams(
+        data_source=("", IdParams(id=99)),
+        preparator=("", IdParams(id=2)),
+        algorithms=[("a0", IdParams(id=1))],
+        serving=("", IdParams(id=4)),
+    )
+    e.eval(ctx, a)
+    e.eval(ctx, b)
+    assert e.stats["ds"] == 2
+    assert e.stats["prep"] == 2
+
+
+def test_fast_eval_same_params_full_hit(ctx):
+    e = FastEvalEngine(_engine())
+    e.eval(ctx, _params(1))
+    e.eval(ctx, _params(1))
+    assert e.stats == {"ds": 1, "prep": 1, "algo": 1}
+
+
+def test_fast_eval_results_match_plain_engine(ctx):
+    plain = _engine().eval(ctx, _params(7))
+    fast = FastEvalEngine(_engine()).eval(ctx, _params(7))
+    assert [(ei.id, qpa) for ei, qpa in plain] == [
+        (ei.id, qpa) for ei, qpa in fast
+    ]
+
+
+# ---------------------------------------------------------------------------
+# run_evaluation workflow
+# ---------------------------------------------------------------------------
+
+
+def test_run_evaluation_lifecycle(ctx, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    evaluation = Evaluation(_engine(), AlgoIdMetric())
+    eval_id, result = run_evaluation(
+        evaluation, [_params(i) for i in (3, 9)], ctx=ctx
+    )
+    assert result.best_score == 9.0
+    rec = ctx.storage.get_metadata().evaluation_instance_get(eval_id)
+    assert rec.status == "EVALCOMPLETED"
+    assert "9.0" in rec.evaluator_results
+    assert rec.evaluator_results_html.startswith("<html>")
+    assert json.loads(rec.evaluator_results_json)["bestScore"] == 9.0
+    assert (tmp_path / "best.json").exists()
+    assert [e.id for e in
+            ctx.storage.get_metadata().evaluation_instance_get_completed()] == [
+        eval_id
+    ]
+
+
+def test_run_evaluation_failure_marks_failed(ctx):
+    class Boom(AlgoIdMetric):
+        def calculate(self, ctx, data):
+            raise RuntimeError("boom")
+
+    evaluation = Evaluation(_engine(), Boom(), output_path=None)
+    with pytest.raises(RuntimeError):
+        run_evaluation(evaluation, [_params(1)], ctx=ctx)
+    assert (
+        ctx.storage.get_metadata().evaluation_instance_get_completed() == []
+    )
+
+
+def test_nan_candidate_never_wins(ctx):
+    """A NaN score from an early candidate must not freeze the argmax."""
+    class SometimesNan(AlgoIdMetric):
+        def calculate(self, ctx, data):
+            v = super().calculate(ctx, data)
+            return float("nan") if v == 3.0 else v
+
+    ev = MetricEvaluator(SometimesNan(), output_path=None)
+    result = ev.evaluate(ctx, _engine(), [_params(i) for i in (3, 5, 4)])
+    assert result.best_score == 5.0
+    # all-NaN: keeps first candidate, no crash
+    class AllNan(AlgoIdMetric):
+        def calculate(self, ctx, data):
+            return float("nan")
+
+    result = MetricEvaluator(AllNan(), output_path=None).evaluate(
+        ctx, _engine(), [_params(1), _params(2)]
+    )
+    assert result.best_index == 0
+
+
+def test_run_evaluation_no_candidates_clean_error(ctx):
+    evaluation = Evaluation(_engine(), AlgoIdMetric(), output_path=None)
+    with pytest.raises(ValueError, match="candidates"):
+        run_evaluation(evaluation, None, ctx=ctx)
+    # no stuck INIT record was left behind
+    assert ctx.storage.get_metadata().evaluation_instance_get_completed() == []
+
+
+def test_evaluation_carries_own_candidates(ctx):
+    evaluation = Evaluation(
+        _engine(), AlgoIdMetric(), output_path=None,
+        engine_params_list=[_params(4), _params(6)],
+    )
+    _, result = run_evaluation(evaluation, None, ctx=ctx)
+    assert result.best_score == 6.0

@@ -1,0 +1,264 @@
+"""Event Server HTTP tests (reference `EventServiceSpec` + route semantics
+from `api/EventAPI.scala`)."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.server.event_server import EventServer, EventServerConfig
+from predictionio_tpu.storage import AccessKey
+
+
+@pytest.fixture()
+def srv(storage_memory):
+    md = storage_memory.get_metadata()
+    app = md.app_insert("evapp")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    restricted = md.access_key_insert(
+        AccessKey(key="", appid=app.id, events=["rate"])
+    )
+    md.channel_insert("mobile", app.id)
+    server = EventServer(storage_memory, EventServerConfig(port=0))
+    server.start_background()
+    base = f"http://127.0.0.1:{server.config.port}"
+    yield base, key, restricted, app, storage_memory
+    server.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _delete(url):
+    req = urllib.request.Request(url, method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+RATE = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 4.5},
+    "eventTime": "2020-06-01T00:00:00.000Z",
+}
+
+
+def test_root_alive(srv):
+    base, *_ = srv
+    status, body = _get(f"{base}/")
+    assert status == 200 and body["status"] == "alive"
+
+
+def test_post_and_get_event(srv):
+    base, key, *_ = srv
+    status, body = _post(f"{base}/events.json?accessKey={key}", RATE)
+    assert status == 201
+    eid = body["eventId"]
+    status, got = _get(f"{base}/events/{eid}.json?accessKey={key}")
+    assert status == 200
+    assert got["event"] == "rate"
+    assert got["entityId"] == "u1"
+    assert got["properties"] == {"rating": 4.5}
+    assert got["eventTime"] == "2020-06-01T00:00:00.000Z"
+
+
+def test_missing_key_401(srv):
+    base, *_ = srv
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/events.json", RATE)
+    assert e.value.code == 401
+
+
+def test_bad_key_401(srv):
+    base, *_ = srv
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/events.json?accessKey=WRONG", RATE)
+    assert e.value.code == 401
+
+
+def test_invalid_event_400(srv):
+    base, key, *_ = srv
+    bad = {**RATE, "event": "$unset", "properties": {}}
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/events.json?accessKey={key}", bad)
+    assert e.value.code == 400
+
+
+def test_event_whitelist_enforced(srv):
+    base, _, restricted, *_ = srv
+    status, _ = _post(f"{base}/events.json?accessKey={restricted}", RATE)
+    assert status == 201
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/events.json?accessKey={restricted}", {**RATE, "event": "buy"})
+    assert e.value.code == 401
+
+
+def test_channel_isolation(srv):
+    base, key, _, app, storage = srv
+    _post(f"{base}/events.json?accessKey={key}&channel=mobile", RATE)
+    # default channel has no events
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/events.json?accessKey={key}")
+    assert e.value.code == 404
+    status, evs = _get(f"{base}/events.json?accessKey={key}&channel=mobile")
+    assert status == 200 and len(evs) == 1
+
+
+def test_unknown_channel_401(srv):
+    base, key, *_ = srv
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/events.json?accessKey={key}&channel=nope", RATE)
+    assert e.value.code == 401
+
+
+def test_get_events_filters(srv):
+    base, key, *_ = srv
+    for i, (name, etype) in enumerate(
+        [("rate", "user"), ("buy", "user"), ("$set", "item")]
+    ):
+        ev = {
+            "event": name,
+            "entityType": etype,
+            "entityId": f"e{i}",
+            "eventTime": f"2020-06-0{i+1}T00:00:00.000Z",
+        }
+        if name != "$set":
+            ev["targetEntityType"] = "item"
+            ev["targetEntityId"] = "i1"
+        else:
+            ev["properties"] = {"a": 1}
+        _post(f"{base}/events.json?accessKey={key}", ev)
+    _, evs = _get(f"{base}/events.json?accessKey={key}&event=rate&event=buy")
+    assert {e["event"] for e in evs} == {"rate", "buy"}
+    _, evs = _get(f"{base}/events.json?accessKey={key}&entityType=item")
+    assert len(evs) == 1
+    _, evs = _get(f"{base}/events.json?accessKey={key}&limit=1&reversed=true")
+    assert len(evs) == 1 and evs[0]["event"] == "$set"
+    _, evs = _get(
+        f"{base}/events.json?accessKey={key}&untilTime=2020-06-02T00:00:00Z"
+    )
+    assert len(evs) == 1 and evs[0]["event"] == "rate"
+    # tri-state target filter: none
+    _, evs = _get(f"{base}/events.json?accessKey={key}&targetEntityType=none")
+    assert {e["event"] for e in evs} == {"$set"}
+
+
+def test_delete_event(srv):
+    base, key, *_ = srv
+    _, body = _post(f"{base}/events.json?accessKey={key}", RATE)
+    eid = body["eventId"]
+    status, _ = _delete(f"{base}/events/{eid}.json?accessKey={key}")
+    assert status == 200
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/events/{eid}.json?accessKey={key}")
+    assert e.value.code == 404
+
+
+def test_batch_events(srv):
+    base, key, *_ = srv
+    batch = [RATE, {**RATE, "event": ""}, {**RATE, "entityId": "u2"}]
+    status, results = _post(f"{base}/batch/events.json?accessKey={key}", batch)
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 400, 201]
+
+
+def test_stats_json(srv):
+    base, key, *_ = srv
+    _post(f"{base}/events.json?accessKey={key}", RATE)
+    status, body = _get(f"{base}/stats.json?accessKey={key}")
+    assert status == 200
+    life = body["lifetime"]
+    assert any(
+        c["status"] == 201 and c["count"] >= 1 for c in life["statusCount"]
+    )
+    assert any(e["event"] == "rate" for e in life["eventCount"])
+
+
+def test_webhook_segmentio(srv):
+    base, key, *_ = srv
+    payload = {
+        "type": "identify",
+        "userId": "seg-user-1",
+        "timestamp": "2020-01-01T00:00:00Z",
+        "traits": {"email": "x@y.z"},
+    }
+    status, body = _post(f"{base}/webhooks/segmentio.json?accessKey={key}", payload)
+    assert status == 201
+    _, got = _get(f"{base}/events/{body['eventId']}.json?accessKey={key}")
+    assert got["event"] == "identify"
+    assert got["entityId"] == "seg-user-1"
+    assert got["properties"]["traits"] == {"email": "x@y.z"}
+
+
+def test_webhook_segmentio_unknown_type_400(srv):
+    base, key, *_ = srv
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/webhooks/segmentio.json?accessKey={key}",
+              {"type": "track", "userId": "x"})
+    assert e.value.code == 400
+
+
+def test_webhook_mailchimp_form(srv):
+    base, key, *_ = srv
+    form = {
+        "type": "subscribe",
+        "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98",
+        "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com",
+        "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp",
+        "data[merges][LNAME]": "API",
+        "data[merges][INTERESTS]": "Group1,Group2",
+        "data[ip_opt]": "10.20.10.30",
+        "data[ip_signup]": "10.20.10.30",
+    }
+    req = urllib.request.Request(
+        f"{base}/webhooks/mailchimp.form?accessKey={key}",
+        data=urllib.parse.urlencode(form).encode(),
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+        eid = json.loads(r.read().decode())["eventId"]
+    _, got = _get(f"{base}/events/{eid}.json?accessKey={key}")
+    assert got["event"] == "subscribe"
+    assert got["targetEntityId"] == "a6b5da1054"
+    assert got["eventTime"].startswith("2009-03-26T21:35:57")
+
+
+def test_webhook_unknown_404(srv):
+    base, key, *_ = srv
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/webhooks/nope.json?accessKey={key}", {})
+    assert e.value.code == 404
+
+
+def test_non_object_body_400(srv):
+    base, key, *_ = srv
+    for payload in (b"[1,2]", b'"hello"', b"42"):
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400, payload

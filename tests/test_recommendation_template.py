@@ -221,3 +221,36 @@ def test_batch_predict_honors_filters(ctx):
         assert [s.item for s in b.item_scores] == [
             s.item for s in single.item_scores
         ]
+
+
+def test_rmse_evaluation_sweep(ctx, tmp_path, monkeypatch):
+    """k-fold RMSE sweep over ALS hyperparameters: better rank/iters should
+    win, best.json written (the BASELINE 'e2 evaluation workflow' config)."""
+    import json
+    from predictionio_tpu.controller import EngineParams
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithmParams,
+        recommendation_evaluation,
+    )
+    from predictionio_tpu.workflow import run_evaluation
+
+    monkeypatch.chdir(tmp_path)
+    evaluation = recommendation_evaluation()
+    ds = DataSourceParams(app_name="recapp", eval_k=2)
+    candidates = [
+        EngineParams(
+            data_source=("", ds),
+            algorithms=[("als", ALSAlgorithmParams(
+                rank=r, num_iterations=it, lam=0.1, seed=3))],
+        )
+        for r, it in [(2, 1), (8, 8)]
+    ]
+    eval_id, result = run_evaluation(evaluation, candidates, ctx=ctx)
+    assert result.metric_header == "RMSE"
+    scores = [s for _, s, _ in result.results]
+    assert all(np.isfinite(s) for s in scores)
+    # the stronger configuration must achieve lower error
+    assert result.best_engine_params.algorithms[0][1].rank == 8
+    assert result.best_score == min(scores)
+    doc = json.loads((tmp_path / "best.json").read_text())
+    assert doc["algorithms"][0]["params"]["rank"] == 8

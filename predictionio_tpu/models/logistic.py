@@ -1,0 +1,81 @@
+"""Multiclass logistic regression on TPU.
+
+The BASELINE.json classification config calls for "NaiveBayes -> TPU
+logistic": a softmax classifier trained by full-batch gradient descent under
+``jit`` (``lax.scan`` over steps — one compiled program for the whole
+training run, no per-step Python dispatch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+__all__ = ["LogisticModel", "train_logistic"]
+
+
+@dataclass
+class LogisticModel:
+    weights: np.ndarray  # [F, C]
+    bias: np.ndarray     # [C]
+    labels: np.ndarray   # [C]
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        logits = np.atleast_2d(x) @ self.weights + self.bias
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        logits = np.atleast_2d(x) @ self.weights + self.bias
+        return self.labels[np.argmax(logits, axis=-1)]
+
+
+def train_logistic(
+    features: np.ndarray,
+    labels: np.ndarray,
+    lr: float = 0.1,
+    steps: int = 300,
+    l2: float = 1e-4,
+) -> LogisticModel:
+    x = jnp.asarray(features, jnp.float32)
+    classes, y = np.unique(labels, return_inverse=True)
+    yj = jnp.asarray(y)
+    n_f, n_c = x.shape[1], len(classes)
+
+    params = {
+        "w": jnp.zeros((n_f, n_c), jnp.float32),
+        "b": jnp.zeros((n_c,), jnp.float32),
+    }
+    opt = optax.adam(lr)
+
+    def loss_fn(p):
+        logits = x @ p["w"] + p["b"]
+        ll = jnp.take_along_axis(
+            jax.nn.log_softmax(logits), yj[:, None], axis=1
+        )
+        return -ll.mean() + l2 * (p["w"] ** 2).sum()
+
+    @jax.jit
+    def fit(p):
+        state = opt.init(p)
+
+        def step(carry, _):
+            p, state = carry
+            g = jax.grad(loss_fn)(p)
+            updates, state = opt.update(g, state)
+            p = optax.apply_updates(p, updates)
+            return (p, state), None
+
+        (p, _), _ = jax.lax.scan(step, (p, state), None, length=steps)
+        return p
+
+    p = fit(params)
+    return LogisticModel(
+        weights=np.asarray(p["w"]),
+        bias=np.asarray(p["b"]),
+        labels=classes,
+    )

@@ -33,7 +33,7 @@ from typing import Any, Callable, Optional
 from ..controller.base import WorkflowContext
 from .http_base import HTTPServerBase, JsonRequestHandler
 from ..controller.engine import Engine, EngineParams
-from ..workflow.train import prepare_deploy
+from ..workflow.train import prepare_deploy_components
 
 logger = logging.getLogger(__name__)
 
@@ -111,11 +111,9 @@ class EngineServer(HTTPServerBase):
 
     # -- lifecycle --------------------------------------------------------
     def _load(self, instance_id: str) -> None:
-        models = prepare_deploy(
+        algorithms, models, serving = prepare_deploy_components(
             self.engine, self.engine_params, instance_id, ctx=self.ctx
         )
-        algorithms = self.engine._algorithms(self.engine_params)
-        serving = self.engine._serving(self.engine_params)
         with self._lock:
             self.models = models
             self.algorithms = algorithms

@@ -1,0 +1,37 @@
+"""Shared template helpers."""
+
+from __future__ import annotations
+
+__all__ = ["DeviceTableMixin"]
+
+
+class DeviceTableMixin:
+    """Lazy one-time host->device transfer of model factor tables, cached on
+    the model instance (serving hot-path: every scoring call reuses the
+    device-resident arrays)."""
+
+    def _cached_device(self, cache_name: str, source):
+        dev = getattr(self, cache_name, None)
+        if dev is None:
+            import jax.numpy as jnp
+
+            dev = jnp.asarray(source)
+            setattr(self, cache_name, dev)
+        return dev
+
+    def device_item_factors(self):
+        return self._cached_device("_dev_item_factors", self.item_factors)
+
+    def device_item_factors_normalized(self):
+        """Row-normalized table for cosine scoring — normalized once, not
+        per request."""
+        dev = getattr(self, "_dev_item_factors_norm", None)
+        if dev is None:
+            import jax.numpy as jnp
+
+            table = self.device_item_factors()
+            dev = table / (
+                jnp.linalg.norm(table, axis=-1, keepdims=True) + 1e-9
+            )
+            self._dev_item_factors_norm = dev
+        return dev

@@ -1,0 +1,158 @@
+"""Classification engine template.
+
+Capability parity with `/root/reference/examples/scala-parallel-
+classification/` (NaiveBayes via MLlib, plus the add-algorithm variant's
+second algorithm demonstrating multi-algo engines).  Per BASELINE.json the
+TPU build pairs NaiveBayes with a **TPU logistic regression** as the second
+algorithm.
+
+Data model parity with the template's quickstart: user entities carry
+``$set`` properties ``attr0..attrN`` (numeric features) and ``label``
+(reference `custom-attributes` variant generalizes attribute names —
+supported here via ``attrs`` / ``label_property`` params).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+    WorkflowContext,
+)
+from ..models.logistic import train_logistic
+from ..models.naive_bayes import train_naive_bayes
+from .recommendation import _resolve_app_id
+
+
+@dataclass(frozen=True)
+class Query:
+    features: tuple[float, ...]
+
+    @staticmethod
+    def from_json(d: dict) -> "Query":
+        if "features" in d:
+            return Query(features=tuple(float(x) for x in d["features"]))
+        # quickstart wire format: {"attr0": 2, "attr1": 0, "attr2": 0} —
+        # attrN keys sort numerically (attr10 after attr9); custom attribute
+        # names (custom-attributes variant) are taken in the JSON object's
+        # own key order, which must match the configured `attrs` order
+        keys = list(d)
+        if all(re.fullmatch(r"attr\d+", k) for k in keys):
+            keys.sort(key=lambda k: int(k[4:]))
+        return Query(features=tuple(float(d[k]) for k in keys))
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    label: Any
+
+    def to_json(self) -> dict:
+        return {"label": self.label}
+
+
+@dataclass(frozen=True)
+class ClassificationDataSourceParams(Params):
+    app_name: str = ""
+    app_id: int = -1
+    entity_type: str = "user"
+    attrs: tuple[str, ...] = ("attr0", "attr1", "attr2")
+    label_property: str = "label"
+
+
+@dataclass
+class ClassificationTrainingData:
+    features: np.ndarray  # [n, F] float32
+    labels: np.ndarray    # [n] object/str
+
+    def sanity_check(self) -> None:
+        if len(self.labels) == 0:
+            raise ValueError("no labeled entities found")
+        if len(np.unique(self.labels)) < 2:
+            raise ValueError("need at least two classes to train")
+
+
+class ClassificationDataSource(DataSource):
+    params_class = ClassificationDataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> ClassificationTrainingData:
+        p = self.params
+        app_id = _resolve_app_id(ctx, p)
+        es = ctx.storage.get_event_store()
+        props = es.aggregate_properties_of(
+            app_id=app_id, entity_type=p.entity_type,
+            required=list(p.attrs) + [p.label_property],
+        )
+        feats, labels = [], []
+        for entity_id, pm in props.items():
+            feats.append([float(pm.get(a)) for a in p.attrs])
+            labels.append(str(pm.get(p.label_property)))
+        return ClassificationTrainingData(
+            features=np.asarray(feats, np.float32) if feats else
+            np.zeros((0, len(p.attrs)), np.float32),
+            labels=np.asarray(labels, dtype=object),
+        )
+
+
+@dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    __param_aliases__ = {"lambda": "lam"}
+
+    lam: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    """(reference `NaiveBayesAlgorithm.scala:16-28`)"""
+
+    params_class = NaiveBayesParams
+
+    def train(self, ctx, data: ClassificationTrainingData):
+        return train_naive_bayes(data.features, data.labels, lam=self.params.lam)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        label = model.predict(np.asarray(query.features, np.float32))[0]
+        return PredictedResult(label=label)
+
+
+@dataclass(frozen=True)
+class LogisticParams(Params):
+    lr: float = 0.1
+    steps: int = 300
+    l2: float = 1e-4
+
+
+class LogisticAlgorithm(Algorithm):
+    """TPU logistic regression (BASELINE.json: 'NaiveBayes -> TPU logistic';
+    stands in for the reference add-algorithm RandomForest as the
+    multi-algorithm demo)."""
+
+    params_class = LogisticParams
+
+    def train(self, ctx, data: ClassificationTrainingData):
+        p = self.params
+        return train_logistic(
+            data.features, data.labels, lr=p.lr, steps=p.steps, l2=p.l2,
+        )
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        label = model.predict(np.asarray(query.features, np.float32))[0]
+        return PredictedResult(label=label)
+
+
+def classification_engine() -> Engine:
+    return Engine(
+        ClassificationDataSource,
+        IdentityPreparator,
+        {"naive": NaiveBayesAlgorithm, "logistic": LogisticAlgorithm,
+         "": NaiveBayesAlgorithm},
+        FirstServing,
+    )

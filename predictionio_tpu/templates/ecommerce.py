@@ -1,0 +1,232 @@
+"""E-commerce recommendation engine template.
+
+Capability parity with `/root/reference/examples/scala-parallel-
+ecommercerecommendation/` (``ECommAlgorithm``): implicit ALS over view
+(+ optional buy/rate) events, with **predict-time event-store reads** —
+the serving path consults the live event store for
+
+* the user's already-seen items (``unseen_only`` + ``seen_events`` params,
+  reference `ALSAlgorithm.scala:160-192`), and
+* the latest ``$set`` on the ``constraint``/``unavailableItems`` entity
+  (reference `:194-215`),
+
+then merges both with the query blacklist before the top-k matmul.  This is
+the template that demonstrates low-latency `LEventStore` access from
+``predict`` (SURVEY §2.6).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    ModelPlacement,
+    Params,
+    WorkflowContext,
+)
+from ..models.als import ALSConfig, train_als
+from ..ops.topk import topk_scores
+from ..storage.columnar import events_to_frame
+from ._common import DeviceTableMixin
+from .recommendation import ItemScore, PredictedResult, Query, _resolve_app_id
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ECommDataSourceParams(Params):
+    app_name: str = ""
+    app_id: int = -1
+    view_events: tuple[str, ...] = ("view",)
+    rating_property: Optional[str] = None  # train-with-rate-event variant
+
+
+@dataclass
+class ECommTrainingData:
+    ratings: Any
+    items: dict[str, dict]
+    app_id: int = -1
+
+    def sanity_check(self) -> None:
+        if len(self.ratings) == 0:
+            raise ValueError("no view events found")
+
+
+class ECommDataSource(DataSource):
+    params_class = ECommDataSourceParams
+
+    def read_training(self, ctx: WorkflowContext) -> ECommTrainingData:
+        p = self.params
+        app_id = _resolve_app_id(ctx, p)
+        es = ctx.storage.get_event_store()
+        if hasattr(es, "find_columnar"):
+            frame = es.find_columnar(
+                app_id=app_id, entity_type="user",
+                event_names=list(p.view_events),
+                float_property=p.rating_property,
+            )
+        else:
+            frame = events_to_frame(
+                es.find(app_id=app_id, entity_type="user",
+                        event_names=list(p.view_events))
+            )
+        ratings = frame.to_ratings(
+            rating_property=p.rating_property,
+            dedup="last" if p.rating_property else "sum",
+        )
+        items = {
+            k: dict(v.fields)
+            for k, v in es.aggregate_properties_of(
+                app_id=app_id, entity_type="item"
+            ).items()
+        }
+        return ECommTrainingData(ratings=ratings, items=items, app_id=app_id)
+
+
+@dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    __param_aliases__ = {"lambda": "lam"}
+
+    rank: int = 10
+    num_iterations: int = 20
+    lam: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+    unseen_only: bool = False
+    seen_events: tuple[str, ...] = ("view", "buy")
+
+
+@dataclass
+class ECommModel(DeviceTableMixin):
+    user_factors: np.ndarray
+    item_factors: np.ndarray
+    users: Any
+    items: Any
+    item_props: dict[str, dict]
+    app_id: int
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = ECommAlgorithmParams
+    placement = ModelPlacement.DEVICE_SHARDED
+
+    def train(self, ctx: WorkflowContext, data: ECommTrainingData) -> ECommModel:
+        p = self.params
+        implicit = True
+        factors = train_als(
+            data.ratings,
+            cfg=ALSConfig(
+                rank=p.rank, num_iterations=p.num_iterations, lam=p.lam,
+                implicit=implicit, alpha=p.alpha, seed=p.seed,
+            ),
+            mesh=ctx.mesh,
+        )
+        self._ctx = ctx  # predict-time event-store access
+        return ECommModel(
+            user_factors=factors.user_factors,
+            item_factors=factors.item_factors,
+            users=data.ratings.users,
+            items=data.ratings.items,
+            item_props=data.items,
+            app_id=data.app_id,
+        )
+
+    # -- predict-time event store reads ------------------------------------
+    def _event_store(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx is None:
+            from ..storage.registry import get_storage
+
+            return get_storage().get_event_store()
+        return ctx.storage.get_event_store()
+
+    def _seen_items(self, model: ECommModel, user: str) -> set[str]:
+        """The user's already-seen items (reference `:160-192`)."""
+        p = self.params
+        try:
+            events = self._event_store().find(
+                app_id=model.app_id,
+                entity_type="user",
+                entity_id=user,
+                event_names=list(p.seen_events),
+            )
+            return {
+                e.target_entity_id for e in events if e.target_entity_id
+            }
+        except Exception as e:
+            logger.error("error reading seen events: %s", e)
+            return set()
+
+    def _unavailable_items(self, model: ECommModel) -> set[str]:
+        """Latest constraint/unavailableItems $set (reference `:194-215`)."""
+        try:
+            pm = self._event_store().aggregate_properties_single_entity(
+                app_id=model.app_id,
+                entity_type="constraint",
+                entity_id="unavailableItems",
+            )
+            if pm is None:
+                return set()
+            return set(pm.get_string_list("items"))
+        except Exception as e:
+            logger.error("error reading unavailableItems: %s", e)
+            return set()
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        uix = model.users.get(query.user)
+        if uix < 0 or query.num <= 0:
+            return PredictedResult(item_scores=())
+        black = set(query.blacklist or ())
+        if self.params.unseen_only:
+            black |= self._seen_items(model, query.user)
+        black |= self._unavailable_items(model)
+
+        n = len(model.items)
+        allowed = np.ones(n, dtype=bool)
+        if query.whitelist:
+            allowed &= np.isin(model.items.ids.astype(str),
+                               np.array(query.whitelist, dtype=str))
+        if query.categories:
+            cats = set(query.categories)
+            has = np.zeros(n, dtype=bool)
+            for item_id, props in model.item_props.items():
+                ix = model.items.get(item_id)
+                if ix >= 0 and cats & set(props.get("categories", [])):
+                    has[ix] = True
+            allowed &= has
+        if black:
+            allowed &= ~np.isin(model.items.ids.astype(str),
+                                np.array(sorted(black), dtype=str))
+        mask = np.where(allowed, 0.0, -np.inf).astype(np.float32)
+        k = min(query.num, n)
+        vals, ixs = topk_scores(
+            np.asarray(model.user_factors[uix], np.float32),
+            model.device_item_factors(), k, bias=mask,
+        )
+        vals, ixs = np.asarray(vals), np.asarray(ixs)
+        ok = np.isfinite(vals)
+        ids = model.items.decode(ixs[ok])
+        return PredictedResult(
+            item_scores=tuple(
+                ItemScore(item=str(i), score=float(s))
+                for i, s in zip(ids, vals[ok])
+            )
+        )
+
+
+def ecommerce_engine() -> Engine:
+    return Engine(
+        ECommDataSource,
+        IdentityPreparator,
+        {"ecomm": ECommAlgorithm, "": ECommAlgorithm},
+        FirstServing,
+    )

@@ -35,6 +35,7 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, topk_scores
 from ..storage.columnar import Ratings, events_to_frame
+from ._common import DeviceTableMixin
 from ..storage.levents import EventStore
 
 
@@ -53,12 +54,15 @@ class Query:
 
     @staticmethod
     def from_json(d: dict) -> "Query":
+        # reference wire format uses camelCase whiteList/blackList
+        wl = d.get("whiteList") or d.get("whitelist")
+        bl = d.get("blackList") or d.get("blacklist")
         return Query(
             user=str(d["user"]),
             num=int(d.get("num", 10)),
             categories=tuple(d["categories"]) if d.get("categories") else None,
-            whitelist=tuple(d["whitelist"]) if d.get("whitelist") else None,
-            blacklist=tuple(d["blacklist"]) if d.get("blacklist") else None,
+            whitelist=tuple(wl) if wl else None,
+            blacklist=tuple(bl) if bl else None,
         )
 
 
@@ -229,7 +233,7 @@ class ALSAlgorithmParams(Params):
 
 
 @dataclass
-class ALSModel:
+class ALSModel(DeviceTableMixin):
     """Factor tables + id dictionaries + item metadata for filtering."""
 
     user_factors: np.ndarray
@@ -244,16 +248,6 @@ class ALSModel:
         if not np.isfinite(self.item_factors).all():
             raise ValueError("item factors contain non-finite values")
 
-    def device_item_factors(self):
-        """Item factor table resident on device — transferred once, then
-        reused by every scoring call (serving hot path)."""
-        dev = getattr(self, "_dev_item_factors", None)
-        if dev is None:
-            import jax.numpy as jnp
-
-            dev = jnp.asarray(self.item_factors)
-            self._dev_item_factors = dev
-        return dev
 
 
 class ALSAlgorithm(Algorithm):

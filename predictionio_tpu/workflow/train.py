@@ -117,8 +117,27 @@ def prepare_deploy(
 ) -> list[Any]:
     """Load persisted models for serving; retrain any NotPersisted model
     (reference `Engine.prepareDeploy` / `:186-208`)."""
+    _, models, _ = prepare_deploy_components(
+        engine, engine_params, instance_id, ctx
+    )
+    return models
+
+
+def prepare_deploy_components(
+    engine: Engine,
+    engine_params: EngineParams,
+    instance_id: str,
+    ctx: Optional[WorkflowContext] = None,
+) -> tuple[list[Any], list[Any], Any]:
+    """Like :func:`prepare_deploy`, but returns the serving-ready component
+    instances too: ``(algorithms, models, serving)``.  Algorithms get the
+    serving context attached (``_ctx``) so predict-time event-store reads
+    (e.g. the ecommerce template) resolve the same storage the deployment
+    uses — the reference reaches this via the Storage global."""
     ctx = ctx or WorkflowContext(mode="Serving")
     algos = engine._algorithms(engine_params)
+    for a in algos:
+        a._ctx = ctx
     names = [n for n, _ in engine_params.algorithms]
     models = load_models(ctx, instance_id, list(zip(names, algos)))
     missing = [i for i, m in enumerate(models) if isinstance(m, NotPersisted)]
@@ -133,4 +152,5 @@ def prepare_deploy(
         )
         for i, model in zip(missing, retrained):
             models[i] = model
-    return models
+    serving = engine._serving(engine_params)
+    return algos, models, serving

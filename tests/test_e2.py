@@ -1,0 +1,105 @@
+"""e2 library tests (reference `CategoricalNaiveBayesTest`, `MarkovChainTest`,
+`CrossValidationTest`)."""
+
+import math
+
+import pytest
+
+from predictionio_tpu.e2 import (
+    MarkovChain,
+    split_data,
+    train_categorical_nb,
+)
+from predictionio_tpu.e2.naive_bayes import LabeledPoint
+
+
+POINTS = [
+    LabeledPoint("spam", ("casino", "win")),
+    LabeledPoint("spam", ("casino", "free")),
+    LabeledPoint("spam", ("pills", "win")),
+    LabeledPoint("ham", ("meeting", "agenda")),
+    LabeledPoint("ham", ("meeting", "notes")),
+]
+
+
+def test_categorical_nb_priors_and_likelihoods():
+    m = train_categorical_nb(POINTS)
+    assert m.priors["spam"] == pytest.approx(math.log(3 / 5))
+    assert m.priors["ham"] == pytest.approx(math.log(2 / 5))
+    # P(casino | spam) = 2/3
+    assert m.likelihoods["spam"][0]["casino"] == pytest.approx(math.log(2 / 3))
+    assert m.likelihoods["ham"][0]["meeting"] == pytest.approx(0.0)
+
+
+def test_categorical_nb_predict():
+    m = train_categorical_nb(POINTS)
+    assert m.predict(("casino", "win")) == "spam"
+    assert m.predict(("meeting", "agenda")) == "ham"
+
+
+def test_categorical_nb_log_score():
+    m = train_categorical_nb(POINTS)
+    s = m.log_score(LabeledPoint("spam", ("casino", "win")))
+    expected = math.log(3 / 5) + math.log(2 / 3) + math.log(2 / 3)
+    assert s == pytest.approx(expected)
+    assert m.log_score(LabeledPoint("unknown-label", ("x", "y"))) is None
+
+
+def test_categorical_nb_unseen_value_uses_default():
+    m = train_categorical_nb(POINTS)
+    s = m.log_score(LabeledPoint("spam", ("never-seen", "win")))
+    assert s is not None and s < m.log_score(
+        LabeledPoint("spam", ("casino", "win"))
+    )
+    # custom default likelihood is honored
+    s2 = m.log_score(
+        LabeledPoint("spam", ("never-seen", "win")),
+        default_likelihood=lambda ls: -100.0,
+    )
+    assert s2 < -90
+
+
+def test_categorical_nb_empty_raises():
+    with pytest.raises(ValueError):
+        train_categorical_nb([])
+
+
+def test_markov_chain_strings():
+    mc = MarkovChain.train(
+        [("a", "b"), ("a", "b"), ("a", "c"), ("b", "a")], top_n=5
+    )
+    d = dict(mc.predict("a"))
+    assert d["b"] == pytest.approx(2 / 3)
+    assert d["c"] == pytest.approx(1 / 3)
+    assert mc.predict("zzz") == []
+
+
+def test_split_data_kfold():
+    data = list(range(10))
+    sets = split_data(
+        3, data, {"info": 1},
+        training_data_creator=lambda tr: list(tr),
+        query_creator=lambda d: ("q", d),
+        actual_creator=lambda d: ("a", d),
+    )
+    assert len(sets) == 3
+    # every element appears in exactly one test set
+    test_elems = [d for _, _, qa in sets for (_, d), _ in qa]
+    assert sorted(test_elems) == data
+    for td, ei, qa in sets:
+        assert ei == {"info": 1}
+        assert len(td) + len(qa) == 10
+        # train and test are disjoint
+        assert not set(td) & {d for (_, d), _ in qa}
+
+
+def test_split_data_validates_k():
+    with pytest.raises(ValueError):
+        split_data(0, [1], None, list, lambda d: d, lambda d: d)
+
+
+def test_categorical_nb_predict_always_returns_label():
+    """All-minus-inf scores still yield a label, not None."""
+    m = train_categorical_nb(POINTS)
+    label = m.predict(("never", "seen"))
+    assert label in ("spam", "ham")

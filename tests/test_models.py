@@ -1,0 +1,76 @@
+"""Model-family tests: NaiveBayes, logistic, Markov chain."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.logistic import train_logistic
+from predictionio_tpu.models.naive_bayes import train_naive_bayes
+from predictionio_tpu.models.markov import train_markov_chain
+
+
+def _blobs(n=200, seed=0):
+    """Count-like data with class-distinct feature proportions (multinomial
+    NB separates by proportions, not magnitudes)."""
+    rng = np.random.default_rng(seed)
+    x0 = rng.multinomial(20, [0.8, 0.2], size=n)
+    x1 = rng.multinomial(20, [0.2, 0.8], size=n)
+    x = np.vstack([x0, x1]).astype(np.float32)
+    y = np.array(["a"] * n + ["b"] * n, dtype=object)
+    return x, y
+
+
+def test_naive_bayes_separable():
+    x, y = _blobs()
+    m = train_naive_bayes(x, y)
+    pred = m.predict(x)
+    assert (pred == y).mean() > 0.95
+    assert set(m.labels) == {"a", "b"}
+    assert m.log_prior.shape == (2,)
+    # priors reflect class balance
+    np.testing.assert_allclose(np.exp(m.log_prior), [0.5, 0.5], atol=1e-6)
+
+
+def test_naive_bayes_prior_imbalance():
+    x = np.ones((10, 2), np.float32)
+    y = np.array(["a"] * 8 + ["b"] * 2, dtype=object)
+    m = train_naive_bayes(x, y)
+    np.testing.assert_allclose(np.exp(m.log_prior), [0.8, 0.2], atol=1e-6)
+
+
+def test_logistic_separable():
+    x, y = _blobs()
+    m = train_logistic(x, y, steps=200)
+    assert (m.predict(x) == y).mean() > 0.97
+    proba = m.predict_proba(x[:3])
+    np.testing.assert_allclose(proba.sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_logistic_multiclass():
+    rng = np.random.default_rng(1)
+    centers = np.array([[0, 0], [4, 0], [0, 4]])
+    x = np.vstack([
+        rng.normal(c, 0.4, size=(80, 2)) for c in centers
+    ]).astype(np.float32)
+    y = np.array([f"c{i}" for i in range(3) for _ in range(80)], dtype=object)
+    m = train_logistic(x, y, steps=300)
+    assert (m.predict(x) == y).mean() > 0.95
+
+
+def test_markov_chain_topn_and_normalization():
+    # 0 -> 1 (3x), 0 -> 2 (1x), 1 -> 0 (2x)
+    frm = np.array([0, 0, 0, 0, 1, 1], dtype=np.int32)
+    to = np.array([1, 1, 1, 2, 0, 0], dtype=np.int32)
+    m = train_markov_chain(frm, to, n_states=3, top_n=2)
+    d0 = dict(m.predict(0))
+    assert d0[1] == pytest.approx(0.75)
+    assert d0[2] == pytest.approx(0.25)
+    assert dict(m.predict(1)) == {0: pytest.approx(1.0)}
+    assert m.predict(2) == []  # no outgoing transitions
+    assert m.predict(99) == []
+
+
+def test_markov_chain_topn_truncates():
+    frm = np.zeros(10, dtype=np.int32)
+    to = np.arange(10, dtype=np.int32) % 5
+    m = train_markov_chain(frm, to, n_states=5, top_n=2)
+    assert len(m.predict(0)) == 2

@@ -1,0 +1,360 @@
+"""similarproduct / classification / ecommerce template tests
+(reference `examples/scala-parallel-*` capability checklist, SURVEY §2.6)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.storage import DataMap, Event
+from predictionio_tpu.workflow import prepare_deploy, run_train
+
+UTC = dt.timezone.utc
+
+
+def _t(m=0):
+    return dt.datetime(2021, 1, 1, 0, m, tzinfo=UTC)
+
+
+def _view(u, i, m=0):
+    return Event(event="view", entity_type="user", entity_id=u,
+                 target_entity_type="item", target_entity_id=i, event_time=_t(m))
+
+
+# ---------------------------------------------------------------------------
+# similarproduct
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def similar_ctx(storage_memory):
+    md = storage_memory.get_metadata()
+    app = md.app_insert("simapp")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(0)
+    events = []
+    # two item clusters: users co-view within a cluster
+    for u in range(20):
+        cluster = u % 2
+        pool = [f"i{j}" for j in range(10) if j % 2 == cluster]
+        for i in rng.choice(pool, size=4, replace=False):
+            events.append(_view(f"u{u}", i))
+    for j in range(10):
+        events.append(
+            Event(event="$set", entity_type="item", entity_id=f"i{j}",
+                  properties=DataMap(
+                      {"categories": ["even" if j % 2 == 0 else "odd"]}),
+                  event_time=_t())
+        )
+    es.insert_batch(events, app_id=app.id)
+    return WorkflowContext(storage=storage_memory)
+
+
+SIM_VARIANT = {
+    "datasource": {"params": {"appName": "simapp"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 8, "numIterations": 10, "lambda": 0.1,
+                    "alpha": 10.0}}
+    ],
+}
+
+
+def test_similarproduct_end_to_end(similar_ctx):
+    from predictionio_tpu.templates.similarproduct import (
+        Query,
+        similarproduct_engine,
+    )
+
+    e = similarproduct_engine()
+    ep = e.params_from_variant(SIM_VARIANT)
+    iid = run_train(e, ep, ctx=similar_ctx, engine_variant="sim.json")
+    models = prepare_deploy(e, ep, iid, ctx=similar_ctx)
+    algo = e._algorithms(ep)[0]
+    res = algo.predict(models[0], Query(items=("i0",), num=3))
+    assert len(res.item_scores) == 3
+    items = [s.item for s in res.item_scores]
+    assert "i0" not in items  # query item excluded
+    evens = sum(1 for i in items if int(i[1:]) % 2 == 0)
+    assert evens >= 2, f"expected same-cluster items, got {items}"
+
+
+def test_similarproduct_custom_persistence_roundtrip(similar_ctx, tmp_path):
+    """The npz save/load path (PersistentModel demo) must round-trip."""
+    from predictionio_tpu.storage import Storage, reset_storage
+    from predictionio_tpu.templates.similarproduct import (
+        Query,
+        similarproduct_engine,
+    )
+
+    e = similarproduct_engine()
+    ep = e.params_from_variant(SIM_VARIANT)
+    iid = run_train(e, ep, ctx=similar_ctx, engine_variant="sim.json")
+    # fresh algorithm instances load from the custom manifest
+    models = prepare_deploy(e, ep, iid, ctx=similar_ctx)
+    m = models[0]
+    assert m.item_factors.dtype == np.float32
+    assert len(m.items) == 10
+    assert m.item_props["i0"]["categories"] == ["even"]
+    # model dir contains the npz, not a pickle
+    mdir = similar_ctx.storage.model_data_dir() / iid
+    assert any(p.suffix == ".npz" for p in mdir.iterdir())
+
+
+def test_similarproduct_filters(similar_ctx):
+    from predictionio_tpu.templates.similarproduct import (
+        Query,
+        similarproduct_engine,
+    )
+
+    e = similarproduct_engine()
+    ep = e.params_from_variant(SIM_VARIANT)
+    models = e.train(similar_ctx, ep)
+    algo = e._algorithms(ep)[0]
+    res = algo.predict(
+        models[0], Query(items=("i0",), num=5, categories=("odd",))
+    )
+    for s in res.item_scores:
+        assert int(s.item[1:]) % 2 == 1
+    res = algo.predict(
+        models[0], Query(items=("i0",), num=5, blacklist=("i2", "i4"))
+    )
+    assert not {"i2", "i4"} & {s.item for s in res.item_scores}
+    assert algo.predict(models[0], Query(items=("ghost",), num=3)).item_scores == ()
+
+
+def test_similarproduct_wire_format():
+    from predictionio_tpu.templates.similarproduct import Query
+
+    q = Query.from_json({"items": ["i1"], "num": 2, "whiteList": ["i3"]})
+    assert q.items == ("i1",) and q.whitelist == ("i3",)
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def class_ctx(storage_memory):
+    md = storage_memory.get_metadata()
+    app = md.app_insert("clsapp")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(0)
+    events = []
+    for n in range(60):
+        label = n % 2
+        # class-distinct proportions (multinomial-NB-separable, like the
+        # quickstart's integer attributes)
+        probs = [0.7, 0.2, 0.1] if label == 0 else [0.1, 0.2, 0.7]
+        counts = rng.multinomial(12, probs)
+        events.append(
+            Event(
+                event="$set", entity_type="user", entity_id=f"u{n}",
+                properties=DataMap({
+                    "attr0": float(counts[0]),
+                    "attr1": float(counts[1]),
+                    "attr2": float(counts[2]),
+                    "label": str(label),
+                }),
+                event_time=_t(),
+            )
+        )
+    # one unlabeled user must be skipped
+    events.append(
+        Event(event="$set", entity_type="user", entity_id="nolabel",
+              properties=DataMap({"attr0": 1.0}), event_time=_t())
+    )
+    es.insert_batch(events, app_id=app.id)
+    return WorkflowContext(storage=storage_memory)
+
+
+CLS_VARIANT = {
+    "datasource": {"params": {"appName": "clsapp"}},
+    "algorithms": [
+        {"name": "naive", "params": {"lambda": 1.0}},
+        {"name": "logistic", "params": {"steps": 200, "lr": 0.2}},
+    ],
+}
+
+
+def test_classification_multi_algo(class_ctx):
+    from predictionio_tpu.templates.classification import (
+        Query,
+        classification_engine,
+    )
+
+    e = classification_engine()
+    ep = e.params_from_variant(CLS_VARIANT)
+    iid = run_train(e, ep, ctx=class_ctx, engine_variant="cls.json")
+    models = prepare_deploy(e, ep, iid, ctx=class_ctx)
+    algos = e._algorithms(ep)
+    assert len(models) == 2
+    for algo, model in zip(algos, models):
+        assert algo.predict(model, Query(features=(8.0, 2.0, 1.0))).label == "0"
+        assert algo.predict(model, Query(features=(1.0, 2.0, 8.0))).label == "1"
+
+
+def test_classification_quickstart_wire_format():
+    from predictionio_tpu.templates.classification import Query
+
+    q = Query.from_json({"attr0": 2, "attr1": 0, "attr2": 0})
+    assert q.features == (2.0, 0.0, 0.0)
+
+
+def test_classification_single_class_fails_sanity(storage_memory):
+    from predictionio_tpu.templates.classification import classification_engine
+
+    md = storage_memory.get_metadata()
+    app = md.app_insert("oneclass")
+    es = storage_memory.get_event_store()
+    es.insert(
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"attr0": 1.0, "attr1": 1.0, "attr2": 1.0,
+                                  "label": "only"})),
+        app_id=app.id,
+    )
+    ctx = WorkflowContext(storage=storage_memory)
+    e = classification_engine()
+    ep = e.params_from_variant(
+        {"datasource": {"params": {"appName": "oneclass"}},
+         "algorithms": [{"name": "naive"}]}
+    )
+    with pytest.raises(ValueError, match="two classes"):
+        e.train(ctx, ep)
+
+
+# ---------------------------------------------------------------------------
+# ecommerce
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def ecomm_ctx(storage_memory):
+    md = storage_memory.get_metadata()
+    app = md.app_insert("ecomm")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(0)
+    events = []
+    for u in range(16):
+        cluster = u % 2
+        pool = [f"i{j}" for j in range(12) if j % 2 == cluster]
+        for i in rng.choice(pool, size=4, replace=False):
+            events.append(_view(f"u{u}", i))
+    es.insert_batch(events, app_id=app.id)
+    return WorkflowContext(storage=storage_memory), app.id
+
+
+ECOMM_VARIANT = {
+    "datasource": {"params": {"appName": "ecomm"}},
+    "algorithms": [
+        {"name": "ecomm",
+         "params": {"rank": 8, "numIterations": 10, "lambda": 0.1,
+                    "alpha": 10.0, "unseenOnly": True,
+                    "seenEvents": ["view"]}}
+    ],
+}
+
+
+def test_ecommerce_filters_seen_and_unavailable(ecomm_ctx):
+    from predictionio_tpu.templates.ecommerce import ecommerce_engine
+    from predictionio_tpu.templates.recommendation import Query
+
+    ctx, app_id = ecomm_ctx
+    es = ctx.storage.get_event_store()
+    e = ecommerce_engine()
+    ep = e.params_from_variant(ECOMM_VARIANT)
+    iid = run_train(e, ep, ctx=ctx, engine_variant="ec.json")
+    models = prepare_deploy(e, ep, iid, ctx=ctx)
+    algo = e._algorithms(ep)[0]
+    algo._ctx = ctx
+
+    # the user's seen items are excluded (unseenOnly)
+    seen = {
+        ev.target_entity_id
+        for ev in es.find(app_id=app_id, entity_type="user", entity_id="u0",
+                          event_names=["view"])
+    }
+    res = algo.predict(models[0], Query(user="u0", num=6))
+    rec_items = {s.item for s in res.item_scores}
+    assert rec_items and not (rec_items & seen)
+
+    # constraint entity marks items unavailable at serving time
+    make_unavailable = sorted(rec_items)[0]
+    es.insert(
+        Event(event="$set", entity_type="constraint",
+              entity_id="unavailableItems",
+              properties=DataMap({"items": [make_unavailable]}),
+              event_time=_t(1)),
+        app_id=app_id,
+    )
+    res2 = algo.predict(models[0], Query(user="u0", num=6))
+    assert make_unavailable not in {s.item for s in res2.item_scores}
+
+    # clearing the constraint restores the item
+    es.insert(
+        Event(event="$set", entity_type="constraint",
+              entity_id="unavailableItems",
+              properties=DataMap({"items": []}), event_time=_t(2)),
+        app_id=app_id,
+    )
+    res3 = algo.predict(models[0], Query(user="u0", num=6))
+    assert make_unavailable in {s.item for s in res3.item_scores}
+
+
+def test_ecommerce_unknown_user_empty(ecomm_ctx):
+    from predictionio_tpu.templates.ecommerce import ecommerce_engine
+    from predictionio_tpu.templates.recommendation import Query
+
+    ctx, _ = ecomm_ctx
+    e = ecommerce_engine()
+    ep = e.params_from_variant(ECOMM_VARIANT)
+    models = e.train(ctx, ep)
+    algo = e._algorithms(ep)[0]
+    assert algo.predict(models[0], Query(user="ghost", num=3)).item_scores == ()
+
+
+def test_ecomm_query_camelcase_lists():
+    """Reference wire format camelCase whiteList/blackList must decode."""
+    from predictionio_tpu.templates.recommendation import Query
+
+    q = Query.from_json({"user": "u1", "num": 4, "blackList": ["i3"],
+                         "whiteList": ["i1", "i2"]})
+    assert q.blacklist == ("i3",)
+    assert q.whitelist == ("i1", "i2")
+
+
+def test_classification_query_attr10_ordering():
+    from predictionio_tpu.templates.classification import Query
+
+    d = {f"attr{i}": float(i) for i in range(12)}
+    q = Query.from_json(d)
+    assert q.features == tuple(float(i) for i in range(12))
+
+
+def test_classification_query_custom_attribute_names():
+    from predictionio_tpu.templates.classification import Query
+
+    q = Query.from_json({"age": 30, "income": 5.5})
+    assert q.features == (30.0, 5.5)
+
+
+def test_prepare_deploy_components_wires_ctx(ecomm_ctx):
+    """prepare_deploy_components attaches the serving ctx so predict-time
+    event-store reads hit the deployment's storage."""
+    from predictionio_tpu.templates.ecommerce import ecommerce_engine
+    from predictionio_tpu.templates.recommendation import Query
+    from predictionio_tpu.workflow.train import prepare_deploy_components
+
+    ctx, app_id = ecomm_ctx
+    e = ecommerce_engine()
+    ep = e.params_from_variant(ECOMM_VARIANT)
+    iid = run_train(e, ep, ctx=ctx, engine_variant="ec2.json")
+    algos, models, serving = prepare_deploy_components(e, ep, iid, ctx=ctx)
+    assert algos[0]._ctx is ctx
+    res = algos[0].predict(models[0], Query(user="u0", num=3))
+    assert res.item_scores  # reads seen-events from ctx storage, no crash

@@ -93,13 +93,6 @@ class Buckets:
     buckets: list[Bucket]
 
 
-def _next_pow2(x: int, lo: int) -> int:
-    k = lo
-    while k < x:
-        k *= 2
-    return k
-
-
 def build_buckets(
     row_ix: np.ndarray,
     col_ix: np.ndarray,
@@ -114,39 +107,44 @@ def build_buckets(
     MLlib which simply never solves them).
     """
     order = np.argsort(row_ix, kind="stable")
-    r_sorted = row_ix[order]
     c_sorted = col_ix[order]
     v_sorted = val[order]
     counts = np.bincount(row_ix, minlength=n_rows)
     starts = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
 
-    active = np.nonzero(counts)[0]
     if max_per_row and max_per_row > 0:
         eff_counts = np.minimum(counts, max_per_row)
     else:
         eff_counts = counts
 
-    # bucket key: next power of two of the (possibly capped) count
-    buckets: dict[int, list[int]] = {}
-    for r in active:
-        k = _next_pow2(int(eff_counts[r]), min_k)
-        buckets.setdefault(k, []).append(int(r))
+    # bucket key: next power of two of the (possibly capped) count —
+    # computed for all rows at once (ceil(log2), floored at min_k)
+    safe = np.maximum(eff_counts, 1)
+    k_of_row = np.maximum(
+        min_k, 1 << np.ceil(np.log2(safe)).astype(np.int64)
+    )
+    active = np.nonzero(counts)[0]
+    k_active = k_of_row[active]
 
     out: list[Bucket] = []
-    for k in sorted(buckets):
-        rows = np.asarray(buckets[k], dtype=np.int32)
-        B = len(rows)
-        idx = np.zeros((B, k), dtype=np.int32)
-        vals = np.zeros((B, k), dtype=np.float32)
-        mask = np.zeros((B, k), dtype=np.float32)
-        for b, r in enumerate(rows):
-            n = int(eff_counts[r])
-            s = starts[r]
-            idx[b, :n] = c_sorted[s : s + n]
-            vals[b, :n] = v_sorted[s : s + n]
-            mask[b, :n] = 1.0
-        out.append(Bucket(rows=rows, idx=idx, val=vals, mask=mask))
+    n_total = len(c_sorted)
+    for k in np.unique(k_active):
+        k = int(k)
+        rows = active[k_active == k].astype(np.int32)
+        # gather each row's slice via a [B, k] position grid; out-of-range
+        # positions are clipped and masked off
+        pos = starts[rows][:, None] + np.arange(k, dtype=np.int64)[None, :]
+        valid = np.arange(k)[None, :] < eff_counts[rows][:, None]
+        pos = np.minimum(pos, n_total - 1)
+        idx = np.where(valid, c_sorted[pos], 0).astype(np.int32)
+        vals = np.where(valid, v_sorted[pos], 0.0).astype(np.float32)
+        out.append(
+            Bucket(
+                rows=rows, idx=idx, val=vals,
+                mask=valid.astype(np.float32),
+            )
+        )
     return Buckets(n_rows=n_rows, buckets=out)
 
 

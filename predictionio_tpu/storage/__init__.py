@@ -8,7 +8,7 @@ Spark RDDs.
 """
 
 from .aggregate import aggregate_properties, aggregate_properties_single
-from .bimap import BiMap, StringIndex
+from .bimap import BiMap, EntityIdIxMap, EntityMap, StringIndex
 from .columnar import EventFrame, Ratings, events_to_frame
 from .event import (
     DataMap,
@@ -33,12 +33,18 @@ from .metadata import (
 )
 from .registry import Storage, StorageError, get_storage, reset_storage
 from .sqlite_events import SQLiteEventStore
+from .store import LEventStore, PEventStore, app_name_to_id
 
 __all__ = [
     "aggregate_properties",
     "aggregate_properties_single",
     "BiMap",
     "StringIndex",
+    "EntityIdIxMap",
+    "EntityMap",
+    "LEventStore",
+    "PEventStore",
+    "app_name_to_id",
     "EventFrame",
     "Ratings",
     "events_to_frame",

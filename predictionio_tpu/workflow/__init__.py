@@ -4,9 +4,12 @@
 from .model_io import NotPersisted, load_models, save_models
 from .params import WorkflowParams
 from .evaluate import run_evaluation
+from .fake import FakeRun, run_fake
 from .train import new_instance_id, prepare_deploy, run_train
 
 __all__ = [
+    "FakeRun",
+    "run_fake",
     "NotPersisted",
     "load_models",
     "save_models",

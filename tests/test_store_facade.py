@@ -1,0 +1,118 @@
+"""Store facades (L4): app-name-addressed reads + EntityMap + FakeRun
+(reference `data/.../store/PEventStore.scala`, `LEventStore.scala`,
+`Common.scala`; `EntityMap.scala`; `workflow/FakeWorkflow.scala`)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.storage import (
+    DataMap,
+    EntityIdIxMap,
+    EntityMap,
+    Event,
+    LEventStore,
+    PEventStore,
+    app_name_to_id,
+)
+
+UTC = dt.timezone.utc
+
+
+def _t(m):
+    return dt.datetime(2021, 6, 1, 0, m, tzinfo=UTC)
+
+
+@pytest.fixture()
+def app(storage_memory):
+    md = storage_memory.get_metadata()
+    a = md.app_insert("shop")
+    es = storage_memory.get_event_store()
+    es.init_channel(a.id)
+    es.insert(Event(event="$set", entity_type="item", entity_id="i1",
+                    properties=DataMap({"category": "x"}), event_time=_t(0)),
+              app_id=a.id)
+    es.insert(Event(event="view", entity_type="user", entity_id="u1",
+                    target_entity_type="item", target_entity_id="i1",
+                    event_time=_t(1)), app_id=a.id)
+    es.insert(Event(event="buy", entity_type="user", entity_id="u1",
+                    target_entity_type="item", target_entity_id="i1",
+                    event_time=_t(2)), app_id=a.id)
+    return a
+
+
+def test_app_name_to_id(storage_memory, app):
+    assert app_name_to_id("shop", storage=storage_memory) == (app.id, 0)
+    with pytest.raises(ValueError):
+        app_name_to_id("nope", storage=storage_memory)
+    with pytest.raises(ValueError):
+        app_name_to_id("shop", "nochan", storage=storage_memory)
+
+
+def test_app_name_to_id_channel(storage_memory, app):
+    md = storage_memory.get_metadata()
+    ch = md.channel_insert("backtest", app.id)
+    assert app_name_to_id("shop", "backtest", storage=storage_memory) == (
+        app.id, ch.id
+    )
+
+
+def test_pevent_store_find(storage_memory, app):
+    p = PEventStore(storage_memory)
+    frame = p.find("shop", entity_type="user", event_names=["view", "buy"])
+    assert len(frame) == 2
+    assert p.find("shop", event_names=["buy"]).event[0] == "buy"
+
+
+def test_pevent_store_aggregate(storage_memory, app):
+    p = PEventStore(storage_memory)
+    props = p.aggregate_properties("shop", "item")
+    assert props["i1"]["category"] == "x"
+    assert p.aggregate_properties("shop", "item", required=["nope"]) == {}
+
+
+def test_levent_store_latest_first(storage_memory, app):
+    l = LEventStore(storage_memory)
+    evs = list(l.find_by_entity("shop", "user", "u1", limit=1))
+    assert len(evs) == 1 and evs[0].event == "buy"  # latest first
+    evs = list(l.find_by_entity("shop", "user", "u1", latest=False))
+    assert [e.event for e in evs] == ["view", "buy"]
+
+
+def test_entity_id_ix_map():
+    m = EntityIdIxMap.from_ids(["b", "a", "c"])
+    assert len(m) == 3
+    assert m.inverse(m("a")) == "a"
+    assert "a" in m and "z" not in m
+    assert m.get("z") == -1
+
+
+def test_entity_map():
+    em = EntityMap({"u1": 10, "u2": 20})
+    assert em["u1"] == 10
+    assert em.get_by_index(em.id_to_ix("u2")) == 20
+    assert len(em) == 2 and "u3" not in em
+
+
+def test_fake_run(storage_memory):
+    from predictionio_tpu.controller.base import WorkflowContext
+    from predictionio_tpu.workflow import run_fake
+
+    seen = []
+    ctx = WorkflowContext(mode="Evaluation", storage=storage_memory)
+    eval_id = run_fake(lambda c: seen.append(c.mode), ctx)
+    assert seen == ["Evaluation"]
+    rec = storage_memory.get_metadata().evaluation_instance_get(eval_id)
+    assert rec.status == "EVALCOMPLETED"
+
+
+def test_fake_run_failure(storage_memory):
+    from predictionio_tpu.controller.base import WorkflowContext
+    from predictionio_tpu.workflow import run_fake
+
+    ctx = WorkflowContext(mode="Evaluation", storage=storage_memory)
+    with pytest.raises(RuntimeError):
+        run_fake(lambda c: (_ for _ in ()).throw(RuntimeError("boom")), ctx)
+    recs = storage_memory.get_metadata().evaluation_instance_get_completed()
+    # failed runs are not listed as completed
+    assert all(r.status != "EVALFAILED" for r in recs)

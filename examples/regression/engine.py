@@ -1,0 +1,90 @@
+"""Local least-squares regression on the device.
+
+Analogue of the reference `examples/experimental/scala-local-regression`
+(ReadsTrainingData from a file; a local model answering feature-vector
+queries).  The solve runs as one XLA ``lstsq`` on the accelerator; the
+model (a coefficient vector) is host-replicated — the P2L placement class.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    IdentityPreparator,
+    Params,
+    Serving,
+)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    path: str = "data.txt"
+
+
+@dataclass
+class TrainingData:
+    x: np.ndarray  # [N, D] features (first column = 1 bias)
+    y: np.ndarray  # [N]
+
+
+@dataclass
+class Query:
+    features: list[float] = field(default_factory=list)
+
+
+class RegressionDataSource(DataSource):
+    """Reads whitespace-separated lines: ``y x1 x2 ...``."""
+
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams = DataSourceParams()):
+        self.params = params
+
+    def read_training(self, ctx) -> TrainingData:
+        rows = []
+        for line in Path(self.params.path).read_text().splitlines():
+            if line.strip():
+                rows.append([float(t) for t in line.split()])
+        data = np.asarray(rows, np.float32)
+        x = np.concatenate(
+            [np.ones((len(data), 1), np.float32), data[:, 1:]], axis=1
+        )
+        return TrainingData(x=x, y=data[:, 0])
+
+
+class LeastSquaresAlgorithm(Algorithm):
+    def train(self, ctx, td: TrainingData) -> np.ndarray:
+        import jax.numpy as jnp
+
+        coef, *_ = jnp.linalg.lstsq(jnp.asarray(td.x), jnp.asarray(td.y))
+        return np.asarray(coef)
+
+    def predict(self, model: np.ndarray, query) -> float:
+        feats = (
+            query.features if isinstance(query, Query) else query["features"]
+        )
+        x = np.concatenate([[1.0], np.asarray(feats, np.float32)])
+        return float(x @ model)
+
+
+class MeanServing(Serving):
+    """Averages multi-algorithm predictions (LAverageServing analogue)."""
+
+    def serve(self, query, predictions):
+        return float(sum(predictions) / len(predictions))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        RegressionDataSource,
+        IdentityPreparator,
+        {"lsq": LeastSquaresAlgorithm},
+        MeanServing,
+    )

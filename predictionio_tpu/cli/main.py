@@ -5,10 +5,12 @@ Re-expression of reference `tools/console/Console.scala:128-737` +
 
   app new|list|show|delete|data-delete|channel-new|channel-delete
   accesskey new|list|delete
-  train | deploy | eval | eventserver | adminserver | dashboard
-  import | export | status | version
+  template list|get
+  train | deploy | undeploy | eval | eventserver | adminserver | dashboard
+  build | unregister | run | import | export | status | upgrade | version
 
-`build`/`unregister` have no analogue (no sbt); engine factories are Python
+There is no sbt: `build` validates the engine variant and registers an
+EngineManifest (RegisterEngine analogue), and engine factories are Python
 callables resolved by dotted path (`WorkflowUtils.getEngine` reflection
 analogue, `workflow/WorkflowUtils.scala:60-77`).
 """
@@ -203,9 +205,13 @@ def cmd_accesskey(args, storage: Storage) -> int:
 
 def cmd_train(args, storage: Storage) -> int:
     from ..controller.base import WorkflowContext
+    from ..parallel.mesh import enable_compilation_cache
+    from ..tools.template_gallery import verify_template_min_version
     from ..workflow.params import WorkflowParams
     from ..workflow.train import run_train
 
+    enable_compilation_cache()
+    verify_template_min_version(Path(args.engine_json).parent)
     engine, ep, variant = load_engine_from_variant(
         args.engine_json, args.engine_factory
     )
@@ -228,8 +234,12 @@ def cmd_train(args, storage: Storage) -> int:
 
 def cmd_deploy(args, storage: Storage) -> int:
     from ..controller.base import WorkflowContext
+    from ..parallel.mesh import enable_compilation_cache
     from ..server.serving import EngineServer, ServerConfig
+    from ..tools.template_gallery import verify_template_min_version
 
+    enable_compilation_cache()
+    verify_template_min_version(Path(args.engine_json).parent)
     engine, ep, variant = load_engine_from_variant(
         args.engine_json, args.engine_factory
     )
@@ -267,8 +277,10 @@ def cmd_deploy(args, storage: Storage) -> int:
 
 def cmd_eval(args, storage: Storage) -> int:
     from ..controller.base import WorkflowContext
+    from ..parallel.mesh import enable_compilation_cache
     from ..workflow.evaluate import run_evaluation
 
+    enable_compilation_cache()
     evaluation = resolve_attr(args.evaluation)
     if callable(evaluation) and not hasattr(evaluation, "engine"):
         evaluation = evaluation()
@@ -336,6 +348,106 @@ def cmd_export(args, storage: Storage) -> int:
     es.init_channel(args.appid, args.channel)
     n = export_events(args.output, es, args.appid, args.channel)
     _out(f"Exported {n} events.")
+    return 0
+
+
+def cmd_template(args, storage: Storage) -> int:
+    """Offline gallery (`console/Template.scala:130-427` analogue)."""
+    from ..tools.template_gallery import list_templates, scaffold
+
+    if args.template_command == "list":
+        for t in list_templates():
+            _out(f"{t.name:<26} {t.description}")
+        return 0
+    if args.template_command == "get":
+        try:
+            target = scaffold(args.name, args.directory or args.name)
+        except (KeyError, FileExistsError) as e:
+            _out(f"Error: {e}")
+            return 1
+        _out(f"Engine template '{args.name}' created at {target}/")
+        return 0
+    raise AssertionError(args.template_command)
+
+
+def cmd_build(args, storage: Storage) -> int:
+    """Validate the engine variant and register its manifest.
+
+    The reference `build` runs sbt then `RegisterEngine` (Console.scala:
+    772-802); with Python engines the build step reduces to import-checking
+    the factory and upserting the `EngineManifest`.
+    """
+    from ..storage.metadata import EngineManifest
+    from ..tools.template_gallery import verify_template_min_version
+
+    verify_template_min_version(Path(args.engine_json).parent)
+    try:
+        engine, ep, variant = load_engine_from_variant(
+            args.engine_json, args.engine_factory
+        )
+    except Exception as e:
+        _out(f"Error: engine variant failed to load: {e}")
+        return 1
+    engine_id = variant.get("id", Path(args.engine_json).resolve().parent.name)
+    storage.get_metadata().manifest_upsert(
+        EngineManifest(
+            id=engine_id,
+            version=args.engine_version,
+            name=engine_id,
+            description=variant.get("description"),
+            files=[str(Path(args.engine_json).resolve())],
+            engine_factory=args.engine_factory
+            or variant.get("engineFactory", ""),
+        )
+    )
+    _out(f"Engine '{engine_id}' built and registered "
+         f"(version {args.engine_version}).")
+    return 0
+
+
+def cmd_unregister(args, storage: Storage) -> int:
+    variant = json.loads(Path(args.engine_json).read_text())
+    engine_id = variant.get("id", Path(args.engine_json).resolve().parent.name)
+    storage.get_metadata().manifest_delete(engine_id, args.engine_version)
+    _out(f"Engine '{engine_id}' unregistered.")
+    return 0
+
+
+def cmd_run(args, storage: Storage) -> int:
+    """Run an arbitrary dotted-path main under the framework env
+    (Console `run` analogue — there it spark-submits a user class)."""
+    fn = resolve_attr(args.main_class)
+    if not callable(fn):
+        _out(f"Error: {args.main_class} resolved to a non-callable "
+             f"{type(fn).__name__}.")
+        return 1
+    rv = fn(*args.args)
+    return int(rv) if isinstance(rv, int) else 0
+
+
+def cmd_undeploy(args, storage: Storage) -> int:
+    """POST /stop to a deployed engine server (Console.scala undeploy)."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=5
+        ) as r:
+            r.read()
+    except (urllib.error.URLError, OSError) as e:
+        _out(f"Error: cannot undeploy {args.ip}:{args.port}: {e}")
+        return 1
+    _out(f"Undeployed engine server at {args.ip}:{args.port}.")
+    return 0
+
+
+def cmd_upgrade(args, storage: Storage) -> int:
+    """The reference phones home for new versions (WorkflowUtils.scala:
+    220-225); this build is offline, so report the installed version."""
+    _out(f"pio-tpu {__version__} — no network egress; upgrade checks "
+         "are disabled in this environment.")
     return 0
 
 
@@ -453,6 +565,31 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--channel", type=int, default=0)
     ex.add_argument("--output", required=True)
 
+    tp = sub.add_parser("template", help="engine template gallery")
+    tps = tp.add_subparsers(dest="template_command", required=True)
+    tps.add_parser("list")
+    x = tps.add_parser("get")
+    x.add_argument("name")
+    x.add_argument("directory", nargs="?")
+
+    b = sub.add_parser("build", help="validate + register an engine")
+    b.add_argument("--engine-json", default="engine.json")
+    b.add_argument("--engine-factory")
+    b.add_argument("--engine-version", default="1")
+
+    ur = sub.add_parser("unregister", help="remove an engine manifest")
+    ur.add_argument("--engine-json", default="engine.json")
+    ur.add_argument("--engine-version", default="1")
+
+    rn = sub.add_parser("run", help="run a dotted-path main under the env")
+    rn.add_argument("main_class")
+    rn.add_argument("args", nargs="*")
+
+    ud = sub.add_parser("undeploy", help="stop a deployed engine server")
+    ud.add_argument("--ip", default="127.0.0.1")
+    ud.add_argument("--port", type=int, default=8000)
+
+    sub.add_parser("upgrade", help="check for framework upgrades")
     sub.add_parser("status", help="check environment and storage")
     sub.add_parser("version")
     return p
@@ -469,6 +606,12 @@ _DISPATCH = {
     "dashboard": cmd_dashboard,
     "import": cmd_import,
     "export": cmd_export,
+    "template": cmd_template,
+    "build": cmd_build,
+    "unregister": cmd_unregister,
+    "run": cmd_run,
+    "undeploy": cmd_undeploy,
+    "upgrade": cmd_upgrade,
     "status": cmd_status,
 }
 

@@ -6,21 +6,26 @@ invoke (`/root/reference/examples/scala-parallel-recommendation/custom-query/
 src/main/scala/ALSAlgorithm.scala`, similarproduct, ecommerce).  The MLlib
 implementation block-partitions factors across Spark executors and shuffles
 factor blocks each half-iteration (SURVEY §2.7(2)); here the whole problem is
-HBM-resident and each half-iteration is a handful of batched XLA calls:
+HBM-resident and each half-iteration is ONE XLA computation:
 
 * Host preprocessing groups rows into **power-of-two padded buckets**
-  (ALX-style, arXiv 2112.02194): every row's rating list is padded to the
-  bucket width K, so the device sees only static-shape dense arrays.
-  Padding waste is bounded by 2x; bucket count is O(log max_count), so at
-  most ~12 compiled shapes per direction.
-* Per bucket, one fused XLA computation: gather opposite factors
+  (ALX-style, arXiv 2112.02194): rows are keyed by next-pow2(rating count),
+  so the device sees only static shapes.  Only the time-sorted COO arrays
+  and tiny per-bucket ``(rows, starts, counts)`` vectors are transferred;
+  the padded ``[B, K]`` rating blocks are expanded **on device** inside the
+  compiled program (a gather from the sorted COO), which cuts host->HBM
+  traffic ~3x and keeps the expansion fused with the solves.
+* Per bucket, inside the same program: gather opposite factors
   ``[B, K, R]`` -> masked Gram matrices via einsum (MXU) -> batched
-  Cholesky solve -> scatter updated factors.
-* Sharding: the batch dim of every bucket is sharded over the mesh's
-  ``data`` axis; factor tables are replicated, so the gather is local and
-  the update is an all-gather-free scatter into the replicated table —
-  XLA inserts the collectives from the shardings (no NCCL/MPI analogue
-  needed).
+  Cholesky solve -> masked scatter into the factor table (OOB rows from
+  batch padding are dropped).
+* The whole half-iteration is a single ``jit`` with the factor table
+  donated, so a 20-iteration train is 40 dispatches and exactly 2 compiled
+  executables (one per direction) regardless of bucket count.
+* Sharding: bucket batch dims are sharded over the mesh's ``data`` axis;
+  factor tables and the COO arrays are replicated, so gathers are local
+  and XLA inserts the collectives for the scatter from the shardings
+  (no NCCL/MPI analogue needed).
 
 Both regularization conventions are implemented:
 
@@ -34,7 +39,7 @@ from __future__ import annotations
 
 import functools
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 import jax
@@ -47,7 +52,19 @@ from ..storage.columnar import Ratings
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ALSConfig", "ALSFactors", "train_als", "rmse", "Buckets"]
+__all__ = [
+    "ALSConfig",
+    "ALSFactors",
+    "ALSTrainer",
+    "train_als",
+    "rmse",
+    "BucketLayout",
+    "build_bucket_layout",
+]
+
+# cap on B*K entries of a single bucket chunk: bounds the [B, K, R]
+# gathered intermediate (~1 GiB at rank 64, f32) regardless of dataset size
+MAX_ENTRIES_PER_BUCKET = 4 << 20
 
 
 @dataclass(frozen=True)
@@ -64,6 +81,10 @@ class ALSConfig:
     max_ratings_per_row: int = 0
     min_bucket_k: int = 8
     compute_dtype: str = "float32"
+    # MXU precision for the Gram einsums: "highest" (f32), "high" (bf16x3),
+    # "default" (bf16).  RMSE parity wants "highest"; ranking-only workloads
+    # can trade down.
+    matmul_precision: str = "highest"
 
 
 @dataclass
@@ -75,40 +96,57 @@ class ALSFactors:
 
 
 # --------------------------------------------------------------------------
-# Host-side preprocessing: COO -> power-of-two padded buckets per direction
+# Host-side preprocessing: COO -> bucket layout (indices only; the padded
+# [B, K] blocks are expanded on device)
 # --------------------------------------------------------------------------
 
 
 @dataclass
 class Bucket:
-    rows: np.ndarray   # [B]    row ids whose systems this bucket solves
-    idx: np.ndarray    # [B, K] opposite-side indices (0-padded)
-    val: np.ndarray    # [B, K] ratings (0-padded)
-    mask: np.ndarray   # [B, K] 1.0 where a real rating
+    k: int             # static pad width (power of two)
+    rows: np.ndarray   # [Bp] row ids; padding = n_rows (OOB -> dropped)
+    starts: np.ndarray  # [Bp] offset of each row's slice in the sorted COO
+    counts: np.ndarray  # [Bp] true rating count (<= k); 0 for padding
 
 
 @dataclass
-class Buckets:
+class BucketLayout:
     n_rows: int
-    buckets: list[Bucket]
+    col_sorted: np.ndarray  # [nnz] opposite-side ids, grouped by row
+    val_sorted: np.ndarray  # [nnz] ratings, grouped by row
+    buckets: list[Bucket] = field(default_factory=list)
 
 
-def build_buckets(
+def build_bucket_layout(
     row_ix: np.ndarray,
     col_ix: np.ndarray,
     val: np.ndarray,
     n_rows: int,
     min_k: int = 8,
     max_per_row: int = 0,
-) -> Buckets:
+    batch_multiple: int = 1,
+    max_entries: Optional[int] = None,
+) -> BucketLayout:
     """Group rows by padded rating-count so the device solves static shapes.
 
     Rows with zero ratings are excluded (their factors stay at init, like
-    MLlib which simply never solves them).
+    MLlib which simply never solves them).  Oversized buckets are split so
+    ``B*K <= max_entries``; batch dims are padded to ``batch_multiple``
+    (the mesh size) for even sharding.
     """
+    if max_entries is None:
+        max_entries = MAX_ENTRIES_PER_BUCKET
+    if len(val) >= np.iinfo(np.int32).max:
+        # Bucket.starts (and the on-device gather positions) are int32;
+        # beyond 2^31 ratings the offsets would wrap. A single-replica COO
+        # that large belongs on a sharded ingest path anyway.
+        raise ValueError(
+            f"{len(val):,} ratings exceed the int32 offset range of a "
+            "single bucket layout; shard the COO across hosts first"
+        )
     order = np.argsort(row_ix, kind="stable")
-    c_sorted = col_ix[order]
-    v_sorted = val[order]
+    c_sorted = np.ascontiguousarray(col_ix[order], dtype=np.int32)
+    v_sorted = np.ascontiguousarray(val[order], dtype=np.float32)
     counts = np.bincount(row_ix, minlength=n_rows)
     starts = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
@@ -127,137 +165,218 @@ def build_buckets(
     active = np.nonzero(counts)[0]
     k_active = k_of_row[active]
 
-    out: list[Bucket] = []
-    n_total = len(c_sorted)
+    layout = BucketLayout(
+        n_rows=n_rows, col_sorted=c_sorted, val_sorted=v_sorted
+    )
     for k in np.unique(k_active):
         k = int(k)
-        rows = active[k_active == k].astype(np.int32)
-        # gather each row's slice via a [B, k] position grid; out-of-range
-        # positions are clipped and masked off
-        pos = starts[rows][:, None] + np.arange(k, dtype=np.int64)[None, :]
-        valid = np.arange(k)[None, :] < eff_counts[rows][:, None]
-        pos = np.minimum(pos, n_total - 1)
-        idx = np.where(valid, c_sorted[pos], 0).astype(np.int32)
-        vals = np.where(valid, v_sorted[pos], 0.0).astype(np.float32)
-        out.append(
-            Bucket(
-                rows=rows, idx=idx, val=vals,
-                mask=valid.astype(np.float32),
-            )
+        rows_k = active[k_active == k].astype(np.int32)
+        b_cap = max(
+            batch_multiple,
+            (max_entries // k) // batch_multiple * batch_multiple,
         )
-    return Buckets(n_rows=n_rows, buckets=out)
+        for s in range(0, len(rows_k), b_cap):
+            rows = rows_k[s : s + b_cap]
+            B = len(rows)
+            Bp = pad_to_multiple(max(B, batch_multiple), batch_multiple)
+            rows_p = np.full(Bp, n_rows, dtype=np.int32)
+            starts_p = np.zeros(Bp, dtype=np.int32)
+            counts_p = np.zeros(Bp, dtype=np.int32)
+            rows_p[:B] = rows
+            starts_p[:B] = starts[rows]
+            counts_p[:B] = eff_counts[rows]
+            layout.buckets.append(
+                Bucket(k=k, rows=rows_p, starts=starts_p, counts=counts_p)
+            )
+    return layout
 
 
 # --------------------------------------------------------------------------
-# Device-side solves
+# Device-side: one jitted half-iteration per direction
 # --------------------------------------------------------------------------
 
 
 @functools.partial(
-    jax.jit, static_argnames=("implicit", "weighted_lambda")
+    jax.jit,
+    static_argnames=("ks", "implicit", "weighted_lambda", "precision"),
+    donate_argnums=(0,),
 )
-def _solve_bucket(
-    opp_factors: jax.Array,  # [M, R] opposite-side factor table (replicated)
-    gram: jax.Array,         # [R, R] YtY (used only for implicit)
-    idx: jax.Array,          # [B, K]
-    val: jax.Array,          # [B, K]
-    mask: jax.Array,         # [B, K]
-    lam: jax.Array,          # scalar
-    alpha: jax.Array,        # scalar
+def _half_iteration(
+    upd: jax.Array,        # [N, R] factor table being solved (donated)
+    opp: jax.Array,        # [M, R] opposite-side factor table
+    c_sorted: jax.Array,   # [nnz] int32
+    v_sorted: jax.Array,   # [nnz] f32
+    bucket_args: tuple,    # tuple of (rows, starts, counts) per bucket
+    lam: jax.Array,        # traced scalar: sweeping λ must not recompile
+    alpha: jax.Array,      # traced scalar
     *,
+    ks: tuple,             # static: pad width per bucket
     implicit: bool,
     weighted_lambda: bool,
+    precision: str,
 ) -> jax.Array:
-    """One normal-equation solve per row of the bucket (batched)."""
-    r = opp_factors.shape[-1]
-    V = opp_factors[idx]                       # [B, K, R] gather
-    Vm = V * mask[..., None]
-    n_row = jnp.sum(mask, axis=-1)             # [B]
+    r = opp.shape[-1]
+    nnz = c_sorted.shape[0]
+    prec = jax.lax.Precision(
+        {"highest": "highest", "high": "high", "default": "default"}[precision]
+    )
     if implicit:
-        # A = YtY + sum alpha*r v v^T + reg;  b = sum (1 + alpha*r) v
-        cw = alpha * val * mask                # (c - 1)
-        A = gram + jnp.einsum("bk,bkr,bks->brs", cw, Vm, Vm)
-        b = jnp.einsum("bk,bkr->br", (1.0 + cw) * mask, Vm)
-    else:
-        A = jnp.einsum("bkr,bks->brs", Vm, Vm)
-        b = jnp.einsum("bk,bkr->br", val * mask, Vm)
-    if weighted_lambda:
-        reg = lam * jnp.maximum(n_row, 1.0)        # ALS-WR: λ·n_row
-    else:
-        reg = jnp.full_like(n_row, lam)
-    A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)
-    # batched SPD solve via Cholesky
-    L = jax.lax.linalg.cholesky(A)
-    y = jax.lax.linalg.triangular_solve(
-        L, b[..., None], left_side=True, lower=True
-    )
-    x = jax.lax.linalg.triangular_solve(
-        L, y, left_side=True, lower=True, transpose_a=True
-    )
-    return x[..., 0]                           # [B, R]
-
-
-def _half_iteration(
-    factors_to_update: jax.Array,
-    opp_factors: jax.Array,
-    device_buckets,
-    cfg: ALSConfig,
-) -> jax.Array:
-    if cfg.implicit:
-        gram = opp_factors.T @ opp_factors
-    else:
-        gram = jnp.zeros(
-            (opp_factors.shape[1], opp_factors.shape[1]), opp_factors.dtype
+        gram = jnp.einsum("mr,ms->rs", opp, opp, precision=prec)
+    for (rows, starts, counts), k in zip(bucket_args, ks):
+        iota = jnp.arange(k, dtype=jnp.int32)
+        pos = jnp.minimum(starts[:, None] + iota[None, :], nnz - 1)
+        valid = iota[None, :] < counts[:, None]          # [B, K]
+        idx = jnp.where(valid, c_sorted[pos], 0)
+        val = jnp.where(valid, v_sorted[pos], 0.0)
+        mask = valid.astype(opp.dtype)
+        Vm = opp[idx] * mask[..., None]                  # [B, K, R] gather
+        n_row = counts.astype(opp.dtype)                 # [B]
+        if implicit:
+            # A = YtY + sum alpha*r v v^T + reg;  b = sum (1 + alpha*r) v
+            cw = alpha.astype(opp.dtype) * val * mask    # (c - 1)
+            A = gram + jnp.einsum(
+                "bk,bkr,bks->brs", cw, Vm, Vm, precision=prec
+            )
+            b = jnp.einsum("bk,bkr->br", (1.0 + cw) * mask, Vm,
+                           precision=prec)
+        else:
+            A = jnp.einsum("bkr,bks->brs", Vm, Vm, precision=prec)
+            b = jnp.einsum("bk,bkr->br", val * mask, Vm, precision=prec)
+        lam_t = lam.astype(opp.dtype)
+        if weighted_lambda:
+            reg = lam_t * jnp.maximum(n_row, 1.0)        # ALS-WR: λ·n_row
+        else:
+            reg = jnp.broadcast_to(lam_t, n_row.shape)
+        A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)
+        # batched SPD solve via Cholesky
+        L = jax.lax.linalg.cholesky(A)
+        y = jax.lax.linalg.triangular_solve(
+            L, b[..., None], left_side=True, lower=True
         )
-    lam = jnp.asarray(cfg.lam, opp_factors.dtype)
-    alpha = jnp.asarray(cfg.alpha, opp_factors.dtype)
-    for rows, idx, val, mask in device_buckets:
-        x = _solve_bucket(
-            opp_factors, gram, idx, val, mask, lam, alpha,
-            implicit=cfg.implicit, weighted_lambda=cfg.weighted_lambda,
+        x = jax.lax.linalg.triangular_solve(
+            L, y, left_side=True, lower=True, transpose_a=True
         )
-        x = x[: rows.shape[0]]                 # drop batch padding
-        factors_to_update = factors_to_update.at[rows].set(x)
-    return factors_to_update
+        # batch-padding rows carry row id == N -> dropped by the scatter
+        upd = upd.at[rows].set(
+            x[..., 0].astype(upd.dtype), mode="drop", unique_indices=True
+        )
+    return upd
 
 
-def _stage_buckets(
-    buckets: Buckets,
-    mesh: Optional[Mesh],
-    max_entries_per_call: int = 4 << 20,
-):
-    """Move bucket arrays to device once, padding the batch dim to the mesh
-    size and sharding it over the data axis.
+class ALSTrainer:
+    """Staged ALS state: build once, iterate cheaply.
 
-    Buckets whose B*K exceeds ``max_entries_per_call`` are split into
-    chunks so the gathered ``[B, K, R]`` intermediate stays within a fixed
-    HBM budget regardless of dataset size (splitting reuses the same
-    compiled executable because K and the chunk shapes repeat).
+    Separates the one-time host preprocessing + device staging from the
+    iteration loop so that serving-time retrains, benchmarks, and
+    warm-started sweeps don't re-pay staging.
     """
-    n_dev = mesh.size if mesh is not None else 1
-    staged = []
-    for b in buckets.buckets:
-        k = b.idx.shape[1]
-        b_cap = max(n_dev, (max_entries_per_call // k) // n_dev * n_dev)
-        for s in range(0, len(b.rows), b_cap):
-            rows = b.rows[s : s + b_cap]
-            B = len(rows)
-            Bp = pad_to_multiple(max(B, n_dev), n_dev)
-            idx = np.zeros((Bp, k), b.idx.dtype)
-            val = np.zeros((Bp, k), b.val.dtype)
-            mask = np.zeros((Bp, k), b.mask.dtype)
-            idx[:B] = b.idx[s : s + b_cap]
-            val[:B] = b.val[s : s + b_cap]
-            mask[:B] = b.mask[s : s + b_cap]
-            if mesh is not None and mesh.size > 1:
-                sh = NamedSharding(mesh, P(DATA_AXIS, None))
-                idx = jax.device_put(idx, sh)
-                val = jax.device_put(val, sh)
-                mask = jax.device_put(mask, sh)
-            else:
-                idx, val, mask = map(jnp.asarray, (idx, val, mask))
-            staged.append((jnp.asarray(rows), idx, val, mask))
-    return staged
+
+    def __init__(
+        self,
+        ratings: Ratings | tuple[np.ndarray, np.ndarray, np.ndarray],
+        n_users: Optional[int] = None,
+        n_items: Optional[int] = None,
+        cfg: ALSConfig = ALSConfig(),
+        mesh: Optional[Mesh] = None,
+    ):
+        if isinstance(ratings, Ratings):
+            u, i, v = ratings.user_ix, ratings.item_ix, ratings.rating
+            n_users = ratings.n_users
+            n_items = ratings.n_items
+        else:
+            u, i, v = ratings
+            assert n_users is not None and n_items is not None
+        self.cfg = cfg
+        self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
+        self.n_users = n_users
+        self.n_items = n_items
+
+        n_dev = self.mesh.size if self.mesh is not None else 1
+        self._user_side = self._stage(
+            build_bucket_layout(
+                u, i, v, n_users, cfg.min_bucket_k,
+                cfg.max_ratings_per_row, batch_multiple=n_dev,
+            )
+        )
+        self._item_side = self._stage(
+            build_bucket_layout(
+                i, u, v, n_items, cfg.min_bucket_k,
+                cfg.max_ratings_per_row, batch_multiple=n_dev,
+            )
+        )
+
+    def _stage(self, layout: BucketLayout):
+        """Transfer the sorted COO + bucket index vectors to the device."""
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            dp = NamedSharding(self.mesh, P(DATA_AXIS))
+            put_rep = lambda x: jax.device_put(x, rep)  # noqa: E731
+            put_dp = lambda x: jax.device_put(x, dp)    # noqa: E731
+        else:
+            put_rep = put_dp = jnp.asarray
+        return {
+            "c_sorted": put_rep(layout.col_sorted),
+            "v_sorted": put_rep(layout.val_sorted),
+            "ks": tuple(b.k for b in layout.buckets),
+            "buckets": tuple(
+                (put_dp(b.rows), put_dp(b.starts), put_dp(b.counts))
+                for b in layout.buckets
+            ),
+        }
+
+    def init_factors(self) -> tuple[jax.Array, jax.Array]:
+        """MLlib-style init: N(0, 1)/sqrt(rank), fixed seed."""
+        cfg = self.cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        ku, ki = jax.random.split(key)
+        dtype = jnp.dtype(cfg.compute_dtype)
+        U = jax.random.normal(ku, (self.n_users, cfg.rank), dtype)
+        U = U / jnp.sqrt(cfg.rank).astype(dtype)
+        V = jax.random.normal(ki, (self.n_items, cfg.rank), dtype)
+        V = V / jnp.sqrt(cfg.rank).astype(dtype)
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            U = jax.device_put(U, rep)
+            V = jax.device_put(V, rep)
+        return U, V
+
+    def _half(self, upd, opp, side) -> jax.Array:
+        cfg = self.cfg
+        return _half_iteration(
+            upd, opp, side["c_sorted"], side["v_sorted"], side["buckets"],
+            jnp.asarray(cfg.lam, jnp.float32),
+            jnp.asarray(cfg.alpha, jnp.float32),
+            ks=side["ks"],
+            implicit=cfg.implicit,
+            weighted_lambda=cfg.weighted_lambda,
+            precision=cfg.matmul_precision,
+        )
+
+    def run(
+        self, U: jax.Array, V: jax.Array, num_iterations: int
+    ) -> tuple[jax.Array, jax.Array]:
+        """Iterate; treats U/V functionally (the caller's arrays survive).
+
+        The half-iterations donate their working buffers, so copy the
+        inputs once up front — two [N, R] copies are noise next to one
+        half-iteration, and callers keep usable arrays for warm restarts.
+        """
+        U = jnp.array(U, copy=True)
+        V = jnp.array(V, copy=True)
+        for it in range(num_iterations):
+            U = self._half(U, V, self._user_side)
+            V = self._half(V, U, self._item_side)
+            logger.debug("ALS iteration %d/%d dispatched", it + 1,
+                         num_iterations)
+        U.block_until_ready()
+        return U, V
+
+    def train(self) -> ALSFactors:
+        U, V = self.init_factors()
+        U, V = self.run(U, V, self.cfg.num_iterations)
+        return ALSFactors(
+            user_factors=np.asarray(U), item_factors=np.asarray(V)
+        )
 
 
 def train_als(
@@ -268,42 +387,7 @@ def train_als(
     mesh: Optional[Mesh] = None,
 ) -> ALSFactors:
     """Run ALS to convergence budget; returns host factor arrays."""
-    if isinstance(ratings, Ratings):
-        u, i, v = ratings.user_ix, ratings.item_ix, ratings.rating
-        n_users = ratings.n_users
-        n_items = ratings.n_items
-    else:
-        u, i, v = ratings
-        assert n_users is not None and n_items is not None
-
-    user_buckets = build_buckets(
-        u, i, v, n_users, cfg.min_bucket_k, cfg.max_ratings_per_row
-    )
-    item_buckets = build_buckets(
-        i, u, v, n_items, cfg.min_bucket_k, cfg.max_ratings_per_row
-    )
-    dev_user_buckets = _stage_buckets(user_buckets, mesh)
-    dev_item_buckets = _stage_buckets(item_buckets, mesh)
-
-    # MLlib-style init: N(0, 1)/sqrt(rank) scaled factors, fixed seed
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, ki = jax.random.split(key)
-    dtype = jnp.dtype(cfg.compute_dtype)
-    U = jax.random.normal(ku, (n_users, cfg.rank), dtype) / jnp.sqrt(cfg.rank)
-    V = jax.random.normal(ki, (n_items, cfg.rank), dtype) / jnp.sqrt(cfg.rank)
-    if mesh is not None and mesh.size > 1:
-        rep = NamedSharding(mesh, P())
-        U = jax.device_put(U, rep)
-        V = jax.device_put(V, rep)
-
-    for it in range(cfg.num_iterations):
-        U = _half_iteration(U, V, dev_user_buckets, cfg)
-        V = _half_iteration(V, U, dev_item_buckets, cfg)
-        logger.debug("ALS iteration %d/%d done", it + 1, cfg.num_iterations)
-    U.block_until_ready()
-    return ALSFactors(
-        user_factors=np.asarray(U), item_factors=np.asarray(V)
-    )
+    return ALSTrainer(ratings, n_users, n_items, cfg, mesh).train()
 
 
 # --------------------------------------------------------------------------

@@ -5,6 +5,7 @@ from .mesh import (
     MODEL_AXIS,
     data_sharding,
     distributed_init,
+    enable_compilation_cache,
     make_mesh,
     pad_to_multiple,
     replicated,
@@ -15,6 +16,7 @@ __all__ = [
     "MODEL_AXIS",
     "data_sharding",
     "distributed_init",
+    "enable_compilation_cache",
     "make_mesh",
     "pad_to_multiple",
     "replicated",

@@ -1,0 +1,236 @@
+"""Template gallery: `pio template list|get` rebuilt offline.
+
+The reference (`tools/console/Template.scala:130-427`) browses a GitHub
+gallery, downloads a release zip, rewrites the Scala package name, and
+records `template.json` metadata; `verifyTemplateMinVersion` (`:417-427`)
+gates `train`/`deploy` on the template's declared minimum framework
+version.  This build has no network egress, so the gallery is the set of
+built-in template families (SURVEY §2.6) and `template get` scaffolds a
+self-contained engine directory — `engine.py` subclassing the built-in
+components, `engine.json` variant, `template.json` metadata, README —
+that `pio-tpu train`/`deploy` consume directly.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from .. import __version__
+
+__all__ = [
+    "GALLERY",
+    "TemplateMeta",
+    "list_templates",
+    "scaffold",
+    "verify_template_min_version",
+    "TemplateVersionError",
+]
+
+
+@dataclass(frozen=True)
+class TemplateMeta:
+    name: str
+    description: str
+    factory: str                     # dotted path to the engine factory
+    engine_params: dict = field(default_factory=dict)
+    evaluation: Optional[str] = None
+    query_example: dict = field(default_factory=dict)
+
+
+GALLERY: dict[str, TemplateMeta] = {
+    "recommendation": TemplateMeta(
+        name="recommendation",
+        description=(
+            "Personalized recommendation via block-ALS on TPU "
+            "(scala-parallel-recommendation analogue)"
+        ),
+        factory="predictionio_tpu.templates.recommendation"
+        ".recommendation_engine",
+        engine_params={
+            "datasource": {
+                "params": {"appName": "MyApp", "eventNames": ["rate", "buy"]}
+            },
+            "algorithms": [
+                {
+                    "name": "als",
+                    "params": {
+                        "rank": 10,
+                        "numIterations": 20,
+                        "lambda": 0.01,
+                        "seed": 3,
+                    },
+                }
+            ],
+        },
+        evaluation="predictionio_tpu.templates.recommendation"
+        ".recommendation_evaluation",
+        query_example={"user": "1", "num": 4},
+    ),
+    "similarproduct": TemplateMeta(
+        name="similarproduct",
+        description=(
+            "Similar-product ranking from item factors "
+            "(scala-parallel-similarproduct analogue)"
+        ),
+        factory="predictionio_tpu.templates.similarproduct"
+        ".similarproduct_engine",
+        engine_params={
+            "datasource": {"params": {"appName": "MyApp"}},
+            "algorithms": [
+                {
+                    "name": "als",
+                    "params": {"rank": 10, "numIterations": 20,
+                               "lambda": 0.01, "seed": 3},
+                }
+            ],
+        },
+        query_example={"items": ["1"], "num": 4},
+    ),
+    "classification": TemplateMeta(
+        name="classification",
+        description=(
+            "Attribute classification: naive bayes / TPU logistic "
+            "(scala-parallel-classification analogue)"
+        ),
+        factory="predictionio_tpu.templates.classification"
+        ".classification_engine",
+        engine_params={
+            "datasource": {"params": {"appName": "MyApp"}},
+            "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+        },
+        query_example={"features": [2.0, 0.0, 0.0]},
+    ),
+    "ecommercerecommendation": TemplateMeta(
+        name="ecommercerecommendation",
+        description=(
+            "E-commerce recommendation with serving-time event filtering "
+            "(scala-parallel-ecommercerecommendation analogue)"
+        ),
+        factory="predictionio_tpu.templates.ecommerce.ecommerce_engine",
+        engine_params={
+            "datasource": {"params": {"appName": "MyApp"}},
+            "algorithms": [
+                {
+                    "name": "ecomm",
+                    "params": {
+                        "appName": "MyApp",
+                        "unseenOnly": True,
+                        "seenEvents": ["buy", "view"],
+                        "rank": 10,
+                        "numIterations": 20,
+                        "lambda": 0.01,
+                        "seed": 3,
+                    },
+                }
+            ],
+        },
+        query_example={"user": "u1", "num": 4},
+    ),
+}
+
+
+def list_templates() -> list[TemplateMeta]:
+    return list(GALLERY.values())
+
+
+_ENGINE_PY = '''\
+"""Engine scaffolded from the built-in `{name}` template.
+
+Customize by subclassing the imported components (the reference's
+`template get` rewrites a downloaded Scala project; here the framework
+components are imported and re-exported so the engine.json stays small).
+"""
+
+from {module} import *  # noqa: F401,F403
+from {module} import {attr} as engine_factory  # noqa: F401
+'''
+
+_README = """\
+# {name} (predictionio_tpu template)
+
+{description}
+
+## Usage
+
+    pio-tpu app new MyApp                 # create app + access key
+    pio-tpu import --appid <id> --input events.jsonl
+    pio-tpu build                         # register the engine
+    pio-tpu train                         # train on the TPU mesh
+    pio-tpu deploy --port 8000            # serve queries.json
+
+Query example:
+
+    curl -H 'Content-Type: application/json' \\
+         -d '{query}' http://localhost:8000/queries.json
+"""
+
+
+def scaffold(template_name: str, target_dir: str | Path) -> Path:
+    """`pio template get` analogue: write a runnable engine directory."""
+    meta = GALLERY.get(template_name)
+    if meta is None:
+        raise KeyError(
+            f"unknown template {template_name!r}; "
+            f"available: {', '.join(sorted(GALLERY))}"
+        )
+    target = Path(target_dir)
+    if target.exists() and any(target.iterdir()):
+        raise FileExistsError(f"target directory {target} is not empty")
+    target.mkdir(parents=True, exist_ok=True)
+
+    module, _, attr = meta.factory.rpartition(".")
+    (target / "engine.py").write_text(
+        _ENGINE_PY.format(name=meta.name, module=module, attr=attr)
+    )
+    variant = {
+        "id": meta.name,
+        "description": meta.description,
+        "engineFactory": meta.factory,
+        **meta.engine_params,
+    }
+    (target / "engine.json").write_text(json.dumps(variant, indent=2) + "\n")
+    # template.json: min-version metadata (Template.scala:417-427 analogue)
+    (target / "template.json").write_text(
+        json.dumps({"pio": {"version": {"min": __version__}}}, indent=2)
+        + "\n"
+    )
+    (target / "README.md").write_text(
+        _README.format(
+            name=meta.name,
+            description=meta.description,
+            query=json.dumps(meta.query_example),
+        )
+    )
+    return target
+
+
+class TemplateVersionError(RuntimeError):
+    pass
+
+
+def _ver_tuple(v: str) -> tuple[int, ...]:
+    parts = []
+    for p in v.split("."):
+        digits = "".join(c for c in p if c.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def verify_template_min_version(engine_dir: str | Path) -> None:
+    """Raise if template.json declares a min version newer than ours."""
+    tj = Path(engine_dir) / "template.json"
+    if not tj.exists():
+        return
+    try:
+        meta = json.loads(tj.read_text())
+        min_v = meta["pio"]["version"]["min"]
+    except (ValueError, KeyError, TypeError):
+        return
+    if _ver_tuple(str(min_v)) > _ver_tuple(__version__):
+        raise TemplateVersionError(
+            f"template requires predictionio_tpu >= {min_v}, "
+            f"this is {__version__}"
+        )

@@ -12,7 +12,8 @@ import pytest
 from predictionio_tpu.models.als import (
     ALSConfig,
     ALSFactors,
-    build_buckets,
+    ALSTrainer,
+    build_bucket_layout,
     rmse,
     train_als,
 )
@@ -62,35 +63,48 @@ def _reference_als_explicit(u, i, v, n_users, n_items, cfg: ALSConfig):
     return ALSFactors(user_factors=U, item_factors=V)
 
 
-def test_buckets_cover_all_ratings():
+def test_bucket_layout_covers_all_ratings():
     u, i, v, nu, ni = _toy()
-    bk = build_buckets(u, i, v, nu, min_k=4)
+    layout = build_bucket_layout(u, i, v, nu, min_k=4)
+    # sorted COO is a permutation of the input
+    assert len(layout.col_sorted) == len(v)
+    np.testing.assert_array_equal(np.sort(layout.val_sorted), np.sort(v))
     seen = 0
-    for b in bk.buckets:
-        assert b.idx.shape == b.val.shape == b.mask.shape
-        assert b.idx.shape[1] >= 4
-        seen += int(b.mask.sum())
-        # every row appears once and padded entries are masked out
-        assert (b.mask.sum(axis=1) > 0).all()
+    real_rows = []
+    for b in layout.buckets:
+        assert b.k >= 4 and b.k & (b.k - 1) == 0  # power of two
+        assert (b.counts <= b.k).all()
+        real = b.rows < nu  # padding rows carry id == n_rows
+        assert (b.counts[~real] == 0).all()
+        assert (b.counts[real] > 0).all()
+        seen += int(b.counts.sum())
+        real_rows.append(b.rows[real])
     assert seen == len(v)
-    all_rows = np.concatenate([b.rows for b in bk.buckets])
+    all_rows = np.concatenate(real_rows)
     assert len(np.unique(all_rows)) == len(all_rows)
+    # per-row slices land on the row's own ratings
+    counts = np.bincount(u, minlength=nu)
+    for b in layout.buckets:
+        for rid, start, cnt in zip(b.rows, b.starts, b.counts):
+            if rid >= nu:
+                continue
+            assert cnt == min(counts[rid], b.k)
 
 
-def test_buckets_pow2_widths():
-    u, i, v, nu, ni = _toy()
-    bk = build_buckets(u, i, v, nu, min_k=4)
-    for b in bk.buckets:
-        k = b.idx.shape[1]
-        assert k & (k - 1) == 0  # power of two
-
-
-def test_buckets_cap_truncates():
+def test_bucket_layout_cap_truncates():
     u = np.zeros(100, dtype=np.int32)
     i = np.arange(100, dtype=np.int32)
     v = np.ones(100, dtype=np.float32)
-    bk = build_buckets(u, i, v, 1, min_k=4, max_per_row=16)
-    assert bk.buckets[0].idx.shape == (1, 16)
+    layout = build_bucket_layout(u, i, v, 1, min_k=4, max_per_row=16)
+    (b,) = layout.buckets
+    assert b.k == 16 and b.counts[0] == 16
+
+
+def test_bucket_layout_batch_multiple_padding():
+    u, i, v, nu, ni = _toy()
+    layout = build_bucket_layout(u, i, v, nu, min_k=4, batch_multiple=8)
+    for b in layout.buckets:
+        assert len(b.rows) % 8 == 0
 
 
 def test_explicit_matches_numpy_reference():
@@ -190,21 +204,40 @@ def test_runs_on_8_device_mesh():
     )
 
 
-def test_bucket_splitting_matches_unsplit():
-    """Capping max entries per device call must not change results."""
+def test_bucket_splitting_matches_unsplit(monkeypatch):
+    """Capping max entries per bucket chunk must not change results."""
     from predictionio_tpu.models import als as als_mod
 
     u, i, v, nu, ni = _toy()
     cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1)
     full = train_als((u, i, v), nu, ni, cfg)
-    orig = als_mod._stage_buckets
-    try:
-        als_mod._stage_buckets = lambda b, m, max_entries_per_call=64: orig(
-            b, m, max_entries_per_call=64
-        )
-        split = train_als((u, i, v), nu, ni, cfg)
-    finally:
-        als_mod._stage_buckets = orig
+    monkeypatch.setattr(als_mod, "MAX_ENTRIES_PER_BUCKET", 64)
+    split = train_als((u, i, v), nu, ni, cfg)
     np.testing.assert_allclose(
         split.user_factors, full.user_factors, rtol=1e-5, atol=1e-5
     )
+
+
+def test_trainer_staged_reuse_matches_fresh():
+    """ALSTrainer.run on a staged trainer == fresh train_als."""
+    u, i, v, nu, ni = _toy()
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1)
+    trainer = ALSTrainer((u, i, v), nu, ni, cfg)
+    U, V = trainer.init_factors()
+    U, V = trainer.run(U, V, 3)
+    fresh = train_als((u, i, v), nu, ni, cfg)
+    np.testing.assert_allclose(np.asarray(U), fresh.user_factors,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_trainer_inputs_survive_run():
+    """run() must not invalidate the caller's arrays (donation is
+    internal): re-running from the same init is the warm-restart
+    contract, and sweeping lam must not recompile into wrong results."""
+    u, i, v, nu, ni = _toy()
+    trainer = ALSTrainer((u, i, v), nu, ni, ALSConfig(rank=4, lam=0.1))
+    U0, V0 = trainer.init_factors()
+    a, _ = trainer.run(U0, V0, 2)
+    b, _ = trainer.run(U0, V0, 2)  # U0/V0 still alive
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert np.isfinite(np.asarray(U0)).all()

@@ -193,3 +193,74 @@ def test_eval_via_cli(cli, tmp_path, monkeypatch):
     assert code == 0
     assert "[7.0]" in out
     assert "Evaluation completed" in out
+
+
+def test_template_list_and_get(cli, tmp_path):
+    run, s, _ = cli
+    code, out = run("template", "list")
+    assert code == 0
+    for name in ("recommendation", "similarproduct", "classification",
+                 "ecommercerecommendation"):
+        assert name in out
+
+    target = tmp_path / "my-engine"
+    code, out = run("template", "get", "recommendation", str(target))
+    assert code == 0
+    assert (target / "engine.json").exists()
+    assert (target / "engine.py").exists()
+    assert (target / "template.json").exists()
+    variant = json.loads((target / "engine.json").read_text())
+    assert variant["engineFactory"].endswith("recommendation_engine")
+
+    # scaffolding into a non-empty directory fails cleanly
+    code, out = run("template", "get", "recommendation", str(target))
+    assert code == 1 and "not empty" in out
+
+    code, out = run("template", "get", "nope", str(tmp_path / "x"))
+    assert code == 1 and "unknown template" in out
+
+
+def test_template_min_version_gate(cli, tmp_path):
+    from predictionio_tpu.tools.template_gallery import (
+        TemplateVersionError, verify_template_min_version)
+
+    d = tmp_path / "eng"
+    d.mkdir()
+    (d / "template.json").write_text(
+        json.dumps({"pio": {"version": {"min": "999.0.0"}}})
+    )
+    with pytest.raises(TemplateVersionError):
+        verify_template_min_version(d)
+    # absent or malformed template.json passes
+    verify_template_min_version(tmp_path)
+    (d / "template.json").write_text("not json")
+    verify_template_min_version(d)
+
+
+def test_build_unregister(cli, tmp_path):
+    run, s, _ = cli
+    target = tmp_path / "eng2"
+    run("template", "get", "classification", str(target))
+    ej = str(target / "engine.json")
+    code, out = run("build", "--engine-json", ej)
+    assert code == 0 and "registered" in out
+    m = s.get_metadata().manifest_get("classification", "1")
+    assert m is not None and m.engine_factory.endswith("classification_engine")
+
+    code, out = run("unregister", "--engine-json", ej)
+    assert code == 0
+    assert s.get_metadata().manifest_get("classification", "1") is None
+
+
+def test_run_command(cli, tmp_path):
+    run, s, _ = cli
+    code, out = run("run", "builtins.print", "hello-from-run")
+    assert code == 0 and "hello-from-run" in out
+
+
+def test_upgrade_and_undeploy_unreachable(cli):
+    run, s, _ = cli
+    code, out = run("upgrade")
+    assert code == 0 and "pio-tpu" in out
+    code, out = run("undeploy", "--ip", "127.0.0.1", "--port", "59999")
+    assert code == 1 and "cannot undeploy" in out

@@ -1,0 +1,81 @@
+"""End-to-end checks for the engines under examples/."""
+
+import importlib
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.controller.base import WorkflowContext
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def _load_example(name: str):
+    """Import an example's engine module the way the CLI would (cwd on path)."""
+    d = EXAMPLES / name
+    sys.path.insert(0, str(d))
+    old_cwd = os.getcwd()
+    os.chdir(d)
+    try:
+        for mod in ("engine",):
+            sys.modules.pop(mod, None)
+        m = importlib.import_module("engine")
+        yield_obj = m
+    finally:
+        pass
+    return yield_obj, old_cwd, str(d)
+
+
+@pytest.fixture()
+def in_example(request):
+    holders = []
+
+    def load(name):
+        m, old_cwd, d = _load_example(name)
+        holders.append((old_cwd, d))
+        return m
+
+    yield load
+    for old_cwd, d in holders:
+        os.chdir(old_cwd)
+        if d in sys.path:
+            sys.path.remove(d)
+    sys.modules.pop("engine", None)
+
+
+def _train_and_params(m):
+    import json
+
+    engine = m.engine_factory()
+    variant = json.loads(Path("engine.json").read_text())
+    ep = engine.params_from_variant(variant)
+    ctx = WorkflowContext()
+    models = engine.train(ctx, ep)
+    return engine, ep, models
+
+
+def test_helloworld(in_example):
+    m = in_example("helloworld")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    r = algo.predict(models[0], m.Query(day="Mon"))
+    assert r.temperature == pytest.approx((75 + 62) / 2)
+
+
+def test_regression(in_example):
+    m = in_example("regression")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # data is y = 1 + x1 + x2 exactly
+    pred = algo.predict(models[0], m.Query(features=[2.0, 3.0]))
+    assert pred == pytest.approx(6.0, abs=0.05)
+
+
+def test_markovchain(in_example):
+    m = in_example("markovchain")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    ranked = algo.predict(models[0], m.Query(state="search"))
+    assert ranked and ranked[0][0] == "product"

@@ -1,0 +1,80 @@
+"""Deprecated batch-view compat layer (reference `data/view/*.scala`)."""
+
+import datetime as dt
+
+import pytest
+
+from predictionio_tpu.storage.event import UTC, DataMap, Event
+from predictionio_tpu.storage.levents import MemoryEventStore
+from predictionio_tpu.storage.views import BatchView, LBatchView, PBatchView
+
+
+def _t(h):
+    return dt.datetime(2024, 1, 1, h, tzinfo=UTC)
+
+
+@pytest.fixture()
+def store():
+    s = MemoryEventStore()
+    s.init_channel(1)
+    events = [
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"a": 1}), event_time=_t(1)),
+        Event(event="$set", entity_type="user", entity_id="u1",
+              properties=DataMap({"b": 2}), event_time=_t(2)),
+        Event(event="$unset", entity_type="user", entity_id="u1",
+              properties=DataMap({"a": None}), event_time=_t(3)),
+        Event(event="$set", entity_type="user", entity_id="u2",
+              properties=DataMap({"a": 9}), event_time=_t(2)),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 4.0}), event_time=_t(4)),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2",
+              properties=DataMap({"rating": 2.0}), event_time=_t(5)),
+    ]
+    s.insert_batch(events, 1)
+    return s
+
+
+def test_events_and_filter(store):
+    view = BatchView(store, app_id=1)
+    assert len(view.events) == 6
+    rates = view.events.filter(event_name="rate")
+    assert len(rates) == 2
+    windowed = view.events.filter(start_time=_t(2), until_time=_t(4))
+    assert len(windowed) == 3  # t2 x2, t3; until is exclusive
+
+
+def test_time_window_at_view_level(store):
+    view = BatchView(store, app_id=1, start_time=_t(4))
+    assert all(e.event == "rate" for e in view.events)
+
+
+def test_aggregate_properties(store):
+    props = BatchView(store, app_id=1).aggregate_properties("user")
+    assert props["u1"].fields == {"b": 2}  # a was unset
+    assert props["u2"].fields == {"a": 9}
+
+
+def test_aggregate_by_entity_ordered(store):
+    view = BatchView(store, app_id=1)
+    sums = view.events.filter(event_name="rate").aggregate_by_entity_ordered(
+        0.0, lambda acc, e: acc + e.properties.get_float("rating")
+    )
+    assert sums == {"u1": 6.0}
+
+
+def test_group_by_entity_ordered(store):
+    view = BatchView(store, app_id=1)
+    seqs = view.events.filter(event_name="rate").group_by_entity_ordered(
+        lambda e: e.target_entity_id
+    )
+    assert seqs == {"u1": ["i1", "i2"]}  # time order preserved
+
+
+def test_deprecation_warnings(store):
+    with pytest.warns(DeprecationWarning):
+        LBatchView(store, app_id=1)
+    with pytest.warns(DeprecationWarning):
+        PBatchView(store, app_id=1)

@@ -43,6 +43,23 @@ def resolve_attr(path: str) -> Any:
         raise ValueError(f"{attr!r} not found in module {mod_name}") from e
 
 
+def _engine_dir_on_path(variant_path: str | Path, factory_path: str) -> None:
+    """Make a scaffolded engine dir importable: its ``engine.py`` is the
+    factory module when engineFactory is ``engine.<attr>`` (the
+    `template get` layout).  Evicts a stale ``engine`` module loaded from
+    a different engine dir."""
+    engine_dir = str(Path(variant_path).resolve().parent)
+    top = factory_path.split(".", 1)[0]
+    candidate = Path(engine_dir) / f"{top}.py"
+    if not candidate.exists():
+        return
+    if engine_dir not in sys.path:
+        sys.path.insert(0, engine_dir)
+    mod = sys.modules.get(top)
+    if mod is not None and getattr(mod, "__file__", None) != str(candidate):
+        del sys.modules[top]
+
+
 def load_engine_from_variant(
     variant_path: str | Path, engine_factory: Optional[str] = None
 ):
@@ -54,6 +71,7 @@ def load_engine_from_variant(
             "engine.json must declare 'engineFactory' "
             "(or pass --engine-factory)"
         )
+    _engine_dir_on_path(variant_path, factory_path)
     factory = resolve_attr(factory_path)
     engine = factory() if callable(factory) else factory
     if hasattr(engine, "apply"):  # EngineFactory object
@@ -212,6 +230,16 @@ def cmd_train(args, storage: Storage) -> int:
 
     enable_compilation_cache()
     verify_template_min_version(Path(args.engine_json).parent)
+    if args.coordinator or args.num_processes is not None:
+        # multi-host bring-up: each host runs the same `pio-tpu train`
+        # with its own --process-id; collectives then span hosts
+        from ..parallel.mesh import distributed_init
+
+        distributed_init(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     engine, ep, variant = load_engine_from_variant(
         args.engine_json, args.engine_factory
     )
@@ -332,22 +360,30 @@ def cmd_dashboard(args, storage: Storage) -> int:
 
 
 def cmd_import(args, storage: Storage) -> int:
-    from ..tools.import_export import import_events
+    from ..tools.import_export import import_events, import_events_columnar
 
     es = storage.get_event_store()
     es.init_channel(args.appid, args.channel)
-    n = import_events(args.input, es, args.appid, args.channel)
+    if str(args.input).endswith(".npz"):
+        n = import_events_columnar(args.input, es, args.appid, args.channel)
+    else:
+        n = import_events(args.input, es, args.appid, args.channel)
     _out(f"Imported {n} events.")
     return 0
 
 
 def cmd_export(args, storage: Storage) -> int:
-    from ..tools.import_export import export_events
+    from ..tools.import_export import columnar_path, export_events
 
     es = storage.get_event_store()
     es.init_channel(args.appid, args.channel)
-    n = export_events(args.output, es, args.appid, args.channel)
-    _out(f"Exported {n} events.")
+    n = export_events(args.output, es, args.appid, args.channel,
+                      fmt=args.format)
+    fmt = args.format or (
+        "columnar" if str(args.output).endswith(".npz") else "json"
+    )
+    written = columnar_path(args.output) if fmt == "columnar" else args.output
+    _out(f"Exported {n} events to {written}.")
     return 0
 
 
@@ -484,6 +520,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--version", action="version",
                    version=f"pio-tpu {__version__}")
+    p.add_argument("--verbose", action="store_true",
+                   help="chatty logging (WorkflowUtils.modifyLogging)")
+    p.add_argument("--debug", action="store_true",
+                   help="debug logging")
     sub = p.add_subparsers(dest="command", required=True)
 
     ap = sub.add_parser("app", help="manage apps")
@@ -524,6 +564,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--skip-sanity-check", action="store_true")
     t.add_argument("--stop-after-read", action="store_true")
     t.add_argument("--stop-after-prepare", action="store_true")
+    t.add_argument("--coordinator",
+                   help="multi-host: coordinator address host:port")
+    t.add_argument("--num-processes", type=int)
+    t.add_argument("--process-id", type=int)
 
     d = sub.add_parser("deploy", help="deploy an engine server")
     d.add_argument("--engine-json", default="engine.json")
@@ -560,10 +604,12 @@ def build_parser() -> argparse.ArgumentParser:
     im.add_argument("--channel", type=int, default=0)
     im.add_argument("--input", required=True)
 
-    ex = sub.add_parser("export", help="export events to JSON-lines file")
+    ex = sub.add_parser("export", help="export events to a file")
     ex.add_argument("--appid", type=int, required=True)
     ex.add_argument("--channel", type=int, default=0)
     ex.add_argument("--output", required=True)
+    ex.add_argument("--format", choices=["json", "columnar"],
+                    help="default: json, or columnar if output is .npz")
 
     tp = sub.add_parser("template", help="engine template gallery")
     tps = tp.add_subparsers(dest="template_command", required=True)
@@ -619,11 +665,19 @@ _DISPATCH = {
 def main(argv: Optional[list[str]] = None,
          storage: Optional[Storage] = None) -> int:
     args = build_parser().parse_args(argv)
+    from ..tools.template_gallery import TemplateVersionError
+    from ..utils.logging import setup_logging
+
+    setup_logging(verbose=args.verbose, debug=args.debug)
     if args.command == "version":
         _out(f"pio-tpu {__version__}")
         return 0
     storage = storage or get_storage()
-    return _DISPATCH[args.command](args, storage)
+    try:
+        return _DISPATCH[args.command](args, storage)
+    except TemplateVersionError as e:
+        _out(f"Error: {e}")
+        return 1
 
 
 if __name__ == "__main__":

@@ -146,6 +146,12 @@ class Algorithm(Generic[PD, M, Q, P]):
     def train(self, ctx: WorkflowContext, prepared_data: PD) -> M:
         raise NotImplementedError
 
+    def warmup(self, model: M) -> None:  # noqa: B027 — optional hook
+        """Pre-compile the scoring path at deploy time so the first real
+        query doesn't pay XLA compilation (the AOT-dispatch obligation of
+        a <100 ms-class rec server; reference deploys are warm because
+        JVM models need no compile)."""
+
     def predict(self, model: M, query: Q) -> P:
         raise NotImplementedError
 

@@ -85,6 +85,9 @@ class ALSConfig:
     # "default" (bf16).  RMSE parity wants "highest"; ranking-only workloads
     # can trade down.
     matmul_precision: str = "highest"
+    # batched SPD solver: "xla" (lax.linalg) or "pallas"
+    # (ops/solve.py batch-lane kernel)
+    solver: str = "xla"
 
 
 @dataclass
@@ -179,7 +182,10 @@ def build_bucket_layout(
             rows = rows_k[s : s + b_cap]
             B = len(rows)
             Bp = pad_to_multiple(max(B, batch_multiple), batch_multiple)
-            rows_p = np.full(Bp, n_rows, dtype=np.int32)
+            # padding ids are distinct OOB values (n_rows, n_rows+1, ...):
+            # the scatter drops them, and uniqueness stays honest for
+            # unique_indices=True
+            rows_p = n_rows + np.arange(Bp, dtype=np.int32)
             starts_p = np.zeros(Bp, dtype=np.int32)
             counts_p = np.zeros(Bp, dtype=np.int32)
             rows_p[:B] = rows
@@ -198,7 +204,9 @@ def build_bucket_layout(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ks", "implicit", "weighted_lambda", "precision"),
+    static_argnames=(
+        "ks", "implicit", "weighted_lambda", "precision", "solver",
+    ),
     donate_argnums=(0,),
 )
 def _half_iteration(
@@ -214,6 +222,7 @@ def _half_iteration(
     implicit: bool,
     weighted_lambda: bool,
     precision: str,
+    solver: str,
 ) -> jax.Array:
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]
@@ -249,16 +258,23 @@ def _half_iteration(
             reg = jnp.broadcast_to(lam_t, n_row.shape)
         A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)
         # batched SPD solve via Cholesky
-        L = jax.lax.linalg.cholesky(A)
-        y = jax.lax.linalg.triangular_solve(
-            L, b[..., None], left_side=True, lower=True
-        )
-        x = jax.lax.linalg.triangular_solve(
-            L, y, left_side=True, lower=True, transpose_a=True
-        )
+        if solver == "pallas":
+            from ..ops.solve import cholesky_solve_batched
+
+            x = cholesky_solve_batched(
+                A.astype(jnp.float32), b.astype(jnp.float32)
+            )
+        else:
+            L = jax.lax.linalg.cholesky(A)
+            y = jax.lax.linalg.triangular_solve(
+                L, b[..., None], left_side=True, lower=True
+            )
+            x = jax.lax.linalg.triangular_solve(
+                L, y, left_side=True, lower=True, transpose_a=True
+            )[..., 0]
         # batch-padding rows carry row id == N -> dropped by the scatter
         upd = upd.at[rows].set(
-            x[..., 0].astype(upd.dtype), mode="drop", unique_indices=True
+            x.astype(upd.dtype), mode="drop", unique_indices=True
         )
     return upd
 
@@ -350,6 +366,7 @@ class ALSTrainer:
             implicit=cfg.implicit,
             weighted_lambda=cfg.weighted_lambda,
             precision=cfg.matmul_precision,
+            solver=cfg.solver,
         )
 
     def run(

@@ -114,6 +114,20 @@ class EngineServer(HTTPServerBase):
         algorithms, models, serving = prepare_deploy_components(
             self.engine, self.engine_params, instance_id, ctx=self.ctx
         )
+        for algo, model in zip(algorithms, models):
+            t0 = time.time()
+            try:
+                algo.warmup(model)
+            except Exception:
+                logger.exception(
+                    "warmup failed for %s (first query will compile)",
+                    type(algo).__name__,
+                )
+            else:
+                dt = time.time() - t0
+                if dt > 0.05:
+                    logger.info("%s warmed up in %.2fs",
+                                type(algo).__name__, dt)
         with self._lock:
             self.models = models
             self.algorithms = algorithms

@@ -181,6 +181,18 @@ class ECommAlgorithm(Algorithm):
             logger.error("error reading unavailableItems: %s", e)
             return set()
 
+    def warmup(self, model: ECommModel) -> None:
+        """Pre-compile the biased top-k scorer for the common ``num``
+        values (every e-comm query carries a filter mask)."""
+        n = len(model.items)
+        if n == 0:
+            return
+        table = model.device_item_factors()
+        vec = np.zeros(model.item_factors.shape[1], np.float32)
+        bias = np.zeros(n, np.float32)
+        for k in {min(k, n) for k in (1, 4, 10, 20)}:
+            topk_scores(vec, table, k, bias=bias)
+
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)
         if uix < 0 or query.num <= 0:

@@ -304,6 +304,19 @@ class ALSAlgorithm(Algorithm):
         mask = np.where(allowed, 0.0, -np.inf).astype(np.float32)
         return mask
 
+    def warmup(self, model: ALSModel) -> None:
+        """Compile the top-k scorers for the common ``num`` values (the
+        static k arg keys the executable) before the first real query."""
+        n = len(model.items)
+        if n == 0:
+            return
+        table = model.device_item_factors()
+        vec = np.zeros(model.item_factors.shape[1], np.float32)
+        bias = np.zeros(n, np.float32)
+        for k in {min(k, n) for k in (1, 4, 10, 20)}:
+            topk_scores(vec, table, k)
+            topk_scores(vec, table, k, bias=bias)
+
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)
         if uix < 0 or query.num <= 0:

@@ -170,6 +170,18 @@ class SimilarProductAlgorithm(Algorithm):
         )
 
     # -- serving -----------------------------------------------------------
+    def warmup(self, model: SimilarALSModel) -> None:
+        """Pre-compile the cosine top-k scorer (and pre-normalize the
+        device table) for the common ``num`` values."""
+        n = len(model.items)
+        if n == 0:
+            return
+        tn = model.device_item_factors_normalized()
+        vec = np.zeros(model.item_factors.shape[1], np.float32)
+        bias = np.zeros(n, np.float32)
+        for k in {min(k, n) for k in (1, 4, 10, 20)}:
+            topk_scores(vec, tn, k, bias=bias)
+
     def predict(self, model: SimilarALSModel, query: Query) -> PredictedResult:
         known = [model.items.get(i) for i in query.items]
         known = [i for i in known if i >= 0]

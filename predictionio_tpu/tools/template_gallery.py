@@ -185,10 +185,13 @@ def scaffold(template_name: str, target_dir: str | Path) -> Path:
     (target / "engine.py").write_text(
         _ENGINE_PY.format(name=meta.name, module=module, attr=attr)
     )
+    # engineFactory points at the scaffolded engine.py (resolved relative
+    # to the engine dir by the workflow loader), so user edits there take
+    # effect — pointing at the built-in factory would make the file dead.
     variant = {
         "id": meta.name,
         "description": meta.description,
-        "engineFactory": meta.factory,
+        "engineFactory": "engine.engine_factory",
         **meta.engine_params,
     }
     (target / "engine.json").write_text(json.dumps(variant, indent=2) + "\n")

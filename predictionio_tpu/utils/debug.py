@@ -1,0 +1,64 @@
+"""Recursive debug dumper for workflow data.
+
+Analogue of the reference's `WorkflowUtils.debugString`
+(`workflow/WorkflowUtils.scala:228-245`), which collects RDDs and walks
+arrays/iterables.  Here the interesting container types are jax arrays
+(fetched to host, summarized with shape/dtype/sharding), numpy arrays,
+dataclasses, and mappings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+__all__ = ["debug_string"]
+
+_MAX_ITEMS = 20
+
+
+def _array_summary(x) -> str:
+    shape = "x".join(map(str, x.shape)) or "scalar"
+    parts = [f"{type(x).__name__}[{shape}] {x.dtype}"]
+    sharding = getattr(x, "sharding", None)
+    if sharding is not None and getattr(sharding, "spec", None) is not None:
+        parts.append(f"spec={sharding.spec}")
+    flat = np.asarray(x).reshape(-1)
+    if flat.size:
+        head = np.array2string(
+            flat[:8], precision=4, separator=",", threshold=8
+        )
+        parts.append(f"head={head}")
+    return " ".join(parts)
+
+
+def debug_string(data: Any, depth: int = 0) -> str:
+    """Human dump of arbitrarily nested workflow data structures."""
+    if depth > 6:
+        return "..."
+    if data is None or isinstance(data, (bool, int, float, str, bytes)):
+        return repr(data)
+    if hasattr(data, "shape") and hasattr(data, "dtype"):
+        return _array_summary(data)
+    if dataclasses.is_dataclass(data) and not isinstance(data, type):
+        inner = ", ".join(
+            f"{f.name}={debug_string(getattr(data, f.name), depth + 1)}"
+            for f in dataclasses.fields(data)
+        )
+        return f"{type(data).__name__}({inner})"
+    if isinstance(data, dict):
+        items = list(data.items())[:_MAX_ITEMS]
+        inner = ", ".join(
+            f"{k!r}: {debug_string(v, depth + 1)}" for k, v in items
+        )
+        more = ", ..." if len(data) > _MAX_ITEMS else ""
+        return "{" + inner + more + "}"
+    if isinstance(data, (list, tuple, set, frozenset)):
+        items = list(data)[:_MAX_ITEMS]
+        inner = ",".join(debug_string(v, depth + 1) for v in items)
+        more = ",..." if len(data) > _MAX_ITEMS else ""
+        open_, close = ("[", "]") if isinstance(data, list) else ("(", ")")
+        return f"{open_}{inner}{more}{close}"
+    return repr(data)

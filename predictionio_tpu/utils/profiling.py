@@ -1,0 +1,65 @@
+"""JAX profiler hooks — the Spark-UI replacement (SURVEY §5).
+
+Wraps ``jax.profiler`` so workflows can capture device traces without
+importing jax at module scope in ops code.  Traces land under
+``$PIO_TPU_HOME/profiles/<tag>`` and open in TensorBoard / Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["profile_trace", "profiled", "profile_dir"]
+
+
+def profile_dir(tag: str = "trace") -> Path:
+    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser(
+        "~/.predictionio_tpu"
+    )
+    p = Path(home) / "profiles" / tag
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+@contextlib.contextmanager
+def profile_trace(tag: str = "trace", enabled: Optional[bool] = None):
+    """Capture a device trace for the enclosed block.
+
+    ``enabled=None`` reads ``PIO_TPU_PROFILE=1`` so production paths can
+    carry the hook at zero cost until it's switched on.
+    """
+    if enabled is None:
+        enabled = os.environ.get("PIO_TPU_PROFILE") == "1"
+    if not enabled:
+        yield None
+        return
+    import jax
+
+    out = profile_dir(tag)
+    t0 = time.time()
+    with jax.profiler.trace(str(out)):
+        yield out
+    logger.info("profile '%s' captured in %.2fs -> %s",
+                tag, time.time() - t0, out)
+
+
+def profiled(tag: Optional[str] = None):
+    """Decorator form of :func:`profile_trace`."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with profile_trace(tag or fn.__qualname__):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco

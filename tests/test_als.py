@@ -241,3 +241,20 @@ def test_trainer_inputs_survive_run():
     b, _ = trainer.run(U0, V0, 2)  # U0/V0 still alive
     np.testing.assert_allclose(np.asarray(a), np.asarray(b))
     assert np.isfinite(np.asarray(U0)).all()
+
+
+def test_pallas_solver_matches_xla():
+    """solver='pallas' (batch-lane Cholesky kernel) == solver='xla'."""
+    u, i, v, nu, ni = _toy()
+    base = ALSConfig(rank=8, num_iterations=3, lam=0.1)
+    xla = train_als((u, i, v), nu, ni, base)
+    pal = train_als(
+        (u, i, v), nu, ni,
+        ALSConfig(rank=8, num_iterations=3, lam=0.1, solver="pallas"),
+    )
+    np.testing.assert_allclose(
+        pal.user_factors, xla.user_factors, rtol=5e-3, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        pal.item_factors, xla.item_factors, rtol=5e-3, atol=5e-3
+    )

@@ -210,7 +210,8 @@ def test_template_list_and_get(cli, tmp_path):
     assert (target / "engine.py").exists()
     assert (target / "template.json").exists()
     variant = json.loads((target / "engine.json").read_text())
-    assert variant["engineFactory"].endswith("recommendation_engine")
+    # factory points at the scaffolded engine.py so user edits take effect
+    assert variant["engineFactory"] == "engine.engine_factory"
 
     # scaffolding into a non-empty directory fails cleanly
     code, out = run("template", "get", "recommendation", str(target))
@@ -245,7 +246,7 @@ def test_build_unregister(cli, tmp_path):
     code, out = run("build", "--engine-json", ej)
     assert code == 0 and "registered" in out
     m = s.get_metadata().manifest_get("classification", "1")
-    assert m is not None and m.engine_factory.endswith("classification_engine")
+    assert m is not None and m.engine_factory == "engine.engine_factory"
 
     code, out = run("unregister", "--engine-json", ej)
     assert code == 0
@@ -264,3 +265,35 @@ def test_upgrade_and_undeploy_unreachable(cli):
     assert code == 0 and "pio-tpu" in out
     code, out = run("undeploy", "--ip", "127.0.0.1", "--port", "59999")
     assert code == 1 and "cannot undeploy" in out
+
+
+def test_export_import_columnar_roundtrip(cli, tmp_path):
+    run, s, _ = cli
+    run("app", "new", "colapp")
+    app = s.get_metadata().app_get_by_name("colapp")
+    es = s.get_event_store()
+    from predictionio_tpu.storage import DataMap, Event
+
+    es.insert_batch(
+        [
+            Event(event="rate", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 4.5})),
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"categories": ["a"]})),
+        ],
+        app.id,
+    )
+    out = tmp_path / "events.npz"
+    code, msg = run("export", "--appid", str(app.id), "--output", str(out))
+    assert code == 0 and "Exported 2" in msg
+
+    run("app", "new", "colapp2")
+    app2 = s.get_metadata().app_get_by_name("colapp2")
+    code, msg = run("import", "--appid", str(app2.id), "--input", str(out))
+    assert code == 0 and "Imported 2" in msg
+    evs = list(es.find(app_id=app2.id))
+    assert len(evs) == 2
+    rate = [e for e in evs if e.event == "rate"][0]
+    assert rate.properties.get_float("rating") == 4.5
+    assert rate.target_entity_id == "i1"

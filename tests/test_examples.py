@@ -12,34 +12,24 @@ from predictionio_tpu.controller.base import WorkflowContext
 EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
 
 
-def _load_example(name: str):
-    """Import an example's engine module the way the CLI would (cwd on path)."""
-    d = EXAMPLES / name
-    sys.path.insert(0, str(d))
-    old_cwd = os.getcwd()
-    os.chdir(d)
-    try:
-        for mod in ("engine",):
-            sys.modules.pop(mod, None)
-        m = importlib.import_module("engine")
-        yield_obj = m
-    finally:
-        pass
-    return yield_obj, old_cwd, str(d)
-
-
 @pytest.fixture()
-def in_example(request):
-    holders = []
+def in_example():
+    """Import an example's engine module the way the CLI would (cwd on
+    path); teardown restores cwd/sys.path even if the import itself fails."""
+    old_cwd = os.getcwd()
+    added: list[str] = []
 
     def load(name):
-        m, old_cwd, d = _load_example(name)
-        holders.append((old_cwd, d))
-        return m
+        d = str(EXAMPLES / name)
+        os.chdir(d)
+        sys.path.insert(0, d)
+        added.append(d)
+        sys.modules.pop("engine", None)
+        return importlib.import_module("engine")
 
     yield load
-    for old_cwd, d in holders:
-        os.chdir(old_cwd)
+    os.chdir(old_cwd)
+    for d in added:
         if d in sys.path:
             sys.path.remove(d)
     sys.modules.pop("engine", None)

@@ -146,3 +146,30 @@ def test_port_in_use_raises(deployed):
     )
     with pytest.raises(OSError):
         dup.start_background()
+
+
+def test_warmup_called_on_load(storage_memory, monkeypatch):
+    """Deploy must warm the scoring path before taking queries."""
+    import numpy as np
+
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm, ALSModel)
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    model = ALSModel(
+        user_factors=np.ones((3, 4), np.float32),
+        item_factors=np.ones((5, 4), np.float32),
+        users=StringIndex(["u0", "u1", "u2"]),
+        items=StringIndex([f"i{n}" for n in range(5)]),
+        item_props={},
+    )
+    algo = ALSAlgorithm()
+    algo.warmup(model)  # must not raise, must populate the device cache
+    assert getattr(model, "_dev_item_factors", None) is not None
+    # empty model: warmup is a no-op, not a crash
+    empty = ALSModel(
+        user_factors=np.zeros((0, 4), np.float32),
+        item_factors=np.zeros((0, 4), np.float32),
+        users=StringIndex([]), items=StringIndex([]), item_props={},
+    )
+    algo.warmup(empty)

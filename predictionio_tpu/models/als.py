@@ -147,12 +147,13 @@ def build_bucket_layout(
             f"{len(val):,} ratings exceed the int32 offset range of a "
             "single bucket layout; shard the COO across hosts first"
         )
-    order = np.argsort(row_ix, kind="stable")
-    c_sorted = np.ascontiguousarray(col_ix[order], dtype=np.int32)
-    v_sorted = np.ascontiguousarray(val[order], dtype=np.float32)
-    counts = np.bincount(row_ix, minlength=n_rows)
-    starts = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(counts, out=starts[1:])
+    # O(n) native counting sort when the C++ runtime is available
+    # (predictionio_tpu/native), NumPy argsort otherwise
+    from ..native import sort_coo_by_row
+
+    c_sorted, v_sorted, counts, starts = sort_coo_by_row(
+        row_ix, col_ix, val, n_rows
+    )
 
     if max_per_row and max_per_row > 0:
         eff_counts = np.minimum(counts, max_per_row)
@@ -388,9 +389,37 @@ class ALSTrainer:
         U.block_until_ready()
         return U, V
 
-    def train(self) -> ALSFactors:
+    def train(
+        self,
+        checkpointer=None,
+        checkpoint_every: int = 5,
+        resume: bool = True,
+    ) -> ALSFactors:
+        """Full run; with a :class:`~predictionio_tpu.workflow.checkpoint.
+        StepCheckpointer`, factor state is saved every ``checkpoint_every``
+        iterations and a crashed run resumes from the latest step (the
+        reference reruns failed training from scratch)."""
         U, V = self.init_factors()
-        U, V = self.run(U, V, self.cfg.num_iterations)
+        if checkpointer is None:
+            # one call keeps the 2*num_iterations dispatches async
+            U, V = self.run(U, V, self.cfg.num_iterations)
+            return ALSFactors(
+                user_factors=np.asarray(U), item_factors=np.asarray(V)
+            )
+        start = 0
+        if resume:
+            latest = checkpointer.latest_step()
+            if latest is not None:
+                state = checkpointer.restore(latest, like={"U": U, "V": V})
+                U, V = state["U"], state["V"]
+                start = latest
+                logger.info("resuming ALS from iteration %d", start)
+        it = start
+        while it < self.cfg.num_iterations:
+            chunk = min(checkpoint_every, self.cfg.num_iterations - it)
+            U, V = self.run(U, V, chunk)
+            it += chunk
+            checkpointer.save(it, {"U": U, "V": V})
         return ALSFactors(
             user_factors=np.asarray(U), item_factors=np.asarray(V)
         )

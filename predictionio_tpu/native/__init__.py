@@ -1,0 +1,126 @@
+"""Native (C++) host runtime, loaded via ctypes.
+
+Holds the in-process equivalents of work the reference shipped to Spark
+executors.  Currently: O(n) counting-sort COO preprocessing for ALS
+(``native/bucketize.cpp``).  The library is compiled on demand with the
+system toolchain and cached under ``$PIO_TPU_HOME/native``; every entry
+point has a NumPy fallback so the framework runs (slower) without a
+compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["sort_coo_by_row", "native_available"]
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "bucketize.cpp"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _cache_dir() -> Path:
+    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser(
+        "~/.predictionio_tpu"
+    )
+    p = Path(home) / "native"
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _SRC.exists():
+            logger.debug("native source %s missing; using NumPy path", _SRC)
+            return None
+        so = _cache_dir() / "_native.so"
+        try:
+            if not so.exists() or so.stat().st_mtime < _SRC.stat().st_mtime:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", str(_SRC),
+                     "-o", str(so)],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(str(so))
+        except (OSError, subprocess.SubprocessError) as e:
+            logger.warning("native build unavailable (%s); NumPy path", e)
+            return None
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.pio_count_rows.argtypes = [i32p, ctypes.c_int64, i64p]
+        lib.pio_count_rows.restype = None
+        lib.pio_sort_coo.argtypes = [
+            i32p, i32p, f32p, ctypes.c_int64, ctypes.c_int64,
+            i64p, i64p, i32p, f32p,
+        ]
+        lib.pio_sort_coo.restype = None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def sort_coo_by_row(
+    row_ix: np.ndarray,
+    col_ix: np.ndarray,
+    val: np.ndarray,
+    n_rows: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Group a COO by row id.
+
+    Returns ``(c_sorted, v_sorted, counts, starts)`` where row ``r``'s
+    ratings occupy ``[starts[r], starts[r+1])`` of the sorted arrays in
+    original order (stable).  O(n) native path; NumPy argsort fallback.
+    """
+    n = len(val)
+    row_ix = np.ascontiguousarray(row_ix, dtype=np.int32)
+    col_ix = np.ascontiguousarray(col_ix, dtype=np.int32)
+    val = np.ascontiguousarray(val, dtype=np.float32)
+    if n and (row_ix.min() < 0 or row_ix.max() >= n_rows):
+        # the C++ path does unchecked ++counts[row[i]]; keep the loud
+        # Python-level failure the NumPy path had
+        raise ValueError(
+            f"row ids must be in [0, {n_rows}); got "
+            f"[{int(row_ix.min())}, {int(row_ix.max())}]"
+        )
+
+    lib = _load()
+    if lib is not None:
+        counts = np.zeros(n_rows, dtype=np.int64)
+        lib.pio_count_rows(row_ix, n, counts)
+        starts = np.zeros(n_rows + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        cursor = np.empty(n_rows, dtype=np.int64)
+        c_sorted = np.empty(n, dtype=np.int32)
+        v_sorted = np.empty(n, dtype=np.float32)
+        lib.pio_sort_coo(
+            row_ix, col_ix, val, n, n_rows, starts, cursor, c_sorted, v_sorted
+        )
+        return c_sorted, v_sorted, counts, starts
+
+    order = np.argsort(row_ix, kind="stable")
+    c_sorted = np.ascontiguousarray(col_ix[order])
+    v_sorted = np.ascontiguousarray(val[order])
+    counts = np.bincount(row_ix, minlength=n_rows).astype(np.int64)
+    starts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return c_sorted, v_sorted, counts, starts

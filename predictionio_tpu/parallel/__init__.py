@@ -1,5 +1,11 @@
 """Device-mesh parallelism utilities (the Spark-substrate replacement)."""
 
+from .collectives import (
+    all_gather_blocks,
+    all_reduce_sum,
+    reduce_scatter_sum,
+    ring_shift,
+)
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -12,6 +18,10 @@ from .mesh import (
 )
 
 __all__ = [
+    "all_gather_blocks",
+    "all_reduce_sum",
+    "reduce_scatter_sum",
+    "ring_shift",
     "DATA_AXIS",
     "MODEL_AXIS",
     "data_sharding",

@@ -1,0 +1,117 @@
+"""Mesh collectives — the distributed communication backend.
+
+The reference's communication substrate is Spark's shuffle/broadcast
+fabric (SURVEY §2.7); the TPU-native equivalent is XLA collectives over
+ICI (within a slice) and DCN (across slices), expressed with ``shard_map``
+over a `Mesh`.  These wrappers give the framework's runtime and engine
+code named, tested entry points for the four primitives the training and
+scoring paths use — all-reduce (gradient/stat sums), all-gather (factor
+blocks), reduce-scatter (sharded updates), and ring permute (block
+rotation) — instead of scattering raw ``jax.lax`` calls around.
+
+Everything here is jit-compatible and works identically on a virtual CPU
+mesh (tests) and a TPU pod slice.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import inspect
+
+if hasattr(jax, "shard_map"):            # jax >= 0.8
+    _shard_map_impl = jax.shard_map
+else:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# collective outputs (psum/all_gather) are replicated in ways the static
+# checker can't always infer; disable it under whichever flag name this
+# jax spells it
+_CHECK_FLAG = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map_impl).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f=None, **kw):
+    kw.setdefault(_CHECK_FLAG, False)
+    if f is None:
+        return functools.partial(_shard_map_impl, **kw)
+    return _shard_map_impl(f, **kw)
+
+from .mesh import DATA_AXIS
+
+__all__ = [
+    "all_reduce_sum",
+    "all_gather_blocks",
+    "reduce_scatter_sum",
+    "ring_shift",
+]
+
+
+def all_reduce_sum(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS):
+    """Sum a data-sharded array's shards: [N, ...] sharded -> same value
+    replicated on every device (the ``psum`` of a per-shard partial)."""
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(),
+    )
+    def _sum(shard):
+        return jax.lax.psum(jnp.sum(shard, axis=0, keepdims=True), axis)
+
+    return _sum(x)[0]
+
+
+def all_gather_blocks(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS):
+    """Gather a sharded leading dim onto every device (factor-block
+    exchange): [N/d per device] -> [N] replicated."""
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(),
+    )
+    def _gather(shard):
+        return jax.lax.all_gather(shard, axis, tiled=True)
+
+    return _gather(x)
+
+
+def reduce_scatter_sum(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS):
+    """Per-device partials [d, M, ...] (sharded on dim 0) -> the summed
+    [M, ...] sharded over the mesh: each device keeps only the slice of
+    the sum it owns (the memory-efficient half of an all-reduce)."""
+    d = mesh.shape[axis]
+    if x.shape[0] != d:
+        raise ValueError(
+            f"reduce_scatter_sum expects leading dim == mesh axis size "
+            f"{d} (one partial per device); got shape {x.shape}"
+        )
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+    )
+    def _scatter(partial):  # [1, M, ...] per device
+        return jax.lax.psum_scatter(
+            partial[0], axis, scatter_dimension=0, tiled=True
+        )
+
+    return _scatter(x)
+
+
+def ring_shift(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS, shift: int = 1):
+    """Rotate shards around the mesh ring (block-cyclic ALS-style
+    exchange): shard i -> device (i + shift) mod d."""
+    n_dev = mesh.shape[axis]
+    perm = [(i, (i + shift) % n_dev) for i in range(n_dev)]
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+    )
+    def _shift(shard):
+        return jax.lax.ppermute(shard, axis, perm)
+
+    return _shift(x)

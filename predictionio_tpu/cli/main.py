@@ -61,9 +61,15 @@ def _engine_dir_on_path(variant_path: str | Path, factory_path: str) -> None:
 
 
 def load_engine_from_variant(
-    variant_path: str | Path, engine_factory: Optional[str] = None
+    variant_path: str | Path,
+    engine_factory: Optional[str] = None,
+    return_factory: bool = False,
 ):
-    """engine.json -> (engine, engine_params, variant dict)."""
+    """engine.json -> (engine, engine_params, variant dict).
+
+    ``return_factory=True`` appends the factory object (an EngineFactory
+    instance, or the bare callable) so callers needing factory-level API
+    like ``engine_params(key)`` don't re-resolve/instantiate it."""
     variant = json.loads(Path(variant_path).read_text())
     factory_path = engine_factory or variant.get("engineFactory")
     if not factory_path:
@@ -73,10 +79,17 @@ def load_engine_from_variant(
         )
     _engine_dir_on_path(variant_path, factory_path)
     factory = resolve_attr(factory_path)
-    engine = factory() if callable(factory) else factory
-    if hasattr(engine, "apply"):  # EngineFactory object
-        engine = engine.apply()
-    return engine, engine.params_from_variant(variant), variant
+    obj = factory() if isinstance(factory, type) else factory
+    if not hasattr(obj, "apply") and callable(obj):
+        obj = obj()  # plain function factory -> Engine (or EngineFactory)
+    if hasattr(obj, "apply"):  # EngineFactory object
+        engine = obj.apply()
+        factory_obj = obj
+    else:
+        engine = obj
+        factory_obj = factory
+    out = (engine, engine.params_from_variant(variant), variant)
+    return (*out, factory_obj) if return_factory else out
 
 
 def _out(msg: str) -> None:
@@ -240,9 +253,21 @@ def cmd_train(args, storage: Storage) -> int:
             num_processes=args.num_processes,
             process_id=args.process_id,
         )
-    engine, ep, variant = load_engine_from_variant(
-        args.engine_json, args.engine_factory
+    engine, ep, variant, factory = load_engine_from_variant(
+        args.engine_json, args.engine_factory, return_factory=True
     )
+    if args.engine_params_key:
+        # programmatic params override: EngineFactory.engine_params(key)
+        # (reference CreateWorkflow --engine-params-key)
+        if not hasattr(factory, "engine_params"):
+            _out("Error: --engine-params-key needs an EngineFactory with "
+                 "engine_params(key).")
+            return 1
+        try:
+            ep = factory.engine_params(args.engine_params_key)
+        except KeyError as e:
+            _out(f"Error: unknown engine params key: {e}")
+            return 1
     ctx = WorkflowContext(storage=storage, mode="Training", batch=args.batch)
     wp = WorkflowParams(
         batch=args.batch,
@@ -298,6 +323,24 @@ def cmd_deploy(args, storage: Storage) -> int:
         engine_id=engine_id,
         engine_variant=str(args.engine_json),
     )
+    # undeploy a stale server holding the port (CreateServer.scala:266-288)
+    import urllib.error
+    import urllib.request
+
+    stale_host = "127.0.0.1" if args.ip == "0.0.0.0" else args.ip
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://{stale_host}:{args.port}/stop", method="POST"
+            ),
+            timeout=2,
+        ):
+            _out(f"Undeployed stale engine server on port {args.port}.")
+            import time
+
+            time.sleep(0.5)
+    except (urllib.error.URLError, OSError):
+        pass
     _out(f"Deploying engine instance {iid} on {args.ip}:{args.port}")
     server.serve_forever()
     return 0
@@ -564,6 +607,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--skip-sanity-check", action="store_true")
     t.add_argument("--stop-after-read", action="store_true")
     t.add_argument("--stop-after-prepare", action="store_true")
+    t.add_argument("--engine-params-key",
+                   help="use EngineFactory.engine_params(<key>) instead of "
+                   "the engine.json params")
     t.add_argument("--coordinator",
                    help="multi-host: coordinator address host:port")
     t.add_argument("--num-processes", type=int)

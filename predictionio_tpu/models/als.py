@@ -399,6 +399,8 @@ class ALSTrainer:
         StepCheckpointer`, factor state is saved every ``checkpoint_every``
         iterations and a crashed run resumes from the latest step (the
         reference reruns failed training from scratch)."""
+        if checkpointer is not None and checkpoint_every <= 0:
+            raise ValueError("checkpoint_every must be positive")
         U, V = self.init_factors()
         if checkpointer is None:
             # one call keeps the 2*num_iterations dispatches async

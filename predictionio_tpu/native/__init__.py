@@ -52,11 +52,15 @@ def _load() -> Optional[ctypes.CDLL]:
         so = _cache_dir() / "_native.so"
         try:
             if not so.exists() or so.stat().st_mtime < _SRC.stat().st_mtime:
+                # compile to a private temp name and publish atomically so
+                # concurrent processes never dlopen a half-written file
+                tmp = so.with_suffix(f".{os.getpid()}.tmp")
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", str(_SRC),
-                     "-o", str(so)],
+                     "-o", str(tmp)],
                     check=True, capture_output=True, timeout=120,
                 )
+                os.replace(tmp, so)
             lib = ctypes.CDLL(str(so))
         except (OSError, subprocess.SubprocessError) as e:
             logger.warning("native build unavailable (%s); NumPy path", e)

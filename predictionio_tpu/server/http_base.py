@@ -55,25 +55,48 @@ class HTTPServerBase:
     def _make_handler(self):
         raise NotImplementedError
 
+    bind_retries = 3  # MasterActor retries the spray bind 3x in the reference
+
     def _bind(self) -> None:
-        self._httpd = ThreadingHTTPServer(
-            (self.host, self.port), self._make_handler()
-        )
+        import errno
+        import time
+
+        retries = max(1, self.bind_retries)
+        for attempt in range(retries):
+            try:
+                self._httpd = ThreadingHTTPServer(
+                    (self.host, self.port), self._make_handler()
+                )
+                break
+            except OSError as e:
+                # only a busy port is transient (a stale server shutting
+                # down); permission/addr errors fail immediately
+                if e.errno != errno.EADDRINUSE or attempt + 1 >= retries:
+                    raise
+                time.sleep(1.0)
         self.port = self._httpd.server_address[1]
+
+    _serving: bool = False
 
     def serve_forever(self) -> None:
         if self._httpd is None:
             self._bind()
+        self._serving = True
         self._httpd.serve_forever()
 
     def start_background(self) -> threading.Thread:
         self._bind()
+        self._serving = True
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         t.start()
         return t
 
     def stop(self) -> None:
         if self._httpd is not None:
-            self._httpd.shutdown()
+            if self._serving:
+                # shutdown() handshakes with the serve loop; calling it on
+                # a bound-but-never-served server would block forever
+                self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+            self._serving = False

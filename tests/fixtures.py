@@ -139,3 +139,29 @@ class Serving0(Serving):
         p = self.params if isinstance(self.params, IdParams) else IdParams()
         first = predictions[0]
         return Prediction(algo_id=first.algo_id, query=query, served_by=p.id)
+
+
+class ParamsKeyFactory:
+    """EngineFactory with named EngineParams presets, for
+    --engine-params-key tests (reference EngineFactory.engineParams)."""
+
+    def apply(self):
+        from predictionio_tpu.controller import Engine, FirstServing
+        from predictionio_tpu.controller.base import IdentityPreparator
+
+        return Engine(
+            DataSource0, IdentityPreparator, {"algo": Algo0}, FirstServing
+        )
+
+    def engine_params(self, key: str):
+        from predictionio_tpu.controller.engine import EngineParams
+
+        presets = {
+            "small": EngineParams(
+                data_source=("", IdParams(id=1)),
+                algorithms=[("algo", IdParams(id=11))],
+            ),
+        }
+        if key not in presets:
+            raise KeyError(key)
+        return presets[key]

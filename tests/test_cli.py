@@ -297,3 +297,19 @@ def test_export_import_columnar_roundtrip(cli, tmp_path):
     rate = [e for e in evs if e.event == "rate"][0]
     assert rate.properties.get_float("rating") == 4.5
     assert rate.target_entity_id == "i1"
+
+
+def test_train_engine_params_key(cli, tmp_path):
+    run, s, tmp = cli
+    ej = tmp / "epk.json"
+    ej.write_text(json.dumps({
+        "id": "epk-test",
+        "engineFactory": "fixtures.ParamsKeyFactory",
+    }))
+    code, out = run("train", "--engine-json", str(ej),
+                    "--engine-params-key", "small")
+    assert code == 0 and "Training completed" in out
+
+    code, out = run("train", "--engine-json", str(ej),
+                    "--engine-params-key", "nope")
+    assert code == 1 and "unknown engine params key" in out

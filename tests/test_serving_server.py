@@ -173,3 +173,32 @@ def test_warmup_called_on_load(storage_memory, monkeypatch):
         users=StringIndex([]), items=StringIndex([]), item_props={},
     )
     algo.warmup(empty)
+
+
+def test_bind_retry_then_fail():
+    """Port conflict: retried, then surfaces as an OSError (reference
+    MasterActor retries the bind 3x)."""
+    import time
+
+    from predictionio_tpu.server.http_base import HTTPServerBase
+
+    class Dummy(HTTPServerBase):
+        bind_retries = 2
+        host = "127.0.0.1"
+
+        def _make_handler(self):
+            from predictionio_tpu.server.http_base import JsonRequestHandler
+
+            return JsonRequestHandler
+
+    a = Dummy()
+    a.port = 0
+    a._bind()
+    taken = a.port
+    b = Dummy()
+    b.port = taken
+    t0 = time.time()
+    with pytest.raises(OSError):
+        b._bind()
+    assert time.time() - t0 >= 0.9  # at least one 1s retry gap
+    a.stop()

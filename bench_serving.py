@@ -1,0 +1,97 @@
+"""Serving-path benchmark: query latency + throughput on the deployed
+engine hot path (reference tracks avgServingSec/lastServingSec on its
+status page but publishes no targets; the working expectation for a rec
+server is a sub-100 ms query path, SURVEY §7 hard-part 5).
+
+Measures predict_json end-to-end (JSON decode -> device top-k -> JSON
+encode) after warmup, single-threaded. Prints ONE JSON line like bench.py.
+
+Usage: python bench_serving.py [--items 100000] [--rank 64] [--n 200]
+       [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--items", type=int, default=100_000)
+    ap.add_argument("--users", type=int, default=10_000)
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--n", type=int, default=200, help="timed queries")
+    ap.add_argument("--num", type=int, default=10, help="top-k per query")
+    ap.add_argument("--platform")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    if args.platform:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm, ALSModel,
+    )
+
+    rng = np.random.default_rng(0)
+    model = ALSModel(
+        user_factors=rng.normal(size=(args.users, args.rank)).astype(
+            np.float32
+        ),
+        item_factors=rng.normal(size=(args.items, args.rank)).astype(
+            np.float32
+        ),
+        users=StringIndex([f"u{i}" for i in range(args.users)]),
+        items=StringIndex([f"i{i}" for i in range(args.items)]),
+        item_props={},
+    )
+    algo = ALSAlgorithm()
+    algo.warmup(model)
+
+    from predictionio_tpu.templates.recommendation import Query
+
+    # timed loop over random users
+    users = rng.integers(0, args.users, args.n)
+    lat = np.empty(args.n)
+    for j, u in enumerate(users):
+        t0 = time.perf_counter()
+        r = algo.predict(model, Query(user=f"u{u}", num=args.num))
+        lat[j] = time.perf_counter() - t0
+        assert len(r.item_scores) == args.num
+    p50, p99 = np.percentile(lat, [50, 99])
+    if args.verbose:
+        print(
+            f"# {args.items:,} items rank {args.rank}: "
+            f"p50 {p50*1e3:.2f}ms p99 {p99*1e3:.2f}ms "
+            f"qps {1.0/lat.mean():.0f}",
+            file=sys.stderr,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "serving_query_p50_ms",
+                "value": round(p50 * 1e3, 3),
+                "unit": "ms",
+                "vs_baseline": round(100.0 / (p50 * 1e3), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

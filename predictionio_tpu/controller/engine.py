@@ -274,6 +274,40 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             serving=comp("serving", self.serving_class_map),
         )
 
+    def params_from_instance(self, instance) -> EngineParams:
+        """EngineInstance record -> the exact EngineParams it was trained
+        with (deploy must serve with the trained params, not whatever the
+        current engine.json says — reference `engineInstanceToEngineParams`,
+        `controller/Engine.scala:386-450`)."""
+        import json as _json
+
+        def one(js: str, cmap: dict[str, type], kind: str):
+            d = _json.loads(js) if js else {}
+            if not d:
+                return ("", None)
+            ((name, params),) = d.items()
+            return self._spec_to_params(
+                {"name": name, "params": params}, cmap, kind
+            )
+
+        algorithms = [
+            self._spec_to_params(
+                {"name": name, "params": params},
+                self.algorithm_class_map, "algorithm",
+            )
+            for spec in _json.loads(instance.algorithms_params or "[]")
+            for name, params in spec.items()
+        ] or [("", None)]
+        return EngineParams(
+            data_source=one(instance.data_source_params,
+                            self.data_source_class_map, "datasource"),
+            preparator=one(instance.preparator_params,
+                           self.preparator_class_map, "preparator"),
+            algorithms=algorithms,
+            serving=one(instance.serving_params,
+                        self.serving_class_map, "serving"),
+        )
+
 
 class _DictParams(Params):
     """Fallback params wrapper when an algorithm declares no params_class."""

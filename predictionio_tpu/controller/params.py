@@ -160,4 +160,7 @@ def params_to_json(p: Any) -> dict[str, Any]:
         return dataclasses.asdict(p)
     if isinstance(p, Mapping):
         return dict(p)
+    fields = getattr(p, "fields", None)  # _DictParams fallback wrapper
+    if isinstance(fields, dict):
+        return dict(fields)
     return {}

@@ -55,12 +55,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 # compile to a private temp name and publish atomically so
                 # concurrent processes never dlopen a half-written file
                 tmp = so.with_suffix(f".{os.getpid()}.tmp")
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", str(_SRC),
-                     "-o", str(tmp)],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, so)
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", str(_SRC),
+                         "-o", str(tmp)],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                    os.replace(tmp, so)
+                finally:
+                    tmp.unlink(missing_ok=True)
             lib = ctypes.CDLL(str(so))
         except (OSError, subprocess.SubprocessError) as e:
             logger.warning("native build unavailable (%s); NumPy path", e)

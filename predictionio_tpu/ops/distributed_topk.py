@@ -1,0 +1,88 @@
+"""Ring top-k scoring over a mesh-sharded item table.
+
+Serving's hot op is ``scores = U @ V.T`` + top-k (`ops/topk.py`).  When the
+item-factor table outgrows one chip's HBM, it lives sharded over the mesh
+(`P("data")` on rows) — and gathering it per query would waste ICI
+bandwidth and HBM.  This op keeps every shard where it is and instead
+rotates them around the ring (the classic ring-matmul schedule): at each
+of the d steps every device scores its resident query block against the
+item shard currently passing through, folds the result into a running
+top-k, and forwards the shard to its neighbor.  Communication is d-1
+shard-sized ppermutes riding neighbor ICI links; nothing is ever
+materialized at [B, M].
+
+The same schedule is the building block the long-sequence world calls
+ring attention — score-block against rotating KV shards with a running
+reduction — applied here to the framework's actual workload (CF scoring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.collectives import shard_map
+from ..parallel.mesh import DATA_AXIS
+
+__all__ = ["ring_topk_scores"]
+
+
+def ring_topk_scores(
+    queries: jax.Array,       # [B, R] replicated query block
+    item_shards: jax.Array,   # [M, R] sharded over `axis` (M % d == 0)
+    k: int,
+    mesh: Mesh,
+    axis: str = DATA_AXIS,
+):
+    """Top-k (values, global indices) of ``queries @ item_table.T``.
+
+    Returns ``([B, k] scores, [B, k] int32 indices)`` replicated.  Index
+    space is the global row index of ``item_shards``.
+    """
+    d = mesh.shape[axis]
+    M = item_shards.shape[0]
+    if M % d:
+        raise ValueError(f"item count {M} must be divisible by mesh size {d}")
+    shard_rows = M // d
+    if k > M:
+        raise ValueError(f"k={k} > item count {M}")
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(axis, None)),
+        out_specs=(P(), P()),
+    )
+    def _ring(q, v_shard):                     # q: [B, R]; v_shard: [M/d, R]
+        my = jax.lax.axis_index(axis)
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+
+        def step(carry, _):
+            v, owner, best_val, best_ix = carry
+            scores = q @ v.T                   # [B, M/d] on the MXU
+            base = owner * shard_rows
+            ix = base + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1
+            )
+            # fold into the running top-k: concat + re-topk (k + M/d wide)
+            cat_val = jnp.concatenate([best_val, scores], axis=1)
+            cat_ix = jnp.concatenate([best_ix, ix], axis=1)
+            new_val, pos = jax.lax.top_k(cat_val, k)
+            new_ix = jnp.take_along_axis(cat_ix, pos, axis=1)
+            # pass the shard to the next device; track whose shard we hold
+            v = jax.lax.ppermute(v, axis, fwd)
+            owner = jax.lax.ppermute(owner, axis, fwd)
+            return (v, owner, new_val, new_ix), None
+
+        init_val = jnp.full((q.shape[0], k), -jnp.inf, q.dtype)
+        init_ix = jnp.zeros((q.shape[0], k), jnp.int32)
+        (v, owner, best_val, best_ix), _ = jax.lax.scan(
+            step, (v_shard, my, init_val, init_ix), None, length=d
+        )
+        # after d steps every device has folded every shard, so the
+        # result is replicated by construction
+        return best_val, best_ix
+
+    return _ring(queries, item_shards)

@@ -111,8 +111,21 @@ class EngineServer(HTTPServerBase):
 
     # -- lifecycle --------------------------------------------------------
     def _load(self, instance_id: str) -> None:
+        # serve with the params the instance was trained with; the current
+        # engine.json may have drifted (engineInstanceToEngineParams parity)
+        engine_params = self.engine_params
+        rec = self.ctx.storage.get_metadata().engine_instance_get(instance_id)
+        if rec is not None and rec.algorithms_params:
+            try:
+                engine_params = self.engine.params_from_instance(rec)
+                self.engine_params = engine_params
+            except Exception:
+                logger.exception(
+                    "could not reconstruct params from instance %s; "
+                    "using variant params", instance_id,
+                )
         algorithms, models, serving = prepare_deploy_components(
-            self.engine, self.engine_params, instance_id, ctx=self.ctx
+            self.engine, engine_params, instance_id, ctx=self.ctx
         )
         for algo, model in zip(algorithms, models):
             t0 = time.time()

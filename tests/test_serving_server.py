@@ -202,3 +202,43 @@ def test_bind_retry_then_fail():
         b._bind()
     assert time.time() - t0 >= 0.9  # at least one 1s retry gap
     a.stop()
+
+
+def test_deploy_serves_trained_params_not_variant(storage_memory):
+    """Reference engineInstanceToEngineParams semantics: serving must use
+    the params the instance was trained with, even if engine.json (or the
+    in-memory EngineParams) has drifted since."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+    from fixtures import Algo0, DataSource0, IdParams, Serving0
+
+    from predictionio_tpu.controller import Engine, EngineParams
+    from predictionio_tpu.controller.base import (
+        IdentityPreparator, WorkflowContext)
+    from predictionio_tpu.server.serving import EngineServer, ServerConfig
+    from predictionio_tpu.workflow.train import run_train
+
+    engine = Engine(DataSource0, IdentityPreparator, {"a0": Algo0}, Serving0)
+    trained_ep = EngineParams(
+        data_source=("", IdParams(id=1)),
+        algorithms=[("a0", IdParams(id=42))],
+    )
+    ctx = WorkflowContext(storage=storage_memory, mode="Training")
+    iid = run_train(engine, trained_ep, ctx=ctx, engine_id="drift",
+                    engine_variant="v")
+
+    # a *different* in-memory params object simulates a drifted engine.json
+    drifted = EngineParams(
+        data_source=("", IdParams(id=1)),
+        algorithms=[("a0", IdParams(id=999))],
+    )
+    server = EngineServer(
+        engine, drifted, iid,
+        ctx=WorkflowContext(storage=storage_memory, mode="Serving"),
+        config=ServerConfig(port=0), engine_id="drift", engine_variant="v",
+    )
+    # the reconstructed algorithm params are the trained ones
+    (name, params), = server.engine_params.algorithms
+    assert name == "a0" and params.id == 42

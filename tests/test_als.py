@@ -258,3 +258,17 @@ def test_pallas_solver_matches_xla():
     np.testing.assert_allclose(
         pal.item_factors, xla.item_factors, rtol=5e-3, atol=5e-3
     )
+
+
+def test_lambda_sweep_does_not_recompile():
+    """lam/alpha are traced scalars: an eval sweep over regularization
+    must reuse the two compiled half-iteration executables."""
+    from predictionio_tpu.models import als as als_mod
+
+    u, i, v, nu, ni = _toy()
+    train_als((u, i, v), nu, ni, ALSConfig(rank=4, num_iterations=1, lam=0.1))
+    size_after_first = als_mod._half_iteration._cache_size()
+    for lam in (0.02, 0.5, 1.0):
+        train_als((u, i, v), nu, ni,
+                  ALSConfig(rank=4, num_iterations=1, lam=lam))
+    assert als_mod._half_iteration._cache_size() == size_after_first

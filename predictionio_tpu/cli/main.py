@@ -101,6 +101,14 @@ def _out(msg: str) -> None:
 # --------------------------------------------------------------------------
 
 
+def _resolve_channel(md, app_id: int, name: str):
+    """Channel name -> Channel for an app, or None if absent."""
+    for c in md.channel_get_by_app(app_id):
+        if c.name == name:
+            return c
+    return None
+
+
 def cmd_app(args, storage: Storage) -> int:
     md = storage.get_metadata()
     es = storage.get_event_store()
@@ -154,18 +162,48 @@ def cmd_app(args, storage: Storage) -> int:
             _out(f"Error: app '{args.name}' not found.")
             return 1
         if args.channel:
-            chans = [
-                c for c in md.channel_get_by_app(app.id) if c.name == args.channel
-            ]
-            if not chans:
+            chan = _resolve_channel(md, app.id, args.channel)
+            if chan is None:
                 _out(f"Error: channel '{args.channel}' not found.")
                 return 1
-            es.remove_channel(app.id, chans[0].id)
-            es.init_channel(app.id, chans[0].id)
+            es.remove_channel(app.id, chan.id)
+            es.init_channel(app.id, chan.id)
         else:
             es.remove_channel(app.id)
             es.init_channel(app.id)
         _out(f"Deleted event data of app '{args.name}'.")
+        return 0
+    if args.app_command == "trim":
+        from ..storage.event import parse_time
+        from ..tools.trim import trim_events
+
+        app = md.app_get_by_name(args.name)
+        if app is None:
+            _out(f"Error: app '{args.name}' not found.")
+            return 1
+        channel_id = 0
+        if args.channel:
+            chan = _resolve_channel(md, app.id, args.channel)
+            if chan is None:
+                _out(f"Error: channel '{args.channel}' not found.")
+                return 1
+            channel_id = chan.id
+        try:
+            before = parse_time(args.before) if args.before else None
+        except ValueError as e:
+            _out(f"Error: invalid --before time: {e}")
+            return 1
+        try:
+            n = trim_events(
+                es, app.id, channel_id,
+                before=before,
+                event_names=args.event or None,
+                keep_special=not args.all,
+            )
+        except ValueError as e:
+            _out(f"Error: {e}")
+            return 1
+        _out(f"Trimmed {n} events from app '{args.name}'.")
         return 0
     if args.app_command == "channel-new":
         app = md.app_get_by_name(args.name)
@@ -185,14 +223,12 @@ def cmd_app(args, storage: Storage) -> int:
         if app is None:
             _out(f"Error: app '{args.name}' not found.")
             return 1
-        chans = [
-            c for c in md.channel_get_by_app(app.id) if c.name == args.channel
-        ]
-        if not chans:
+        chan = _resolve_channel(md, app.id, args.channel)
+        if chan is None:
             _out(f"Error: channel '{args.channel}' not found.")
             return 1
-        es.remove_channel(app.id, chans[0].id)
-        md.channel_delete(chans[0].id)
+        es.remove_channel(app.id, chan.id)
+        md.channel_delete(chan.id)
         _out(f"Deleted channel '{args.channel}'.")
         return 0
     raise AssertionError(args.app_command)
@@ -583,6 +619,14 @@ def build_parser() -> argparse.ArgumentParser:
     x = aps.add_parser("data-delete")
     x.add_argument("name")
     x.add_argument("--channel")
+    x = aps.add_parser("trim", help="delete old events")
+    x.add_argument("name")
+    x.add_argument("--before", help="delete events before this ISO8601 time")
+    x.add_argument("--event", action="append",
+                   help="restrict to these event names (repeatable)")
+    x.add_argument("--channel")
+    x.add_argument("--all", action="store_true",
+                   help="also delete $set/$unset/$delete property events")
     x = aps.add_parser("channel-new")
     x.add_argument("name")
     x.add_argument("channel")
@@ -684,6 +728,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("upgrade", help="check for framework upgrades")
     sub.add_parser("status", help="check environment and storage")
     sub.add_parser("version")
+    sub.add_parser("help", help="show this help")
     return p
 
 
@@ -717,6 +762,9 @@ def main(argv: Optional[list[str]] = None,
     setup_logging(verbose=args.verbose, debug=args.debug)
     if args.command == "version":
         _out(f"pio-tpu {__version__}")
+        return 0
+    if args.command == "help":
+        build_parser().print_help()
         return 0
     storage = storage or get_storage()
     try:

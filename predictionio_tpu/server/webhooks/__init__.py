@@ -55,10 +55,16 @@ def to_event(connector, data) -> Event:
 
 from .segmentio import SegmentIOConnector  # noqa: E402
 from .mailchimp import MailChimpConnector  # noqa: E402
+from .example import (  # noqa: E402
+    ExampleFormConnector,
+    ExampleJsonConnector,
+)
 
 JSON_CONNECTORS: dict[str, JsonConnector] = {
     "segmentio": SegmentIOConnector(),
+    "examplejson": ExampleJsonConnector(),
 }
 FORM_CONNECTORS: dict[str, FormConnector] = {
     "mailchimp": MailChimpConnector(),
+    "exampleform": ExampleFormConnector(),
 }

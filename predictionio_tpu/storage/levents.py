@@ -81,6 +81,15 @@ class EventStore(abc.ABC):
     @abc.abstractmethod
     def delete(self, event_id: str, app_id: int, channel_id: int = 0) -> bool: ...
 
+    def delete_batch(
+        self, event_ids: Iterable[str], app_id: int, channel_id: int = 0
+    ) -> int:
+        """Bulk delete; returns the number actually removed.  Backends
+        override to avoid per-row commits."""
+        return sum(
+            bool(self.delete(eid, app_id, channel_id)) for eid in event_ids
+        )
+
     # -- scans ------------------------------------------------------------
     @abc.abstractmethod
     def find(

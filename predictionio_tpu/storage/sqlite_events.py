@@ -203,6 +203,18 @@ class SQLiteEventStore(EventStore):
             self._conn.commit()
             return cur.rowcount > 0
 
+    def delete_batch(self, event_ids, app_id: int, channel_id: int = 0) -> int:
+        t = self._ensure_table(app_id, channel_id)
+        ids = [(eid,) for eid in event_ids]
+        if not ids:
+            return 0
+        with self._lock:
+            cur = self._conn.executemany(
+                f"DELETE FROM {t} WHERE event_id=?", ids
+            )
+            self._conn.commit()
+            return cur.rowcount if cur.rowcount >= 0 else len(ids)
+
     # -- scans ------------------------------------------------------------
     def _query(
         self,

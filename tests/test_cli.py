@@ -313,3 +313,56 @@ def test_train_engine_params_key(cli, tmp_path):
     code, out = run("train", "--engine-json", str(ej),
                     "--engine-params-key", "nope")
     assert code == 1 and "unknown engine params key" in out
+
+
+def test_help_command(cli):
+    run, *_ = cli
+    code, out = run("help")
+    assert code == 0 and "train" in out and "template" in out
+
+
+def test_app_trim(cli):
+    import datetime as dt
+
+    from predictionio_tpu.storage.event import UTC
+
+    run, s, _ = cli
+    run("app", "new", "trimapp")
+    app = s.get_metadata().app_get_by_name("trimapp")
+    es = s.get_event_store()
+    old = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    new = dt.datetime(2024, 6, 1, tzinfo=UTC)
+    es.insert_batch(
+        [
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=old),
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"a": 1}), event_time=old),
+            Event(event="view", entity_type="user", entity_id="u2",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=new),
+        ],
+        app.id,
+    )
+    code, out = run("app", "trim", "trimapp", "--before",
+                    "2022-01-01T00:00:00.000Z")
+    assert code == 0 and "Trimmed 1 events" in out  # $set survives
+    remaining = {e.event for e in es.find(app_id=app.id)}
+    assert remaining == {"$set", "view"}
+    assert len(list(es.find(app_id=app.id))) == 2
+
+    # --all also drops property events in the window
+    code, out = run("app", "trim", "trimapp", "--before",
+                    "2022-01-01T00:00:00.000Z", "--all")
+    assert code == 0 and "Trimmed 1 events" in out
+    assert len(list(es.find(app_id=app.id))) == 1
+
+
+def test_app_trim_requires_filter(cli):
+    run, s, _ = cli
+    run("app", "new", "trimguard")
+    code, out = run("app", "trim", "trimguard")
+    assert code == 1 and "requires a time window" in out
+    code, out = run("app", "trim", "trimguard", "--before", "not-a-time")
+    assert code == 1 and "invalid --before" in out

@@ -262,3 +262,27 @@ def test_non_object_body_400(srv):
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req, timeout=10)
         assert e.value.code == 400, payload
+
+
+def test_example_webhook_connectors():
+    """The example connectors map payloads to valid events (reference
+    examplejson/exampleform test fixtures)."""
+    from predictionio_tpu.server.webhooks import (
+        ConnectorError, FORM_CONNECTORS, JSON_CONNECTORS, to_event)
+
+    c = JSON_CONNECTORS["examplejson"]
+    e = to_event(c, {
+        "type": "view", "userId": "u9", "itemId": "i3",
+        "timestamp": "2024-01-01T00:00:00.000Z", "channel": "web",
+    })
+    assert e.event == "view" and e.entity_id == "u9"
+    assert e.target_entity_id == "i3"
+    assert e.properties.get_string("channel") == "web"
+
+    with pytest.raises(ConnectorError):
+        to_event(c, {"userId": "u9"})
+
+    f = FORM_CONNECTORS["exampleform"]
+    e2 = to_event(f, {"type": "signup", "userId": "u1",
+                      "timestamp": "2024-01-01T00:00:00.000Z"})
+    assert e2.event == "signup" and e2.target_entity_id is None

@@ -2,36 +2,54 @@
 
 from __future__ import annotations
 
+from typing import Optional
+
 __all__ = ["DeviceTableMixin"]
 
 
 class DeviceTableMixin:
     """Lazy one-time host->device transfer of model factor tables, cached on
     the model instance (serving hot-path: every scoring call reuses the
-    device-resident arrays)."""
+    device-resident arrays).
 
-    def _cached_device(self, cache_name: str, source):
-        dev = getattr(self, cache_name, None)
+    ``dtype`` lets serving trade precision for HBM bandwidth: a
+    ``bfloat16`` table halves the bytes each scoring matmul reads, which
+    is the scoring bottleneck for large item tables, at a ranking-only
+    precision cost (RMSE-parity training is unaffected — this is
+    serve-time only).  Each dtype is cached separately.
+    """
+
+    def _cached_device(self, cache_name: str, source,
+                       dtype: Optional[str] = None):
+        import jax.numpy as jnp
+
+        key = f"{cache_name}_{dtype or 'native'}"
+        dev = getattr(self, key, None)
         if dev is None:
-            import jax.numpy as jnp
-
             dev = jnp.asarray(source)
-            setattr(self, cache_name, dev)
+            if dtype:
+                dev = dev.astype(jnp.dtype(dtype))
+            setattr(self, key, dev)
         return dev
 
-    def device_item_factors(self):
-        return self._cached_device("_dev_item_factors", self.item_factors)
+    def device_item_factors(self, dtype: Optional[str] = None):
+        return self._cached_device(
+            "_dev_item_factors", self.item_factors, dtype
+        )
 
-    def device_item_factors_normalized(self):
-        """Row-normalized table for cosine scoring — normalized once, not
-        per request."""
-        dev = getattr(self, "_dev_item_factors_norm", None)
+    def device_item_factors_normalized(self, dtype: Optional[str] = None):
+        """Row-normalized table for cosine scoring — normalized once (in
+        f32, then cast), not per request."""
+        import jax.numpy as jnp
+
+        key = f"_dev_item_factors_norm_{dtype or 'native'}"
+        dev = getattr(self, key, None)
         if dev is None:
-            import jax.numpy as jnp
-
             table = self.device_item_factors()
             dev = table / (
                 jnp.linalg.norm(table, axis=-1, keepdims=True) + 1e-9
             )
-            self._dev_item_factors_norm = dev
+            if dtype:
+                dev = dev.astype(jnp.dtype(dtype))
+            setattr(self, key, dev)
         return dev

@@ -254,3 +254,31 @@ def test_rmse_evaluation_sweep(ctx, tmp_path, monkeypatch):
     assert result.best_score == min(scores)
     doc = json.loads((tmp_path / "best.json").read_text())
     assert doc["algorithms"][0]["params"]["rank"] == 8
+
+
+def test_bfloat16_serving_matches_f32_ranking(ctx):
+    """serving_dtype=bfloat16 halves scoring reads; rankings must agree
+    with f32 on well-separated scores (training is untouched)."""
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm, ALSAlgorithmParams)
+
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    model = models[0]
+
+    from predictionio_tpu.controller.base import instantiate
+
+    f32 = instantiate(ALSAlgorithm, ALSAlgorithmParams(rank=8, num_iterations=10))
+    bf16 = instantiate(
+        ALSAlgorithm,
+        ALSAlgorithmParams(rank=8, num_iterations=10,
+                           serving_dtype="bfloat16"),
+    )
+    bf16.warmup(model)
+    q = Query(user="u1", num=3)
+    a = f32.predict(model, q)
+    b = bf16.predict(model, q)
+    assert [s.item for s in a.item_scores] == [s.item for s in b.item_scores]
+    for sa, sb in zip(a.item_scores, b.item_scores):
+        assert abs(sa.score - sb.score) < 0.05 * max(1.0, abs(sa.score))

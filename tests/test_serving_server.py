@@ -165,7 +165,7 @@ def test_warmup_called_on_load(storage_memory, monkeypatch):
     )
     algo = ALSAlgorithm()
     algo.warmup(model)  # must not raise, must populate the device cache
-    assert getattr(model, "_dev_item_factors", None) is not None
+    assert getattr(model, "_dev_item_factors_native", None) is not None
     # empty model: warmup is a no-op, not a crash
     empty = ALSModel(
         user_factors=np.zeros((0, 4), np.float32),

@@ -9,12 +9,24 @@ has no network egress to fetch the real set; shapes and sparsity structure —
 what determines ALS cost — match.
 
 Flags: --scale 0.05 for a quick small run, --iters/--rank to override.
+
+Robustness contract (round-2 fix): the default invocation must NEVER hang or
+time out without output.  The parent process does no jax work at all; it
+(1) probes the accelerator backend in a subprocess with a bounded timeout,
+(2) runs the timed train in a subprocess (``--inner``) with a bounded
+timeout on the chosen platform, and (3) falls back to a small-scale CPU run
+— so ONE JSON line is always printed, with ``platform``/``scale``/``error``
+fields recording what actually ran.  Round 1 failed here: axon TPU init
+flaked, the silent CPU fallback ran the full 20M train, and the driver
+killed it with no number (BENCH_r01.json rc=124).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -24,6 +36,11 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).parent))
 
 BASELINE_SECONDS = 60.0  # north star: < 60 s on v5e-8 (BASELINE.md)
+
+PROBE_TIMEOUT = 240   # s: accelerator backend init + tiny matmul
+TPU_RUN_TIMEOUT = 1200  # s: full-scale staged train incl. first compile
+CPU_RUN_TIMEOUT = 480   # s: small-scale fallback
+CPU_FALLBACK_SCALE = 0.02
 
 N_USERS = 138_493
 N_ITEMS = 26_744
@@ -49,7 +66,7 @@ def synth_ml20m(scale: float = 1.0, seed: int = 0):
     return u, i, v, n_users, n_items
 
 
-def main() -> None:
+def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--rank", type=int, default=64)
@@ -61,19 +78,30 @@ def main() -> None:
         help="force a jax platform (e.g. cpu) before backend init; "
         "overrides the axon sitecustomize default",
     )
-    args = ap.parse_args()
+    ap.add_argument(
+        "--inner",
+        action="store_true",
+        help="run the timed train in THIS process (no probe/subprocess "
+        "supervision); used by the default orchestrated invocation",
+    )
+    return ap.parse_args(argv)
 
-    if args.platform:
-        import os
 
-        os.environ["JAX_PLATFORMS"] = args.platform
-
+def _force_platform(platform: str) -> None:
+    os.environ["JAX_PLATFORMS"] = platform
     import jax
 
+    # the axon plugin sets jax_platforms directly at interpreter boot;
+    # the config knob (not the env var) is what actually wins
+    jax.config.update("jax_platforms", platform)
+
+
+def run_inner(args) -> None:
+    """The actual timed train: stages, warms up, trains, prints the JSON."""
     if args.platform:
-        # the axon plugin sets jax_platforms directly at interpreter boot;
-        # the config knob (not the env var) is what actually wins
-        jax.config.update("jax_platforms", args.platform)
+        _force_platform(args.platform)
+
+    import jax
 
     from predictionio_tpu.models.als import (
         ALSConfig, ALSFactors, ALSTrainer, rmse,
@@ -122,7 +150,108 @@ def main() -> None:
                 "metric": "ml20m_als_rank64_20iter_train_seconds",
                 "value": round(dt, 3),
                 "unit": "s",
-                "vs_baseline": round(BASELINE_SECONDS / dt, 3),
+                # only a full-scale run is comparable to the 60 s target
+                "vs_baseline": (
+                    round(BASELINE_SECONDS / dt, 3)
+                    if args.scale >= 1.0
+                    else None
+                ),
+                "platform": jax.default_backend(),
+                "scale": args.scale,
+            }
+        )
+    )
+
+
+def _probe_accelerator(timeout: int = PROBE_TIMEOUT):
+    """Init the default jax backend in a subprocess; returns the platform
+    name (e.g. 'tpu', 'axon') or None if init fails/hangs."""
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "x = jnp.ones((256, 256))\n"
+        "(x @ x).block_until_ready()\n"
+        "print('PLATFORM=' + jax.default_backend())\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "backend init timed out after %ds" % timeout
+    for line in proc.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            platform = line.split("=", 1)[1]
+            if platform != "cpu":
+                return platform, None
+            return None, "backend resolved to cpu (no accelerator)"
+    return None, (proc.stderr.strip().splitlines() or ["backend init failed"])[-1]
+
+
+def _run_inner_subprocess(extra_args, timeout):
+    """Run ``bench.py --inner`` under a timeout; returns (json_line, err)."""
+    cmd = [sys.executable, str(Path(__file__).resolve()), "--inner"] + extra_args
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout}s"
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            return line, None
+    return None, (proc.stderr.strip().splitlines() or ["no output"])[-1]
+
+
+def main() -> None:
+    args = _parse_args()
+    if args.inner or args.platform:
+        # explicit platform or inner mode: run directly, no supervision
+        run_inner(args)
+        return
+
+    # ---- orchestrated default invocation: never hang, always print JSON ----
+    common = [
+        "--scale", str(args.scale), "--rank", str(args.rank),
+        "--iters", str(args.iters), "--seed", str(args.seed),
+    ] + (["--verbose"] if args.verbose else [])
+
+    platform, probe_err = _probe_accelerator()
+    if platform is not None:
+        line, err = _run_inner_subprocess(common, TPU_RUN_TIMEOUT)
+        if line is not None:
+            print(line)
+            return
+        probe_err = f"accelerator run failed: {err}"
+
+    # CPU fallback: small scale, platform forced, bounded time
+    cpu_scale = min(args.scale, CPU_FALLBACK_SCALE)
+    cpu_args = [
+        "--scale", str(cpu_scale), "--rank", str(args.rank),
+        "--iters", str(args.iters), "--seed", str(args.seed),
+        "--platform", "cpu",
+    ] + (["--verbose"] if args.verbose else [])
+    line, err = _run_inner_subprocess(cpu_args, CPU_RUN_TIMEOUT)
+    if line is not None:
+        rec = json.loads(line)
+        rec["error"] = f"accelerator unavailable: {probe_err}"
+        print(json.dumps(rec))
+        return
+
+    # absolute last resort: still one JSON line
+    print(
+        json.dumps(
+            {
+                "metric": "ml20m_als_rank64_20iter_train_seconds",
+                "value": None,
+                "unit": "s",
+                "vs_baseline": None,
+                "platform": None,
+                "error": f"accelerator: {probe_err}; cpu fallback: {err}",
             }
         )
     )

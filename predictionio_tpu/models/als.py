@@ -88,6 +88,13 @@ class ALSConfig:
     # batched SPD solver: "xla" (lax.linalg) or "pallas"
     # (ops/solve.py batch-lane kernel)
     solver: str = "xla"
+    # factor-table placement on the mesh: "replicated" keeps both tables
+    # on every device (fastest when they fit one chip's HBM); "sharded"
+    # block-shards both tables over the ``data`` axis (ALX-style, arXiv
+    # 2112.02194) so trainable model size scales with mesh HBM — the
+    # opposite table is all-gathered transiently per half-iteration and
+    # updates are written shard-locally
+    factor_placement: str = "replicated"
 
 
 @dataclass
@@ -225,13 +232,51 @@ def _half_iteration(
     precision: str,
     solver: str,
 ) -> jax.Array:
+    def write(acc, rows, x):
+        acc = upd if acc is None else acc
+        # batch-padding rows carry row id >= N -> dropped by the scatter
+        return acc.at[rows].set(
+            x.astype(acc.dtype), mode="drop", unique_indices=True
+        )
+
+    out = _solve_buckets(
+        write, opp, c_sorted, v_sorted, bucket_args, lam, alpha,
+        ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
+        precision=precision, solver=solver,
+    )
+    return upd if out is None else out
+
+
+def _solve_buckets(
+    upd_write,             # callback(rows, x) -> new upd table/shard
+    opp: jax.Array,        # [M, R] full opposite table (local or gathered)
+    c_sorted: jax.Array,
+    v_sorted: jax.Array,
+    bucket_args: tuple,
+    lam: jax.Array,
+    alpha: jax.Array,
+    *,
+    ks: tuple,
+    implicit: bool,
+    weighted_lambda: bool,
+    precision: str,
+    solver: str,
+    gram: Optional[jax.Array] = None,
+):
+    """Shared bucket-solve math for the replicated and sharded paths.
+
+    ``gram`` (implicit mode only) lets the sharded path supply the YtY
+    matrix computed shard-locally + psum'd instead of redundantly from the
+    gathered full table.
+    """
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]
     prec = jax.lax.Precision(
         {"highest": "highest", "high": "high", "default": "default"}[precision]
     )
-    if implicit:
+    if implicit and gram is None:
         gram = jnp.einsum("mr,ms->rs", opp, opp, precision=prec)
+    out = None
     for (rows, starts, counts), k in zip(bucket_args, ks):
         iota = jnp.arange(k, dtype=jnp.int32)
         pos = jnp.minimum(starts[:, None] + iota[None, :], nnz - 1)
@@ -242,7 +287,6 @@ def _half_iteration(
         Vm = opp[idx] * mask[..., None]                  # [B, K, R] gather
         n_row = counts.astype(opp.dtype)                 # [B]
         if implicit:
-            # A = YtY + sum alpha*r v v^T + reg;  b = sum (1 + alpha*r) v
             cw = alpha.astype(opp.dtype) * val * mask    # (c - 1)
             A = gram + jnp.einsum(
                 "bk,bkr,bks->brs", cw, Vm, Vm, precision=prec
@@ -258,7 +302,6 @@ def _half_iteration(
         else:
             reg = jnp.broadcast_to(lam_t, n_row.shape)
         A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)
-        # batched SPD solve via Cholesky
         if solver == "pallas":
             from ..ops.solve import cholesky_solve_batched
 
@@ -273,11 +316,100 @@ def _half_iteration(
             x = jax.lax.linalg.triangular_solve(
                 L, y, left_side=True, lower=True, transpose_a=True
             )[..., 0]
-        # batch-padding rows carry row id == N -> dropped by the scatter
-        upd = upd.at[rows].set(
-            x.astype(upd.dtype), mode="drop", unique_indices=True
+        out = upd_write(out, rows, x)
+    return out
+
+
+def build_sharded_half(
+    mesh: Mesh,
+    *,
+    ks: tuple,
+    implicit: bool,
+    weighted_lambda: bool,
+    precision: str,
+    solver: str,
+):
+    """ALX-style half-iteration over block-sharded factor tables.
+
+    Layout (SURVEY §2.7(2); the TPU answer to MLlib's block-partitioned
+    ALS, reference `examples/scala-parallel-similarproduct/multi/src/main/
+    scala/ALSAlgorithm.scala`):
+
+    * Both factor tables live **sharded** ``P('data', None)`` at rest, so
+      model capacity scales with total mesh HBM instead of one chip's.
+    * Per half-iteration, each device all-gathers the opposite table over
+      ICI (transient), solves its shard of every bucket's batch, then
+      all-gathers the small solved blocks ``[B, R]`` and writes only the
+      rows its own factor shard owns — updates never cross devices.
+    * Rating COO arrays are replicated (their sharding is the multi-host
+      ingest axis, not this one).
+
+    Requires row counts padded to a multiple of the mesh size; bucket
+    padding rows carry ids >= the padded row count, so they drop out of
+    every shard's scatter window.
+    """
+    import functools as _ft
+
+    try:
+        shard_map = _ft.partial(jax.shard_map, check_vma=False)  # jax >= 0.8
+    except AttributeError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        shard_map = _ft.partial(shard_map, check_rep=False)
+
+    axis = DATA_AXIS
+
+    def body(upd, opp, c_sorted, v_sorted, lam, alpha, *flat_buckets):
+        # upd/opp arrive as local shards [Np/d, R] / [Mp/d, R]
+        me = jax.lax.axis_index(axis)
+        shard_n = upd.shape[0]
+        lo = (me * shard_n).astype(jnp.int32)
+        opp_full = jax.lax.all_gather(opp, axis, axis=0, tiled=True)
+        gram = None
+        if implicit:
+            # YtY from the LOCAL shard + psum: identical [R, R] result at
+            # 1/d the FLOPs of redoing the full einsum on every device
+            prec = jax.lax.Precision(
+                {"highest": "highest", "high": "high", "default": "default"}[
+                    precision
+                ]
+            )
+            gram = jax.lax.psum(
+                jnp.einsum("mr,ms->rs", opp, opp, precision=prec), axis
+            )
+        bucket_args = tuple(
+            tuple(flat_buckets[i : i + 3])
+            for i in range(0, len(flat_buckets), 3)
         )
-    return upd
+
+        def write(acc, rows, x):
+            acc = upd if acc is None else acc
+            xg = jax.lax.all_gather(x, axis, axis=0, tiled=True)   # [B, R]
+            rg = jax.lax.all_gather(rows, axis, axis=0, tiled=True)
+            local = rg - lo
+            inside = (local >= 0) & (local < shard_n)
+            # OOB sentinel: shard_n is out of range -> dropped by the
+            # scatter (covers other shards' rows AND bucket padding)
+            safe = jnp.where(inside, local, shard_n)
+            return acc.at[safe].set(xg.astype(acc.dtype), mode="drop")
+
+        out = _solve_buckets(
+            write, opp_full, c_sorted, v_sorted, bucket_args, lam, alpha,
+            ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
+            precision=precision, solver=solver, gram=gram,
+        )
+        return upd if out is None else out
+
+    P_ = P
+    sharded2 = P_(axis, None)
+    rep = P_()
+    in_specs = (
+        sharded2, sharded2, rep, rep, rep, rep,
+    ) + (P_(axis),) * (3 * len(ks))
+    mapped = shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=sharded2,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
 
 
 class ALSTrainer:
@@ -309,18 +441,40 @@ class ALSTrainer:
         self.n_items = n_items
 
         n_dev = self.mesh.size if self.mesh is not None else 1
+        # sharded factor tables need a real mesh and row counts divisible
+        # by it; single-device "sharded" degenerates to replicated
+        self.sharded = (
+            cfg.factor_placement == "sharded" and self.mesh is not None
+        )
+        self._pad_users = pad_to_multiple(n_users, n_dev)
+        self._pad_items = pad_to_multiple(n_items, n_dev)
+        nu = self._pad_users if self.sharded else n_users
+        ni = self._pad_items if self.sharded else n_items
         self._user_side = self._stage(
             build_bucket_layout(
-                u, i, v, n_users, cfg.min_bucket_k,
+                u, i, v, nu, cfg.min_bucket_k,
                 cfg.max_ratings_per_row, batch_multiple=n_dev,
             )
         )
         self._item_side = self._stage(
             build_bucket_layout(
-                i, u, v, n_items, cfg.min_bucket_k,
+                i, u, v, ni, cfg.min_bucket_k,
                 cfg.max_ratings_per_row, batch_multiple=n_dev,
             )
         )
+        if self.sharded:
+            common = dict(
+                implicit=cfg.implicit,
+                weighted_lambda=cfg.weighted_lambda,
+                precision=cfg.matmul_precision,
+                solver=cfg.solver,
+            )
+            self._sharded_user_half = build_sharded_half(
+                self.mesh, ks=self._user_side["ks"], **common
+            )
+            self._sharded_item_half = build_sharded_half(
+                self.mesh, ks=self._item_side["ks"], **common
+            )
 
     def _stage(self, layout: BucketLayout):
         """Transfer the sorted COO + bucket index vectors to the device."""
@@ -342,7 +496,12 @@ class ALSTrainer:
         }
 
     def init_factors(self) -> tuple[jax.Array, jax.Array]:
-        """MLlib-style init: N(0, 1)/sqrt(rank), fixed seed."""
+        """MLlib-style init: N(0, 1)/sqrt(rank), fixed seed.
+
+        Sharded placement pads the row dim to the mesh size with ZERO rows
+        (never solved; zeros keep the implicit-mode Gram matrix exact) and
+        places each table ``P('data', None)``.
+        """
         cfg = self.cfg
         key = jax.random.PRNGKey(cfg.seed)
         ku, ki = jax.random.split(key)
@@ -351,6 +510,11 @@ class ALSTrainer:
         U = U / jnp.sqrt(cfg.rank).astype(dtype)
         V = jax.random.normal(ki, (self.n_items, cfg.rank), dtype)
         V = V / jnp.sqrt(cfg.rank).astype(dtype)
+        if self.sharded:
+            U = jnp.pad(U, ((0, self._pad_users - self.n_users), (0, 0)))
+            V = jnp.pad(V, ((0, self._pad_items - self.n_items), (0, 0)))
+            sh = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            return jax.device_put(U, sh), jax.device_put(V, sh)
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             U = jax.device_put(U, rep)
@@ -359,6 +523,19 @@ class ALSTrainer:
 
     def _half(self, upd, opp, side) -> jax.Array:
         cfg = self.cfg
+        if self.sharded:
+            fn = (
+                self._sharded_user_half
+                if side is self._user_side
+                else self._sharded_item_half
+            )
+            flat = [a for b in side["buckets"] for a in b]
+            return fn(
+                upd, opp, side["c_sorted"], side["v_sorted"],
+                jnp.asarray(cfg.lam, jnp.float32),
+                jnp.asarray(cfg.alpha, jnp.float32),
+                *flat,
+            )
         return _half_iteration(
             upd, opp, side["c_sorted"], side["v_sorted"], side["buckets"],
             jnp.asarray(cfg.lam, jnp.float32),
@@ -405,9 +582,7 @@ class ALSTrainer:
         if checkpointer is None:
             # one call keeps the 2*num_iterations dispatches async
             U, V = self.run(U, V, self.cfg.num_iterations)
-            return ALSFactors(
-                user_factors=np.asarray(U), item_factors=np.asarray(V)
-            )
+            return self._factors(U, V)
         start = 0
         if resume:
             latest = checkpointer.latest_step()
@@ -422,9 +597,13 @@ class ALSTrainer:
             U, V = self.run(U, V, chunk)
             it += chunk
             checkpointer.save(it, {"U": U, "V": V})
-        return ALSFactors(
-            user_factors=np.asarray(U), item_factors=np.asarray(V)
-        )
+        return self._factors(U, V)
+
+    def _factors(self, U, V) -> ALSFactors:
+        """Host factor arrays; sharded runs drop the mesh-padding rows."""
+        U = np.asarray(U)[: self.n_users]
+        V = np.asarray(V)[: self.n_items]
+        return ALSFactors(user_factors=U, item_factors=V)
 
 
 def train_als(

@@ -85,6 +85,13 @@ def _visible_devices():
     forced = os.environ.get("PIO_TPU_PLATFORM")
     if forced:
         jax.config.update("jax_platforms", forced)
+    else:
+        env = os.environ.get("JAX_PLATFORMS")
+        if env and str(jax.config.jax_platforms or "") not in (env, ""):
+            # accelerator plugins (sitecustomize) may set jax_platforms at
+            # interpreter boot, which outranks the env var; an explicitly
+            # exported JAX_PLATFORMS is the user's word — honor it
+            jax.config.update("jax_platforms", env)
     try:
         return jax.devices()
     except RuntimeError as e:

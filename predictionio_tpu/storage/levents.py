@@ -112,6 +112,58 @@ class EventStore(abc.ABC):
         events first.  Events are ordered by event_time.
         """
 
+    # -- columnar batch read (PEvents analogue) ---------------------------
+    def find_columnar(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: TargetFilter = None,
+        target_entity_id: TargetFilter = None,
+        float_property: Optional[str] = None,
+        float_default: float = float("nan"),
+    ):
+        """Bulk scan into column arrays (the `PEvents` analogue,
+        reference `data/.../storage/PEvents.scala:30-138`).
+
+        Generic implementation built on :meth:`find` +
+        :func:`~predictionio_tpu.storage.columnar.events_to_frame`, so
+        EVERY backend satisfies the columnar contract; backends with a
+        native bulk path override it
+        (`sqlite_events.SQLiteEventStore.find_columnar` reads straight
+        from the cursor).  With ``float_property`` the named property is
+        extracted per event into a float64 ``value`` column (missing ->
+        ``float_default``) — the training-data hot path.
+        """
+        from dataclasses import replace
+
+        from .columnar import events_to_frame
+
+        frame = events_to_frame(
+            self.find(
+                app_id=app_id,
+                channel_id=channel_id,
+                start_time=start_time,
+                until_time=until_time,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
+            )
+        )
+        if float_property is not None:
+            frame = replace(
+                frame,
+                value=frame.property_column(float_property, float_default),
+                properties=None,
+            )
+        return frame
+
     # -- aggregation (built on find, like the reference) ------------------
     def aggregate_properties_of(
         self,

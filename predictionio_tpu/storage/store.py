@@ -25,7 +25,7 @@ import datetime as _dt
 from typing import Iterator, Optional, Sequence
 
 from .aggregate import PropertyMap
-from .columnar import EventFrame, events_to_frame
+from .columnar import EventFrame
 from .event import Event
 from .registry import Storage, get_storage
 
@@ -91,9 +91,9 @@ class PEventStore:
             target_entity_type=target_entity_type,
             target_entity_id=target_entity_id,
         )
-        if hasattr(es, "find_columnar"):
-            return es.find_columnar(**kwargs)
-        return events_to_frame(es.find(**kwargs))
+        # part of the EventStore contract: the base class supplies a
+        # generic implementation, sqlite overrides with a native bulk read
+        return es.find_columnar(**kwargs)
 
     def aggregate_properties(
         self,

@@ -35,7 +35,7 @@ from ..controller import (
 )
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import topk_scores
-from ..storage.columnar import events_to_frame
+
 from ._common import DeviceTableMixin
 from .recommendation import ItemScore, PredictedResult, Query, _resolve_app_id
 
@@ -68,17 +68,11 @@ class ECommDataSource(DataSource):
         p = self.params
         app_id = _resolve_app_id(ctx, p)
         es = ctx.storage.get_event_store()
-        if hasattr(es, "find_columnar"):
-            frame = es.find_columnar(
-                app_id=app_id, entity_type="user",
-                event_names=list(p.view_events),
-                float_property=p.rating_property,
-            )
-        else:
-            frame = events_to_frame(
-                es.find(app_id=app_id, entity_type="user",
-                        event_names=list(p.view_events))
-            )
+        frame = es.find_columnar(
+            app_id=app_id, entity_type="user",
+            event_names=list(p.view_events),
+            float_property=p.rating_property,
+        )
         ratings = frame.to_ratings(
             rating_property=p.rating_property,
             dedup="last" if p.rating_property else "sum",

@@ -34,7 +34,7 @@ from ..controller import (
 )
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, topk_scores
-from ..storage.columnar import Ratings, events_to_frame
+from ..storage.columnar import Ratings
 from ._common import DeviceTableMixin
 from ..storage.levents import EventStore
 
@@ -131,21 +131,12 @@ class RecommendationDataSource(DataSource):
         p: DataSourceParams = self.params
         app_id = _resolve_app_id(ctx, p)
         es: EventStore = ctx.storage.get_event_store()
-        if hasattr(es, "find_columnar"):
-            frame = es.find_columnar(
-                app_id=app_id,
-                entity_type=p.entity_type,
-                event_names=list(p.event_names),
-                float_property=p.rating_property,
-            )
-        else:
-            frame = events_to_frame(
-                es.find(
-                    app_id=app_id,
-                    entity_type=p.entity_type,
-                    event_names=list(p.event_names),
-                )
-            )
+        frame = es.find_columnar(
+            app_id=app_id,
+            entity_type=p.entity_type,
+            event_names=list(p.event_names),
+            float_property=p.rating_property,
+        )
         items = {
             k: dict(v.fields)
             for k, v in es.aggregate_properties_of(

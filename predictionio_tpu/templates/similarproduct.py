@@ -30,7 +30,7 @@ from ..controller import (
 )
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import topk_scores
-from ..storage.columnar import events_to_frame
+
 from ._common import DeviceTableMixin
 from .recommendation import ItemScore, PredictedResult, _resolve_app_id
 
@@ -80,16 +80,10 @@ class SimilarProductDataSource(DataSource):
         p = self.params
         app_id = _resolve_app_id(ctx, p)
         es = ctx.storage.get_event_store()
-        if hasattr(es, "find_columnar"):
-            frame = es.find_columnar(
-                app_id=app_id, entity_type="user",
-                event_names=list(p.view_events),
-            )
-        else:
-            frame = events_to_frame(
-                es.find(app_id=app_id, entity_type="user",
-                        event_names=list(p.view_events))
-            )
+        frame = es.find_columnar(
+            app_id=app_id, entity_type="user",
+            event_names=list(p.view_events),
+        )
         ratings = frame.to_ratings(dedup="sum")  # implicit view counts
         items = {
             k: dict(v.fields)

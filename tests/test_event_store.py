@@ -161,9 +161,10 @@ def test_sqlite_persistence(tmp_path):
     s2.close()
 
 
-def test_sqlite_columnar(store):
-    if not isinstance(store, SQLiteEventStore):
-        pytest.skip("columnar fast path is sqlite-only")
+def test_columnar_contract(store):
+    """find_columnar is part of the EventStore contract for EVERY backend:
+    the base class supplies a generic implementation on top of find();
+    sqlite overrides it with a native bulk read."""
     _load(store)
     frame = store.find_columnar(
         app_id=1, entity_type="user", event_names=["rate"], float_property="rating"

@@ -87,19 +87,12 @@ def _parse_args(argv=None):
     return ap.parse_args(argv)
 
 
-def _force_platform(platform: str) -> None:
-    os.environ["JAX_PLATFORMS"] = platform
-    import jax
-
-    # the axon plugin sets jax_platforms directly at interpreter boot;
-    # the config knob (not the env var) is what actually wins
-    jax.config.update("jax_platforms", platform)
-
-
 def run_inner(args) -> None:
     """The actual timed train: stages, warms up, trains, prints the JSON."""
     if args.platform:
-        _force_platform(args.platform)
+        from predictionio_tpu.parallel.mesh import force_platform
+
+        force_platform(args.platform)
 
     import jax
 

@@ -1,0 +1,136 @@
+"""All-pairs item-item cosine similarity ("DIMSUM" analogue).
+
+Analogue of the reference `examples/experimental/scala-parallel-
+similarproduct-dimsum/` (`DIMSUMAlgorithm.scala`), which uses Spark MLlib's
+DIMSUM sampling to APPROXIMATE all-pairs column cosine similarity of the
+user x item rating matrix — sampling is needed because an exact all-pairs
+pass is shuffle-bound on a cluster.
+
+TPU-native shape: the exact computation is one Gram matmul on the MXU
+(``S = Ĉᵀ Ĉ`` over the column-normalized rating matrix), so no sampling or
+similarity threshold is needed — the "approximation knob" disappears and
+the model is the exact top-N similarity lists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+from predictionio_tpu.storage.bimap import StringIndex
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    path: str = "ratings.csv"
+
+
+@dataclass(frozen=True)
+class AlgoParams(Params):
+    top_n: int = 10
+
+
+@dataclass
+class Query:
+    items: tuple
+    num: int = 4
+
+
+@dataclass
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass
+class TrainingData:
+    users: StringIndex
+    items: StringIndex
+    matrix: np.ndarray  # [n_users, n_items] ratings (0 = unrated)
+
+
+class RatingsDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        triples = []
+        for line in Path(self.params.path).read_text().splitlines():
+            if line.strip():
+                u, i, r = line.split(",")
+                triples.append((u.strip(), i.strip(), float(r)))
+        users = StringIndex.from_values(t[0] for t in triples)
+        items = StringIndex.from_values(t[1] for t in triples)
+        m = np.zeros((len(users), len(items)), np.float32)
+        for u, i, r in triples:
+            m[users[u], items[i]] = r
+        return TrainingData(users, items, m)
+
+
+@dataclass
+class SimilarityModel:
+    items: StringIndex
+    top_items: np.ndarray   # [n_items, top_n] int32 neighbor indices
+    top_scores: np.ndarray  # [n_items, top_n] cosine scores
+
+
+class CosineSimilarityAlgorithm(Algorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, td: TrainingData) -> SimilarityModel:
+        import jax.numpy as jnp
+
+        n = len(td.items)
+        top_n = min(self.params.top_n, n - 1)
+        C = jnp.asarray(td.matrix)
+        # column-normalize, then ONE Gram matmul = exact all-pairs cosine
+        norms = jnp.linalg.norm(C, axis=0, keepdims=True)
+        Cn = C / jnp.maximum(norms, 1e-9)
+        S = Cn.T @ Cn                       # [n_items, n_items] on the MXU
+        S = S - 2.0 * jnp.eye(n)            # exclude self-similarity
+        import jax
+
+        scores, idx = jax.lax.top_k(S, top_n)
+        return SimilarityModel(
+            items=td.items,
+            top_items=np.asarray(idx, np.int32),
+            top_scores=np.asarray(scores, np.float32),
+        )
+
+    def predict(self, model: SimilarityModel, query: Query):
+        known = [model.items.get(i) for i in query.items]
+        known = [i for i in known if i >= 0]
+        if not known:
+            return []
+        # merge the query items' neighbor lists, best score per neighbor
+        best: dict[int, float] = {}
+        for ix in known:
+            for j, s in zip(model.top_items[ix], model.top_scores[ix]):
+                j = int(j)
+                if j in known:
+                    continue
+                if s > best.get(j, -np.inf):
+                    best[j] = float(s)
+        ranked = sorted(best.items(), key=lambda kv: -kv[1])[: query.num]
+        return [
+            ItemScore(item=str(model.items.id_of(j)), score=s)
+            for j, s in ranked
+        ]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        RatingsDataSource,
+        IdentityPreparator,
+        {"cosine": CosineSimilarityAlgorithm},
+        FirstServing,
+    )

@@ -349,13 +349,16 @@ def build_sharded_half(
     every shard's scatter window.
     """
     import functools as _ft
+    import inspect
 
-    try:
-        shard_map = _ft.partial(jax.shard_map, check_vma=False)  # jax >= 0.8
-    except AttributeError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
-
-        shard_map = _ft.partial(shard_map, check_rep=False)
+    raw = getattr(jax, "shard_map", None)
+    if raw is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as raw
+    # the replication-check kwarg was renamed check_rep -> check_vma; probe
+    # the signature rather than the jax version
+    params = inspect.signature(raw).parameters
+    flag = "check_vma" if "check_vma" in params else "check_rep"
+    shard_map = _ft.partial(raw, **{flag: False})
 
     axis = DATA_AXIS
 

@@ -6,6 +6,12 @@ from .collectives import (
     reduce_scatter_sum,
     ring_shift,
 )
+from .ingest import (
+    find_columnar_sharded,
+    gather_ratings,
+    ids_exchange,
+    read_ratings_distributed,
+)
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -22,6 +28,10 @@ __all__ = [
     "all_reduce_sum",
     "reduce_scatter_sum",
     "ring_shift",
+    "find_columnar_sharded",
+    "gather_ratings",
+    "ids_exchange",
+    "read_ratings_distributed",
     "DATA_AXIS",
     "MODEL_AXIS",
     "data_sharding",

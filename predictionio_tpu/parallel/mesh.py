@@ -26,12 +26,25 @@ __all__ = [
     "make_mesh",
     "distributed_init",
     "enable_compilation_cache",
+    "force_platform",
     "data_sharding",
     "replicated",
     "pad_to_multiple",
     "DATA_AXIS",
     "MODEL_AXIS",
 ]
+
+
+def force_platform(platform: str) -> None:
+    """Force a jax platform, overriding any accelerator plugin.
+
+    Plugins registered via sitecustomize may set the ``jax_platforms``
+    CONFIG at interpreter boot, which outranks the ``JAX_PLATFORMS`` env
+    var — so both must be set.  Call before any backend initializes."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = platform
+    jax.config.update("jax_platforms", platform)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"

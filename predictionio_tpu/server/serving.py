@@ -63,6 +63,19 @@ def _default_query_decoder(engine: Engine, engine_params: EngineParams):
         qcls = getattr(mod, "Query", None) if mod else None
     if qcls is not None and hasattr(qcls, "from_json"):
         return qcls.from_json
+    if qcls is not None and is_dataclass(qcls):
+        # plain dataclass Query without from_json: construct it from the
+        # matching JSON fields (the generic analogue of the reference's
+        # json4s ``Extraction.extract`` into case classes,
+        # `CreateServer.scala:470-471`); unknown keys are ignored
+        import dataclasses
+
+        names = {f.name for f in dataclasses.fields(qcls)}
+
+        def decode(d):
+            return qcls(**{k: v for k, v in d.items() if k in names})
+
+        return decode
     return lambda d: d
 
 
@@ -71,6 +84,10 @@ def _result_to_json(r: Any) -> Any:
         return r.to_json()
     if is_dataclass(r) and not isinstance(r, type):
         return asdict(r)
+    if isinstance(r, (list, tuple)):
+        return [_result_to_json(v) for v in r]
+    if isinstance(r, dict):
+        return {k: _result_to_json(v) for k, v in r.items()}
     return r
 
 

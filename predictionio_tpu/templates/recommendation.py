@@ -147,6 +147,33 @@ class RecommendationDataSource(DataSource):
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p: DataSourceParams = self.params
+        import jax
+
+        if jax.process_count() > 1:
+            # multi-host run: each process scans only its entity-hash shard
+            # (the region-parallel HBase analogue, `HBPEvents.scala:99-105`),
+            # then id dictionaries + COO are exchanged/gathered
+            from ..parallel.ingest import read_ratings_distributed
+
+            app_id = _resolve_app_id(ctx, p)
+            es: EventStore = ctx.storage.get_event_store()
+            ratings = read_ratings_distributed(
+                es,
+                exchange_dir=ctx.storage.model_data_dir() / "_ingest",
+                tag=f"app{app_id}",
+                rating_property=p.rating_property,
+                dedup="last" if p.rating_property else "sum",
+                app_id=app_id,
+                entity_type=p.entity_type,
+                event_names=list(p.event_names),
+            )
+            items = {
+                k: dict(v.fields)
+                for k, v in es.aggregate_properties_of(
+                    app_id=app_id, entity_type=p.item_entity_type
+                ).items()
+            }
+            return TrainingData(ratings=ratings, items=items)
         frame, items = self._read_frame(ctx)
         ratings = frame.to_ratings(
             rating_property=p.rating_property,

@@ -20,17 +20,21 @@ The metadata `models` table stores the manifest JSON keyed by
 
 from __future__ import annotations
 
+import dataclasses
 import json
+import logging
 import pickle
 from pathlib import Path
 from typing import Any, Optional
 
 import numpy as np
 
-from ..controller.base import Algorithm, WorkflowContext
+from ..controller.base import Algorithm, ModelPlacement, WorkflowContext
 from ..storage.metadata import Model
 
 __all__ = ["save_models", "load_models", "NotPersisted"]
+
+logger = logging.getLogger(__name__)
 
 
 class NotPersisted:
@@ -45,6 +49,129 @@ def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree
     )
+
+
+# --------------------------------------------------------------------------
+# DEVICE_SHARDED persistence: array fields as .npz with recorded partition
+# specs, re-placed onto the CURRENT mesh at load (which may be a different
+# size than the training mesh) — the load-bearing consequence of the
+# reference's P/P2L/L taxonomy (`controller/PAlgorithm.scala:45-121`:
+# distributed models need an explicit persistence format; local models
+# serialize as blobs).
+# --------------------------------------------------------------------------
+
+
+def _split_array_fields(model: Any):
+    """Dataclass model -> ({array fields}, {other fields}), or None if the
+    model can't round-trip through ``cls(**fields)`` (not a dataclass, or
+    it has init=False fields whose state would be silently dropped) —
+    caller falls back to pickle."""
+    if not dataclasses.is_dataclass(model) or isinstance(model, type):
+        return None
+    if any(not f.init for f in dataclasses.fields(model)):
+        return None
+    import jax
+
+    arrays: dict[str, Any] = {}
+    rest: dict[str, Any] = {}
+    for f in dataclasses.fields(model):
+        v = getattr(model, f.name)
+        # only numeric/bool arrays ride the npz (object-dtype arrays would
+        # save fine but be unloadable under allow_pickle=False)
+        if (
+            isinstance(v, (np.ndarray, jax.Array))
+            and getattr(v, "ndim", 0) >= 1
+            and np.dtype(v.dtype).kind in "biufc"
+        ):
+            arrays[f.name] = v
+        else:
+            rest[f.name] = v
+    return arrays, rest
+
+
+def _spec_of(v: Any) -> Optional[list]:
+    """JSON-able partition spec of a sharded jax.Array, else None."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if not isinstance(v, jax.Array):
+        return None
+    sh = v.sharding
+    if not isinstance(sh, NamedSharding) or sh.is_fully_replicated:
+        return None
+    out = []
+    for entry in sh.spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(e) for e in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _save_sharded(model: Any, base_dir: Path, key: str) -> Optional[dict]:
+    """DEVICE_SHARDED format: one .npz of array fields + pickled rest;
+    per-field partition specs go in the manifest.  Returns None when the
+    model has no recognizable array fields (caller falls back to pickle)."""
+    split = _split_array_fields(model)
+    if split is None or not split[0]:
+        return None
+    arrays, rest = split
+    base_dir.mkdir(parents=True, exist_ok=True)
+    npz_name = f"{key}-arrays.npz"
+    rest_name = f"{key}-rest.pkl"
+    np.savez_compressed(
+        base_dir / npz_name, **{k: np.asarray(v) for k, v in arrays.items()}
+    )
+    with open(base_dir / rest_name, "wb") as f:
+        pickle.dump({"cls": type(model), "fields": rest}, f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+    return {
+        "kind": "sharded",
+        "npz": npz_name,
+        "rest": rest_name,
+        "specs": {k: _spec_of(v) for k, v in arrays.items()},
+    }
+
+
+def _load_sharded(
+    ctx: WorkflowContext, manifest: dict, base_dir: Path
+) -> Any:
+    """Rebuild a DEVICE_SHARDED model, re-placing each recorded-spec array
+    onto the CURRENT mesh (any size whose axis names match)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    with open(base_dir / manifest["rest"], "rb") as f:
+        rest = pickle.load(f)
+    data = np.load(base_dir / manifest["npz"], allow_pickle=False)
+    mesh = getattr(ctx, "mesh", None)
+    kw = dict(rest["fields"])
+    for k in data.files:
+        arr = data[k]
+        spec = manifest.get("specs", {}).get(k)
+        if spec is not None and mesh is not None and mesh.size > 1:
+            names = {
+                n
+                for e in spec
+                if e is not None
+                for n in (e if isinstance(e, list) else [e])
+            }
+            if names <= set(mesh.axis_names):
+                entries = [
+                    tuple(e) if isinstance(e, list) else e for e in spec
+                ]
+                arr = jax.device_put(
+                    arr, NamedSharding(mesh, PartitionSpec(*entries))
+                )
+            else:
+                logger.warning(
+                    "model array %r recorded axes %s not in serving mesh "
+                    "%s; loading replicated", k, names, mesh.axis_names,
+                )
+        kw[k] = arr
+    return rest["cls"](**kw)
 
 
 def model_key(instance_id: str, ax: int, name: str) -> str:
@@ -68,13 +195,21 @@ def save_models(
             if custom is not None:
                 manifest = {"kind": "custom", "custom": custom}
             else:
-                base_dir.mkdir(parents=True, exist_ok=True)
-                fname = f"model_{ax}_{name or 'default'}.pkl"
-                with open(base_dir / fname, "wb") as f:
-                    pickle.dump(_to_host(model), f, protocol=pickle.HIGHEST_PROTOCOL)
-                # store the name relative to base_dir so the storage tree
-                # can be relocated between train and deploy hosts
-                manifest = {"kind": "pickle", "file": fname}
+                manifest = None
+                if algo.placement is ModelPlacement.DEVICE_SHARDED:
+                    # placement drives the persistence format: sharded
+                    # models round-trip as array files + partition specs
+                    # so deploy can re-place them on a different mesh
+                    manifest = _save_sharded(model, base_dir, key)
+                if manifest is None:
+                    base_dir.mkdir(parents=True, exist_ok=True)
+                    fname = f"model_{ax}_{name or 'default'}.pkl"
+                    with open(base_dir / fname, "wb") as f:
+                        pickle.dump(_to_host(model), f,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                    # store the name relative to base_dir so the storage
+                    # tree can be relocated between train and deploy hosts
+                    manifest = {"kind": "pickle", "file": fname}
         md.model_insert(Model(id=key, models=json.dumps(manifest).encode()))
 
 
@@ -99,6 +234,8 @@ def load_models(
             out.append(NotPersisted())
         elif kind == "custom":
             out.append(algo.load_model(ctx, key, manifest["custom"], base_dir))
+        elif kind == "sharded":
+            out.append(_load_sharded(ctx, manifest, base_dir))
         elif kind == "pickle":
             path = (
                 base_dir / manifest["file"]

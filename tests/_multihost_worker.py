@@ -1,0 +1,76 @@
+"""Worker for the 2-process multi-host ingest test.
+
+Launched by tests/test_multihost.py as:
+    python _multihost_worker.py <pid> <nprocs> <coordinator> <db> <exch> <out>
+
+Each process jax.distributed-inits into the cluster, reads ITS entity-hash
+shard of the shared sqlite event store, exchanges id dictionaries, gathers
+the global COO, and (to prove the union trains) runs a tiny ALS locally;
+results go to <out> for the parent to compare.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main() -> None:
+    pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    coordinator, db, exch, out = sys.argv[3:7]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs, jax.process_count()
+
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.parallel.ingest import (
+        find_columnar_sharded, read_ratings_distributed,
+    )
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    es = SQLiteEventStore(db)
+
+    # the local shard really is a strict subset (both processes see >0 rows
+    # for any non-trivial dataset split by entity hash)
+    local = find_columnar_sharded(
+        es, n_shards=nprocs, shard_id=pid,
+        app_id=1, event_names=["rate"], float_property="rating",
+    )
+
+    ratings = read_ratings_distributed(
+        es, exch, rating_property="rating",
+        app_id=1, event_names=["rate"],
+    )
+
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3)
+    factors = train_als(ratings, cfg=cfg)
+
+    order = np.lexsort((ratings.item_ix, ratings.user_ix))
+    np.savez(
+        out,
+        local_rows=np.int64(len(local)),
+        n_total=np.int64(len(ratings)),
+        user_ix=ratings.user_ix[order],
+        item_ix=ratings.item_ix[order],
+        rating=ratings.rating[order],
+        user_ids=ratings.users.ids.astype(str),
+        item_ids=ratings.items.ids.astype(str),
+        user_factors=factors.user_factors,
+        item_factors=factors.item_factors,
+    )
+    print("WORKER_OK", pid, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,3 +69,98 @@ def test_markovchain(in_example):
     algo = engine._algorithms(ep)[0]
     ranked = algo.predict(models[0], m.Query(state="search"))
     assert ranked and ranked[0][0] == "product"
+
+
+def test_friendrec(in_example):
+    m = in_example("friendrec")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # shared 'music' keyword: 2.0 * 1.0 = 2.0 >= threshold
+    r = algo.predict(models[0], m.Query(user="alice", item="jazz-club"))
+    assert r.confidence == pytest.approx(2.0)
+    assert r.acceptance
+    # no shared keywords
+    r = algo.predict(models[0], m.Query(user="carol", item="jazz-club"))
+    assert r.confidence == 0.0 and not r.acceptance
+    # unseen entity -> 0/False like the reference
+    r = algo.predict(models[0], m.Query(user="nobody", item="jazz-club"))
+    assert r.confidence == 0.0 and not r.acceptance
+    # batch path agrees with the scalar path
+    qs = [m.Query(user="alice", item="jazz-club"),
+          m.Query(user="bob", item="trail-group")]
+    batch = algo.batch_predict(models[0], qs)
+    singles = [algo.predict(models[0], q) for q in qs]
+    assert [b.confidence for b in batch] == pytest.approx(
+        [s.confidence for s in singles])
+
+
+def test_dimsum(in_example):
+    m = in_example("dimsum")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # i1 and i2 are co-rated high by u1-u3 -> most similar pair
+    res = algo.predict(models[0], m.Query(items=("i1",), num=2))
+    assert res and res[0].item == "i2"
+    res34 = algo.predict(models[0], m.Query(items=("i3",), num=2))
+    assert res34 and res34[0].item == "i4"
+    # query items never recommend themselves
+    assert all(r.item != "i1" for r in res)
+
+
+def test_stock(in_example):
+    m = in_example("stock")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    assert algo.predict(models[0], m.Query(ticker="UPCO")).signal == "long"
+    assert algo.predict(models[0], m.Query(ticker="DNCO")).signal == "short"
+    assert algo.predict(models[0], m.Query(ticker="FLAT")).signal == "flat"
+    assert algo.predict(models[0], m.Query(ticker="NOPE")).signal == "flat"
+
+
+def test_parallel_regression(in_example):
+    m = in_example("parallel-regression")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # data is y = 1 + 2*x1 - 0.5*x2 exactly; mesh run must recover it
+    pred = algo.predict(models[0], m.Query(features=[1.0, 2.0]))
+    assert pred == pytest.approx(1 + 2 * 1.0 - 0.5 * 2.0, abs=0.05)
+    w = models[0]
+    assert w[0] == pytest.approx(1.0, abs=0.05)
+    assert w[1] == pytest.approx(2.0, abs=0.05)
+    assert w[2] == pytest.approx(-0.5, abs=0.05)
+
+
+def test_custom_datasource(in_example):
+    m = in_example("custom-datasource")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # u0 likes even items (group 0): top recommendation should be even
+    res = algo.predict(models[0], m.Query(user="u0", num=3))
+    assert res and int(res[0].item[1:]) % 2 == 0
+    assert algo.predict(models[0], m.Query(user="ghost", num=3)) == []
+
+
+def test_movielens_eval(in_example, tmp_path, monkeypatch):
+    m = in_example("movielens-eval")
+    import os
+
+    from predictionio_tpu.workflow import run_evaluation
+
+    # best.json should land in a scratch dir, not the example dir
+    data = os.path.join(os.getcwd(), "ratings.csv")
+    monkeypatch.chdir(tmp_path)
+    candidates = [
+        type(ep)(
+            data_source=("", type(ep.data_source[1])(path=data)),
+            algorithms=ep.algorithms,
+        )
+        for ep in m.engine_params_list()
+    ]
+    evaluation = m.evaluation_factory()
+    _, result = run_evaluation(evaluation, candidates)
+    assert result.metric_header == "MSE"
+    scores = [s for _, s, _ in result.results]
+    assert all(s == s for s in scores)  # finite
+    # the stronger candidate (rank 6, 8 iters) must win
+    assert result.best_engine_params.algorithms[0][1].rank == 6
+    assert result.best_score == min(scores)

@@ -242,3 +242,55 @@ def test_deploy_serves_trained_params_not_variant(storage_memory):
     # the reconstructed algorithm params are the trained ones
     (name, params), = server.engine_params.algorithms
     assert name == "a0" and params.id == 42
+
+
+def test_generic_dataclass_query_decode_and_result_encode():
+    """Engines whose Query is a plain dataclass (no from_json) and whose
+    results are lists of dataclasses must serve without custom codecs —
+    the generic analogue of json4s Extraction.extract
+    (`CreateServer.scala:470-471`)."""
+    from dataclasses import dataclass
+
+    from predictionio_tpu.controller import (
+        Algorithm, DataSource, Engine, EngineParams, FirstServing,
+        IdentityPreparator,
+    )
+    from predictionio_tpu.server.serving import (
+        _default_query_decoder, _result_to_json,
+    )
+
+    @dataclass
+    class PlainQuery:
+        user: str
+        num: int = 4
+
+    @dataclass
+    class Score:
+        item: str
+        score: float
+
+    class PlainAlgo(Algorithm):
+        query_class = PlainQuery
+
+        def train(self, ctx, pd):
+            return None
+
+        def predict(self, model, query):
+            return [Score(item="a", score=1.0)]
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return None
+
+    engine = Engine(DS, IdentityPreparator, {"a": PlainAlgo}, FirstServing)
+    ep = EngineParams(algorithms=[("a", None)])
+    decode = _default_query_decoder(engine, ep)
+    q = decode({"user": "u1", "num": 7, "unknownKey": "ignored"})
+    assert isinstance(q, PlainQuery) and q.user == "u1" and q.num == 7
+
+    out = _result_to_json([Score(item="a", score=1.0),
+                           Score(item="b", score=0.5)])
+    assert out == [{"item": "a", "score": 1.0}, {"item": "b", "score": 0.5}]
+    assert _result_to_json({"k": (Score(item="c", score=2.0),)}) == {
+        "k": [{"item": "c", "score": 2.0}]
+    }

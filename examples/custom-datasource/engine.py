@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from pathlib import Path
 
+import jax
 import numpy as np
 
 from predictionio_tpu.controller import (
@@ -116,9 +117,10 @@ class CsvALSAlgorithm(Algorithm):
             np.asarray(model.factors.item_factors, np.float32),
             k,
         )
+        vals, ixs = jax.device_get((vals, ixs))  # one host sync per query
         return [
             ItemScore(item=str(model.items.id_of(int(j))), score=float(s))
-            for s, j in zip(np.asarray(vals), np.asarray(ixs))
+            for s, j in zip(vals, ixs)
         ]
 
 

@@ -143,12 +143,16 @@ class KeywordSimilarityAlgorithm(Algorithm):
             sims[ok] = np.einsum(
                 "qk,qk->q", model.user_kw[uix[ok]], model.item_kw[iix[ok]]
             )
+        # unseen users/items are hard-rejected like the scalar path (NOT
+        # run through the threshold test, which a threshold <= 0 would pass)
         return [
             Prediction(
                 confidence=float(s),
-                acceptance=bool(s * model.sim_weight >= model.threshold),
+                acceptance=bool(
+                    k and s * model.sim_weight >= model.threshold
+                ),
             )
-            for s in sims
+            for s, k in zip(sims, ok)
         ]
 
 

@@ -36,34 +36,37 @@ def _solve_kernel(a_ref, b_ref, x_ref, l_scr, y_scr):
 
     All loop-carried state lives in VMEM scratch; each ``fori_loop`` step
     is vectorized over the TB batch lanes.
+
+    Row/column selection and single-row updates use broadcasted-iota
+    one-hot masks (multiply + reduce / select) instead of
+    ``dynamic_slice`` — Mosaic does not lower ``dynamic_slice`` /
+    ``dynamic_update_slice`` on *values* inside a TPU kernel (verified on
+    real v5e hardware; the interpreter accepts them, which is why CPU
+    tests alone missed it).  The masked forms are pure elementwise +
+    reduction VPU ops and lower everywhere.
     """
     A = a_ref[:]                       # [TB, R, R]
     b = b_ref[:]                       # [TB, R]
     R = A.shape[-1]
-    row_i = jax.lax.broadcasted_iota(jnp.int32, (1, R), 1)  # [1, R]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, R), 1)   # [1, R]
 
     l_scr[:] = jnp.zeros_like(A)
 
     def chol_step(j, _):
         L = l_scr[:]
+        oh = (lane == j).astype(A.dtype)                    # [1, R] one-hot
         # row j of L, zeroed at columns >= j: closes the k<j sum below
-        Lj = jnp.where(
-            row_i < j, jax.lax.dynamic_slice_in_dim(L, j, 1, 1)[:, 0, :], 0.0
-        )                                                   # [TB, R]
+        Lrow = jnp.sum(L * oh[:, :, None], axis=1)          # [TB, R]
+        Lj = jnp.where(lane < j, Lrow, 0.0)                 # [TB, R]
         # c[b, i] = sum_{k<j} L[b, i, k] * L[b, j, k]
-        c = jax.lax.dot_general(
-            L, Lj[..., None],
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )[..., 0]                                           # [TB, R]
-        v = jax.lax.dynamic_slice_in_dim(A, j, 1, 2)[..., 0] - c
+        c = jnp.sum(L * Lj[:, None, :], axis=-1)            # [TB, R]
+        v = jnp.sum(A * oh[:, None, :], axis=-1) - c        # A[:, :, j] - c
         d = jnp.sqrt(
-            jnp.maximum(jax.lax.dynamic_slice_in_dim(v, j, 1, 1)[:, 0], _EPS)
+            jnp.maximum(jnp.sum(v * oh, axis=-1), _EPS)
         )                                                   # [TB]
-        col = jnp.where(row_i >= j, v / d[:, None], 0.0)    # [TB, R]
-        l_scr[:] = jax.lax.dynamic_update_slice_in_dim(
-            L, col[..., None], j, 2
-        )
+        col = jnp.where(lane >= j, v / d[:, None], 0.0)     # [TB, R]
+        # write column j: L = L with [:, :, j] <- col
+        l_scr[:] = L * (1.0 - oh[:, None, :]) + col[:, :, None] * oh[:, None, :]
         return 0
 
     jax.lax.fori_loop(0, R, chol_step, 0)
@@ -74,11 +77,12 @@ def _solve_kernel(a_ref, b_ref, x_ref, l_scr, y_scr):
     def fwd_step(j, _):
         L = l_scr[:]
         y = y_scr[:]
-        Lj = jax.lax.dynamic_slice_in_dim(L, j, 1, 1)[:, 0, :]  # [TB, R]
+        oh = (lane == j).astype(A.dtype)
+        Lj = jnp.sum(L * oh[:, :, None], axis=1)            # row j, [TB, R]
         s = jnp.sum(Lj * y, axis=-1)
-        diag = jax.lax.dynamic_slice_in_dim(Lj, j, 1, 1)[:, 0]
-        yj = (jax.lax.dynamic_slice_in_dim(b, j, 1, 1)[:, 0] - s) / diag
-        y_scr[:] = jax.lax.dynamic_update_slice_in_dim(y, yj[:, None], j, 1)
+        diag = jnp.sum(Lj * oh, axis=-1)
+        yj = (jnp.sum(b * oh, axis=-1) - s) / diag
+        y_scr[:] = y * (1.0 - oh) + yj[:, None] * oh
         return 0
 
     jax.lax.fori_loop(0, R, fwd_step, 0)
@@ -92,19 +96,28 @@ def _solve_kernel(a_ref, b_ref, x_ref, l_scr, y_scr):
         j = R - 1 - t
         L = l_scr[:]
         x = x_scr[:]
-        Lcol = jax.lax.dynamic_slice_in_dim(L, j, 1, 2)[..., 0]  # [TB, R]
+        oh = (lane == j).astype(A.dtype)
+        Lcol = jnp.sum(L * oh[:, None, :], axis=-1)         # col j, [TB, R]
         s = jnp.sum(Lcol * x, axis=-1)
-        diag = jax.lax.dynamic_slice_in_dim(Lcol, j, 1, 1)[:, 0]
-        xj = (jax.lax.dynamic_slice_in_dim(y, j, 1, 1)[:, 0] - s) / diag
-        x_scr[:] = jax.lax.dynamic_update_slice_in_dim(x, xj[:, None], j, 1)
+        diag = jnp.sum(Lcol * oh, axis=-1)
+        xj = (jnp.sum(y * oh, axis=-1) - s) / diag
+        x_scr[:] = x * (1.0 - oh) + xj[:, None] * oh
         return 0
 
     jax.lax.fori_loop(0, R, back_step, 0)
 
 
 def _tile_rows(r: int) -> int:
-    """Batch-tile size targeting ~1 MiB of L-scratch in VMEM."""
-    budget = (1 << 20) // max(r * r * 4, 1)
+    """Batch-tile size targeting ~1 MiB of L-scratch in VMEM.
+
+    Sized on the PADDED footprint: Mosaic tiles f32 VMEM values to
+    (8, 128), so a [TB, R, R] block actually occupies
+    TB * roundup(R, 8) * roundup(R, 128) * 4 bytes — for small ranks the
+    lane padding dominates (R=10 pads 16x) and sizing on r*r overflows
+    the 16 MiB scoped-vmem limit (observed on v5e).
+    """
+    padded = max(-(-r // 8) * 8, 8) * max(-(-r // 128) * 128, 128) * 4
+    budget = (1 << 20) // padded
     return int(max(8, min(512, 1 << max(0, int(np.log2(max(budget, 1)))))))
 
 

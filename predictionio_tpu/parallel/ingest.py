@@ -56,14 +56,21 @@ def entity_shard(entity_id: str, n_shards: int) -> int:
 
 
 def shard_mask(entity_ids: np.ndarray, n_shards: int, shard_id: int) -> np.ndarray:
-    """Boolean mask of the rows whose entity hashes into ``shard_id``."""
+    """Boolean mask of the rows whose entity hashes into ``shard_id``.
+
+    Hashes each UNIQUE id once and scatters by inverse index: event tables
+    have many rows per entity, so hashing per-row would repeat the
+    interpreter-level md5 N/U times for nothing.
+    """
     if n_shards <= 1:
         return np.ones(len(entity_ids), dtype=bool)
-    return np.fromiter(
-        (entity_shard(e, n_shards) == shard_id for e in entity_ids),
-        dtype=bool,
-        count=len(entity_ids),
+    uniq, inv = np.unique(np.asarray(entity_ids), return_inverse=True)
+    owners = np.fromiter(
+        (entity_shard(str(e), n_shards) for e in uniq),
+        dtype=np.int64,
+        count=len(uniq),
     )
+    return owners[inv] == shard_id
 
 
 def find_columnar_sharded(
@@ -90,6 +97,29 @@ def find_columnar_sharded(
 # Global id dictionary via shared-directory exchange
 # --------------------------------------------------------------------------
 
+_STALE_AGE_S = 3600.0
+
+
+def _sweep_stale(exchange_dir: Path, age_s: float = _STALE_AGE_S) -> None:
+    """Best-effort removal of exchange files no live run can still want.
+
+    Files from a crashed run (SIGKILL between publish and cleanup) or from
+    explicit-topology callers (who have no barrier to clean up behind) are
+    nonce- or caller-tagged and will never be matched again; anything older
+    than ``age_s`` is dead weight in the shared storage tree.  Live runs
+    are unaffected: their files are seconds old.
+    """
+    cutoff = time.time() - age_s
+    try:
+        for f in exchange_dir.glob("*.npz"):
+            try:
+                if f.stat().st_mtime < cutoff:
+                    f.unlink(missing_ok=True)
+            except OSError:
+                continue
+    except OSError:
+        pass
+
 
 def ids_exchange(
     local_ids: Sequence[str],
@@ -106,18 +136,40 @@ def ids_exchange(
     PredictionIO deployment shares its storage tree, as the reference shared
     HDFS/HBase); files are written atomically and polled with a timeout, so
     no collective is needed for the string payload.
+
+    When the process topology comes from ``jax.distributed`` (the default —
+    ``process_id``/``process_count`` not given), the call is self-protecting
+    against stale files: a run nonce broadcast from process 0 is folded into
+    the file names, and every process deletes its own file after a global
+    sync, so shard files left behind by a crashed earlier run with the same
+    ``tag`` can never be merged into this run's union.  Callers that pass an
+    explicit ``process_id``/``process_count`` (no jax.distributed to ride)
+    must guarantee tag uniqueness per run themselves.
     """
     import jax
 
     from ..storage.bimap import StringIndex
 
+    managed = process_id is None and process_count is None
     pid = jax.process_index() if process_id is None else process_id
     n = jax.process_count() if process_count is None else process_count
     if n <= 1:
         return StringIndex.from_values(local_ids)
+    if managed:
+        import secrets
+
+        from jax.experimental import multihost_utils
+
+        nonce = int(
+            multihost_utils.broadcast_one_to_all(
+                np.int64(secrets.randbits(62))
+            )
+        )
+        tag = f"{tag}-{nonce:016x}"
 
     exchange_dir = Path(exchange_dir)
     exchange_dir.mkdir(parents=True, exist_ok=True)
+    _sweep_stale(exchange_dir)
     mine = exchange_dir / f"{tag}-{pid}.npz"
     # keep the .npz suffix on the temp name: np.savez appends it otherwise
     tmp = exchange_dir / f"{tag}-{pid}.tmp.npz"
@@ -128,17 +180,29 @@ def ids_exchange(
 
     union: set[str] = set()
     deadline = time.time() + timeout
-    for other in range(n):
-        path = exchange_dir / f"{tag}-{other}.npz"
-        while not path.exists():
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"ids_exchange: shard file {path} not published "
-                    f"within {timeout}s"
-                )
-            time.sleep(0.05)
-        data = np.load(path, allow_pickle=False)
-        union.update(data["ids"].tolist())
+    try:
+        for other in range(n):
+            path = exchange_dir / f"{tag}-{other}.npz"
+            while not path.exists():
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"ids_exchange: shard file {path} not published "
+                        f"within {timeout}s"
+                    )
+                time.sleep(0.05)
+            data = np.load(path, allow_pickle=False)
+            union.update(data["ids"].tolist())
+    except BaseException:
+        # failed exchange: withdraw this process's file so it can't be
+        # merged into (or leak from) a later run
+        mine.unlink(missing_ok=True)
+        raise
+    if managed:
+        # everyone has read every shard file; drop this process's own
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ids-exchange-{tag}")
+        mine.unlink(missing_ok=True)
     return StringIndex.from_values(union)
 
 
@@ -209,20 +273,8 @@ def read_ratings_distributed(
         es, n_shards=n, shard_id=pid,
         float_property=rating_property, **scan_kwargs,
     )
-    if n > 1:
-        # run nonce from process 0, agreed via collective broadcast: makes
-        # the exchange files unique per run so a stale file from an earlier
-        # train with the same tag can never be mistaken for this run's
-        from jax.experimental import multihost_utils
-
-        import secrets
-
-        nonce = int(
-            multihost_utils.broadcast_one_to_all(
-                np.int64(secrets.randbits(62))
-            )
-        )
-        tag = f"{tag}-{nonce:016x}"
+    # ids_exchange self-protects against stale files (per-run nonce +
+    # post-sync cleanup) on the jax-managed path used here
     users = ids_exchange(
         frame.entity_id.tolist(), exchange_dir, f"{tag}-users"
     )
@@ -235,14 +287,4 @@ def read_ratings_distributed(
         item_index=items,
         dedup=dedup,
     )
-    gathered = gather_ratings(local)
-    if n > 1:
-        # everyone has read every shard file by now; drop this process's own
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"ingest-{tag}")
-        for suffix in ("users", "items"):
-            (Path(exchange_dir) / f"{tag}-{suffix}-{pid}.npz").unlink(
-                missing_ok=True
-            )
-    return gathered
+    return gather_ratings(local)

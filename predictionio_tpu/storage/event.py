@@ -229,6 +229,13 @@ class Event:
     event_id: Optional[str] = None
     creation_time: _dt.datetime = field(default_factory=now_utc)
 
+    def __post_init__(self) -> None:
+        # ergonomics: accept a plain dict for properties (the reference's
+        # typed DataMap has no such ambiguity; in Python a raw dict is the
+        # natural thing to pass and must not crash later in validation)
+        if not isinstance(self.properties, DataMap):
+            object.__setattr__(self, "properties", DataMap(self.properties))
+
     def with_id(self, event_id: str) -> "Event":
         return replace(self, event_id=event_id)
 

@@ -21,6 +21,7 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Optional
 
+import jax
 import numpy as np
 
 from ..controller import (
@@ -218,7 +219,7 @@ class ECommAlgorithm(Algorithm):
             np.asarray(model.user_factors[uix], np.float32),
             model.device_item_factors(), k, bias=mask,
         )
-        vals, ixs = np.asarray(vals), np.asarray(ixs)
+        vals, ixs = jax.device_get((vals, ixs))  # one host sync per query
         ok = np.isfinite(vals)
         ids = model.items.decode(ixs[ok])
         return PredictedResult(

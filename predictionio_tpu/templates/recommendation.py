@@ -19,6 +19,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
+import jax
 import numpy as np
 
 from ..controller import (
@@ -127,6 +128,15 @@ class RecommendationDataSource(DataSource):
 
     params_class = DataSourceParams
 
+    def _read_items(self, es: EventStore, app_id: int) -> dict[str, dict]:
+        p: DataSourceParams = self.params
+        return {
+            k: dict(v.fields)
+            for k, v in es.aggregate_properties_of(
+                app_id=app_id, entity_type=p.item_entity_type
+            ).items()
+        }
+
     def _read_frame(self, ctx: WorkflowContext):
         p: DataSourceParams = self.params
         app_id = _resolve_app_id(ctx, p)
@@ -137,18 +147,10 @@ class RecommendationDataSource(DataSource):
             event_names=list(p.event_names),
             float_property=p.rating_property,
         )
-        items = {
-            k: dict(v.fields)
-            for k, v in es.aggregate_properties_of(
-                app_id=app_id, entity_type=p.item_entity_type
-            ).items()
-        }
-        return frame, items
+        return frame, self._read_items(es, app_id)
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p: DataSourceParams = self.params
-        import jax
-
         if jax.process_count() > 1:
             # multi-host run: each process scans only its entity-hash shard
             # (the region-parallel HBase analogue, `HBPEvents.scala:99-105`),
@@ -167,13 +169,9 @@ class RecommendationDataSource(DataSource):
                 entity_type=p.entity_type,
                 event_names=list(p.event_names),
             )
-            items = {
-                k: dict(v.fields)
-                for k, v in es.aggregate_properties_of(
-                    app_id=app_id, entity_type=p.item_entity_type
-                ).items()
-            }
-            return TrainingData(ratings=ratings, items=items)
+            return TrainingData(
+                ratings=ratings, items=self._read_items(es, app_id)
+            )
         frame, items = self._read_frame(ctx)
         ratings = frame.to_ratings(
             rating_property=p.rating_property,
@@ -357,8 +355,10 @@ class ALSAlgorithm(Algorithm):
             vals, ixs = topk_scores(
                 np.asarray(model.user_factors[uix]), table, k, bias=mask,
             )
-        vals = np.asarray(vals)
-        ixs = np.asarray(ixs)
+        # ONE device->host sync for both results: on a tunneled accelerator
+        # each distinct readback costs a full RTT (measured ~70 ms through
+        # the axon tunnel), so two np.asarray calls double query latency.
+        vals, ixs = jax.device_get((vals, ixs))
         ok = np.isfinite(vals)
         item_ids = model.items.decode(ixs[ok])
         return PredictedResult(
@@ -391,8 +391,7 @@ class ALSAlgorithm(Algorithm):
             uvecs, model.device_item_factors(self._serve_dtype()), k,
             mask=mask,
         )
-        vals = np.asarray(vals)
-        ixs = np.asarray(ixs)
+        vals, ixs = jax.device_get((vals, ixs))  # one host sync, see predict
         for row, (bi, _) in enumerate(idx):
             n = queries[bi].num
             ok = np.isfinite(vals[row, :n])

@@ -16,6 +16,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
+import jax
 import numpy as np
 
 from ..controller import (
@@ -207,7 +208,7 @@ class SimilarProductAlgorithm(Algorithm):
         qn = qvec / (np.linalg.norm(qvec) + 1e-9)
         tn = model.device_item_factors_normalized()
         vals, ixs = topk_scores(np.asarray(qn, np.float32), tn, k, bias=mask)
-        vals, ixs = np.asarray(vals), np.asarray(ixs)
+        vals, ixs = jax.device_get((vals, ixs))  # one host sync per query
         ok = np.isfinite(vals)
         ids = model.items.decode(ixs[ok])
         return PredictedResult(

@@ -42,12 +42,33 @@ class NotPersisted:
     (reference `controller/Engine.scala:186-208`)."""
 
 
+def _fetch_global(v: Any) -> np.ndarray:
+    """Numpy value of a possibly process-sharded array.
+
+    ``np.asarray`` raises on a ``jax.Array`` that spans non-addressable
+    devices (multi-host training with sharded factor tables); those are
+    fully replicated with ``process_allgather`` first.  For such arrays
+    this is a COLLECTIVE — every process must reach it in the same order,
+    which is why save runs the conversions on all processes and gates only
+    the file writes on the chief.
+    """
+    import jax
+
+    if isinstance(v, jax.Array) and not (
+        v.is_fully_addressable or v.is_fully_replicated
+    ):
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(v))
+    return np.asarray(v)
+
+
 def _to_host(tree: Any) -> Any:
     """jax.Array leaves -> numpy (identity for plain host models)."""
     import jax
 
     return jax.tree_util.tree_map(
-        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, tree
+        lambda x: _fetch_global(x) if isinstance(x, jax.Array) else x, tree
     )
 
 
@@ -110,23 +131,34 @@ def _spec_of(v: Any) -> Optional[list]:
     return out
 
 
-def _save_sharded(model: Any, base_dir: Path, key: str) -> Optional[dict]:
+def _save_sharded(
+    model: Any, base_dir: Path, key: str, chief: bool = True
+) -> Optional[dict]:
     """DEVICE_SHARDED format: one .npz of array fields + pickled rest;
     per-field partition specs go in the manifest.  Returns None when the
-    model has no recognizable array fields (caller falls back to pickle)."""
+    model has no recognizable array fields (caller falls back to pickle).
+
+    Device->host conversions run on EVERY process (they are collectives
+    for process-sharded arrays); only the chief writes the files.
+    """
     split = _split_array_fields(model)
     if split is None or not split[0]:
         return None
     arrays, rest = split
-    base_dir.mkdir(parents=True, exist_ok=True)
     npz_name = f"{key}-arrays.npz"
     rest_name = f"{key}-rest.pkl"
-    np.savez_compressed(
-        base_dir / npz_name, **{k: np.asarray(v) for k, v in arrays.items()}
-    )
-    with open(base_dir / rest_name, "wb") as f:
-        pickle.dump({"cls": type(model), "fields": rest}, f,
-                    protocol=pickle.HIGHEST_PROTOCOL)
+    host_arrays = {k: _fetch_global(v) for k, v in arrays.items()}
+    # _to_host: jax scalars / arrays nested inside non-array fields
+    # (dicts, lists, 0-d values) must land as numpy, same as the pickle
+    # blob path — a device-backed value here would fail to pickle or
+    # pin device state
+    host_rest = _to_host(rest)
+    if chief:
+        base_dir.mkdir(parents=True, exist_ok=True)
+        np.savez_compressed(base_dir / npz_name, **host_arrays)
+        with open(base_dir / rest_name, "wb") as f:
+            pickle.dump({"cls": type(model), "fields": host_rest}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
     return {
         "kind": "sharded",
         "npz": npz_name,
@@ -183,7 +215,18 @@ def save_models(
     instance_id: str,
     algo_tuples: list[tuple[str, Algorithm, Any]],
 ) -> None:
-    """Persist every algorithm's model; manifest goes into the models repo."""
+    """Persist every algorithm's model; manifest goes into the models repo.
+
+    Multi-host: every process runs the device->host conversions (collectives
+    for process-sharded arrays) and custom ``save_model`` hooks (which must
+    gate their own file IO on ``jax.process_index() == 0`` if they write);
+    only process 0 writes files and metadata rows, and a global barrier at
+    the end keeps non-chief processes from racing ahead to deploy before
+    the files exist.
+    """
+    import jax
+
+    chief = jax.process_index() == 0
     md = ctx.storage.get_metadata()
     base_dir = ctx.storage.model_data_dir() / instance_id
     for ax, (name, algo, model) in enumerate(algo_tuples):
@@ -200,17 +243,27 @@ def save_models(
                     # placement drives the persistence format: sharded
                     # models round-trip as array files + partition specs
                     # so deploy can re-place them on a different mesh
-                    manifest = _save_sharded(model, base_dir, key)
+                    manifest = _save_sharded(model, base_dir, key,
+                                             chief=chief)
                 if manifest is None:
-                    base_dir.mkdir(parents=True, exist_ok=True)
+                    payload = _to_host(model)  # collective: all processes
                     fname = f"model_{ax}_{name or 'default'}.pkl"
-                    with open(base_dir / fname, "wb") as f:
-                        pickle.dump(_to_host(model), f,
-                                    protocol=pickle.HIGHEST_PROTOCOL)
+                    if chief:
+                        base_dir.mkdir(parents=True, exist_ok=True)
+                        with open(base_dir / fname, "wb") as f:
+                            pickle.dump(payload, f,
+                                        protocol=pickle.HIGHEST_PROTOCOL)
                     # store the name relative to base_dir so the storage
                     # tree can be relocated between train and deploy hosts
                     manifest = {"kind": "pickle", "file": fname}
-        md.model_insert(Model(id=key, models=json.dumps(manifest).encode()))
+        if chief:
+            md.model_insert(
+                Model(id=key, models=json.dumps(manifest).encode())
+            )
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"save-models-{instance_id}")
 
 
 def load_models(

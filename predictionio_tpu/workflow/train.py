@@ -33,6 +33,22 @@ def new_instance_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def _shared_instance_id() -> str:
+    """One instance id for the whole (possibly multi-process) run: chief
+    draws it, everyone else receives it via collective broadcast."""
+    import jax
+
+    iid = new_instance_id()
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        buf = np.frombuffer(iid.encode("ascii"), dtype=np.uint8)
+        buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        iid = buf.tobytes().decode("ascii")
+    return iid
+
+
 def _params_json(engine_params: EngineParams) -> dict[str, str]:
     return {
         "data_source_params": json.dumps(
@@ -60,12 +76,22 @@ def run_train(
     engine_variant: str = "engine.json",
     engine_factory: str = "",
 ) -> str:
-    """Run training end-to-end; returns the engine instance id."""
+    """Run training end-to-end; returns the engine instance id.
+
+    Multi-host: all processes run the same training program (SPMD — the
+    collectives inside require it); one instance id is broadcast from the
+    chief, and only the chief writes the instance/model metadata rows (the
+    reference's single Spark driver owns those writes; here every process
+    is a "driver", so writes are explicitly gated).
+    """
+    import jax
+
     ctx = ctx or WorkflowContext(mode="Training")
     wp = workflow_params or WorkflowParams()
     md = ctx.storage.get_metadata()
+    chief = jax.process_index() == 0
 
-    instance_id = new_instance_id()
+    instance_id = _shared_instance_id()
     ei = EngineInstance(
         id=instance_id,
         status="INIT",
@@ -79,11 +105,13 @@ def run_train(
         mesh_conf={"n_devices": ctx.n_devices},
         **_params_json(engine_params),
     )
-    md.engine_instance_insert(ei)
+    if chief:
+        md.engine_instance_insert(ei)
 
     try:
         ei.status = "TRAINING"
-        md.engine_instance_update(ei)
+        if chief:
+            md.engine_instance_update(ei)
         # keep the trained instances: persistence hooks may rely on state
         # the algorithm built during train
         algos, models = engine.train_components(ctx, engine_params, wp)
@@ -94,18 +122,29 @@ def run_train(
             )
         ei.status = "COMPLETED"
         ei.end_time = format_time(now_utc())
-        md.engine_instance_update(ei)
+        if chief:
+            md.engine_instance_update(ei)
+        if jax.process_count() > 1:
+            # non-chief processes must not observe (or act on) the
+            # instance before the chief's COMPLETED row is durable
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"train-complete-{instance_id}"
+            )
         logger.info("training finished: instance %s", instance_id)
         return instance_id
     except TrainingInterrupted:
         ei.status = "INTERRUPTED"
         ei.end_time = format_time(now_utc())
-        md.engine_instance_update(ei)
+        if chief:
+            md.engine_instance_update(ei)
         raise
     except Exception:
         ei.status = "FAILED"
         ei.end_time = format_time(now_utc())
-        md.engine_instance_update(ei)
+        if chief:
+            md.engine_instance_update(ei)
         raise
 
 

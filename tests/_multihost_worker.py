@@ -21,17 +21,22 @@ import numpy as np
 def main() -> None:
     pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
     coordinator, db, exch, out = sys.argv[3:7]
+    home = sys.argv[7] if len(sys.argv) > 7 else ""
 
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    from predictionio_tpu.parallel.mesh import force_platform
+
+    force_platform("cpu")
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=nprocs,
         process_id=pid,
     )
     assert jax.process_count() == nprocs, jax.process_count()
+
+    if home:
+        return _run_train_end_to_end(pid, home, out)
 
     from predictionio_tpu.models.als import ALSConfig, train_als
     from predictionio_tpu.parallel.ingest import (
@@ -68,6 +73,58 @@ def main() -> None:
         item_ids=ratings.items.ids.astype(str),
         user_factors=factors.user_factors,
         item_factors=factors.item_factors,
+    )
+    print("WORKER_OK", pid, flush=True)
+
+
+def _run_train_end_to_end(pid: int, home: str, out: str) -> None:
+    """Full multi-host workflow over shared storage: run_train (sharded
+    ingest + SPMD train + chief-only metadata/model writes) then deploy +
+    predict on BOTH processes from the persisted instance."""
+    os.environ["PIO_TPU_HOME"] = home
+    import jax
+
+    from predictionio_tpu.storage.registry import get_storage
+    from predictionio_tpu.templates.recommendation import (
+        Query, recommendation_engine,
+    )
+    from predictionio_tpu.workflow.train import (
+        prepare_deploy_components, run_train,
+    )
+
+    engine = recommendation_engine()
+    params = engine.params_from_variant({
+        "datasource": {"params": {"app_name": "mhapp"}},
+        "algorithms": [{
+            "name": "als",
+            "params": {"rank": 4, "numIterations": 3, "lambda": 0.1},
+        }],
+    })
+    iid = run_train(engine, params)
+
+    md = get_storage().get_metadata()
+    inst = md.engine_instance_get(iid)
+    assert inst is not None and inst.status == "COMPLETED", inst
+    # exactly one instance row + one model row (chief-only writes)
+    n_rows = sum(
+        1 for i in md.engine_instance_get_completed("default", "1",
+                                                    "engine.json")
+        if i.id == iid
+    )
+    assert n_rows == 1, f"duplicate instance rows: {n_rows}"
+
+    algos, models, _ = prepare_deploy_components(engine, params, iid)
+    r = algos[0].predict(models[0], Query(user="u1", num=3))
+    assert len(r.item_scores) == 3, r
+
+    np.savez(
+        out,
+        iid=np.array([iid], dtype=str),
+        user_factors=np.asarray(models[0].user_factors),
+        predict_items=np.array([s.item for s in r.item_scores], dtype=str),
+        predict_scores=np.array(
+            [s.score for s in r.item_scores], dtype=np.float64
+        ),
     )
     print("WORKER_OK", pid, flush=True)
 

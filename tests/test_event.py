@@ -120,3 +120,16 @@ def test_time_parse_formats():
     t = parse_time("2020-01-01T01:00:00+01:00")
     assert t == dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
     assert format_time(t).endswith("Z")
+
+
+def test_event_coerces_plain_dict_properties():
+    """Ergonomics: Event(properties={...raw dict...}) must behave exactly
+    like Event(properties=DataMap({...})) through validation and JSON."""
+    e = Event(
+        event="rate", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i1",
+        properties={"rating": 4.0},
+    )
+    assert isinstance(e.properties, DataMap)
+    validate_event(e)  # used to crash: dict has no .keyset()
+    assert e.to_json()["properties"] == {"rating": 4.0}

@@ -153,3 +153,63 @@ def test_two_process_ingest_and_train(tmp_path):
         np.testing.assert_allclose(
             r["user_factors"], exp_factors.user_factors, rtol=1e-4, atol=1e-4
         )
+
+
+def test_two_process_run_train_end_to_end(tmp_path):
+    """The FULL workflow across 2 processes sharing one storage home:
+    run_train (sharded ingest, SPMD train, chief-only metadata/model
+    writes, collective-safe save) then deploy + predict on both.
+    Regressions covered: duplicate metadata rows, np.asarray on
+    process-spanning arrays at save time, divergent instance ids."""
+    import os
+
+    from predictionio_tpu.storage.registry import Storage
+
+    home = tmp_path / "home"
+    st = Storage({"PIO_TPU_HOME": str(home)})
+    app = st.get_metadata().app_insert("mhapp")
+    es = st.get_event_store()
+    for e in _make_events():
+        es.insert(e, app_id=app.id)
+    st.close()
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [tmp_path / f"train_out{p}.npz" for p in range(2)]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, str(WORKER), str(p), "2", coordinator,
+                "-", "-", str(outs[p]), str(home),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for p in range(2)
+    ]
+    results = []
+    for p, proc in enumerate(procs):
+        try:
+            stdout, stderr = proc.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"worker {p} timed out")
+        assert proc.returncode == 0, (
+            f"worker {p} rc={proc.returncode}\n{stdout}\n{stderr}"
+        )
+        assert f"WORKER_OK {p}" in stdout
+        results.append(np.load(outs[p], allow_pickle=False))
+
+    # same instance, same model, same predictions on both processes
+    assert results[0]["iid"][0] == results[1]["iid"][0]
+    np.testing.assert_allclose(
+        results[0]["user_factors"], results[1]["user_factors"],
+        rtol=1e-5, atol=1e-5,
+    )
+    assert (
+        results[0]["predict_items"].tolist()
+        == results[1]["predict_items"].tolist()
+    )

@@ -276,3 +276,41 @@ def test_instantiate_propagates_constructor_errors():
 
     with pytest.raises(TypeError, match="real bug"):
         instantiate(Buggy, IdParams(id=1))
+
+
+@_dataclass
+class ShardedModelWithScalars:
+    """Sharded model whose non-array fields hide device values: a 0-d jax
+    scalar and a jax array nested in a dict both ride the pickle side and
+    must be host-converted on save (regression: _save_sharded used to
+    pickle them device-backed)."""
+
+    table: object        # [16, 4] array -> npz side
+    mean: object         # 0-d jax scalar -> rest side
+    extras: dict         # dict with a nested jax array -> rest side
+
+
+class ShardedScalarAlgo(Algorithm):
+    placement = ModelPlacement.DEVICE_SHARDED
+
+    def train(self, ctx, pd):
+        import jax.numpy as jnp
+        import numpy as np
+
+        t = jnp.asarray(np.arange(64.0, dtype=np.float32).reshape(16, 4))
+        return ShardedModelWithScalars(
+            table=t, mean=jnp.mean(t), extras={"bias": jnp.ones(3)}
+        )
+
+    def predict(self, model, query):
+        return float(model.mean)
+
+
+def test_sharded_save_hosts_nonarray_device_fields(ctx):
+    import numpy as np
+
+    e = SimpleEngine(DataSource0, ShardedScalarAlgo)
+    iid = run_train(e, EngineParams(), ctx=ctx)
+    m = prepare_deploy(e, EngineParams(), iid, ctx=ctx)[0]
+    assert float(np.asarray(m.mean)) == np.arange(64.0).mean()
+    np.testing.assert_array_equal(np.asarray(m.extras["bias"]), np.ones(3))

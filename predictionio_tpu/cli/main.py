@@ -402,6 +402,7 @@ def cmd_eval(args, storage: Storage) -> int:
         evaluation, params_list, ctx=ctx,
         evaluation_class=args.evaluation,
         engine_params_generator_class=args.engine_params_generator or "",
+        parallelism=args.parallelism,
     )
     _out(result.to_one_liner())
     _out(f"Evaluation completed. Instance id: {eval_id}")
@@ -675,6 +676,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("engine_params_generator", nargs="?",
                    help="dotted path to an EngineParamsGenerator")
     e.add_argument("--batch", default="")
+    e.add_argument("--parallelism", type=int, default=1,
+                   help="candidates scored concurrently (>1 disables "
+                        "FastEval prefix caching)")
 
     ev = sub.add_parser("eventserver", help="run the event server")
     ev.add_argument("--ip", default="0.0.0.0")

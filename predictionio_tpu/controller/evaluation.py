@@ -64,12 +64,14 @@ class Evaluation:
         ctx: WorkflowContext,
         engine_params_list: Sequence[EngineParams],
         workflow_params=None,
+        parallelism: int = 1,
     ) -> "MetricEvaluatorResult":
         evaluator = MetricEvaluator(
             self.metric, self.metrics, output_path=self.output_path
         )
         return evaluator.evaluate(
-            ctx, self.engine, engine_params_list, workflow_params
+            ctx, self.engine, engine_params_list, workflow_params,
+            parallelism=parallelism,
         )
 
 
@@ -169,33 +171,80 @@ class MetricEvaluator:
         self.other_metrics = list(other_metrics)
         self.output_path = output_path
 
+    def _score_one(self, ctx, engine, ep, workflow_params):
+        eval_out = engine.eval(ctx, ep, workflow_params)
+        score = self.metric.calculate(ctx, eval_out)
+        other = [m.calculate(ctx, eval_out) for m in self.other_metrics]
+        return (ep, score, other)
+
     def evaluate(
         self,
         ctx: WorkflowContext,
         engine: Engine,
         engine_params_list: Sequence[EngineParams],
         workflow_params=None,
+        parallelism: int = 1,
     ) -> MetricEvaluatorResult:
+        """Score all candidates; ``parallelism > 1`` runs them from a
+        thread pool (the reference's ``.par`` sweep,
+        `MetricEvaluator.scala:183-192`).  Device work still serializes on
+        the accelerator queue, but host-side reads/prep/metric math of one
+        candidate overlap another's device time, and jitted executables
+        are shared across threads (same shapes -> same cache entry).
+        Results keep candidate order either way; storage backends and
+        dispatch are thread-safe.  Sweeps through a ``FastEvalEngine`` are
+        better run sequentially: its prefix cache dedupes shared pipeline
+        stages only when candidates arrive in order."""
         if not engine_params_list:
             raise ValueError("engine_params_list must not be empty")
-        results: list[tuple[EngineParams, Any, list[Any]]] = []
-        best_ix, best_score = -1, None
-        for ix, ep in enumerate(engine_params_list):
-            eval_out = engine.eval(ctx, ep, workflow_params)
-            score = self.metric.calculate(ctx, eval_out)
-            other = [m.calculate(ctx, eval_out) for m in self.other_metrics]
-            results.append((ep, score, other))
-            logger.info(
-                "MetricEvaluator: candidate %d/%d -> %s = %s",
-                ix + 1, len(engine_params_list), self.metric.header, score,
-            )
-            # NaN-safe argmax: a NaN score never beats a finite one, and a
-            # finite score always replaces a NaN incumbent (Metric.compare
-            # returns -1 for any NaN comparison, which would otherwise let
-            # a NaN first candidate win the whole sweep)
-            def _is_nan(x) -> bool:
-                return isinstance(x, float) and x != x
+        if parallelism > 1:
+            from concurrent.futures import ThreadPoolExecutor
 
+            from .fast_eval import FastEvalEngine
+
+            if isinstance(engine, FastEvalEngine):
+                raise ValueError(
+                    "parallelism > 1 cannot run through a FastEvalEngine "
+                    "(its prefix caches are not thread-safe); pass the "
+                    "plain Engine, or use run_evaluation which unwraps it"
+                )
+
+            with ThreadPoolExecutor(max_workers=parallelism) as ex:
+                results = list(
+                    ex.map(
+                        lambda ep: self._score_one(
+                            ctx, engine, ep, workflow_params
+                        ),
+                        engine_params_list,
+                    )
+                )
+            for ix, (_, score, _o) in enumerate(results):
+                logger.info(
+                    "MetricEvaluator: candidate %d/%d -> %s = %s",
+                    ix + 1, len(engine_params_list), self.metric.header,
+                    score,
+                )
+        else:
+            results = []
+            for ix, ep in enumerate(engine_params_list):
+                results.append(
+                    self._score_one(ctx, engine, ep, workflow_params)
+                )
+                logger.info(
+                    "MetricEvaluator: candidate %d/%d -> %s = %s",
+                    ix + 1, len(engine_params_list), self.metric.header,
+                    results[-1][1],
+                )
+
+        # NaN-safe argmax: a NaN score never beats a finite one, and a
+        # finite score always replaces a NaN incumbent (Metric.compare
+        # returns -1 for any NaN comparison, which would otherwise let
+        # a NaN first candidate win the whole sweep)
+        def _is_nan(x) -> bool:
+            return isinstance(x, float) and x != x
+
+        best_ix, best_score = -1, None
+        for ix, (_, score, _other) in enumerate(results):
             if (
                 best_ix < 0
                 or (_is_nan(best_score) and not _is_nan(score))

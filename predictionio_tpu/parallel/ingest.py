@@ -169,7 +169,8 @@ def ids_exchange(
 
     exchange_dir = Path(exchange_dir)
     exchange_dir.mkdir(parents=True, exist_ok=True)
-    _sweep_stale(exchange_dir)
+    # never sweep inside the window a live straggler could still publish in
+    _sweep_stale(exchange_dir, age_s=max(_STALE_AGE_S, 2.0 * timeout))
     mine = exchange_dir / f"{tag}-{pid}.npz"
     # keep the .npz suffix on the temp name: np.savez appends it otherwise
     tmp = exchange_dir / f"{tag}-{pid}.tmp.npz"

@@ -247,6 +247,79 @@ class EngineServer(HTTPServerBase):
             "startTime": self.start_time,
         }
 
+    def status_html(self) -> str:
+        """Browser view of the deployed engine (reference's Twirl status
+        page, `core/src/main/twirl/io/prediction/workflow/index.scala.html`):
+        engine + server info and per-component params.  Same data as
+        :meth:`status_json`; content-negotiated on ``/``."""
+        import html as _html
+
+        from ..controller.params import params_to_json
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        def row(k, v) -> str:
+            return f"<tr><th>{esc(k)}</th><td>{esc(v)}</td></tr>"
+
+        def table(rows) -> str:
+            return "<table border='1' cellpadding='4'>" + "".join(rows) + "</table>"
+
+        rec = self.ctx.storage.get_metadata().engine_instance_get(
+            self.instance_id
+        )
+        engine_rows = [
+            row("Instance ID", self.instance_id),
+            row("Engine ID", self.engine_id),
+            row("Engine Version", self.engine_version),
+            row("Variant", self.engine_variant),
+        ]
+        if rec is not None:
+            engine_rows += [
+                row("Training Start Time", rec.start_time),
+                row("Training End Time", rec.end_time),
+            ]
+        started = time.strftime(
+            "%Y-%m-%d %H:%M:%S UTC", time.gmtime(self.start_time)
+        )
+        server_rows = [
+            row("Start Time", started),
+            row("Request Count", self.request_count),
+            row("Average Serving Time", f"{self.avg_serving_sec:.4f} s"),
+            row("Last Serving Time", f"{self.last_serving_sec:.4f} s"),
+        ]
+        ep = self.engine_params
+        comp_rows = [
+            row(f"Data Source [{ep.data_source[0] or 'default'}]",
+                json.dumps(params_to_json(ep.data_source[1]))),
+            row(f"Preparator [{ep.preparator[0] or 'default'}]",
+                json.dumps(params_to_json(ep.preparator[1]))),
+        ]
+        for name, p in ep.algorithms:
+            comp_rows.append(
+                row(f"Algorithm [{name or 'default'}]",
+                    json.dumps(params_to_json(p)))
+            )
+        comp_rows.append(
+            row(f"Serving [{ep.serving[0] or 'default'}]",
+                json.dumps(params_to_json(ep.serving[1])))
+        )
+        title = (
+            f"Engine Server at {self.config.host}:{self.config.port}"
+        )
+        return (
+            "<!DOCTYPE html><html><head>"
+            f"<title>{esc(title)}</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace}</style></head><body>"
+            f"<h1>{esc(title)}</h1>"
+            "<h2>Engine Information</h2>" + table(engine_rows) +
+            "<h2>Server Information</h2>" + table(server_rows) +
+            "<h2>Components</h2>" + table(comp_rows) +
+            "<p>POST queries to <code>/queries.json</code>.</p>"
+            "</body></html>"
+        )
+
     # -- http --------------------------------------------------------------
     @property
     def host(self) -> str:
@@ -266,7 +339,15 @@ class EngineServer(HTTPServerBase):
 
             def do_GET(self):
                 if self.path == "/" or self.path.startswith("/?"):
-                    self._reply(200, server.status_json())
+                    # browsers get the HTML status page, everyone else the
+                    # JSON document (reference served Twirl HTML here)
+                    if "text/html" in self.headers.get("Accept", ""):
+                        self._reply(
+                            200, server.status_html().encode(),
+                            ctype="text/html; charset=utf-8",
+                        )
+                    else:
+                        self._reply(200, server.status_json())
                 elif self.path.startswith("/reload"):
                     try:
                         iid = server.reload()

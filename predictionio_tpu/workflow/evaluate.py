@@ -13,7 +13,7 @@ import logging
 from typing import Optional, Sequence
 
 from ..controller.base import WorkflowContext
-from ..controller.engine import EngineParams
+from ..controller.engine import Engine, EngineParams
 from ..controller.evaluation import Evaluation, MetricEvaluatorResult
 from ..controller.fast_eval import FastEvalEngine
 from ..storage.event import format_time, now_utc
@@ -34,8 +34,16 @@ def run_evaluation(
     evaluation_class: str = "",
     engine_params_generator_class: str = "",
     fast_eval: bool = True,
+    parallelism: int = 1,
 ) -> tuple[str, MetricEvaluatorResult]:
-    """Run the sweep; returns (evaluation instance id, result)."""
+    """Run the sweep; returns (evaluation instance id, result).
+
+    ``parallelism > 1`` scores candidates from a thread pool and implies
+    ``fast_eval=False`` (FastEval's prefix cache dedupes shared pipeline
+    stages only for in-order candidates — running both would re-compute
+    the prefixes it exists to save)."""
+    if parallelism > 1:
+        fast_eval = False
     ctx = ctx or WorkflowContext(mode="Evaluation")
     wp = workflow_params or WorkflowParams()
     md = ctx.storage.get_metadata()
@@ -68,13 +76,29 @@ def run_evaluation(
         rec.status = "EVALUATING"
         md.evaluation_instance_update(rec)
         engine = evaluation.engine
-        if fast_eval and not isinstance(engine, FastEvalEngine):
+        if parallelism > 1 and isinstance(engine, FastEvalEngine):
+            # FastEval's check-then-insert prefix caches are not
+            # thread-safe; a pre-wrapped engine must be unwrapped, not
+            # just the auto-wrap skipped
+            engine = Engine(
+                engine.data_source_class_map,
+                engine.preparator_class_map,
+                engine.algorithm_class_map,
+                engine.serving_class_map,
+            )
+            evaluation = Evaluation(
+                engine, evaluation.metric, evaluation.metrics,
+                evaluation.output_path,
+            )
+        elif fast_eval and not isinstance(engine, FastEvalEngine):
             engine = FastEvalEngine(engine)
             evaluation = Evaluation(
                 engine, evaluation.metric, evaluation.metrics,
                 evaluation.output_path,
             )
-        result = evaluation.run(ctx, engine_params_list, wp)
+        result = evaluation.run(
+            ctx, engine_params_list, wp, parallelism=parallelism
+        )
         rec.status = "EVALCOMPLETED"
         rec.end_time = format_time(now_utc())
         rec.evaluator_results = result.to_one_liner()

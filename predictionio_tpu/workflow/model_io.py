@@ -229,6 +229,25 @@ def save_models(
     chief = jax.process_index() == 0
     md = ctx.storage.get_metadata()
     base_dir = ctx.storage.model_data_dir() / instance_id
+    try:
+        _save_models_inner(
+            ctx, md, base_dir, instance_id, algo_tuples, chief
+        )
+    finally:
+        if jax.process_count() > 1:
+            # the barrier must run on the failure path too: a chief-only
+            # write error would otherwise leave every non-chief process
+            # (which saw no error) waiting here forever
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"save-models-{instance_id}"
+            )
+
+
+def _save_models_inner(
+    ctx, md, base_dir: Path, instance_id: str, algo_tuples, chief: bool
+) -> None:
     for ax, (name, algo, model) in enumerate(algo_tuples):
         key = model_key(instance_id, ax, name)
         if not algo.persist_model:
@@ -260,10 +279,6 @@ def save_models(
             md.model_insert(
                 Model(id=key, models=json.dumps(manifest).encode())
             )
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        multihost_utils.sync_global_devices(f"save-models-{instance_id}")
 
 
 def load_models(

@@ -108,6 +108,7 @@ def run_train(
     if chief:
         md.engine_instance_insert(ei)
 
+    completed = False
     try:
         ei.status = "TRAINING"
         if chief:
@@ -124,14 +125,7 @@ def run_train(
         ei.end_time = format_time(now_utc())
         if chief:
             md.engine_instance_update(ei)
-        if jax.process_count() > 1:
-            # non-chief processes must not observe (or act on) the
-            # instance before the chief's COMPLETED row is durable
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(
-                f"train-complete-{instance_id}"
-            )
+        completed = True
         logger.info("training finished: instance %s", instance_id)
         return instance_id
     except TrainingInterrupted:
@@ -146,6 +140,27 @@ def run_train(
         if chief:
             md.engine_instance_update(ei)
         raise
+    finally:
+        if jax.process_count() > 1:
+            # outcome agreement, reached on success AND failure paths (a
+            # plain success-path barrier would deadlock non-chiefs when a
+            # chief-only write raised): the chief's verdict is broadcast;
+            # non-chiefs that saw no local error but learn the chief
+            # failed raise instead of acting on a FAILED instance.  Also
+            # orders the chief's COMPLETED row before any process returns.
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            agreed = int(
+                multihost_utils.broadcast_one_to_all(
+                    np.int32(1 if completed else 0)
+                )
+            )
+            if completed and not agreed:
+                raise RuntimeError(
+                    f"training failed on the chief process "
+                    f"(instance {instance_id})"
+                )
 
 
 def prepare_deploy(

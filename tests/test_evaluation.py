@@ -290,3 +290,14 @@ def test_evaluation_carries_own_candidates(ctx):
     )
     _, result = run_evaluation(evaluation, None, ctx=ctx)
     assert result.best_score == 6.0
+
+
+def test_parallel_sweep_matches_sequential(ctx):
+    """parallelism>1 returns the same scores, ordering, and winner as the
+    sequential sweep (the reference's .par parity)."""
+    eps = [_params(i) for i in (3, 9, 5, 2, 7, 1)]
+    ev = MetricEvaluator(AlgoIdMetric(), output_path=None)
+    seq = ev.evaluate(ctx, _engine(), eps)
+    par = ev.evaluate(ctx, _engine(), eps, parallelism=4)
+    assert par.best_index == seq.best_index == 1
+    assert [s for _, s, _ in par.results] == [s for _, s, _ in seq.results]

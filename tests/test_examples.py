@@ -164,3 +164,100 @@ def test_movielens_eval(in_example, tmp_path, monkeypatch):
     # the stronger candidate (rank 6, 8 iters) must win
     assert result.best_engine_params.algorithms[0][1].rank == 6
     assert result.best_score == min(scores)
+
+
+def test_entitymap(in_example):
+    m = in_example("entitymap")
+    engine, ep, models = _train_and_params(m)
+    model = models[0]
+    # required-attribute filter: u6 (no attr2) and i5 (no attrA) dropped
+    assert "u6" not in model.users and len(model.users) == 6
+    assert "i5" not in model.items and len(model.items) == 5
+    # typed payloads survive extraction
+    assert model.users["u2"] == m.User(attr0=3.5, attr1=2, attr2=12)
+    assert model.items["i1"].attrA == "green"
+    assert isinstance(model.items["i0"].attrC, bool)
+    algo = engine._algorithms(ep)[0]
+    r = algo.predict(model, m.Query(user="u0", num=3))
+    assert len(r) == 3
+    assert all(isinstance(s.payload, m.Item) for s in r)
+    scores = [s.score for s in r]
+    assert scores == sorted(scores, reverse=True)
+    # unseen user -> empty, like the reference
+    assert algo.predict(model, m.Query(user="nobody")) == []
+
+
+def test_movielens_filtering(in_example, tmp_path):
+    m = in_example("movielens-filtering")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # serve against a scratch COPY of the blocklist so the test can edit
+    # it without dirtying the checked-in example file
+    import pathlib
+
+    from predictionio_tpu.controller.base import instantiate
+
+    blocked = tmp_path / "blocked.txt"
+    blocked.write_text(pathlib.Path("blocked.txt").read_text())
+    serving = instantiate(
+        m.BlocklistServing, m.FilterParams(filepath=str(blocked))
+    )
+
+    def recommend(user, num=4):
+        return serving.serve(
+            m.Query(user=user, num=num),
+            [algo.predict(models[0], m.Query(user=user, num=num))],
+        )
+
+    r = recommend("u0")
+    items = [s.item for s in r.item_scores]
+    assert len(items) == 4
+    # blocklisted movies never surface, whatever their score
+    assert "m0" not in items and "m7" not in items
+    # the blocklist is read per request: editing it changes the result
+    # without retraining (reference Filtering.scala re-reads the file)
+    blocked.write_text("")
+    r2 = recommend("u0", num=10)
+    assert "m0" in [s.item for s in r2.item_scores]
+
+
+def test_similarproduct_local(in_example):
+    m = in_example("similarproduct-local")
+    from predictionio_tpu.controller import ModelPlacement
+
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # the point of the variant: host placement routes persistence through
+    # the plain pickle path and predict never dispatches to a device
+    assert algo.placement is ModelPlacement.HOST
+    model = models[0]
+    import numpy as np
+
+    assert isinstance(model.item_factors, np.ndarray)
+    r = algo.predict(model, m.Query(items=("phone",), num=3))
+    assert len(r) == 3
+    got = [s.item for s in r]
+    assert "phone" not in got  # query items never recommended back
+    # co-viewed electronics outrank garden items for an electronics query
+    assert set(got[:2]) <= {"laptop", "tablet", "camera"}, got
+    # unseen query items -> empty
+    assert algo.predict(model, m.Query(items=("nothere",))) == []
+
+
+def test_recommendation_cat(in_example):
+    m = in_example("recommendation-cat")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    # unfiltered: any item may appear
+    r = algo.predict(models[0], m.Query(user="u0", num=5))
+    assert len(r.item_scores) == 5
+    # category-filtered: every result is a drama
+    dramas = {"m2", "m3", "m6", "m7"}
+    r = algo.predict(models[0], m.Query(user="u0", num=3,
+                                        categories=("drama",)))
+    assert r.item_scores and {s.item for s in r.item_scores} <= dramas
+    # categories compose with blacklist
+    r = algo.predict(models[0], m.Query(user="u0", num=3,
+                                        categories=("drama",),
+                                        blacklist=("m2",)))
+    assert {s.item for s in r.item_scores} <= dramas - {"m2"}

@@ -294,3 +294,25 @@ def test_generic_dataclass_query_decode_and_result_encode():
     assert _result_to_json({"k": (Score(item="c", score=2.0),)}) == {
         "k": [{"item": "c", "score": 2.0}]
     }
+
+
+def test_status_page_html_for_browsers(deployed):
+    """`/` content-negotiates: browsers (Accept: text/html) get the HTML
+    status page (the reference's Twirl index page role), API clients keep
+    getting JSON."""
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    req = urllib.request.Request(
+        f"{base}/", headers={"Accept": "text/html,application/xhtml+xml"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/html")
+        page = r.read().decode()
+    assert "<html" in page and "Engine Information" in page
+    assert server.instance_id in page
+    # component params are rendered
+    assert "Algorithm [als]" in page and "rank" in page
+    # JSON clients are unaffected
+    status, body = _get(f"{base}/")
+    assert status == 200 and body["status"] == "alive"

@@ -171,10 +171,15 @@ class MetricEvaluator:
         self.other_metrics = list(other_metrics)
         self.output_path = output_path
 
-    def _score_one(self, ctx, engine, ep, workflow_params):
+    def _score_one(self, ctx, engine, ep, workflow_params, ix, total):
         eval_out = engine.eval(ctx, ep, workflow_params)
         score = self.metric.calculate(ctx, eval_out)
         other = [m.calculate(ctx, eval_out) for m in self.other_metrics]
+        # streamed from here so the parallel sweep shows live progress too
+        logger.info(
+            "MetricEvaluator: candidate %d/%d -> %s = %s",
+            ix + 1, total, self.metric.header, score,
+        )
         return (ep, score, other)
 
     def evaluate(
@@ -209,32 +214,25 @@ class MetricEvaluator:
                     "plain Engine, or use run_evaluation which unwraps it"
                 )
 
+            total = len(engine_params_list)
             with ThreadPoolExecutor(max_workers=parallelism) as ex:
                 results = list(
                     ex.map(
-                        lambda ep: self._score_one(
-                            ctx, engine, ep, workflow_params
+                        lambda ix_ep: self._score_one(
+                            ctx, engine, ix_ep[1], workflow_params,
+                            ix_ep[0], total,
                         ),
-                        engine_params_list,
+                        enumerate(engine_params_list),
                     )
                 )
-            for ix, (_, score, _o) in enumerate(results):
-                logger.info(
-                    "MetricEvaluator: candidate %d/%d -> %s = %s",
-                    ix + 1, len(engine_params_list), self.metric.header,
-                    score,
-                )
         else:
-            results = []
-            for ix, ep in enumerate(engine_params_list):
-                results.append(
-                    self._score_one(ctx, engine, ep, workflow_params)
+            results = [
+                self._score_one(
+                    ctx, engine, ep, workflow_params, ix,
+                    len(engine_params_list),
                 )
-                logger.info(
-                    "MetricEvaluator: candidate %d/%d -> %s = %s",
-                    ix + 1, len(engine_params_list), self.metric.header,
-                    results[-1][1],
-                )
+                for ix, ep in enumerate(engine_params_list)
+            ]
 
         # NaN-safe argmax: a NaN score never beats a finite one, and a
         # finite score always replaces a NaN incumbent (Metric.compare

@@ -220,34 +220,21 @@ def save_models(
     Multi-host: every process runs the device->host conversions (collectives
     for process-sharded arrays) and custom ``save_model`` hooks (which must
     gate their own file IO on ``jax.process_index() == 0`` if they write);
-    only process 0 writes files and metadata rows, and a global barrier at
-    the end keeps non-chief processes from racing ahead to deploy before
-    the files exist.
+    only process 0 writes files and metadata rows.  Callers outside
+    ``run_train`` that need "files visible on every host before use" must
+    order that through the shared metadata store the way ``run_train``
+    does (wait for the chief's terminal instance status), not a barrier.
     """
     import jax
 
     chief = jax.process_index() == 0
     md = ctx.storage.get_metadata()
     base_dir = ctx.storage.model_data_dir() / instance_id
-    try:
-        _save_models_inner(
-            ctx, md, base_dir, instance_id, algo_tuples, chief
-        )
-    finally:
-        if jax.process_count() > 1:
-            # the barrier must run on the failure path too: a chief-only
-            # write error would otherwise leave every non-chief process
-            # (which saw no error) waiting here forever
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices(
-                f"save-models-{instance_id}"
-            )
-
-
-def _save_models_inner(
-    ctx, md, base_dir: Path, instance_id: str, algo_tuples, chief: bool
-) -> None:
+    # NO collective barrier here: a barrier could pair out of order with a
+    # collective inside a failing peer and hang.  "Files exist before any
+    # process deploys" is guaranteed through the shared metadata store
+    # instead — run_train's non-chief processes wait for the chief's
+    # terminal status row, which the chief writes only after this returns.
     for ax, (name, algo, model) in enumerate(algo_tuples):
         key = model_key(instance_id, ax, name)
         if not algo.persist_model:

@@ -33,6 +33,36 @@ def new_instance_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def _await_chief_terminal_status(
+    md, instance_id: str, timeout: float = 300.0
+) -> None:
+    """Non-chief wait for the chief's terminal instance status via the
+    shared metadata store (the coordination plane every multi-host
+    deployment already shares — the role HBase/ES played for the
+    reference).  Raises if the chief recorded a failure or never wrote a
+    terminal row (chief died before/inside its chief-only writes)."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while True:
+        rec = md.engine_instance_get(instance_id)
+        status = rec.status if rec is not None else "MISSING"
+        if status == "COMPLETED":
+            return
+        if status in ("FAILED", "INTERRUPTED"):
+            raise RuntimeError(
+                f"training {status.lower()} on the chief process "
+                f"(instance {instance_id})"
+            )
+        if _time.time() > deadline:
+            raise TimeoutError(
+                f"chief process never recorded a terminal status for "
+                f"instance {instance_id} (last seen: {status}) within "
+                f"{timeout}s"
+            )
+        _time.sleep(0.05)
+
+
 def _shared_instance_id() -> str:
     """One instance id for the whole (possibly multi-process) run: chief
     draws it, everyone else receives it via collective broadcast."""
@@ -141,26 +171,18 @@ def run_train(
             md.engine_instance_update(ei)
         raise
     finally:
-        if jax.process_count() > 1:
-            # outcome agreement, reached on success AND failure paths (a
-            # plain success-path barrier would deadlock non-chiefs when a
-            # chief-only write raised): the chief's verdict is broadcast;
-            # non-chiefs that saw no local error but learn the chief
-            # failed raise instead of acting on a FAILED instance.  Also
-            # orders the chief's COMPLETED row before any process returns.
-            import numpy as np
-            from jax.experimental import multihost_utils
-
-            agreed = int(
-                multihost_utils.broadcast_one_to_all(
-                    np.int32(1 if completed else 0)
-                )
-            )
-            if completed and not agreed:
-                raise RuntimeError(
-                    f"training failed on the chief process "
-                    f"(instance {instance_id})"
-                )
+        if jax.process_count() > 1 and not chief and completed:
+            # Outcome agreement rides the SHARED METADATA STORE, not a
+            # collective: a collective here could pair out of order with
+            # one inside a failing peer's training step and hang.  The
+            # chief's terminal status row is the verdict — non-chiefs
+            # that finished their SPMD part wait for it (it also orders
+            # the chief's COMPLETED row and model files before any
+            # process returns or deploys).  Failures INSIDE the SPMD
+            # phase are symmetric (every process raises) and skip this;
+            # a chief that dies without writing any terminal status is
+            # caught by the timeout.
+            _await_chief_terminal_status(md, instance_id)
 
 
 def prepare_deploy(

@@ -1,0 +1,105 @@
+"""Batched-SPD-solver benchmark: XLA (cholesky + triangular_solve) vs the
+Pallas kernel (`ops/solve.py`), on the default accelerator.
+
+VERDICT r1 item 3: the crossover must be MEASURED on the real chip, not
+promised in a docstring.  Run with the TPU reachable:
+
+    python bench_solver.py                 # full grid, prints a table
+    python bench_solver.py --rank 64 --batch 32768   # one cell
+
+Prints one JSON line per (rank, batch) cell:
+  {"metric": "spd_solve_batched_ms", "rank": R, "batch": B,
+   "xla_ms": ..., "pallas_ms": ..., "speedup": ..., "max_err": ...}
+and a final summary line recommending the default solver per rank.
+Results should be recorded in docs/ARCHITECTURE.md ("Measured
+performance") and, if Pallas wins at the north-star rank, the
+`ALSConfig.solver` default flipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rank", type=int, action="append",
+                    help="rank(s) to test (default: 10 64 128)")
+    ap.add_argument("--batch", type=int, action="append",
+                    help="batch size(s) (default: 4096 32768)")
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--platform", help="force a jax platform (e.g. cpu)")
+    args = ap.parse_args()
+
+    if args.platform:
+        from predictionio_tpu.parallel.mesh import force_platform
+
+        force_platform(args.platform)
+
+    import jax
+    import jax.numpy as jnp
+
+    from predictionio_tpu.ops.solve import cholesky_solve_batched
+
+    def xla_solve(A, b):
+        L = jax.lax.linalg.cholesky(A)
+        y = jax.lax.linalg.triangular_solve(
+            L, b[..., None], left_side=True, lower=True
+        )
+        return jax.lax.linalg.triangular_solve(
+            L, y, left_side=True, lower=True, transpose_a=True
+        )[..., 0]
+
+    xla_j = jax.jit(xla_solve)
+    rng = np.random.default_rng(0)
+    ranks = args.rank or [10, 64, 128]
+    batches = args.batch or [4096, 32768]
+    wins: dict[int, list[float]] = {}
+    for R in ranks:
+        for B in batches:
+            M = rng.normal(size=(B, R, R)).astype(np.float32)
+            A = jax.device_put(
+                M @ M.transpose(0, 2, 1)
+                + 10 * np.eye(R, dtype=np.float32)
+            )
+            b = jax.device_put(rng.normal(size=(B, R)).astype(np.float32))
+            x1 = jax.block_until_ready(xla_j(A, b))
+            x2 = jax.block_until_ready(cholesky_solve_batched(A, b))
+            err = float(jnp.max(jnp.abs(x1 - x2)))
+            times = {"xla": [], "pallas": []}
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(xla_j(A, b))
+                times["xla"].append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                jax.block_until_ready(cholesky_solve_batched(A, b))
+                times["pallas"].append(time.perf_counter() - t0)
+            xm = sorted(times["xla"])[args.reps // 2] * 1e3
+            pm = sorted(times["pallas"])[args.reps // 2] * 1e3
+            wins.setdefault(R, []).append(xm / pm)
+            print(json.dumps({
+                "metric": "spd_solve_batched_ms",
+                "platform": jax.default_backend(),
+                "rank": R, "batch": B,
+                "xla_ms": round(xm, 3), "pallas_ms": round(pm, 3),
+                "speedup": round(xm / pm, 3),
+                "max_err": float(f"{err:.3e}"),
+            }), flush=True)
+    rec = {
+        R: ("pallas" if float(np.mean(s)) > 1.0 else "xla")
+        for R, s in wins.items()
+    }
+    print(json.dumps({"metric": "solver_recommendation",
+                      "per_rank": rec}))
+
+
+if __name__ == "__main__":
+    main()

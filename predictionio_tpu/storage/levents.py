@@ -16,6 +16,7 @@ an improvement SURVEY §4 calls for over the reference's live-HBase-only specs.
 from __future__ import annotations
 
 import abc
+import contextlib
 import datetime as _dt
 import itertools
 import threading
@@ -64,13 +65,33 @@ class EventStore(abc.ABC):
 
     # -- writes -----------------------------------------------------------
     @abc.abstractmethod
-    def insert(self, event: Event, app_id: int, channel_id: int = 0) -> str:
-        """Validate + persist; returns the assigned event id."""
+    def insert(self, event: Event, app_id: int, channel_id: int = 0,
+               validate: bool = True) -> str:
+        """Persist (validating first unless ``validate=False`` — for
+        events that already passed validation, e.g. from
+        ``Event.from_json``); returns the assigned event id."""
 
     def insert_batch(
-        self, events: Iterable[Event], app_id: int, channel_id: int = 0
+        self,
+        events: Iterable[Event],
+        app_id: int,
+        channel_id: int = 0,
+        validate: bool = True,
     ) -> list[str]:
-        return [self.insert(e, app_id, channel_id) for e in events]
+        """``validate=False`` skips per-event re-validation for events
+        that already passed it (e.g. built by ``Event.from_json``) — the
+        bulk-import path validated twice otherwise."""
+        return [
+            self.insert(e, app_id, channel_id, validate=validate)
+            for e in events
+        ]
+
+    @contextlib.contextmanager
+    def bulk(self):
+        """Bulk-write scope: transactional backends may defer their
+        commit to the end of the scope (one fsync per import instead of
+        one per batch).  Base implementation is a no-op."""
+        yield self
 
     # -- point reads ------------------------------------------------------
     @abc.abstractmethod
@@ -296,8 +317,10 @@ class MemoryEventStore(EventStore):
         with self._lock:
             return self._tables.pop((app_id, channel_id), None) is not None
 
-    def insert(self, event: Event, app_id: int, channel_id: int = 0) -> str:
-        validate_event(event)
+    def insert(self, event: Event, app_id: int, channel_id: int = 0,
+               validate: bool = True) -> str:
+        if validate:
+            validate_event(event)
         eid = event.event_id or new_event_id()
         with self._lock:
             self._table(app_id, channel_id)[eid] = event.with_id(eid)

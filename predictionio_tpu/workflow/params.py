@@ -14,3 +14,8 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    # multi-host: how long non-chief processes wait for the chief's
+    # terminal instance-status row after finishing their SPMD part — the
+    # chief may still be writing a large model to shared storage.  Size
+    # to the slowest expected model write, not the train itself.
+    chief_wait_timeout_s: float = 1800.0

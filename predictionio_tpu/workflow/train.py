@@ -34,7 +34,7 @@ def new_instance_id() -> str:
 
 
 def _await_chief_terminal_status(
-    md, instance_id: str, timeout: float = 300.0
+    md, instance_id: str, timeout: float = 1800.0
 ) -> None:
     """Non-chief wait for the chief's terminal instance status via the
     shared metadata store (the coordination plane every multi-host
@@ -182,7 +182,9 @@ def run_train(
             # phase are symmetric (every process raises) and skip this;
             # a chief that dies without writing any terminal status is
             # caught by the timeout.
-            _await_chief_terminal_status(md, instance_id)
+            _await_chief_terminal_status(
+                md, instance_id, timeout=wp.chief_wait_timeout_s
+            )
 
 
 def prepare_deploy(

@@ -173,3 +173,29 @@ def test_columnar_contract(store):
     assert frame.value.tolist() == [4.0, 2.0]
     assert frame.entity_id.tolist() == ["u1", "u2"]
     assert frame.target_entity_id.tolist() == ["i1", "i2"]
+
+
+def test_bulk_import_scope_and_unvalidated_batch(tmp_path):
+    """insert_batch(validate=False) + bulk() defer-commit path: ids stay
+    unique, rows land, and events are readable after the scope."""
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    es = SQLiteEventStore(tmp_path / "e.db")
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{i}",
+              target_entity_type="item", target_entity_id=f"i{i % 3}",
+              properties=DataMap({"rating": float(i % 5 + 1)}))
+        for i in range(100)
+    ]
+    with es.bulk():
+        ids1 = es.insert_batch(evs[:50], app_id=1, validate=False)
+        ids2 = es.insert_batch(evs[50:], app_id=1, validate=False)
+    all_ids = ids1 + ids2
+    assert len(set(all_ids)) == 100
+    got = list(es.find(app_id=1, event_names=["rate"]))
+    assert len(got) == 100
+    # memory store accepts the same signature (no-op bulk)
+    mem = MemoryEventStore()
+    with mem.bulk():
+        mem.insert_batch(evs[:5], app_id=1, validate=False)
+    assert len(list(mem.find(app_id=1))) == 5

@@ -261,3 +261,27 @@ def test_recommendation_cat(in_example):
                                         categories=("drama",),
                                         blacklist=("m2",)))
     assert {s.item for s in r.item_scores} <= dramas - {"m2"}
+
+
+def test_similarproduct_multi(in_example):
+    m = in_example("similarproduct-multi")
+    engine, ep, models = _train_and_params(m)
+    algos = engine._algorithms(ep)
+    assert len(algos) == 2 and len(models) == 2
+    serving = engine._serving(ep)
+    q = m.Query(items=("phone",), num=3)
+    preds = [a.predict(mod, q) for a, mod in zip(algos, models)]
+    r = serving.serve(q, preds)
+    assert len(r.item_scores) == 3
+    got = [s.item for s in r.item_scores]
+    assert "phone" not in got
+    # both electronics-cluster signals agree: blend prefers electronics
+    assert got[0] in {"laptop", "tablet", "camera"}, got
+    # z-scores: combined scores are O(1), not raw-cosine-scale
+    assert all(abs(s.score) < 10 for s in r.item_scores)
+    # single-item query path (no standardization) still works
+    r1 = serving.serve(m.Query(items=("phone",), num=1), [
+        a.predict(mod, m.Query(items=("phone",), num=1))
+        for a, mod in zip(algos, models)
+    ])
+    assert len(r1.item_scores) == 1

@@ -168,19 +168,21 @@ class _CosineALS(Algorithm):
         tn = (t / (np.linalg.norm(t, axis=1, keepdims=True) + 1e-9)).astype(
             np.float32
         )
-        # over-fetch so the blend still has num items after dropping the
-        # query items themselves
-        k = min(query.num + len(known), len(model.items))
-        vals, ixs = topk_scores(q, tn, k)
+        # -inf bias masks out the query items at FIXED k, like the
+        # similarproduct template — a k that varied with len(known) would
+        # recompile the jitted top-k per distinct value at serving time
+        k = min(query.num, len(model.items))
+        mask = np.zeros(len(t), np.float32)
+        mask[known] = -np.inf
+        vals, ixs = topk_scores(q, tn, k, bias=mask)
         vals, ixs = jax.device_get((vals, ixs))  # one host sync per query
-        qset = set(known)
         return PredictedResult(
             item_scores=[
                 ItemScore(item=str(model.items.id_of(int(j))),
                           score=float(s))
                 for s, j in zip(vals, ixs)
-                if int(j) not in qset
-            ][: query.num]
+                if np.isfinite(s)
+            ]
         )
 
 

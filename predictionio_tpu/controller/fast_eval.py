@@ -10,6 +10,7 @@ nothing.  Cache keys mirror the reference's ``DataSourcePrefix`` /
 
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Any, Sequence, Tuple
 
@@ -21,11 +22,32 @@ logger = logging.getLogger(__name__)
 __all__ = ["FastEvalEngine"]
 
 
+_OPAQUE = itertools.count()
+
+
 def _key(named_params) -> Any:
-    """Hashable key for a (name, Params) pair or list thereof."""
+    """Hashable key for a (name, Params) pair or list thereof.
+
+    Params without value semantics (no custom ``__repr__`` — the default
+    one embeds a reusable memory address) key on OBJECT IDENTITY via a
+    token stamped on the instance: the same object keeps hitting the
+    cache (trivially equal to itself), but a different object never
+    aliases it even when the allocator reuses the address — the
+    reference's "not cached when isEqual is not implemented" rule
+    (`FastEvalEngineTest.scala:131`).  Keying on the raw default repr
+    would silently alias two different candidates on address reuse.
+    """
     if isinstance(named_params, list):
         return tuple(_key(x) for x in named_params)
     name, params = named_params
+    if params is not None and type(params).__repr__ is object.__repr__:
+        try:
+            tok = params.__dict__.setdefault(
+                "_pio_opaque_token", next(_OPAQUE)
+            )
+        except AttributeError:  # __slots__ object: identity only
+            tok = id(params)
+        return (name, f"opaque-{tok}")
     return (name, repr(params))
 
 

@@ -194,11 +194,21 @@ class SQLiteEventStore(EventStore):
         skip the commit its own connection needs (rows stuck invisible in
         an open transaction).  Other threads' writes keep their normal
         commit-per-call behavior while a bulk scope is active here.
+
+        A failed scope ROLLS BACK instead of committing: the single
+        transaction makes a crashed import atomic — no half-persisted
+        file with no marker of how far it got.
         """
         self._local.bulk_depth = self._bulk_depth + 1
         try:
             yield self
-        finally:
+        except BaseException:
+            self._local.bulk_depth -= 1
+            if self._local.bulk_depth == 0:
+                with self._lock:
+                    self._conn.rollback()
+            raise
+        else:
             self._local.bulk_depth -= 1
             if self._local.bulk_depth == 0:
                 with self._lock:

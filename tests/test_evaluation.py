@@ -209,6 +209,33 @@ def test_fast_eval_same_params_full_hit(ctx):
     assert e.stats == {"ds": 1, "prep": 1, "algo": 1}
 
 
+def test_fast_eval_no_value_equality_not_cached(ctx):
+    """Params without value-based equality are conservatively NOT cached
+    (the reference's "Not cached when isEqual is not implemented",
+    FastEvalEngineTest.scala:131): identical-looking candidates must
+    re-run, never alias another candidate's results."""
+    class OpaqueParams:  # plain object: repr includes identity, not value
+        def __init__(self, id):
+            self.id = id
+
+    def p():
+        return EngineParams(
+            data_source=("", OpaqueParams(id=1)),
+            preparator=("", IdParams(id=2)),
+            algorithms=[("a0", IdParams(id=3))],
+            serving=("", IdParams(id=4)),
+        )
+
+    e = FastEvalEngine(_engine())
+    p1, p2 = p(), p()  # both alive: addresses provably distinct
+    e.eval(ctx, p1)
+    e.eval(ctx, p2)  # same values, different objects -> cache miss
+    assert e.stats["ds"] == 2
+    # the SAME object is trivially equal to itself -> cache hit
+    e.eval(ctx, p1)
+    assert e.stats["ds"] == 2
+
+
 def test_fast_eval_results_match_plain_engine(ctx):
     plain = _engine().eval(ctx, _params(7))
     fast = FastEvalEngine(_engine()).eval(ctx, _params(7))

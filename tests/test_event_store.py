@@ -199,3 +199,25 @@ def test_bulk_import_scope_and_unvalidated_batch(tmp_path):
     with mem.bulk():
         mem.insert_batch(evs[:5], app_id=1, validate=False)
     assert len(list(mem.find(app_id=1))) == 5
+
+
+def test_bulk_scope_rolls_back_on_error(tmp_path):
+    """A failed bulk() scope must leave the store unchanged (atomic
+    import), not half-persisted."""
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    es = SQLiteEventStore(tmp_path / "e.db")
+    ev = Event(event="rate", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id="i1",
+               properties=DataMap({"rating": 3.0}))
+    try:
+        with es.bulk():
+            es.insert_batch([ev] * 10, app_id=1, validate=False)
+            raise RuntimeError("simulated mid-import failure")
+    except RuntimeError:
+        pass
+    assert list(es.find(app_id=1)) == []
+    # and a clean scope still commits
+    with es.bulk():
+        es.insert_batch([ev], app_id=1, validate=False)
+    assert len(list(es.find(app_id=1))) == 1

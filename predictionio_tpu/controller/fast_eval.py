@@ -23,6 +23,11 @@ __all__ = ["FastEvalEngine"]
 
 
 _OPAQUE = itertools.count()
+# __slots__ objects can't carry the token; pin them (strong ref) so their
+# address can never be reused by a different params object while this
+# process lives — id() is then a safe identity key.  Bounded by the number
+# of distinct slotted-no-repr params candidates ever evaluated (rare).
+_OPAQUE_PINNED: dict[int, tuple[int, Any]] = {}
 
 
 def _key(named_params) -> Any:
@@ -45,8 +50,10 @@ def _key(named_params) -> Any:
             tok = params.__dict__.setdefault(
                 "_pio_opaque_token", next(_OPAQUE)
             )
-        except AttributeError:  # __slots__ object: identity only
-            tok = id(params)
+        except AttributeError:  # __slots__ object: pin + identity token
+            tok = _OPAQUE_PINNED.setdefault(
+                id(params), (next(_OPAQUE), params)
+            )[0]
         return (name, f"opaque-{tok}")
     return (name, repr(params))
 

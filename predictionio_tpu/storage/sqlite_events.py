@@ -155,7 +155,8 @@ class SQLiteEventStore(EventStore):
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 self._row(event, eid),
             )
-            self._conn.commit()
+            if not self._bulk_depth:
+                self._conn.commit()
         return eid
 
     def insert_batch(
@@ -197,7 +198,14 @@ class SQLiteEventStore(EventStore):
 
         A failed scope ROLLS BACK instead of committing: the single
         transaction makes a crashed import atomic — no half-persisted
-        file with no marker of how far it got.
+        file with no marker of how far it got.  Every write path on this
+        thread (insert/insert_batch/delete/delete_batch) defers its
+        commit inside the scope.  Caveats: creating a NEW (app, channel)
+        table mid-scope runs DDL, which sqlite auto-commits — call
+        ``init_channel`` before the scope for strict atomicity (the bulk
+        importer does); and the shared-connection ``:memory:`` mode can
+        have another thread's commit absorb pending rows (test-only
+        backend, single-writer assumption).
         """
         self._local.bulk_depth = self._bulk_depth + 1
         try:
@@ -243,7 +251,8 @@ class SQLiteEventStore(EventStore):
             cur = self._conn.execute(
                 f"DELETE FROM {t} WHERE event_id=?", (event_id,)
             )
-            self._conn.commit()
+            if not self._bulk_depth:
+                self._conn.commit()
             return cur.rowcount > 0
 
     def delete_batch(self, event_ids, app_id: int, channel_id: int = 0) -> int:
@@ -255,7 +264,8 @@ class SQLiteEventStore(EventStore):
             cur = self._conn.executemany(
                 f"DELETE FROM {t} WHERE event_id=?", ids
             )
-            self._conn.commit()
+            if not self._bulk_depth:
+                self._conn.commit()
             return cur.rowcount if cur.rowcount >= 0 else len(ids)
 
     # -- scans ------------------------------------------------------------

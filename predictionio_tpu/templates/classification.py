@@ -29,6 +29,7 @@ from ..controller import (
     Params,
     WorkflowContext,
 )
+from ..models.forest import ForestConfig, forest_predict, train_forest
 from ..models.logistic import train_logistic
 from ..models.naive_bayes import train_naive_bayes
 from .recommendation import _resolve_app_id
@@ -148,11 +149,59 @@ class LogisticAlgorithm(Algorithm):
         return PredictedResult(label=label)
 
 
+@dataclass(frozen=True)
+class RandomForestParams(Params):
+    """Reference param names (`RandomForestAlgorithm.scala:2-9`); maxBins
+    and impurity are not carried: the tensor-forest uses exact threshold
+    search and gini (the reference example's default)."""
+
+    num_trees: int = 16
+    max_depth: int = 6
+    # MLlib vocabulary: sqrt/auto, log2, onethird, all
+    feature_subset_strategy: str = "sqrt"
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    """Random forest — the reference add-algorithm variant's third
+    algorithm (`add-algorithm/.../RandomForestAlgorithm.scala:1-60`).
+    Host-fitted CART trees stored as tensors; batch prediction is a
+    jitted lock-step tree walk (`models/forest.py`)."""
+
+    params_class = RandomForestParams
+
+    def train(self, ctx, data: ClassificationTrainingData):
+        p = self.params
+        classes = sorted({str(l) for l in data.labels.tolist()})
+        lut = {c: i for i, c in enumerate(classes)}
+        y = np.asarray([lut[str(l)] for l in data.labels], np.int32)
+        forest = train_forest(
+            data.features, y,
+            ForestConfig(
+                n_trees=p.num_trees,
+                max_depth=p.max_depth,
+                num_classes=len(classes),
+                # passed through verbatim: train_forest rejects unknown
+                # strategies instead of silently training a different forest
+                feature_subset=p.feature_subset_strategy,
+                seed=p.seed,
+            ),
+        )
+        return {"forest": forest, "classes": classes}
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        ix = forest_predict(
+            model["forest"], np.asarray([query.features], np.float32)
+        )[0]
+        return PredictedResult(label=model["classes"][int(ix)])
+
+
 def classification_engine() -> Engine:
     return Engine(
         ClassificationDataSource,
         IdentityPreparator,
         {"naive": NaiveBayesAlgorithm, "logistic": LogisticAlgorithm,
+         "randomforest": RandomForestAlgorithm,
          "": NaiveBayesAlgorithm},
         FirstServing,
     )

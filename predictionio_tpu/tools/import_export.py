@@ -42,6 +42,9 @@ def import_events(
     """
     n = 0
     batch: list[Event] = []
+    # table DDL before the transaction scope: sqlite auto-commits DDL,
+    # which would break the all-or-nothing rollback guarantee
+    store.init_channel(app_id, channel_id)
     with open(path) as f, store.bulk():
         for line in f:
             line = line.strip()

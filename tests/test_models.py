@@ -74,3 +74,113 @@ def test_markov_chain_topn_truncates():
     to = np.arange(10, dtype=np.int32) % 5
     m = train_markov_chain(frm, to, n_states=5, top_n=2)
     assert len(m.predict(0)) == 2
+
+
+# ---------------------------------------------------------------------------
+# Random forest (reference add-algorithm RandomForestAlgorithm parity)
+# ---------------------------------------------------------------------------
+
+
+def _gauss_blobs(n=400, seed=0):
+    """3 gaussian blobs -> (X, y) cleanly separable."""
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [4, 4], [0, 5]], np.float32)
+    y = rng.integers(0, 3, n).astype(np.int32)
+    X = centers[y] + rng.normal(scale=0.5, size=(n, 2)).astype(np.float32)
+    return X, y
+
+
+def test_forest_learns_gauss_blobs():
+    from predictionio_tpu.models.forest import (
+        ForestConfig, forest_predict, train_forest,
+    )
+
+    X, y = _gauss_blobs()
+    m = train_forest(X, y, ForestConfig(n_trees=12, max_depth=5,
+                                        num_classes=3, seed=1))
+    acc = float((forest_predict(m, X) == y).mean())
+    assert acc > 0.95, acc
+    # fresh points from the same blobs classify correctly
+    Xt, yt = _gauss_blobs(seed=9)
+    acc_t = float((forest_predict(m, Xt) == yt).mean())
+    assert acc_t > 0.9, acc_t
+
+
+def test_forest_device_walk_matches_host_walk():
+    """The jitted lock-step walk must agree with a straightforward
+    per-tree host traversal of the same tensors."""
+    from predictionio_tpu.models.forest import (
+        ForestConfig, forest_predict, train_forest,
+    )
+
+    X, y = _gauss_blobs(n=200, seed=3)
+    m = train_forest(X, y, ForestConfig(n_trees=7, max_depth=4,
+                                        num_classes=3, seed=2))
+
+    def host_predict_one(x):
+        votes = np.zeros(3, np.int64)
+        for t in range(m.feature.shape[0]):
+            node = 0
+            while m.feature[t, node] >= 0:
+                f = m.feature[t, node]
+                node = (2 * node + 1 if x[f] <= m.threshold[t, node]
+                        else 2 * node + 2)
+            votes[m.label[t, node]] += 1
+        return int(np.argmax(votes))
+
+    got = forest_predict(m, X[:50])
+    want = np.array([host_predict_one(x) for x in X[:50]])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_forest_single_class_and_empty():
+    from predictionio_tpu.models.forest import (
+        ForestConfig, forest_predict, train_forest,
+    )
+
+    X = np.ones((10, 3), np.float32)
+    y = np.zeros(10, np.int32)
+    m = train_forest(X, y, ForestConfig(n_trees=3, max_depth=3,
+                                        num_classes=2))
+    assert (forest_predict(m, X) == 0).all()
+    import pytest
+
+    with pytest.raises(ValueError):
+        train_forest(np.zeros((0, 2), np.float32), np.zeros(0, np.int32))
+
+
+def test_classification_template_random_forest():
+    from predictionio_tpu.templates.classification import (
+        PredictedResult, Query, RandomForestAlgorithm, RandomForestParams,
+    )
+    from predictionio_tpu.templates.classification import (
+        ClassificationTrainingData,
+    )
+    from predictionio_tpu.controller.base import instantiate
+
+    X, y = _gauss_blobs(n=300, seed=5)
+    labels = np.asarray([f"class{c}" for c in y], dtype=object)
+    algo = instantiate(RandomForestAlgorithm,
+                       RandomForestParams(num_trees=10, max_depth=5))
+    model = algo.train(None, ClassificationTrainingData(
+        features=X, labels=labels))
+    r = algo.predict(model, Query(features=[4.0, 4.0]))
+    assert isinstance(r, PredictedResult)
+    assert r.label == "class1"
+    r0 = algo.predict(model, Query(features=[0.0, 0.0]))
+    assert r0.label == "class0"
+
+
+def test_forest_rejects_unknown_strategy():
+    import pytest
+
+    from predictionio_tpu.models.forest import ForestConfig, train_forest
+
+    X, y = _gauss_blobs(n=50)
+    with pytest.raises(ValueError, match="feature_subset"):
+        train_forest(X, y, ForestConfig(num_classes=3,
+                                        feature_subset="bogus"))
+    # the reference's other MLlib strategies are accepted
+    for s in ("log2", "onethird", "all", "auto"):
+        train_forest(X[:30], y[:30], ForestConfig(
+            n_trees=2, max_depth=3, num_classes=3, feature_subset=s))

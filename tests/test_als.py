@@ -341,3 +341,72 @@ def test_lambda_sweep_does_not_recompile():
         train_als((u, i, v), nu, ni,
                   ALSConfig(rank=4, num_iterations=1, lam=lam))
     assert als_mod._half_iteration._cache_size() == size_after_first
+
+
+def _reference_als_implicit(u, i, v, n_users, n_items, cfg: ALSConfig):
+    """Dense NumPy Hu-Koren implicit ALS, identical init: confidence
+    c = 1 + alpha*r on observed cells, preference p = 1, full-YtY term for
+    the unobserved cells (SURVEY hard part 2: both modes must exist and
+    match the MLlib convention)."""
+    import jax
+
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, ki = jax.random.split(key)
+    U = np.asarray(
+        jax.random.normal(ku, (n_users, cfg.rank), "float32")
+    ) / np.sqrt(cfg.rank)
+    V = np.asarray(
+        jax.random.normal(ki, (n_items, cfg.rank), "float32")
+    ) / np.sqrt(cfg.rank)
+
+    def solve_side(X, Y, rows, cols, vals, n_rows):
+        YtY = Y.T @ Y
+        for r in range(n_rows):
+            sel = rows == r
+            n = sel.sum()
+            Yr = Y[cols[sel]]
+            cw = cfg.alpha * vals[sel]                    # c - 1
+            A = YtY + (Yr * cw[:, None]).T @ Yr + cfg.lam * (
+                n if cfg.weighted_lambda else 1.0
+            ) * np.eye(cfg.rank)
+            b = (Yr * (1.0 + cw)[:, None]).sum(axis=0)
+            X[r] = np.linalg.solve(A, b)
+        return X
+
+    for _ in range(cfg.num_iterations):
+        U = solve_side(U, V, u, i, v, n_users)
+        V = solve_side(V, U, i, u, v, n_items)
+    return ALSFactors(user_factors=U, item_factors=V)
+
+
+def test_implicit_matches_numpy_reference():
+    u, i, v, nu, ni = _toy()
+    v = np.abs(v) + 1.0  # implicit counts: positive
+    cfg = ALSConfig(rank=4, num_iterations=4, lam=0.1, seed=7,
+                    implicit=True, alpha=2.0)
+    ours = train_als((u, i, v), nu, ni, cfg)
+    ref = _reference_als_implicit(u, i, v, nu, ni, cfg)
+    np.testing.assert_allclose(
+        ours.user_factors, ref.user_factors, rtol=2e-2, atol=2e-2
+    )
+    np.testing.assert_allclose(
+        ours.item_factors, ref.item_factors, rtol=2e-2, atol=2e-2
+    )
+    pred_ours = ours.user_factors @ ours.item_factors.T
+    pred_ref = ref.user_factors @ ref.item_factors.T
+    np.testing.assert_allclose(pred_ours, pred_ref, atol=2e-2)
+
+
+def test_implicit_single_halfstep_exact():
+    u, i, v, nu, ni = _toy(seed=11)
+    v = np.abs(v) + 1.0
+    cfg = ALSConfig(rank=4, num_iterations=1, lam=0.1, seed=3,
+                    implicit=True, alpha=1.0, weighted_lambda=False)
+    ours = train_als((u, i, v), nu, ni, cfg)
+    ref = _reference_als_implicit(u, i, v, nu, ni, cfg)
+    np.testing.assert_allclose(
+        ours.user_factors, ref.user_factors, rtol=3e-4, atol=3e-4
+    )
+    np.testing.assert_allclose(
+        ours.item_factors, ref.item_factors, rtol=3e-4, atol=3e-4
+    )

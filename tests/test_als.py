@@ -364,6 +364,8 @@ def _reference_als_implicit(u, i, v, n_users, n_items, cfg: ALSConfig):
         for r in range(n_rows):
             sel = rows == r
             n = sel.sum()
+            if n == 0:
+                continue  # empty rows stay at init, like train_als
             Yr = Y[cols[sel]]
             cw = cfg.alpha * vals[sel]                    # c - 1
             A = YtY + (Yr * cw[:, None]).T @ Yr + cfg.lam * (

@@ -285,3 +285,86 @@ def test_similarproduct_multi(in_example):
         for a, mod in zip(algos, models)
     ])
     assert len(r1.item_scores) == 1
+
+
+def test_trim_app(in_example, storage_memory):
+    import datetime as dt
+
+    from predictionio_tpu.controller.base import WorkflowContext
+    from predictionio_tpu.storage.event import DataMap, Event
+
+    m = in_example("trim-app")
+    UTC = dt.timezone.utc
+    ctx = WorkflowContext(storage=storage_memory)
+    es = ctx.storage.get_event_store()
+    for day in (1, 2, 3, 4, 5):
+        es.insert(Event(event="rate", entity_type="user", entity_id=f"u{day}",
+                        target_entity_type="item", target_entity_id="i1",
+                        properties=DataMap({"rating": 3.0}),
+                        event_time=dt.datetime(2020, 1, day, tzinfo=UTC)),
+                  app_id=1)
+    import json
+    from pathlib import Path
+
+    engine = m.engine_factory()
+    ep = engine.params_from_variant(json.loads(Path("engine.json").read_text()))
+    models = engine.train(ctx, ep)
+    summary = models[0]
+    # window [Jan 2, Jan 4): days 2 and 3 only
+    assert summary.copied == 2
+    got = sorted(e.entity_id for e in es.find(app_id=2))
+    assert got == ["u2", "u3"]
+    # event ids preserved across the copy
+    src_ids = {e.event_id for e in es.find(app_id=1)}
+    assert {e.event_id for e in es.find(app_id=2)} <= src_ids
+    # refuses a non-empty destination
+    import pytest
+
+    with pytest.raises(RuntimeError, match="not empty"):
+        engine.train(ctx, ep)
+
+
+def test_trim_app_failed_copy_leaves_dst_empty(in_example, storage_memory):
+    """A mid-copy failure must clean the destination so a retry is
+    possible — on ANY backend, including the non-transactional memory
+    store."""
+    import datetime as dt
+    import json
+    from pathlib import Path
+
+    import pytest
+
+    from predictionio_tpu.controller.base import WorkflowContext
+    from predictionio_tpu.storage.event import DataMap, Event
+
+    m = in_example("trim-app")
+    UTC = dt.timezone.utc
+    ctx = WorkflowContext(storage=storage_memory)
+    es = ctx.storage.get_event_store()
+    for day in (2, 3):
+        es.insert(Event(event="rate", entity_type="user", entity_id=f"u{day}",
+                        target_entity_type="item", target_entity_id="i1",
+                        properties=DataMap({"rating": 3.0}),
+                        event_time=dt.datetime(2020, 1, day, tzinfo=UTC)),
+                  app_id=1)
+    engine = m.engine_factory()
+    ep = engine.params_from_variant(
+        json.loads(Path("engine.json").read_text())
+    )
+    real = es.insert_batch
+
+    def boom(events, app_id, *a, **kw):
+        if app_id == 2:
+            real(events[:1], app_id, *a, **kw)  # partial write, then die
+            raise OSError("disk full")
+        return real(events, app_id, *a, **kw)
+
+    es.insert_batch = boom
+    try:
+        with pytest.raises(OSError):
+            engine.train(ctx, ep)
+    finally:
+        es.insert_batch = real
+    assert list(es.find(app_id=2)) == []  # cleaned up
+    models = engine.train(ctx, ep)  # retry succeeds
+    assert models[0].copied == 2

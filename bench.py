@@ -84,11 +84,20 @@ def _parse_args(argv=None):
         help="run the timed train in THIS process (no probe/subprocess "
         "supervision); used by the default orchestrated invocation",
     )
+    ap.add_argument(
+        "--breakdown",
+        action="store_true",
+        help="also time each phase (host bucketing, device staging, "
+        "compile, per-side half-iterations) — the bottleneck data the "
+        "perf note needs; implies --inner semantics",
+    )
     return ap.parse_args(argv)
 
 
-def run_inner(args) -> None:
-    """The actual timed train: stages, warms up, trains, prints the JSON."""
+def _prepare(args):
+    """Shared --inner/--breakdown setup: platform forcing, backend-touching
+    imports, compilation cache, synthetic data, mesh, config.  One place so
+    both paths always measure an identically-configured trainer."""
     if args.platform:
         from predictionio_tpu.parallel.mesh import force_platform
 
@@ -96,9 +105,7 @@ def run_inner(args) -> None:
 
     import jax
 
-    from predictionio_tpu.models.als import (
-        ALSConfig, ALSFactors, ALSTrainer, rmse,
-    )
+    from predictionio_tpu.models.als import ALSConfig
     from predictionio_tpu.parallel.mesh import (
         enable_compilation_cache, make_mesh,
     )
@@ -111,12 +118,80 @@ def run_inner(args) -> None:
             f"devices={jax.devices()}",
             file=sys.stderr,
         )
-
     mesh = make_mesh()
     mesh = mesh if mesh.size > 1 else None
     cfg = ALSConfig(
         rank=args.rank, num_iterations=args.iters, lam=0.01, seed=args.seed
     )
+    return jax, (u, i, v, n_users, n_items), mesh, cfg
+
+
+def run_breakdown(args) -> None:
+    """Phase-by-phase timing of the north-star train (VERDICT r1 item 2:
+    'what's the bottleneck: solves, gathers, or scatter?' — this is the
+    measurement half; run it on the real chip and paste the JSON into
+    docs/ARCHITECTURE.md).  Prints one JSON line per phase."""
+    t0 = time.time()
+    jax, (u, i, v, n_users, n_items), mesh, cfg = _prepare(args)
+    from predictionio_tpu.models.als import ALSTrainer
+
+    def emit(phase, seconds, **kw):
+        print(json.dumps({"metric": "als_phase_seconds", "phase": phase,
+                          "value": round(seconds, 4), **kw}), flush=True)
+
+    emit("setup_and_synth_data", time.time() - t0)
+
+    t0 = time.time()
+    trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh)
+    emit("bucketize_and_stage", time.time() - t0)
+
+    t0 = time.time()
+    U, V = trainer.init_factors()
+    jax.block_until_ready((U, V))
+    emit("init_factors", time.time() - t0)
+
+    # first compile: one half-iteration per side
+    t0 = time.time()
+    U1 = trainer._half(U, V, trainer._user_side)
+    U1.block_until_ready()
+    emit("user_half_first_incl_compile", time.time() - t0)
+    t0 = time.time()
+    V1 = trainer._half(V, U1, trainer._item_side)
+    V1.block_until_ready()
+    emit("item_half_first_incl_compile", time.time() - t0)
+
+    # steady state: per-side medians over the remaining iterations
+    sides = {"user_half_steady": [], "item_half_steady": []}
+    for _ in range(max(args.iters - 1, 1)):
+        t0 = time.time()
+        U1 = trainer._half(U1, V1, trainer._user_side)
+        U1.block_until_ready()
+        sides["user_half_steady"].append(time.time() - t0)
+        t0 = time.time()
+        V1 = trainer._half(V1, U1, trainer._item_side)
+        V1.block_until_ready()
+        sides["item_half_steady"].append(time.time() - t0)
+    for phase, ts in sides.items():
+        ts.sort()
+        emit(phase, ts[len(ts) // 2], n=len(ts),
+             total=round(sum(ts), 4))
+    nnz = len(v)
+    flops_iter = 2 * (2 * nnz * args.rank ** 2) + (
+        (n_users + n_items) * 2 * args.rank ** 3 // 3
+    )
+    steady = sides["user_half_steady"][len(sides["user_half_steady"]) // 2] \
+        + sides["item_half_steady"][len(sides["item_half_steady"]) // 2]
+    print(json.dumps({
+        "metric": "als_derived_tflops_per_s",
+        "value": flops_iter / steady / 1e12,
+        "platform": str(jax.devices()[0].platform),
+    }), flush=True)
+
+
+def run_inner(args) -> None:
+    """The actual timed train: stages, warms up, trains, prints the JSON."""
+    jax, (u, i, v, n_users, n_items), mesh, cfg = _prepare(args)
+    from predictionio_tpu.models.als import ALSFactors, ALSTrainer, rmse
 
     # warmup: compile both half-iteration executables (one per direction)
     warm = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh)
@@ -239,6 +314,9 @@ def _last_accelerator_measurement():
 
 def main() -> None:
     args = _parse_args()
+    if args.breakdown:
+        run_breakdown(args)
+        return
     if args.inner or args.platform:
         # explicit platform or inner mode: run directly, no supervision
         run_inner(args)

@@ -85,6 +85,12 @@ def _parse_args(argv=None):
         "supervision); used by the default orchestrated invocation",
     )
     ap.add_argument(
+        "--profile",
+        metavar="DIR",
+        help="with --breakdown: capture a jax profiler trace of the "
+        "steady-state iterations into DIR (TensorBoard/Perfetto)",
+    )
+    ap.add_argument(
         "--breakdown",
         action="store_true",
         help="also time each phase (host bucketing, device staging, "
@@ -161,16 +167,27 @@ def run_breakdown(args) -> None:
     emit("item_half_first_incl_compile", time.time() - t0)
 
     # steady state: per-side medians over the remaining iterations
+    import contextlib
+
+    prof = (
+        jax.profiler.trace(args.profile)
+        if args.profile
+        else contextlib.nullcontext()
+    )
     sides = {"user_half_steady": [], "item_half_steady": []}
-    for _ in range(max(args.iters - 1, 1)):
-        t0 = time.time()
-        U1 = trainer._half(U1, V1, trainer._user_side)
-        U1.block_until_ready()
-        sides["user_half_steady"].append(time.time() - t0)
-        t0 = time.time()
-        V1 = trainer._half(V1, U1, trainer._item_side)
-        V1.block_until_ready()
-        sides["item_half_steady"].append(time.time() - t0)
+    with prof:
+        for _ in range(max(args.iters - 1, 1)):
+            t0 = time.time()
+            U1 = trainer._half(U1, V1, trainer._user_side)
+            U1.block_until_ready()
+            sides["user_half_steady"].append(time.time() - t0)
+            t0 = time.time()
+            V1 = trainer._half(V1, U1, trainer._item_side)
+            V1.block_until_ready()
+            sides["item_half_steady"].append(time.time() - t0)
+    if args.profile:
+        print(json.dumps({"metric": "profile_trace_dir",
+                          "value": args.profile}), flush=True)
     for phase, ts in sides.items():
         ts.sort()
         emit(phase, ts[len(ts) // 2], n=len(ts),

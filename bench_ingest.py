@@ -1,0 +1,111 @@
+"""Event-server ingest benchmark: REST path events/s (single + batch-50).
+
+The reference's event server is its highest-traffic surface (spray/akka
+on HBase); this measures ours end-to-end — HTTP parse -> auth -> validate
+-> sqlite insert — plus the offline importer for contrast.  Prints one
+JSON line per mode.
+
+Usage: python bench_ingest.py [--n 2000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=2000)
+    args = ap.parse_args()
+
+    from predictionio_tpu.server.event_server import (
+        EventServer, EventServerConfig,
+    )
+    from predictionio_tpu.storage.registry import Storage
+
+    tmp = tempfile.mkdtemp(prefix="pio_ingest_bench_")
+    storage = Storage({"PIO_TPU_HOME": tmp})
+    from predictionio_tpu.storage.metadata import AccessKey
+
+    md = storage.get_metadata()
+    app = md.app_insert("bench")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    server = EventServer(storage, EventServerConfig(port=0))
+    server.start_background()
+    base = f"http://127.0.0.1:{server.config.port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"{base}{path}?accessKey={key}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read().decode())
+
+    def ev(k):
+        return {
+            "event": "rate", "entityType": "user", "entityId": f"u{k % 997}",
+            "targetEntityType": "item", "targetEntityId": f"i{k % 313}",
+            "properties": {"rating": float(k % 5 + 1)},
+        }
+
+    # warm + single-event path
+    post("/events.json", ev(0))
+    t0 = time.perf_counter()
+    for k in range(args.n):
+        post("/events.json", ev(k))
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "ingest_single_events_per_s",
+        "value": round(args.n / dt, 1), "unit": "events/s",
+    }), flush=True)
+
+    # batch path (reference cap: 50/request); the endpoint replies 200
+    # with PER-EVENT statuses, so throughput must be self-checking —
+    # otherwise rejected events would be counted as ingested
+    t0 = time.perf_counter()
+    batches = max(args.n // 50, 1)
+    for b in range(batches):
+        _, body = post(
+            "/batch/events.json", [ev(b * 50 + j) for j in range(50)]
+        )
+        assert all(item.get("status") == 201 for item in body), body[:3]
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "ingest_batch50_events_per_s",
+        "value": round(batches * 50 / dt, 1), "unit": "events/s",
+    }), flush=True)
+
+    server.stop()
+
+    # offline importer on the same store, for contrast
+    from predictionio_tpu.tools.import_export import import_events
+
+    path = Path(tmp) / "bulk.jsonl"
+    with open(path, "w") as f:
+        for k in range(args.n * 5):
+            f.write(json.dumps({**ev(k),
+                                "eventTime": "2020-01-01T00:00:00.000Z"})
+                    + "\n")
+    es = storage.get_event_store()
+    t0 = time.perf_counter()
+    n = import_events(path, es, app.id)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "import_bulk_events_per_s",
+        "value": round(n / dt, 1), "unit": "events/s",
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

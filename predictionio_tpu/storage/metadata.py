@@ -186,6 +186,12 @@ class MetadataStore:
     (accessor parity with `Storage.scala:259-290`)."""
 
     def __init__(self, path: str | Path = ":memory:"):
+        if not isinstance(path, (str, Path)):
+            # str(dict) would silently become a garbage FILENAME
+            raise TypeError(
+                f"path must be str/Path, got {type(path).__name__} "
+                "(pass conf['path'], not the conf dict)"
+            )
         self._path = str(path)
         self._lock = threading.RLock()
         self._conn = sqlite3.connect(self._path, check_same_thread=False)

@@ -71,6 +71,12 @@ def _table_name(app_id: int, channel_id: int) -> str:
 
 class SQLiteEventStore(EventStore):
     def __init__(self, path: str | Path = ":memory:"):
+        if not isinstance(path, (str, Path)):
+            # str(dict) would silently become a garbage FILENAME
+            raise TypeError(
+                f"path must be str/Path, got {type(path).__name__} "
+                "(pass conf['path'], not the conf dict)"
+            )
         self._path = str(path)
         self._lock = threading.RLock()
         self._local = threading.local()

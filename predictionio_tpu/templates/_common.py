@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["DeviceTableMixin"]
+__all__ = ["DeviceTableMixin", "filter_bias_mask"]
 
 
 class DeviceTableMixin:
@@ -53,3 +53,46 @@ class DeviceTableMixin:
                 dev = dev.astype(jnp.dtype(dtype))
             setattr(self, key, dev)
         return dev
+
+
+def filter_bias_mask(
+    items,
+    item_props: Optional[dict] = None,
+    *,
+    categories=None,
+    whitelist=None,
+    blacklist=(),
+    exclude_ix=(),
+    none_if_empty: bool = False,
+):
+    """Additive -inf bias over the item table for query-side filtering —
+    the shared core of the filter-by-category / whitelist / blacklist
+    template variants (plus query-item exclusion for similar-item
+    queries).  ``none_if_empty=True`` returns None when no filter is
+    active so callers can dispatch the cheaper unbiased scorer.
+    """
+    import numpy as np
+
+    ex = tuple(exclude_ix)  # materialize ONCE: one-shot iterables
+    has_filter = bool(categories or whitelist or blacklist or ex)
+    if none_if_empty and not has_filter:
+        return None
+    n = len(items)
+    allowed = np.ones(n, dtype=bool)
+    if ex:
+        allowed[list(ex)] = False
+    if whitelist:
+        allowed &= np.isin(items.ids.astype(str),
+                           np.array(sorted(whitelist), dtype=str))
+    if categories:
+        cats = set(categories)
+        has = np.zeros(n, dtype=bool)
+        for item_id, props in (item_props or {}).items():
+            ix = items.get(item_id)
+            if ix >= 0 and cats & set(props.get("categories", [])):
+                has[ix] = True
+        allowed &= has
+    if blacklist:
+        allowed &= ~np.isin(items.ids.astype(str),
+                            np.array(sorted(blacklist), dtype=str))
+    return np.where(allowed, 0.0, -np.inf).astype(np.float32)

@@ -21,7 +21,6 @@ import logging
 from dataclasses import dataclass
 from typing import Any, Optional
 
-import jax
 import numpy as np
 
 from ..controller import (
@@ -37,8 +36,13 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import topk_scores
 
-from ._common import DeviceTableMixin
-from .recommendation import ItemScore, PredictedResult, Query, _resolve_app_id
+from ._common import DeviceTableMixin, filter_bias_mask
+from .recommendation import (
+    PredictedResult,
+    Query,
+    _resolve_app_id,
+    decode_item_scores,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -197,36 +201,18 @@ class ECommAlgorithm(Algorithm):
             black |= self._seen_items(model, query.user)
         black |= self._unavailable_items(model)
 
-        n = len(model.items)
-        allowed = np.ones(n, dtype=bool)
-        if query.whitelist:
-            allowed &= np.isin(model.items.ids.astype(str),
-                               np.array(query.whitelist, dtype=str))
-        if query.categories:
-            cats = set(query.categories)
-            has = np.zeros(n, dtype=bool)
-            for item_id, props in model.item_props.items():
-                ix = model.items.get(item_id)
-                if ix >= 0 and cats & set(props.get("categories", [])):
-                    has[ix] = True
-            allowed &= has
-        if black:
-            allowed &= ~np.isin(model.items.ids.astype(str),
-                                np.array(sorted(black), dtype=str))
-        mask = np.where(allowed, 0.0, -np.inf).astype(np.float32)
-        k = min(query.num, n)
+        mask = filter_bias_mask(
+            model.items, model.item_props,
+            categories=query.categories, whitelist=query.whitelist,
+            blacklist=black,
+        )
+        k = min(query.num, len(model.items))
         vals, ixs = topk_scores(
             np.asarray(model.user_factors[uix], np.float32),
             model.device_item_factors(), k, bias=mask,
         )
-        vals, ixs = jax.device_get((vals, ixs))  # one host sync per query
-        ok = np.isfinite(vals)
-        ids = model.items.decode(ixs[ok])
         return PredictedResult(
-            item_scores=tuple(
-                ItemScore(item=str(i), score=float(s))
-                for i, s in zip(ids, vals[ok])
-            )
+            item_scores=decode_item_scores(model.items, vals, ixs)
         )
 
 

@@ -36,7 +36,7 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, topk_scores
 from ..storage.columnar import Ratings
-from ._common import DeviceTableMixin
+from ._common import DeviceTableMixin, filter_bias_mask
 from ..storage.levents import EventStore
 
 
@@ -111,6 +111,19 @@ class TrainingData:
     def sanity_check(self) -> None:
         if len(self.ratings) == 0:
             raise ValueError("no rating events found — is the app empty?")
+
+
+def decode_item_scores(items, vals, ixs) -> tuple:
+    """ONE host sync for both top-k outputs (each separate readback costs
+    a full RTT on a remote-attached accelerator), then decode to
+    :class:`ItemScore` rows, dropping -inf-masked entries."""
+    vals, ixs = jax.device_get((vals, ixs))
+    ok = np.isfinite(vals)
+    ids = items.decode(ixs[ok])
+    return tuple(
+        ItemScore(item=str(i), score=float(s))
+        for i, s in zip(ids, vals[ok])
+    )
 
 
 def _resolve_app_id(ctx: WorkflowContext, p: DataSourceParams) -> int:
@@ -305,27 +318,13 @@ class ALSAlgorithm(Algorithm):
     # -- serving ----------------------------------------------------------
     def _allowed_mask(self, model: ALSModel, query: Query) -> Optional[np.ndarray]:
         """-inf additive mask for filtered-out items (filter-by-category /
-        whitelist / blacklist variants)."""
-        if not (query.categories or query.whitelist or query.blacklist):
-            return None
-        n = len(model.items)
-        allowed = np.ones(n, dtype=bool)
-        if query.whitelist:
-            allowed &= np.isin(model.items.ids.astype(str),
-                               np.array(query.whitelist, dtype=str))
-        if query.categories:
-            cats = set(query.categories)
-            has_cat = np.zeros(n, dtype=bool)
-            for item_id, props in model.item_props.items():
-                ix = model.items.get(item_id)
-                if ix >= 0 and cats & set(props.get("categories", [])):
-                    has_cat[ix] = True
-            allowed &= has_cat
-        if query.blacklist:
-            allowed &= ~np.isin(model.items.ids.astype(str),
-                                np.array(query.blacklist, dtype=str))
-        mask = np.where(allowed, 0.0, -np.inf).astype(np.float32)
-        return mask
+        whitelist / blacklist variants); None when the query has no
+        filters so the unbiased scorer executable is dispatched."""
+        return filter_bias_mask(
+            model.items, model.item_props,
+            categories=query.categories, whitelist=query.whitelist,
+            blacklist=query.blacklist or (), none_if_empty=True,
+        )
 
     def warmup(self, model: ALSModel) -> None:
         """Compile the top-k scorers for the common ``num`` values (the
@@ -355,17 +354,8 @@ class ALSAlgorithm(Algorithm):
             vals, ixs = topk_scores(
                 np.asarray(model.user_factors[uix]), table, k, bias=mask,
             )
-        # ONE device->host sync for both results: on a tunneled accelerator
-        # each distinct readback costs a full RTT (measured ~70 ms through
-        # the axon tunnel), so two np.asarray calls double query latency.
-        vals, ixs = jax.device_get((vals, ixs))
-        ok = np.isfinite(vals)
-        item_ids = model.items.decode(ixs[ok])
         return PredictedResult(
-            item_scores=tuple(
-                ItemScore(item=str(it), score=float(s))
-                for it, s in zip(item_ids, vals[ok])
-            )
+            item_scores=decode_item_scores(model.items, vals, ixs)
         )
 
     def batch_predict(self, model: ALSModel, queries: Sequence[Query]):

@@ -16,7 +16,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
-import jax
 import numpy as np
 
 from ..controller import (
@@ -32,8 +31,12 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import topk_scores
 
-from ._common import DeviceTableMixin
-from .recommendation import ItemScore, PredictedResult, _resolve_app_id
+from ._common import DeviceTableMixin, filter_bias_mask
+from .recommendation import (
+    PredictedResult,
+    _resolve_app_id,
+    decode_item_scores,
+)
 
 
 @dataclass(frozen=True)
@@ -184,38 +187,19 @@ class SimilarProductAlgorithm(Algorithm):
             return PredictedResult(item_scores=())
         qvec = model.item_factors[known].mean(axis=0)
         # exclude the query items themselves plus any filters
-        n = len(model.items)
-        allowed = np.ones(n, dtype=bool)
-        allowed[known] = False
-        if query.whitelist:
-            allowed &= np.isin(model.items.ids.astype(str),
-                               np.array(query.whitelist, dtype=str))
-        if query.blacklist:
-            allowed &= ~np.isin(model.items.ids.astype(str),
-                                np.array(query.blacklist, dtype=str))
-        if query.categories:
-            cats = set(query.categories)
-            has = np.zeros(n, dtype=bool)
-            for item_id, props in model.item_props.items():
-                ix = model.items.get(item_id)
-                if ix >= 0 and cats & set(props.get("categories", [])):
-                    has[ix] = True
-            allowed &= has
-        mask = np.where(allowed, 0.0, -np.inf).astype(np.float32)
-        k = min(query.num, n)
+        mask = filter_bias_mask(
+            model.items, model.item_props,
+            categories=query.categories, whitelist=query.whitelist,
+            blacklist=query.blacklist or (), exclude_ix=known,
+        )
+        k = min(query.num, len(model.items))
         # cosine: both sides normalized; the table normalization is cached
         # on the model (computed once, reused every request)
         qn = qvec / (np.linalg.norm(qvec) + 1e-9)
         tn = model.device_item_factors_normalized()
         vals, ixs = topk_scores(np.asarray(qn, np.float32), tn, k, bias=mask)
-        vals, ixs = jax.device_get((vals, ixs))  # one host sync per query
-        ok = np.isfinite(vals)
-        ids = model.items.decode(ixs[ok])
         return PredictedResult(
-            item_scores=tuple(
-                ItemScore(item=str(i), score=float(s))
-                for i, s in zip(ids, vals[ok])
-            )
+            item_scores=decode_item_scores(model.items, vals, ixs)
         )
 
 

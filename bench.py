@@ -72,6 +72,10 @@ def _parse_args(argv=None):
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--gather-dtype", default="float32",
+                    choices=("float32", "bfloat16"),
+                    help="ALS opposite-table gather dtype; A/B the "
+                    "bandwidth optimization")
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument(
         "--platform",
@@ -127,7 +131,8 @@ def _prepare(args):
     mesh = make_mesh()
     mesh = mesh if mesh.size > 1 else None
     cfg = ALSConfig(
-        rank=args.rank, num_iterations=args.iters, lam=0.01, seed=args.seed
+        rank=args.rank, num_iterations=args.iters, lam=0.01,
+        seed=args.seed, gather_dtype=args.gather_dtype,
     )
     return jax, (u, i, v, n_users, n_items), mesh, cfg
 

@@ -88,6 +88,21 @@ class ALSConfig:
     # batched SPD solver: "xla" (lax.linalg) or "pallas"
     # (ops/solve.py batch-lane kernel)
     solver: str = "xla"
+    # dtype the opposite factor table is GATHERED in: "float32" (exact,
+    # default) or "bfloat16" — the Gram einsums are gather-bandwidth-bound
+    # (see docs/ARCHITECTURE.md cost model), so a bf16 table halves the
+    # bytes the hot gather moves (and the ICI all-gather in sharded mode)
+    # at a small accuracy cost; solves and accumulation stay f32
+    gather_dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if self.gather_dtype not in ("float32", "bfloat16"):
+            # checked here, not at use sites: the use sites only test
+            # == "bfloat16", so a typo would silently run the f32 path
+            raise ValueError(
+                f"gather_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.gather_dtype!r}"
+            )
     # factor-table placement on the mesh: "replicated" keeps both tables
     # on every device (fastest when they fit one chip's HBM); "sharded"
     # block-shards both tables over the ``data`` axis (ALX-style, arXiv
@@ -214,6 +229,7 @@ def build_bucket_layout(
     jax.jit,
     static_argnames=(
         "ks", "implicit", "weighted_lambda", "precision", "solver",
+        "gather_dtype",
     ),
     donate_argnums=(0,),
 )
@@ -231,6 +247,7 @@ def _half_iteration(
     weighted_lambda: bool,
     precision: str,
     solver: str,
+    gather_dtype: str = "float32",
 ) -> jax.Array:
     def write(acc, rows, x):
         acc = upd if acc is None else acc
@@ -242,7 +259,7 @@ def _half_iteration(
     out = _solve_buckets(
         write, opp, c_sorted, v_sorted, bucket_args, lam, alpha,
         ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
-        precision=precision, solver=solver,
+        precision=precision, solver=solver, gather_dtype=gather_dtype,
     )
     return upd if out is None else out
 
@@ -261,6 +278,7 @@ def _solve_buckets(
     weighted_lambda: bool,
     precision: str,
     solver: str,
+    gather_dtype: str = "float32",
     gram: Optional[jax.Array] = None,
 ):
     """Shared bucket-solve math for the replicated and sharded paths.
@@ -268,6 +286,11 @@ def _solve_buckets(
     ``gram`` (implicit mode only) lets the sharded path supply the YtY
     matrix computed shard-locally + psum'd instead of redundantly from the
     gathered full table.
+
+    ``gather_dtype="bfloat16"`` casts the opposite table once per
+    half-iteration and feeds the MXU bf16 operands with f32 accumulation
+    (``preferred_element_type``): the hot [B, K, R] gather moves half the
+    HBM bytes.  The YtY gram, regularization, and solves stay f32.
     """
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]
@@ -276,27 +299,43 @@ def _solve_buckets(
     )
     if implicit and gram is None:
         gram = jnp.einsum("mr,ms->rs", opp, opp, precision=prec)
+    opp_g = (
+        opp.astype(jnp.bfloat16)
+        if gather_dtype == "bfloat16" and opp.dtype != jnp.bfloat16
+        else opp
+    )
+    f32 = jnp.float32
     out = None
     for (rows, starts, counts), k in zip(bucket_args, ks):
         iota = jnp.arange(k, dtype=jnp.int32)
         pos = jnp.minimum(starts[:, None] + iota[None, :], nnz - 1)
         valid = iota[None, :] < counts[:, None]          # [B, K]
         idx = jnp.where(valid, c_sorted[pos], 0)
-        val = jnp.where(valid, v_sorted[pos], 0.0)
-        mask = valid.astype(opp.dtype)
-        Vm = opp[idx] * mask[..., None]                  # [B, K, R] gather
-        n_row = counts.astype(opp.dtype)                 # [B]
+        val = jnp.where(valid, v_sorted[pos], 0.0)       # f32, masked
+        maskf = valid.astype(f32)
+        Vm = opp_g[idx] * valid[..., None].astype(opp_g.dtype)  # [B,K,R]
+        n_row = counts.astype(f32)                       # [B]
+        # weight vectors are computed in f32 then cast to the gather dtype
+        # right before the einsum, so a mixed-dtype contraction never
+        # silently promotes (and re-materializes) the big Vm operand
         if implicit:
-            cw = alpha.astype(opp.dtype) * val * mask    # (c - 1)
+            cw = alpha.astype(f32) * val * maskf         # (c - 1), f32
             A = gram + jnp.einsum(
-                "bk,bkr,bks->brs", cw, Vm, Vm, precision=prec
+                "bk,bkr,bks->brs", cw.astype(Vm.dtype), Vm, Vm,
+                precision=prec, preferred_element_type=f32,
             )
-            b = jnp.einsum("bk,bkr->br", (1.0 + cw) * mask, Vm,
-                           precision=prec)
+            b = jnp.einsum(
+                "bk,bkr->br", ((1.0 + cw) * maskf).astype(Vm.dtype), Vm,
+                precision=prec, preferred_element_type=f32,
+            )
         else:
-            A = jnp.einsum("bkr,bks->brs", Vm, Vm, precision=prec)
-            b = jnp.einsum("bk,bkr->br", val * mask, Vm, precision=prec)
-        lam_t = lam.astype(opp.dtype)
+            A = jnp.einsum("bkr,bks->brs", Vm, Vm, precision=prec,
+                           preferred_element_type=f32)
+            b = jnp.einsum(
+                "bk,bkr->br", (val * maskf).astype(Vm.dtype), Vm,
+                precision=prec, preferred_element_type=f32,
+            )
+        lam_t = lam.astype(f32)
         if weighted_lambda:
             reg = lam_t * jnp.maximum(n_row, 1.0)        # ALS-WR: λ·n_row
         else:
@@ -328,6 +367,7 @@ def build_sharded_half(
     weighted_lambda: bool,
     precision: str,
     solver: str,
+    gather_dtype: str = "float32",
 ):
     """ALX-style half-iteration over block-sharded factor tables.
 
@@ -367,7 +407,13 @@ def build_sharded_half(
         me = jax.lax.axis_index(axis)
         shard_n = upd.shape[0]
         lo = (me * shard_n).astype(jnp.int32)
-        opp_full = jax.lax.all_gather(opp, axis, axis=0, tiled=True)
+        # cast BEFORE the all-gather so bf16 mode also halves ICI traffic
+        opp_send = (
+            opp.astype(jnp.bfloat16)
+            if gather_dtype == "bfloat16"
+            else opp
+        )
+        opp_full = jax.lax.all_gather(opp_send, axis, axis=0, tiled=True)
         gram = None
         if implicit:
             # YtY from the LOCAL shard + psum: identical [R, R] result at
@@ -399,7 +445,8 @@ def build_sharded_half(
         out = _solve_buckets(
             write, opp_full, c_sorted, v_sorted, bucket_args, lam, alpha,
             ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
-            precision=precision, solver=solver, gram=gram,
+            precision=precision, solver=solver,
+            gather_dtype=gather_dtype, gram=gram,
         )
         return upd if out is None else out
 
@@ -471,6 +518,7 @@ class ALSTrainer:
                 weighted_lambda=cfg.weighted_lambda,
                 precision=cfg.matmul_precision,
                 solver=cfg.solver,
+                gather_dtype=cfg.gather_dtype,
             )
             self._sharded_user_half = build_sharded_half(
                 self.mesh, ks=self._user_side["ks"], **common
@@ -548,6 +596,7 @@ class ALSTrainer:
             weighted_lambda=cfg.weighted_lambda,
             precision=cfg.matmul_precision,
             solver=cfg.solver,
+            gather_dtype=cfg.gather_dtype,
         )
 
     def run(

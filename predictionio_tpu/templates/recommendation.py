@@ -262,6 +262,9 @@ class ALSAlgorithmParams(Params):
     # serve-time scoring dtype: "float32" (default) or "bfloat16" (halves
     # HBM reads per query; ranking-only precision cost, training unaffected)
     serving_dtype: str = "float32"
+    # train-time gather dtype for the opposite factor table ("bfloat16"
+    # halves the hot gather's HBM bytes; solves stay f32 — models/als.py)
+    gather_dtype: str = "float32"
 
 
 @dataclass
@@ -299,6 +302,7 @@ class ALSAlgorithm(Algorithm):
             implicit=p.implicit,
             alpha=p.alpha,
             weighted_lambda=p.weighted_lambda,
+            gather_dtype=p.gather_dtype,
         )
 
     def _serve_dtype(self):

@@ -412,3 +412,47 @@ def test_implicit_single_halfstep_exact():
     np.testing.assert_allclose(
         ours.item_factors, ref.item_factors, rtol=3e-4, atol=3e-4
     )
+
+
+def test_bf16_gather_close_to_f32():
+    """gather_dtype='bfloat16' halves the hot gather's bytes; the result
+    must stay close to exact f32 training (f32 accumulation + solves)."""
+    u, i, v, nu, ni = _toy(density=0.5)
+    base = dict(rank=6, num_iterations=6, lam=0.05, seed=2)
+    exact = train_als((u, i, v), nu, ni, ALSConfig(**base))
+    fast = train_als((u, i, v), nu, ni,
+                     ALSConfig(**base, gather_dtype="bfloat16"))
+    pred_exact = exact.user_factors @ exact.item_factors.T
+    pred_fast = fast.user_factors @ fast.item_factors.T
+    # prediction-matrix agreement within bf16-input tolerance
+    np.testing.assert_allclose(pred_fast, pred_exact, atol=0.15)
+    # and fit quality is essentially unchanged
+    assert abs(rmse(fast, u, i, v) - rmse(exact, u, i, v)) < 0.02
+
+
+def test_bf16_gather_implicit_and_sharded():
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy()
+    v = np.abs(v) + 1.0
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1, implicit=True,
+                    alpha=2.0, gather_dtype="bfloat16",
+                    factor_placement="sharded")
+    mesh = make_mesh()
+    sharded = train_als((u, i, v), nu, ni, cfg, mesh=mesh)
+    single = train_als((u, i, v), nu, ni,
+                       ALSConfig(rank=4, num_iterations=3, lam=0.1,
+                                 implicit=True, alpha=2.0,
+                                 gather_dtype="bfloat16"))
+    # bf16 sharded matches bf16 replicated (same math, different layout)
+    np.testing.assert_allclose(
+        sharded.user_factors, single.user_factors, rtol=2e-2, atol=2e-2
+    )
+    assert np.isfinite(sharded.item_factors).all()
+
+
+def test_gather_dtype_typo_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="gather_dtype"):
+        ALSConfig(gather_dtype="bf16")

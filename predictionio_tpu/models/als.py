@@ -80,6 +80,10 @@ class ALSConfig:
     # truncate pathological rows beyond this many ratings (0 = no cap)
     max_ratings_per_row: int = 0
     min_bucket_k: int = 8
+    # storage dtype of the factor tables themselves (init + iterates).
+    # Whatever this is, Gram accumulation, regularization, and the SPD
+    # solves always run in f32 (bf16 normal equations are numerically
+    # unsafe); use gather_dtype to cut the hot gather's bandwidth instead
     compute_dtype: str = "float32"
     # MXU precision for the Gram einsums: "highest" (f32), "high" (bf16x3),
     # "default" (bf16).  RMSE parity wants "highest"; ranking-only workloads

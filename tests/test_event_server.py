@@ -286,3 +286,61 @@ def test_example_webhook_connectors():
     e2 = to_event(f, {"type": "signup", "userId": "u1",
                       "timestamp": "2024-01-01T00:00:00.000Z"})
     assert e2.event == "signup" and e2.target_entity_id is None
+
+
+def test_concurrent_posts_and_reads(tmp_path):
+    """The event server is a ThreadingHTTPServer over a WAL sqlite store:
+    N client threads posting while others read must neither drop writes
+    nor error (the reference's spray/akka + HBase equivalent guarantee)."""
+    import concurrent.futures
+    import json as _json
+    import urllib.request
+
+    from predictionio_tpu.storage.registry import Storage
+
+    storage = Storage({"PIO_TPU_HOME": str(tmp_path)})
+    md = storage.get_metadata()
+    app = md.app_insert("concapp")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    server = EventServer(storage, EventServerConfig(port=0))
+    server.start_background()
+    base = f"http://127.0.0.1:{server.config.port}"
+
+    def post_one(k):
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}",
+            data=_json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": f"cu{k}", "targetEntityType": "item",
+                "targetEntityId": f"ci{k % 7}",
+                "properties": {"rating": float(k % 5 + 1)},
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status
+
+    def read_some(_):
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}&limit=20"
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, len(_json.loads(r.read().decode()))
+
+    try:  # server must stop even when an assertion fires mid-test
+        n = 120
+        with concurrent.futures.ThreadPoolExecutor(max_workers=12) as ex:
+            writes = [ex.submit(post_one, k) for k in range(n)]
+            reads = [ex.submit(read_some, k) for k in range(20)]
+            assert all(f.result() == 201 for f in writes)
+            assert all(f.result()[0] == 200 for f in reads)
+
+        # every write landed
+        req = urllib.request.Request(
+            f"{base}/events.json?accessKey={key}&limit=-1&event=rate"
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            got = _json.loads(r.read().decode())
+        assert sum(1 for e in got if e["entityId"].startswith("cu")) == n
+    finally:
+        server.stop()

@@ -316,3 +316,32 @@ def test_status_page_html_for_browsers(deployed):
     # JSON clients are unaffected
     status, body = _get(f"{base}/")
     assert status == 200 and body["status"] == "alive"
+
+
+def test_concurrent_queries(deployed):
+    """Concurrent /queries.json requests: the threading server + cached
+    device tables + shared jit executables must serve in parallel without
+    errors or cross-request corruption."""
+    import concurrent.futures
+
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+
+    def query(u):
+        status, body = _post(f"{base}/queries.json",
+                             {"user": f"u{u % 8}", "num": 3})
+        assert status == 200
+        scores = [s["score"] for s in body["itemScores"]]
+        assert scores == sorted(scores, reverse=True)
+        return body
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=10) as ex:
+        results = list(ex.map(query, range(60)))
+    # same user -> same answer regardless of interleaving
+    by_user = {}
+    for u, body in zip(range(60), results):
+        k = u % 8
+        if k in by_user:
+            assert body == by_user[k]
+        else:
+            by_user[k] = body

@@ -16,7 +16,7 @@ Re-expression of reference `controller/Engine.scala` (class `Engine`
 from __future__ import annotations
 
 import logging
-from typing import Any, Generic, Mapping, Optional, Sequence, Tuple, TypeVar
+from typing import Any, Generic, Mapping, Optional, Sequence, Tuple
 
 from .base import (
     A,
@@ -25,7 +25,6 @@ from .base import (
     EI,
     FirstServing,
     IdentityPreparator,
-    M,
     P,
     PD,
     Preparator,
@@ -37,7 +36,7 @@ from .base import (
     WorkflowContext,
     instantiate,
 )
-from .params import EmptyParams, Params, extract_params
+from .params import Params, extract_params
 
 logger = logging.getLogger(__name__)
 

@@ -12,9 +12,9 @@ from __future__ import annotations
 
 import itertools
 import logging
-from typing import Any, Sequence, Tuple
+from typing import Any
 
-from .base import WorkflowContext, instantiate
+from .base import WorkflowContext
 from .engine import Engine, EngineParams
 
 logger = logging.getLogger(__name__)

@@ -9,7 +9,6 @@ land in NumPy and reduce in one shot).
 
 from __future__ import annotations
 
-import math
 from typing import Any, Generic, Optional, Sequence, Tuple, TypeVar
 
 import numpy as np

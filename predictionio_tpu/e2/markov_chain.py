@@ -5,7 +5,6 @@ from __future__ import annotations
 
 from typing import Sequence
 
-import numpy as np
 
 from ..models.markov import MarkovChainModel, train_markov_chain
 from ..storage.bimap import StringIndex

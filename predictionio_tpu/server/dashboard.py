@@ -11,7 +11,6 @@ from __future__ import annotations
 import html as _html
 import json
 import logging
-import threading
 import urllib.parse
 from typing import Optional
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import contextlib
 import datetime as _dt
-import itertools
 import json
 import sqlite3
 import threading

@@ -14,7 +14,7 @@ RDDs with ``saveAsObjectFile``; here: one ``.npz``).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Optional, Sequence
+from typing import Any, Optional
 
 import numpy as np
 

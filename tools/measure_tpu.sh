@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Full TPU measurement battery — run when the accelerator is reachable.
+# Captures, in order: the north-star number (recorded to
+# BENCH_HISTORY.jsonl automatically), the phase breakdown + profiler
+# trace, the f32-vs-bf16 gather A/B, the xla-vs-pallas solver grid, and
+# serving latency.  Outputs land in $OUT (default ./tpu_measurements).
+#
+# Paste the JSON into docs/ARCHITECTURE.md ("Measured performance") and
+# SERVING_BENCH.md; flip ALSConfig.solver / gather_dtype defaults if the
+# measurements say so.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${OUT:-tpu_measurements}"
+mkdir -p "$OUT"
+run() {
+  name=$1; shift
+  echo "=== $name: $*" | tee -a "$OUT/log.txt"
+  timeout "${STEP_TIMEOUT:-1200}" "$@" > "$OUT/$name.json" 2> >(tail -40 >> "$OUT/log.txt")
+  echo "--- rc=$? -> $OUT/$name.json" | tee -a "$OUT/log.txt"
+}
+
+run north_star        python bench.py --verbose
+run breakdown         python bench.py --breakdown --profile "$OUT/trace"
+run breakdown_bf16    python bench.py --breakdown --gather-dtype bfloat16
+run north_star_bf16   python bench.py --inner --gather-dtype bfloat16 --verbose
+run solver_grid       python bench_solver.py
+run serving           python bench_serving.py --verbose
+echo "done; review $OUT/*.json and update docs"

@@ -193,6 +193,11 @@ def test_eval_via_cli(cli, tmp_path, monkeypatch):
     assert code == 0
     assert "[7.0]" in out
     assert "Evaluation completed" in out
+    # parallel sweep: same winner through the CLI flag
+    code, out = run("eval", "cli_eval_mod.make_eval", "cli_eval_mod.Gen",
+                    "--parallelism", "2")
+    assert code == 0
+    assert "[7.0]" in out
 
 
 def test_template_list_and_get(cli, tmp_path):

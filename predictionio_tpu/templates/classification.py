@@ -123,6 +123,9 @@ class NaiveBayesAlgorithm(Algorithm):
         label = model.predict(np.asarray(query.features, np.float32))[0]
         return PredictedResult(label=label)
 
+    def batch_predict(self, model, queries):
+        return _batch_classify(model, queries)
+
 
 @dataclass(frozen=True)
 class LogisticParams(Params):
@@ -147,6 +150,9 @@ class LogisticAlgorithm(Algorithm):
     def predict(self, model, query: Query) -> PredictedResult:
         label = model.predict(np.asarray(query.features, np.float32))[0]
         return PredictedResult(label=label)
+
+    def batch_predict(self, model, queries):
+        return _batch_classify(model, queries)
 
 
 @dataclass(frozen=True)
@@ -194,6 +200,25 @@ class RandomForestAlgorithm(Algorithm):
             model["forest"], np.asarray([query.features], np.float32)
         )[0]
         return PredictedResult(label=model["classes"][int(ix)])
+
+    def batch_predict(self, model, queries):
+        """Eval path: the whole query set through ONE jitted forest walk."""
+        if not queries:
+            return []
+        X = np.asarray([q.features for q in queries], np.float32)
+        ixs = forest_predict(model["forest"], X)
+        return [
+            PredictedResult(label=model["classes"][int(i)]) for i in ixs
+        ]
+
+
+def _batch_classify(model, queries):
+    """Eval path: one vectorized model.predict for the whole query set
+    (the reference's batchPredict analogue; the base class would loop)."""
+    if not queries:
+        return []
+    X = np.asarray([q.features for q in queries], np.float32)
+    return [PredictedResult(label=l) for l in model.predict(X)]
 
 
 def classification_engine() -> Engine:

@@ -358,3 +358,39 @@ def test_prepare_deploy_components_wires_ctx(ecomm_ctx):
     assert algos[0]._ctx is ctx
     res = algos[0].predict(models[0], Query(user="u0", num=3))
     assert res.item_scores  # reads seen-events from ctx storage, no crash
+
+
+def test_classification_batch_predict_matches_scalar():
+    """All three classification algorithms vectorize batch_predict; the
+    eval path must agree exactly with per-query predict."""
+    import numpy as np
+
+    from predictionio_tpu.controller.base import instantiate
+    from predictionio_tpu.templates.classification import (
+        ClassificationTrainingData,
+        LogisticAlgorithm,
+        LogisticParams,
+        NaiveBayesAlgorithm,
+        NaiveBayesParams,
+        Query,
+        RandomForestAlgorithm,
+        RandomForestParams,
+    )
+
+    rng = np.random.default_rng(0)
+    X = np.vstack([
+        rng.multinomial(20, [0.8, 0.1, 0.1], size=60),
+        rng.multinomial(20, [0.1, 0.1, 0.8], size=60),
+    ]).astype(np.float32)
+    labels = np.asarray(["a"] * 60 + ["b"] * 60, dtype=object)
+    data = ClassificationTrainingData(features=X, labels=labels)
+    queries = [Query(features=tuple(row)) for row in X[::7]]
+    for cls, params in ((NaiveBayesAlgorithm, NaiveBayesParams()),
+                        (LogisticAlgorithm, LogisticParams()),
+                        (RandomForestAlgorithm, RandomForestParams())):
+        algo = instantiate(cls, params)
+        model = algo.train(None, data)
+        batch = algo.batch_predict(model, queries)
+        singles = [algo.predict(model, q) for q in queries]
+        assert [b.label for b in batch] == [s.label for s in singles], cls
+        assert algo.batch_predict(model, []) == []

@@ -30,6 +30,9 @@ def main() -> None:
     ap.add_argument("--rank", type=int, default=64)
     ap.add_argument("--n", type=int, default=200, help="timed queries")
     ap.add_argument("--num", type=int, default=10, help="top-k per query")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="also measure batch_predict at this batch size "
+                    "(the eval-path throughput)")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
@@ -91,6 +94,27 @@ def main() -> None:
             }
         )
     )
+
+    if args.batch > 0:
+        qs = [Query(user=f"u{int(u)}", num=args.num)
+              for u in rng.integers(0, args.users, args.batch)]
+        algo.batch_predict(model, qs)  # warm the batched executable
+        reps = max(200 // args.batch, 3)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            rb = algo.batch_predict(model, qs)
+        dt = time.perf_counter() - t0
+        assert all(len(r.item_scores) == args.num for r in rb)
+        print(
+            json.dumps(
+                {
+                    "metric": "serving_batch_queries_per_s",
+                    "value": round(reps * args.batch / dt, 1),
+                    "unit": "queries/s",
+                    "batch": args.batch,
+                }
+            )
+        )
 
 
 if __name__ == "__main__":

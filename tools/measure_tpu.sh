@@ -24,5 +24,5 @@ run breakdown         python bench.py --breakdown --profile "$OUT/trace"
 run breakdown_bf16    python bench.py --breakdown --gather-dtype bfloat16
 run north_star_bf16   python bench.py --inner --gather-dtype bfloat16 --verbose
 run solver_grid       python bench_solver.py
-run serving           python bench_serving.py --verbose
+run serving           python bench_serving.py --verbose --batch 64
 echo "done; review $OUT/*.json and update docs"

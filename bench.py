@@ -280,12 +280,20 @@ def _probe_accelerator(timeout: int = PROBE_TIMEOUT):
     return None, (proc.stderr.strip().splitlines() or ["backend init failed"])[-1]
 
 
-def _run_inner_subprocess(extra_args, timeout):
-    """Run ``bench.py --inner`` under a timeout; returns (json_line, err)."""
+def _run_inner_subprocess(extra_args, timeout, cpu_only=False):
+    """Run ``bench.py --inner`` under a timeout; returns (json_line, err).
+
+    ``cpu_only`` boots the subprocess with a plugin-free interpreter (see
+    plugin_env module docstring) so a down TPU tunnel can't hang it."""
+    from plugin_env import scrub_plugin_env
+
     cmd = [sys.executable, str(Path(__file__).resolve()), "--inner"] + extra_args
+    env = dict(os.environ)
+    if cpu_only:
+        scrub_plugin_env(env)
     try:
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout
+            cmd, capture_output=True, text=True, timeout=timeout, env=env
         )
     except subprocess.TimeoutExpired:
         return None, f"timed out after {timeout}s"
@@ -336,6 +344,12 @@ def _last_accelerator_measurement():
 
 def main() -> None:
     args = _parse_args()
+    if args.platform == "cpu":
+        # explicit CPU runs must not touch the accelerator plugin either —
+        # re-exec with a plugin-free interpreter before jax is imported
+        from plugin_env import reexec_without_plugin
+
+        reexec_without_plugin()
     if args.breakdown:
         run_breakdown(args)
         return
@@ -366,7 +380,7 @@ def main() -> None:
         "--iters", str(args.iters), "--seed", str(args.seed),
         "--platform", "cpu",
     ] + (["--verbose"] if args.verbose else [])
-    line, err = _run_inner_subprocess(cpu_args, CPU_RUN_TIMEOUT)
+    line, err = _run_inner_subprocess(cpu_args, CPU_RUN_TIMEOUT, cpu_only=True)
     if line is not None:
         rec = json.loads(line)
         rec["error"] = f"accelerator unavailable: {probe_err}"

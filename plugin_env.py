@@ -1,0 +1,66 @@
+"""Accelerator-plugin interpreter hygiene. jax-free; safe to import anywhere.
+
+The axon TPU plugin registers itself at interpreter boot via sitecustomize,
+keyed off a trigger env var.  Once that registration has happened, even
+``import jax`` under ``JAX_PLATFORMS=cpu`` can block indefinitely on the
+plugin's remote handshake when the TPU tunnel is down — post-boot env
+overrides are too late.  The only reliable isolation for a CPU-only process
+is a fresh interpreter booted WITHOUT the trigger var.  Two tools:
+
+* :func:`scrub_plugin_env` — drop the trigger vars from an env dict that is
+  about to be handed to a CPU-bound subprocess.
+* :func:`reexec_without_plugin` — one-shot ``os.execve`` of the current
+  process with the trigger vars removed (used by entry points that decide
+  *in-process* they only need CPU, before anything imports jax).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# every var that makes the accelerator sitecustomize register its plugin;
+# update HERE when the plugin adds/renames triggers
+PLUGIN_TRIGGER_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+_REEXEC_SENTINEL = "_PIO_TPU_PLUGIN_REEXEC"
+
+
+def plugin_env_active() -> bool:
+    """True when the current interpreter booted with the plugin registered.
+
+    Truthiness (not presence) on purpose: the sitecustomize gates its
+    ``register()`` call on ``os.environ.get(var)``, so an empty-string var
+    never registered a plugin and needs no scrubbing."""
+    return any(os.environ.get(v) for v in PLUGIN_TRIGGER_VARS)
+
+
+def scrub_plugin_env(env: dict) -> dict:
+    """Remove accelerator-plugin trigger vars from ``env`` (in place)."""
+    for v in PLUGIN_TRIGGER_VARS:
+        env.pop(v, None)
+    return env
+
+
+def reexec_without_plugin() -> None:
+    """Re-exec the current process with a plugin-free interpreter, once.
+
+    No-op when the plugin was never triggered, when this process already
+    re-exec'd, or when jax is already imported (in which case the import
+    didn't hang, so the plugin isn't blocking anything).  Also skipped when
+    ``sys.argv`` cannot round-trip through ``python argv`` — e.g. ``-c``
+    invocations or embedded runners whose argv[0] is not a real script —
+    since re-execing those would run the wrong program; such callers must
+    scrub the env themselves before spawning CPU work.
+    """
+    if (
+        not plugin_env_active()
+        or os.environ.get(_REEXEC_SENTINEL) == "1"
+        or "jax" in sys.modules
+    ):
+        return
+    if not sys.argv or not os.path.exists(sys.argv[0]):
+        return
+    env = scrub_plugin_env(dict(os.environ))
+    env[_REEXEC_SENTINEL] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)

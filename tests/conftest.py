@@ -5,6 +5,16 @@ stands in for a cluster (reference `core/src/test/.../BaseTest.scala:14-74`).
 """
 
 import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+# Tests are CPU-only: boot a plugin-free interpreter so a down TPU tunnel
+# can't hang `import jax` (see plugin_env module docstring).
+from plugin_env import reexec_without_plugin  # noqa: E402
+
+reexec_without_plugin()
 
 # Force-set (not setdefault): the axon TPU plugin exports JAX_PLATFORMS=axon
 # and registers itself in sitecustomize, so we must override both the env var

@@ -76,6 +76,15 @@ def _parse_args(argv=None):
                     choices=("float32", "bfloat16"),
                     help="ALS opposite-table gather dtype; A/B the "
                     "bandwidth optimization")
+    ap.add_argument("--staging", default="auto",
+                    choices=("auto", "host", "device"),
+                    help="COO staging path: host counting-sort vs compact "
+                    "transfer + on-device sort (auto: device at this "
+                    "bench's full scale)")
+    ap.add_argument("--solver", default=None,
+                    choices=("xla", "pallas"),
+                    help="batched SPD solver override (default: "
+                    "ALSConfig default)")
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument(
         "--platform",
@@ -130,9 +139,10 @@ def _prepare(args):
         )
     mesh = make_mesh()
     mesh = mesh if mesh.size > 1 else None
+    extra = {"solver": args.solver} if args.solver else {}
     cfg = ALSConfig(
         rank=args.rank, num_iterations=args.iters, lam=0.01,
-        seed=args.seed, gather_dtype=args.gather_dtype,
+        seed=args.seed, gather_dtype=args.gather_dtype, **extra,
     )
     return jax, (u, i, v, n_users, n_items), mesh, cfg
 
@@ -141,10 +151,18 @@ def run_breakdown(args) -> None:
     """Phase-by-phase timing of the north-star train (VERDICT r1 item 2:
     'what's the bottleneck: solves, gathers, or scatter?' — this is the
     measurement half; run it on the real chip and paste the JSON into
-    docs/ARCHITECTURE.md).  Prints one JSON line per phase."""
+    docs/ARCHITECTURE.md).  Prints one JSON line per phase.
+
+    Every phase boundary is a ``fence`` (tiny d2h), never
+    ``block_until_ready`` — the latter is a no-op through the axon tunnel,
+    which made round-2's first breakdown report dispatch times (and a
+    physically impossible 1045 TFLOP/s).  Steady state is timed as ONE
+    span over iters-1 iterations with a single closing fence, so the
+    per-iteration figure isn't polluted by per-step host round-trips."""
     t0 = time.time()
     jax, (u, i, v, n_users, n_items), mesh, cfg = _prepare(args)
     from predictionio_tpu.models.als import ALSTrainer
+    from predictionio_tpu.parallel.mesh import fence
 
     def emit(phase, seconds, **kw):
         print(json.dumps({"metric": "als_phase_seconds", "phase": phase,
@@ -153,25 +171,32 @@ def run_breakdown(args) -> None:
     emit("setup_and_synth_data", time.time() - t0)
 
     t0 = time.time()
-    trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh)
-    emit("bucketize_and_stage", time.time() - t0)
+    trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
+                         staging=args.staging)
+    emit("bucketize_and_stage_dispatch", time.time() - t0,
+         staging=trainer.staging)
 
     t0 = time.time()
     U, V = trainer.init_factors()
-    jax.block_until_ready((U, V))
+    fence(U, V)
     emit("init_factors", time.time() - t0)
 
-    # first compile: one half-iteration per side
+    # first compile + wait for staged arrays: one half-iteration per side
     t0 = time.time()
     U1 = trainer._half(U, V, trainer._user_side)
-    U1.block_until_ready()
-    emit("user_half_first_incl_compile", time.time() - t0)
+    fence(U1)
+    emit("user_half_first_incl_compile_and_staging", time.time() - t0)
     t0 = time.time()
     V1 = trainer._half(V, U1, trainer._item_side)
-    V1.block_until_ready()
+    fence(V1)
     emit("item_half_first_incl_compile", time.time() - t0)
 
-    # steady state: per-side medians over the remaining iterations
+    # fence cost: subtracted from the steady-state span below
+    t0 = time.time()
+    fence(U1)
+    rtt = time.time() - t0
+    emit("fence_round_trip", rtt)
+
     import contextlib
 
     prof = (
@@ -179,33 +204,23 @@ def run_breakdown(args) -> None:
         if args.profile
         else contextlib.nullcontext()
     )
-    sides = {"user_half_steady": [], "item_half_steady": []}
+    n_steady = max(args.iters - 1, 1)
     with prof:
-        for _ in range(max(args.iters - 1, 1)):
-            t0 = time.time()
-            U1 = trainer._half(U1, V1, trainer._user_side)
-            U1.block_until_ready()
-            sides["user_half_steady"].append(time.time() - t0)
-            t0 = time.time()
-            V1 = trainer._half(V1, U1, trainer._item_side)
-            V1.block_until_ready()
-            sides["item_half_steady"].append(time.time() - t0)
+        t0 = time.time()
+        Us, Vs = trainer.run(U1, V1, n_steady)   # run() fences at the end
+        span = time.time() - t0
     if args.profile:
         print(json.dumps({"metric": "profile_trace_dir",
                           "value": args.profile}), flush=True)
-    for phase, ts in sides.items():
-        ts.sort()
-        emit(phase, ts[len(ts) // 2], n=len(ts),
-             total=round(sum(ts), 4))
+    per_iter = (span - rtt) / n_steady
+    emit("steady_iteration", per_iter, n=n_steady, total=round(span, 4))
     nnz = len(v)
     flops_iter = 2 * (2 * nnz * args.rank ** 2) + (
         (n_users + n_items) * 2 * args.rank ** 3 // 3
     )
-    steady = sides["user_half_steady"][len(sides["user_half_steady"]) // 2] \
-        + sides["item_half_steady"][len(sides["item_half_steady"]) // 2]
     print(json.dumps({
         "metric": "als_derived_tflops_per_s",
-        "value": flops_iter / steady / 1e12,
+        "value": round(flops_iter / per_iter / 1e12, 3),
         "platform": str(jax.devices()[0].platform),
     }), flush=True)
 
@@ -216,14 +231,18 @@ def run_inner(args) -> None:
     from predictionio_tpu.models.als import ALSFactors, ALSTrainer, rmse
 
     # warmup: compile both half-iteration executables (one per direction)
-    warm = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh)
+    warm = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
+                      staging=args.staging)
     wU, wV = warm.init_factors()
     warm.run(wU, wV, 1)
     del warm, wU, wV
 
-    # timed: full train — staging + 20 iterations (compiles now cached)
+    # timed: full train — staging + 20 iterations (compiles now cached).
+    # trainer.run() ends with a fence (tiny d2h), so dt includes the full
+    # device execution, not just dispatch — see parallel/mesh.py fence.
     t0 = time.time()
-    trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh)
+    trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
+                         staging=args.staging)
     U, V = trainer.init_factors()
     U, V = trainer.run(U, V, cfg.num_iterations)
     dt = time.time() - t0
@@ -248,6 +267,8 @@ def run_inner(args) -> None:
                 ),
                 "platform": jax.default_backend(),
                 "scale": args.scale,
+                "staging": trainer.staging,
+                "solver": cfg.solver,
             }
         )
     )
@@ -257,9 +278,12 @@ def _probe_accelerator(timeout: int = PROBE_TIMEOUT):
     """Init the default jax backend in a subprocess; returns the platform
     name (e.g. 'tpu', 'axon') or None if init fails/hangs."""
     code = (
+        # fetch a value, don't block_until_ready: the latter is a no-op on
+        # remote-tunnel backends, which would pass the probe while compute
+        # is actually unreachable
         "import jax, jax.numpy as jnp\n"
         "x = jnp.ones((256, 256))\n"
-        "(x @ x).block_until_ready()\n"
+        "assert float((x @ x)[0, 0]) == 256.0\n"
         "print('PLATFORM=' + jax.default_backend())\n"
     )
     try:
@@ -321,6 +345,10 @@ def _record_history(line: str) -> None:
             rec["recorded_at"] = time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             )
+            # records from before the fence fix measured dispatch, not
+            # compute (they carry no "fenced" key); everything recorded
+            # through this path now is a true device-complete timing
+            rec["fenced"] = True
             with open(HISTORY_PATH, "a") as f:
                 f.write(json.dumps(rec) + "\n")
     except Exception:
@@ -335,7 +363,9 @@ def _last_accelerator_measurement():
         last = None
         for ln in HISTORY_PATH.read_text().splitlines():
             rec = json.loads(ln)
-            if rec.get("scale", 0) >= 1.0:
+            # unfenced records measured dispatch, not compute — never
+            # resurface them as "the accelerator number exists"
+            if rec.get("scale", 0) >= 1.0 and rec.get("fenced"):
                 last = rec
         return last
     except Exception:
@@ -362,7 +392,9 @@ def main() -> None:
     common = [
         "--scale", str(args.scale), "--rank", str(args.rank),
         "--iters", str(args.iters), "--seed", str(args.seed),
-    ] + (["--verbose"] if args.verbose else [])
+        "--gather-dtype", args.gather_dtype, "--staging", args.staging,
+    ] + (["--solver", args.solver] if args.solver else []) \
+      + (["--verbose"] if args.verbose else [])
 
     platform, probe_err = _probe_accelerator()
     if platform is not None:

@@ -71,19 +71,30 @@ def main() -> None:
                 + 10 * np.eye(R, dtype=np.float32)
             )
             b = jax.device_put(rng.normal(size=(B, R)).astype(np.float32))
-            x1 = jax.block_until_ready(xla_j(A, b))
-            x2 = jax.block_until_ready(cholesky_solve_batched(A, b))
+            from predictionio_tpu.parallel.mesh import fence
+
+            x1 = xla_j(A, b)
+            fence(x1)
+            x2 = cholesky_solve_batched(A, b)
+            fence(x2)
             err = float(jnp.max(jnp.abs(x1 - x2)))
-            times = {"xla": [], "pallas": []}
-            for _ in range(args.reps):
+            # fence (tiny d2h) instead of block_until_ready — the latter is
+            # a no-op on remote-tunnel backends.  Time all reps as one span
+            # with a single closing fence so the per-solve figure excludes
+            # the host round-trip, then subtract the measured fence cost.
+            t0 = time.perf_counter()
+            fence(x1)
+            rtt = time.perf_counter() - t0
+
+            def timed(fn):
                 t0 = time.perf_counter()
-                jax.block_until_ready(xla_j(A, b))
-                times["xla"].append(time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                jax.block_until_ready(cholesky_solve_batched(A, b))
-                times["pallas"].append(time.perf_counter() - t0)
-            xm = sorted(times["xla"])[args.reps // 2] * 1e3
-            pm = sorted(times["pallas"])[args.reps // 2] * 1e3
+                for _ in range(args.reps):
+                    x = fn(A, b)
+                fence(x)
+                return max(time.perf_counter() - t0 - rtt, 0.0) / args.reps
+
+            xm = timed(xla_j) * 1e3
+            pm = timed(cholesky_solve_batched) * 1e3
             wins.setdefault(R, []).append(xm / pm)
             print(json.dumps({
                 "metric": "spd_solve_batched_ms",

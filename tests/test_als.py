@@ -456,3 +456,61 @@ def test_gather_dtype_typo_rejected():
 
     with pytest.raises(ValueError, match="gather_dtype"):
         ALSConfig(gather_dtype="bf16")
+
+
+def test_device_staging_matches_host_staging():
+    """staging="device" (compact transfer + on-device sort) must train to
+    the same factors as the host counting-sort path, including on a mesh
+    and with half-star ratings that take the uint8 encode path."""
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy(n_users=40, n_items=30, density=0.5)
+    v = (np.round(np.clip(np.abs(v), 0.5, 5.0) * 2) / 2).astype(np.float32)
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1)
+
+    host = ALSTrainer((u, i, v), nu, ni, cfg, staging="host")
+    dev = ALSTrainer((u, i, v), nu, ni, cfg, staging="device")
+    hU, hV = host.run(*host.init_factors(), cfg.num_iterations)
+    dU, dV = dev.run(*dev.init_factors(), cfg.num_iterations)
+    np.testing.assert_allclose(np.asarray(hU), np.asarray(dU),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hV), np.asarray(dV),
+                               rtol=1e-4, atol=1e-5)
+
+    mesh = make_mesh()
+    host_m = ALSTrainer((u, i, v), nu, ni, cfg, mesh=mesh, staging="host")
+    dev_m = ALSTrainer((u, i, v), nu, ni, cfg, mesh=mesh, staging="device")
+    hUm, _ = host_m.run(*host_m.init_factors(), cfg.num_iterations)
+    dUm, _ = dev_m.run(*dev_m.init_factors(), cfg.num_iterations)
+    np.testing.assert_allclose(np.asarray(hUm), np.asarray(dUm),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_device_staging_non_halfstar_values():
+    """Arbitrary float ratings must skip the uint8 encode and still match."""
+    u, i, v, nu, ni = _toy(seed=3)
+    cfg = ALSConfig(rank=3, num_iterations=2, lam=0.2)
+    host = ALSTrainer((u, i, v), nu, ni, cfg, staging="host")
+    dev = ALSTrainer((u, i, v), nu, ni, cfg, staging="device")
+    hU, hV = host.run(*host.init_factors(), cfg.num_iterations)
+    dU, dV = dev.run(*dev.init_factors(), cfg.num_iterations)
+    np.testing.assert_allclose(np.asarray(hU), np.asarray(dU),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_device_staging_sharded_placement():
+    """Device staging composes with ALX-style sharded factor tables."""
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy(n_users=32, n_items=24, density=0.5, seed=1)
+    cfg = ALSConfig(rank=4, num_iterations=2, lam=0.1,
+                    factor_placement="sharded")
+    mesh = make_mesh()
+    sh = ALSTrainer((u, i, v), nu, ni, cfg, mesh=mesh, staging="device")
+    rep = ALSTrainer((u, i, v), nu, ni,
+                     ALSConfig(rank=4, num_iterations=2, lam=0.1),
+                     staging="host")
+    sU, _ = sh.run(*sh.init_factors(), cfg.num_iterations)
+    rU, _ = rep.run(*rep.init_factors(), cfg.num_iterations)
+    np.testing.assert_allclose(np.asarray(sU)[:nu], np.asarray(rU),
+                               rtol=1e-3, atol=1e-4)

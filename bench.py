@@ -85,6 +85,10 @@ def _parse_args(argv=None):
                     choices=("xla", "pallas"),
                     help="batched SPD solver override (default: "
                     "ALSConfig default)")
+    ap.add_argument("--precision", default=None,
+                    choices=("highest", "high", "default"),
+                    help="Gram-einsum MXU precision override "
+                    "(highest=f32, high=bf16x3, default=bf16)")
     ap.add_argument("--verbose", action="store_true")
     ap.add_argument(
         "--platform",
@@ -139,7 +143,11 @@ def _prepare(args):
         )
     mesh = make_mesh()
     mesh = mesh if mesh.size > 1 else None
-    extra = {"solver": args.solver} if args.solver else {}
+    extra = {}
+    if args.solver:
+        extra["solver"] = args.solver
+    if args.precision:
+        extra["matmul_precision"] = args.precision
     cfg = ALSConfig(
         rank=args.rank, num_iterations=args.iters, lam=0.01,
         seed=args.seed, gather_dtype=args.gather_dtype, **extra,
@@ -394,6 +402,7 @@ def main() -> None:
         "--iters", str(args.iters), "--seed", str(args.seed),
         "--gather-dtype", args.gather_dtype, "--staging", args.staging,
     ] + (["--solver", args.solver] if args.solver else []) \
+      + (["--precision", args.precision] if args.precision else []) \
       + (["--verbose"] if args.verbose else [])
 
     platform, probe_err = _probe_accelerator()

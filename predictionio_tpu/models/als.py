@@ -47,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, fence, pad_to_multiple
+from ..parallel.mesh import DATA_AXIS, fence, pad_to_multiple, replicated
 from ..storage.columnar import Ratings
 
 logger = logging.getLogger(__name__)
@@ -607,6 +607,21 @@ class ALSTrainer:
                 f"{len(v):,} ratings exceed the int32 offset range of a "
                 "single bucket layout; shard the COO across hosts first"
             )
+        # the host path's counting sort validates id ranges; match it here
+        # BEFORE the uint16 compaction can wrap an oversized id silently
+        if len(v):
+            u = np.asarray(u)
+            i = np.asarray(i)
+            if int(u.min()) < 0 or int(u.max()) >= self.n_users:
+                raise ValueError(
+                    f"user ids must be in [0, {self.n_users}); "
+                    f"got [{int(u.min())}, {int(u.max())}]"
+                )
+            if int(i.min()) < 0 or int(i.max()) >= self.n_items:
+                raise ValueError(
+                    f"item ids must be in [0, {self.n_items}); "
+                    f"got [{int(i.min())}, {int(i.max())}]"
+                )
         counts_u = np.bincount(u, minlength=nu).astype(np.int64)
         counts_i = np.bincount(i, minlength=ni).astype(np.int64)
         starts_u = np.concatenate(
@@ -641,8 +656,6 @@ class ALSTrainer:
         v_scale = 0.5 if half_star else 1.0
 
         if self.mesh is not None:
-            from ..parallel.mesh import replicated
-
             put = lambda x: jax.device_put(x, replicated(self.mesh))  # noqa: E731
         else:
             put = jax.device_put
@@ -666,8 +679,6 @@ class ALSTrainer:
     def _stage_side(self, c_sorted, v_sorted, buckets):
         """Place one side's arrays; accepts host or already-device arrays."""
         if self.mesh is not None:
-            from ..parallel.mesh import replicated
-
             rep = replicated(self.mesh)
             dp = NamedSharding(self.mesh, P(DATA_AXIS))
             put_rep = lambda x: jax.device_put(x, rep)  # noqa: E731
@@ -705,8 +716,6 @@ class ALSTrainer:
             sh = NamedSharding(self.mesh, P(DATA_AXIS, None))
             return jax.device_put(U, sh), jax.device_put(V, sh)
         if self.mesh is not None:
-            from ..parallel.mesh import replicated
-
             U = jax.device_put(U, replicated(self.mesh))
             V = jax.device_put(V, replicated(self.mesh))
         return U, V

@@ -1,13 +1,14 @@
 #!/usr/bin/env bash
 # Full TPU measurement battery — run when the accelerator is reachable.
 # Captures, in order: the north-star number (recorded to
-# BENCH_HISTORY.jsonl automatically), the phase breakdown + profiler
-# trace, the f32-vs-bf16 gather A/B, the xla-vs-pallas solver grid, and
-# serving latency.  Outputs land in $OUT (default ./tpu_measurements).
+# BENCH_HISTORY.jsonl automatically), the fenced phase breakdown +
+# profiler trace, the staging / solver / gather-dtype / precision A/Bs,
+# the xla-vs-pallas solver grid, and serving + ingest latency.  Outputs
+# land in $OUT (default ./tpu_measurements).
 #
 # Paste the JSON into docs/ARCHITECTURE.md ("Measured performance") and
-# SERVING_BENCH.md; flip ALSConfig.solver / gather_dtype defaults if the
-# measurements say so.
+# SERVING_BENCH.md; flip ALSConfig.solver / gather_dtype /
+# matmul_precision defaults if the measurements say so.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 OUT="${OUT:-tpu_measurements}"
@@ -19,10 +20,15 @@ run() {
   echo "--- rc=$? -> $OUT/$name.json" | tee -a "$OUT/log.txt"
 }
 
-run north_star        python bench.py --verbose
-run breakdown         python bench.py --breakdown --profile "$OUT/trace"
-run breakdown_bf16    python bench.py --breakdown --gather-dtype bfloat16
-run north_star_bf16   python bench.py --inner --gather-dtype bfloat16 --verbose
-run solver_grid       python bench_solver.py
-run serving           python bench_serving.py --verbose --batch 64
+# headline: device staging (the default at full scale), then the A/Bs
+run north_star          python bench.py --verbose
+run breakdown           python bench.py --breakdown --profile "$OUT/trace"
+run breakdown_host_stage python bench.py --breakdown --staging host
+run breakdown_pallas    python bench.py --breakdown --solver pallas
+run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16
+run breakdown_prec_high python bench.py --breakdown --precision high
+run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
+run solver_grid         python bench_solver.py
+run serving             python bench_serving.py --verbose --batch 64
+run ingest              python bench_ingest.py
 echo "done; review $OUT/*.json and update docs"

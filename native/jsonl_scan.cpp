@@ -1,0 +1,390 @@
+// Bulk JSON-lines event scanner: the native data-loader fast path.
+//
+// The reference's bulk import is a Spark job (FileToEvents.scala) whose
+// heavy lifting runs on JVM executors; this framework's equivalent is an
+// in-process C++ scanner.  The Python import path costs ~50 us/event in
+// object churn (dict -> Event -> validate -> re-serialize); this scanner
+// extracts the storage-row fields (and the raw `properties` JSON substring,
+// which the store keeps as text) in one pass at memory-bandwidth speed.
+//
+// Parity strategy: ONLY the clean common shape is handled natively —
+// flat JSON object, unescaped strings, ISO-8601 times, no tags, events
+// that pass every `validate_event` rule.  ANY deviation (escapes,
+// unknown keys are fine but malformed syntax, reserved-name violations,
+// missing required fields, weird timestamps, tags present) sets
+// status=1 and the Python caller re-parses that line with the exact
+// `Event.from_json` path, so error messages and edge semantics are
+// byte-identical to the pure-Python importer.
+//
+// Built into _native.so together with bucketize.cpp by
+// predictionio_tpu/native/__init__.py.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// field slots written per event (offsets into the input buffer + lengths)
+enum Field {
+    F_EVENT = 0,
+    F_ENTITY_TYPE,
+    F_ENTITY_ID,
+    F_TARGET_ENTITY_TYPE,
+    F_TARGET_ENTITY_ID,
+    F_PR_ID,
+    F_EVENT_ID,
+    F_PROPERTIES,   // raw JSON object substring
+    N_FIELDS
+};
+
+struct Span { int64_t off; int32_t len; };
+
+inline bool starts_with(const char* p, int32_t len, const char* pre) {
+    int32_t n = (int32_t)std::strlen(pre);
+    return len >= n && std::memcmp(p, pre, n) == 0;
+}
+
+inline bool is_reserved_prefix(const char* p, int32_t len) {
+    return (len >= 1 && p[0] == '$') || starts_with(p, len, "pio_");
+}
+
+inline bool span_eq(const char* buf, Span s, const char* lit) {
+    int32_t n = (int32_t)std::strlen(lit);
+    return s.len == n && std::memcmp(buf + s.off, lit, n) == 0;
+}
+
+inline const char* skip_ws(const char* p, const char* end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    return p;
+}
+
+// scan a JSON string starting at the opening quote; returns pointer past
+// the closing quote, or nullptr on escapes/control chars (-> fallback).
+const char* scan_simple_string(const char* p, const char* end, Span* out) {
+    if (p >= end || *p != '"') return nullptr;
+    ++p;
+    const char* s = p;
+    while (p < end) {
+        unsigned char c = (unsigned char)*p;
+        if (c == '"') {
+            out->off = -1;  // caller fills absolute offset
+            out->len = (int32_t)(p - s);
+            return p + 1;
+        }
+        if (c == '\\' || c < 0x20) return nullptr;  // escapes -> fallback
+        ++p;
+    }
+    return nullptr;
+}
+
+// skip a JSON value of any type; strings inside handle escapes (we don't
+// extract them, just need extents).  Returns past-the-value pointer or
+// nullptr on malformed input.
+const char* skip_value(const char* p, const char* end);
+
+const char* skip_string_any(const char* p, const char* end) {
+    if (p >= end || *p != '"') return nullptr;
+    ++p;
+    while (p < end) {
+        if (*p == '\\') { p += 2; continue; }
+        if (*p == '"') return p + 1;
+        ++p;
+    }
+    return nullptr;
+}
+
+const char* skip_container(const char* p, const char* end, char open, char close) {
+    int depth = 0;
+    while (p < end) {
+        char c = *p;
+        if (c == '"') { p = skip_string_any(p, end); if (!p) return nullptr; continue; }
+        if (c == open) ++depth;
+        else if (c == close) { --depth; if (depth == 0) return p + 1; }
+        ++p;
+    }
+    return nullptr;
+}
+
+const char* skip_value(const char* p, const char* end) {
+    p = skip_ws(p, end);
+    if (p >= end) return nullptr;
+    char c = *p;
+    if (c == '"') return skip_string_any(p, end);
+    if (c == '{') return skip_container(p, end, '{', '}');
+    if (c == '[') return skip_container(p, end, '[', ']');
+    // number / true / false / null: scan to a delimiter
+    const char* s = p;
+    while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
+           *p != '\t' && *p != '\r')
+        ++p;
+    return p > s ? p : nullptr;
+}
+
+// days-from-civil (Howard Hinnant's algorithm), for epoch-millis
+inline int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+    y -= m <= 2;
+    const int64_t era = (y >= 0 ? y : y - 399) / 400;
+    const int64_t yoe = y - era * 400;
+    const int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+    const int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return era * 146097 + doe - 719468;
+}
+
+inline int digits(const char* p, int n, int64_t* out) {
+    int64_t v = 0;
+    for (int i = 0; i < n; ++i) {
+        if (p[i] < '0' || p[i] > '9') return 0;
+        v = v * 10 + (p[i] - '0');
+    }
+    *out = v;
+    return 1;
+}
+
+// sentinel for "absent or unparseable": a real epoch-millis value can be
+// any other int64 (negative = pre-1970, which is legal and preserved)
+constexpr int64_t TIME_NONE = INT64_MIN;
+
+// "YYYY-MM-DDTHH:MM:SS(.f{1,9})?(Z|±HH:MM)" -> epoch millis;
+// TIME_NONE on parse failure (-> python fallback)
+int64_t parse_iso8601_ms(const char* p, int32_t len) {
+    const char* end = p + len;
+    int64_t Y, M, D, h, m, s;
+    if (len < 20) return TIME_NONE;
+    if (!digits(p, 4, &Y) || p[4] != '-' || !digits(p + 5, 2, &M) ||
+        p[7] != '-' || !digits(p + 8, 2, &D) || (p[10] != 'T' && p[10] != ' ') ||
+        !digits(p + 11, 2, &h) || p[13] != ':' || !digits(p + 14, 2, &m) ||
+        p[16] != ':' || !digits(p + 17, 2, &s))
+        return TIME_NONE;
+    if (M < 1 || M > 12 || D < 1 || D > 31 || h > 23 || m > 59 || s > 60)
+        return TIME_NONE;
+    p += 19;
+    int64_t ms = 0;
+    if (p < end && *p == '.') {
+        ++p;
+        int nd = 0;
+        int64_t frac = 0;
+        while (p < end && *p >= '0' && *p <= '9' && nd < 9) {
+            frac = frac * 10 + (*p - '0');
+            ++p; ++nd;
+        }
+        if (nd == 0) return TIME_NONE;
+        while (nd > 3) { frac /= 10; --nd; }
+        while (nd < 3) { frac *= 10; ++nd; }
+        ms = frac;
+    }
+    int64_t off_min = 0;
+    if (p < end && (*p == 'Z' || *p == 'z')) {
+        ++p;
+    } else if (p < end && (*p == '+' || *p == '-')) {
+        int sign = (*p == '-') ? -1 : 1;
+        ++p;
+        int64_t oh, om;
+        if (end - p < 5 || !digits(p, 2, &oh) || p[2] != ':' ||
+            !digits(p + 3, 2, &om))
+            return TIME_NONE;
+        off_min = sign * (oh * 60 + om);
+        p += 5;
+    } else {
+        return TIME_NONE;  // naive timestamps -> python decides the zone
+    }
+    if (p != end) return TIME_NONE;
+    int64_t days = days_from_civil(Y, M, D);
+    int64_t epoch_s = days * 86400 + h * 3600 + m * 60 + s - off_min * 60;
+    return epoch_s * 1000 + ms;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan up to max_events newline-separated JSON events from buf.
+//   field_off/field_len: [max_events * N_FIELDS], -1 len = absent
+//   event_ms/creation_ms: epoch millis (possibly negative: pre-1970);
+//     INT64_MIN = absent (caller fills now())
+//   line_off/line_len: the full line (for python fallback re-parse)
+//   status: 0 = native row ready, 1 = re-parse this line in python
+// Returns number of events scanned (== lines consumed, blank lines
+// skipped and not counted).  *consumed is set to the buffer offset just
+// past the last consumed line, so callers can chunk.
+int64_t pio_scan_events_jsonl(
+    const char* buf, int64_t len, int64_t max_events,
+    int64_t* field_off, int32_t* field_len,
+    int64_t* event_ms, int64_t* creation_ms,
+    int64_t* line_off, int32_t* line_len,
+    int32_t* status, int64_t* consumed
+) {
+    int64_t n = 0;
+    const char* cur = buf;
+    const char* bufend = buf + len;
+    while (cur < bufend && n < max_events) {
+        const char* line_start = cur;
+        const char* nl = (const char*)memchr(cur, '\n', bufend - cur);
+        const char* lend = nl ? nl : bufend;
+        cur = nl ? nl + 1 : bufend;
+
+        const char* p = skip_ws(line_start, lend);
+        // trailing \r already handled by skip_ws at the end checks below
+        const char* e = lend;
+        while (e > p && (e[-1] == ' ' || e[-1] == '\t' || e[-1] == '\r'))
+            --e;
+        if (p == e) continue;  // blank line: skip, don't count
+
+        int64_t* foff = field_off + n * N_FIELDS;
+        int32_t* flen = field_len + n * N_FIELDS;
+        for (int i = 0; i < N_FIELDS; ++i) { foff[i] = -1; flen[i] = -1; }
+        event_ms[n] = TIME_NONE;
+        creation_ms[n] = TIME_NONE;
+        line_off[n] = line_start - buf;
+        line_len[n] = (int32_t)(lend - line_start);
+        status[n] = 1;  // pessimistic: prove it clean below
+        int64_t idx = n++;
+
+        if (*p != '{') continue;
+        ++p;
+        bool ok = true;
+        bool saw_tags = false;
+        Span ev_time{-1, -1}, cr_time{-1, -1};
+        while (ok) {
+            p = skip_ws(p, e);
+            if (p < e && *p == '}') { ++p; break; }
+            Span key;
+            const char* q = scan_simple_string(p, e, &key);
+            if (!q) { ok = false; break; }
+            key.off = (p + 1) - buf;
+            const char* kp = buf + key.off;
+            p = skip_ws(q, e);
+            if (p >= e || *p != ':') { ok = false; break; }
+            p = skip_ws(p + 1, e);
+            if (p >= e) { ok = false; break; }
+
+            int slot = -1;
+            bool is_time = false, is_creation = false, is_props = false;
+            if (span_eq(buf, key, "event")) slot = F_EVENT;
+            else if (span_eq(buf, key, "entityType")) slot = F_ENTITY_TYPE;
+            else if (span_eq(buf, key, "entityId")) slot = F_ENTITY_ID;
+            else if (span_eq(buf, key, "targetEntityType")) slot = F_TARGET_ENTITY_TYPE;
+            else if (span_eq(buf, key, "targetEntityId")) slot = F_TARGET_ENTITY_ID;
+            else if (span_eq(buf, key, "prId")) slot = F_PR_ID;
+            else if (span_eq(buf, key, "eventId")) slot = F_EVENT_ID;
+            else if (span_eq(buf, key, "properties")) is_props = true;
+            else if (span_eq(buf, key, "eventTime")) is_time = true;
+            else if (span_eq(buf, key, "creationTime")) is_creation = true;
+            else if (span_eq(buf, key, "tags")) saw_tags = true;
+            (void)kp;
+
+            if (slot >= 0 || is_time || is_creation) {
+                if (*p == 'n') {  // null -> treat as absent
+                    const char* v = skip_value(p, e);
+                    if (!v) { ok = false; break; }
+                    p = v;
+                } else {
+                    Span val;
+                    const char* v = scan_simple_string(p, e, &val);
+                    if (!v) { ok = false; break; }
+                    val.off = (p + 1) - buf;
+                    if (slot >= 0) { foff[slot] = val.off; flen[slot] = val.len; }
+                    else if (is_time) ev_time = val;
+                    else cr_time = val;
+                    p = v;
+                }
+            } else if (is_props) {
+                p = skip_ws(p, e);
+                if (p < e && *p == '{') {
+                    const char* v = skip_container(p, e, '{', '}');
+                    if (!v) { ok = false; break; }
+                    foff[F_PROPERTIES] = p - buf;
+                    flen[F_PROPERTIES] = (int32_t)(v - p);
+                    p = v;
+                } else if (p < e && *p == 'n') {  // null
+                    const char* v = skip_value(p, e);
+                    if (!v) { ok = false; break; }
+                    p = v;
+                } else { ok = false; break; }
+            } else {
+                const char* v = skip_value(p, e);
+                if (!v) { ok = false; break; }
+                p = v;
+            }
+            p = skip_ws(p, e);
+            if (p < e && *p == ',') { ++p; continue; }
+            if (p < e && *p == '}') { ++p; break; }
+            ok = false;
+        }
+        if (!ok) continue;
+        p = skip_ws(p, e);
+        if (p != e) continue;           // trailing garbage -> fallback
+        if (saw_tags) continue;          // rare; python path handles tags
+
+        // ---- validate_event parity checks (any failure -> fallback so
+        // python raises with its canonical message) ----
+        if (flen[F_EVENT] <= 0 || flen[F_ENTITY_TYPE] <= 0 ||
+            flen[F_ENTITY_ID] <= 0)
+            continue;
+        if (flen[F_TARGET_ENTITY_TYPE] == 0 || flen[F_TARGET_ENTITY_ID] == 0)
+            continue;  // empty-string target fields
+        if ((flen[F_TARGET_ENTITY_TYPE] >= 0) !=
+            (flen[F_TARGET_ENTITY_ID] >= 0))
+            continue;  // must be specified together
+        const char* evp = buf + foff[F_EVENT];
+        int32_t evl = flen[F_EVENT];
+        bool special = span_eq(buf, Span{foff[F_EVENT], evl}, "$set") ||
+                       span_eq(buf, Span{foff[F_EVENT], evl}, "$unset") ||
+                       span_eq(buf, Span{foff[F_EVENT], evl}, "$delete");
+        if (is_reserved_prefix(evp, evl) && !special) continue;
+        if (special && flen[F_TARGET_ENTITY_TYPE] >= 0) continue;
+        const char* etp = buf + foff[F_ENTITY_TYPE];
+        if (is_reserved_prefix(etp, flen[F_ENTITY_TYPE]) &&
+            !span_eq(buf, Span{foff[F_ENTITY_TYPE], flen[F_ENTITY_TYPE]},
+                     "pio_pr"))
+            continue;
+        if (flen[F_TARGET_ENTITY_TYPE] > 0) {
+            const char* tp = buf + foff[F_TARGET_ENTITY_TYPE];
+            if (is_reserved_prefix(tp, flen[F_TARGET_ENTITY_TYPE]) &&
+                !span_eq(buf, Span{foff[F_TARGET_ENTITY_TYPE],
+                                   flen[F_TARGET_ENTITY_TYPE]}, "pio_pr"))
+                continue;
+        }
+        // properties: $unset must be non-empty; keys must not be reserved
+        bool props_empty = true;
+        if (flen[F_PROPERTIES] > 0) {
+            const char* pp = buf + foff[F_PROPERTIES];
+            const char* pe = pp + flen[F_PROPERTIES];
+            const char* q = skip_ws(pp + 1, pe);
+            bool bad_key = false;
+            while (q < pe && *q != '}') {
+                props_empty = false;
+                Span k;
+                const char* r = scan_simple_string(q, pe, &k);
+                if (!r) { bad_key = true; break; }
+                k.off = (q + 1) - buf;
+                if (is_reserved_prefix(buf + k.off, k.len)) { bad_key = true; break; }
+                q = skip_ws(r, pe);
+                if (q >= pe || *q != ':') { bad_key = true; break; }
+                q = skip_value(q + 1, pe);
+                if (!q) { bad_key = true; break; }
+                q = skip_ws(q, pe);
+                if (q < pe && *q == ',') q = skip_ws(q + 1, pe);
+            }
+            if (bad_key) continue;
+        }
+        if (span_eq(buf, Span{foff[F_EVENT], evl}, "$unset") && props_empty)
+            continue;
+
+        // times (TIME_NONE = unparseable -> python fallback)
+        if (ev_time.len > 0) {
+            int64_t ms = parse_iso8601_ms(buf + ev_time.off, ev_time.len);
+            if (ms == TIME_NONE) continue;
+            event_ms[idx] = ms;
+        }
+        if (cr_time.len > 0) {
+            int64_t ms = parse_iso8601_ms(buf + cr_time.off, cr_time.len);
+            if (ms == TIME_NONE) continue;
+            creation_ms[idx] = ms;
+        }
+        status[idx] = 0;
+    }
+    *consumed = cur - buf;
+    return n;
+}
+
+}  // extern "C"

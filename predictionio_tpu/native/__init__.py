@@ -1,11 +1,17 @@
 """Native (C++) host runtime, loaded via ctypes.
 
 Holds the in-process equivalents of work the reference shipped to Spark
-executors.  Currently: O(n) counting-sort COO preprocessing for ALS
-(``native/bucketize.cpp``).  The library is compiled on demand with the
-system toolchain and cached under ``$PIO_TPU_HOME/native``; every entry
-point has a NumPy fallback so the framework runs (slower) without a
-compiler.
+executors:
+
+* O(n) counting-sort COO preprocessing for ALS (``native/bucketize.cpp``;
+  reference analogue: the executor-side shuffle in MLlib ALS).
+* bulk JSON-lines event scanning for the importer
+  (``native/jsonl_scan.cpp``; reference analogue: the FileToEvents Spark
+  job, `tools/.../imprt/FileToEvents.scala:30-95`).
+
+The library is compiled on demand with the system toolchain and cached
+under ``$PIO_TPU_HOME/native``; every entry point has a pure-Python/NumPy
+fallback so the framework runs (slower) without a compiler.
 """
 
 from __future__ import annotations
@@ -22,9 +28,10 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["sort_coo_by_row", "native_available"]
+__all__ = ["sort_coo_by_row", "scan_events_jsonl", "native_available"]
 
-_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "bucketize.cpp"
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_SRCS = [_NATIVE_DIR / "bucketize.cpp", _NATIVE_DIR / "jsonl_scan.cpp"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -46,19 +53,22 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not _SRC.exists():
-            logger.debug("native source %s missing; using NumPy path", _SRC)
+        srcs = [p for p in _SRCS if p.exists()]
+        if not srcs:
+            logger.debug("native sources missing under %s; using NumPy path",
+                         _NATIVE_DIR)
             return None
         so = _cache_dir() / "_native.so"
         try:
-            if not so.exists() or so.stat().st_mtime < _SRC.stat().st_mtime:
+            newest = max(p.stat().st_mtime for p in srcs)
+            if not so.exists() or so.stat().st_mtime < newest:
                 # compile to a private temp name and publish atomically so
                 # concurrent processes never dlopen a half-written file
                 tmp = so.with_suffix(f".{os.getpid()}.tmp")
                 try:
                     subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC", str(_SRC),
-                         "-o", str(tmp)],
+                        ["g++", "-O3", "-shared", "-fPIC"]
+                        + [str(p) for p in srcs] + ["-o", str(tmp)],
                         check=True, capture_output=True, timeout=120,
                     )
                     os.replace(tmp, so)
@@ -78,6 +88,13 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, i64p, i32p, f32p,
         ]
         lib.pio_sort_coo.restype = None
+        if hasattr(lib, "pio_scan_events_jsonl"):
+            lib.pio_scan_events_jsonl.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                i64p, i32p, i64p, i64p, i64p, i32p, i32p,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.pio_scan_events_jsonl.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -131,3 +148,48 @@ def sort_coo_by_row(
     starts = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=starts[1:])
     return c_sorted, v_sorted, counts, starts
+
+
+# number of per-event string-field slots emitted by pio_scan_events_jsonl
+# (matches the Field enum in native/jsonl_scan.cpp)
+_N_FIELDS = 8
+(F_EVENT, F_ENTITY_TYPE, F_ENTITY_ID, F_TARGET_ENTITY_TYPE,
+ F_TARGET_ENTITY_ID, F_PR_ID, F_EVENT_ID, F_PROPERTIES) = range(_N_FIELDS)
+
+
+def scan_events_jsonl(data: bytes):
+    """Native scan of a JSON-lines event buffer.
+
+    Returns ``(n, field_off, field_len, event_ms, creation_ms, line_off,
+    line_len, status)`` numpy arrays (sized n), or ``None`` when the
+    native library is unavailable.  ``status[i] == 0`` means event ``i``'s
+    storage-row fields were extracted natively; ``1`` means the caller
+    must re-parse that line with the exact Python path (escapes, tags,
+    validation failures, odd timestamps — parity by construction).
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "pio_scan_events_jsonl"):
+        return None
+    # one slot per newline upper-bounds the event count
+    max_events = data.count(b"\n") + 1
+    field_off = np.empty(max_events * _N_FIELDS, dtype=np.int64)
+    field_len = np.empty(max_events * _N_FIELDS, dtype=np.int32)
+    event_ms = np.empty(max_events, dtype=np.int64)
+    creation_ms = np.empty(max_events, dtype=np.int64)
+    line_off = np.empty(max_events, dtype=np.int64)
+    line_len = np.empty(max_events, dtype=np.int32)
+    status = np.empty(max_events, dtype=np.int32)
+    consumed = ctypes.c_int64(0)
+    n = lib.pio_scan_events_jsonl(
+        data, len(data), max_events,
+        field_off, field_len, event_ms, creation_ms,
+        line_off, line_len, status, ctypes.byref(consumed),
+    )
+    n = int(n)
+    return (
+        n,
+        field_off[: n * _N_FIELDS].reshape(n, _N_FIELDS),
+        field_len[: n * _N_FIELDS].reshape(n, _N_FIELDS),
+        event_ms[:n], creation_ms[:n], line_off[:n], line_len[:n],
+        status[:n],
+    )

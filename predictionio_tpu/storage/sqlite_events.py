@@ -186,6 +186,24 @@ class SQLiteEventStore(EventStore):
                 self._conn.commit()
         return ids
 
+    def insert_raw_rows(self, rows, app_id: int, channel_id: int = 0) -> None:
+        """Low-level bulk insert of pre-built storage rows.
+
+        The native importer fast path (`tools/import_export.py` +
+        `native/jsonl_scan.cpp`) extracts row fields without constructing
+        Event objects; each row must match the 11-column events schema of
+        :meth:`_row` exactly and be pre-validated.  Not part of the
+        EventStore contract — callers feature-test with ``hasattr``.
+        """
+        t = self._ensure_table(app_id, channel_id)
+        with self._lock:
+            self._conn.executemany(
+                f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                rows,
+            )
+            if not self._bulk_depth:
+                self._conn.commit()
+
     @property
     def _bulk_depth(self) -> int:
         return getattr(self._local, "bulk_depth", 0)

@@ -40,11 +40,15 @@ def import_events(
     whole import runs in one ``store.bulk()`` scope (transactional
     backends commit once at the end, not per batch).
     """
-    n = 0
-    batch: list[Event] = []
     # table DDL before the transaction scope: sqlite auto-commits DDL,
     # which would break the all-or-nothing rollback guarantee
     store.init_channel(app_id, channel_id)
+    if hasattr(store, "insert_raw_rows"):
+        n = _import_events_native(path, store, app_id, channel_id)
+        if n is not None:
+            return n
+    n = 0
+    batch: list[Event] = []
     with open(path) as f, store.bulk():
         for line in f:
             line = line.strip()
@@ -60,6 +64,94 @@ def import_events(
             store.insert_batch(batch, app_id, channel_id, validate=False)
             n += len(batch)
     return n
+
+
+def _import_events_native(
+    path: str | Path, store: EventStore, app_id: int, channel_id: int
+) -> Optional[int]:
+    """C++-scanned import fast path; None when the native lib is absent.
+
+    ``native/jsonl_scan.cpp`` extracts each event's storage-row fields
+    (and the raw ``properties`` substring, stored as-is — readers parse
+    JSON text, so non-canonical spacing/ordering is semantically
+    identical) in one pass.  Lines the scanner marks ``status=1`` —
+    escapes, tags, validation failures, unusual timestamps — are
+    re-parsed with the exact ``Event.from_json`` Python path, so errors
+    and edge semantics match the portable importer byte for byte.
+    Events without an eventTime get ONE shared import-time default
+    rather than per-event ``now()`` calls.
+    """
+    import numpy as np
+
+    from ..native import (
+        F_ENTITY_ID, F_ENTITY_TYPE, F_EVENT, F_EVENT_ID, F_PR_ID,
+        F_PROPERTIES, F_TARGET_ENTITY_ID, F_TARGET_ENTITY_TYPE,
+        scan_events_jsonl,
+    )
+    from ..storage.event import new_event_ids, now_utc, time_millis
+
+    data = Path(path).read_bytes()
+    scan = scan_events_jsonl(data)
+    if scan is None:
+        return None
+    n, foff, flen, ev_ms, cr_ms, loff, llen, status = scan
+    time_none = np.iinfo(np.int64).min  # TIME_NONE in jsonl_scan.cpp
+    now_ms = time_millis(now_utc())
+    ids = new_event_ids(n)
+    imported = 0
+    # ordered mixed buffer: INSERT OR REPLACE means a duplicate eventId is
+    # last-line-wins, so raw rows and python-fallback events must flush in
+    # strict file order (consecutive same-kind runs batch together)
+    pending: list[tuple[str, object]] = []
+
+    def flush():
+        nonlocal imported
+        i = 0
+        while i < len(pending):
+            kind = pending[i][0]
+            j = i
+            while j < len(pending) and pending[j][0] == kind:
+                j += 1
+            chunk = [p[1] for p in pending[i:j]]
+            if kind == "raw":
+                store.insert_raw_rows(chunk, app_id, channel_id)
+            else:
+                store.insert_batch(chunk, app_id, channel_id, validate=False)
+            imported += len(chunk)
+            i = j
+        pending.clear()
+
+    with store.bulk():
+        for k in range(n):
+            if status[k]:
+                line = data[loff[k]: loff[k] + llen[k]].decode()
+                pending.append(("evt", Event.from_json(json.loads(line))))
+            else:
+                f, ln = foff[k], flen[k]
+
+                def s(slot):
+                    return (
+                        data[f[slot]: f[slot] + ln[slot]].decode()
+                        if ln[slot] >= 0 else None
+                    )
+
+                pending.append(("raw", (
+                    s(F_EVENT_ID) or ids[k],
+                    s(F_EVENT),
+                    s(F_ENTITY_TYPE),
+                    s(F_ENTITY_ID),
+                    s(F_TARGET_ENTITY_TYPE),
+                    s(F_TARGET_ENTITY_ID),
+                    s(F_PROPERTIES) or "{}",
+                    int(ev_ms[k]) if ev_ms[k] != time_none else now_ms,
+                    "[]",
+                    s(F_PR_ID),
+                    int(cr_ms[k]) if cr_ms[k] != time_none else now_ms,
+                )))
+            if len(pending) >= _BATCH:
+                flush()
+        flush()
+    return imported
 
 
 def export_events(

@@ -1,0 +1,204 @@
+"""Native JSON-lines importer parity (`native/jsonl_scan.cpp`).
+
+The C++ scanner fast-paths the clean common shape and falls back per
+line to the exact Python path for everything else, so the two importers
+must be observationally identical on any corpus.  Reference analogue:
+`tools/src/main/scala/io/prediction/tools/imprt/FileToEvents.scala:30-95`.
+"""
+
+import json
+
+import pytest
+
+from predictionio_tpu.native import native_available, scan_events_jsonl
+from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+from predictionio_tpu.tools.import_export import import_events
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain"
+)
+
+
+def _write(tmp_path, lines):
+    p = tmp_path / "events.json"
+    p.write_text("\n".join(lines) + "\n")
+    return p
+
+
+def _stores(tmp_path):
+    a = SQLiteEventStore(str(tmp_path / "a.db"))
+    b = SQLiteEventStore(str(tmp_path / "b.db"))
+    return a, b
+
+
+def _import_python_only(path, store, app_id, monkeypatch=None):
+    """Force the portable path by hiding insert_raw_rows."""
+    raw = SQLiteEventStore.insert_raw_rows
+    try:
+        del SQLiteEventStore.insert_raw_rows
+        return import_events(path, store, app_id)
+    finally:
+        SQLiteEventStore.insert_raw_rows = raw
+
+
+def _canon(events):
+    out = []
+    for e in sorted(events, key=lambda e: (e.entity_id, e.event,
+                                           str(e.target_entity_id))):
+        out.append((
+            e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, dict(e.properties.to_json()),
+            e.event_time.isoformat() if e.event_time else None,
+            tuple(e.tags), e.pr_id,
+        ))
+    return out
+
+
+TRICKY = [
+    # clean fast-path shapes
+    json.dumps({"event": "rate", "entityType": "user", "entityId": "u1",
+                "targetEntityType": "item", "targetEntityId": "i1",
+                "properties": {"rating": 4.5},
+                "eventTime": "2021-06-01T12:34:56.789Z"}),
+    json.dumps({"event": "$set", "entityType": "item", "entityId": "i9",
+                "properties": {"categories": ["a", "b"], "price": 9.99},
+                "eventTime": "2021-06-01T00:00:00+05:30"}),
+    # no eventTime -> import-time default
+    json.dumps({"event": "view", "entityType": "user", "entityId": "u2",
+                "targetEntityType": "item", "targetEntityId": "i2"}),
+    # escaped strings -> python fallback
+    json.dumps({"event": "rate", "entityType": "user",
+                "entityId": "weird\"quote",
+                "targetEntityType": "item", "targetEntityId": "i3",
+                "properties": {"note": "line\nbreak"},
+                "eventTime": "2021-06-01T12:00:00.000Z"}),
+    # tags -> python fallback
+    json.dumps({"event": "buy", "entityType": "user", "entityId": "u4",
+                "targetEntityType": "item", "targetEntityId": "i4",
+                "tags": ["x", "y"],
+                "eventTime": "2021-06-02T12:00:00.000Z"}),
+    # prId + explicit eventId on the fast path
+    json.dumps({"event": "view", "entityType": "user", "entityId": "u5",
+                "targetEntityType": "item", "targetEntityId": "i5",
+                "prId": "pr-1", "eventId": "e" * 32,
+                "eventTime": "2021-06-03T01:02:03.000Z"}),
+    # unusual-but-valid timestamp (space separator) -> fallback parse
+    json.dumps({"event": "view", "entityType": "user", "entityId": "u6",
+                "targetEntityType": "item", "targetEntityId": "i6",
+                "eventTime": "2021-06-03T01:02:03.000000Z"}),
+    # $delete with no properties (clean special event)
+    json.dumps({"event": "$delete", "entityType": "user",
+                "entityId": "gone"}),
+]
+
+
+def test_native_importer_matches_python(tmp_path):
+    path = _write(tmp_path, TRICKY)
+    nat, py = _stores(tmp_path)
+    n1 = import_events(path, nat, 7)
+    n2 = _import_python_only(path, py, 7)
+    assert n1 == n2 == len(TRICKY)
+    a = _canon(nat.find(7))
+    b = _canon(py.find(7))
+    # import-time defaults differ between the two runs; compare them
+    # only for events that carried an explicit eventTime
+    for ra, rb in zip(a, b):
+        assert ra[:6] == rb[:6]
+        assert ra[7:] == rb[7:]
+    # explicit times must match exactly
+    times_a = {r[2]: r[6] for r in a if r[0] == "rate"}
+    times_b = {r[2]: r[6] for r in b if r[0] == "rate"}
+    assert times_a == times_b
+
+
+def test_native_importer_rejects_invalid_like_python(tmp_path):
+    from predictionio_tpu.storage.event import EventValidationError
+
+    bad = [
+        json.dumps({"event": "$unset", "entityType": "user",
+                    "entityId": "u", "properties": {}}),
+    ]
+    path = _write(tmp_path, bad)
+    nat, py = _stores(tmp_path)
+    with pytest.raises(EventValidationError) as e_nat:
+        import_events(path, nat, 1)
+    with pytest.raises(EventValidationError) as e_py:
+        _import_python_only(path, py, 1)
+    assert str(e_nat.value) == str(e_py.value)
+
+    bad2 = [json.dumps({"event": "pio_reserved", "entityType": "user",
+                        "entityId": "u"})]
+    path2 = _write(tmp_path, bad2)
+    with pytest.raises(EventValidationError):
+        import_events(path2, nat, 2)
+
+
+def test_scanner_statuses(tmp_path):
+    """Fast path on clean lines, fallback flags on tricky ones."""
+    data = ("\n".join(TRICKY) + "\n").encode()
+    scan = scan_events_jsonl(data)
+    assert scan is not None
+    n, foff, flen, ev_ms, cr_ms, loff, llen, status = scan
+    assert n == len(TRICKY)
+    # escaped strings (idx 3) and tags (idx 4) must fall back
+    assert status[3] == 1 and status[4] == 1
+    # clean lines take the native path
+    assert status[0] == 0 and status[1] == 0 and status[5] == 0
+    # timezone-offset timestamp parsed to the same epoch python computes
+    from predictionio_tpu.storage.event import parse_time, time_millis
+
+    assert ev_ms[1] == time_millis(parse_time("2021-06-01T00:00:00+05:30"))
+    assert ev_ms[0] == time_millis(parse_time("2021-06-01T12:34:56.789Z"))
+
+
+def test_import_time_default_is_shared_not_per_event(tmp_path):
+    lines = [json.dumps({"event": "view", "entityType": "u",
+                         "entityId": str(k), "targetEntityType": "i",
+                         "targetEntityId": str(k)}) for k in range(10)]
+    path = _write(tmp_path, lines)
+    store, _ = _stores(tmp_path)
+    import_events(path, store, 3)
+    times = {e.event_time for e in store.find(3)}
+    assert len(times) == 1
+
+
+def test_pre_1970_times_preserved(tmp_path):
+    """Negative epoch millis are legal values, not 'absent' (the scanner's
+    absent sentinel is INT64_MIN, never a real timestamp)."""
+    lines = [json.dumps({"event": "rate", "entityType": "u", "entityId": "a",
+                         "targetEntityType": "i", "targetEntityId": "b",
+                         "eventTime": "1965-03-01T00:00:00.000Z"}),
+             json.dumps({"event": "rate", "entityType": "u", "entityId": "c",
+                         "targetEntityType": "i", "targetEntityId": "d",
+                         "eventTime": "1969-12-31T23:59:59.999Z"})]
+    path = _write(tmp_path, lines)
+    nat, py = _stores(tmp_path)
+    import_events(path, nat, 1)
+    _import_python_only(path, py, 1)
+    ta = sorted(e.event_time.isoformat() for e in nat.find(1))
+    tb = sorted(e.event_time.isoformat() for e in py.find(1))
+    assert ta == tb
+    assert ta[0].startswith("1965-03-01")
+
+
+def test_duplicate_event_id_last_line_wins_across_paths(tmp_path):
+    """INSERT OR REPLACE semantics must follow file order even when the
+    duplicate ids straddle the native fast path and the python fallback."""
+    eid = "f" * 32
+    first = json.dumps({"event": "rate", "entityType": "u", "entityId": "x",
+                        "targetEntityType": "i", "targetEntityId": "y",
+                        "eventId": eid, "properties": {"v": 1},
+                        "eventTime": "2021-01-01T00:00:00.000Z"})
+    # later line with the same id takes the python fallback (escape)
+    second = json.dumps({"event": "rate", "entityType": "u",
+                         "entityId": "x\"esc", "targetEntityType": "i",
+                         "targetEntityId": "y", "eventId": eid,
+                         "properties": {"v": 2},
+                         "eventTime": "2021-01-02T00:00:00.000Z"})
+    path = _write(tmp_path, [first, second])
+    nat, py = _stores(tmp_path)
+    import_events(path, nat, 1)
+    _import_python_only(path, py, 1)
+    (ea,) = list(nat.find(1))
+    (eb,) = list(py.find(1))
+    assert ea.properties.to_json() == eb.properties.to_json() == {"v": 2}

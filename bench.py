@@ -114,7 +114,17 @@ def _parse_args(argv=None):
         "compile, per-side half-iterations) — the bottleneck data the "
         "perf note needs; implies --inner semantics",
     )
-    return ap.parse_args(argv)
+    ap.add_argument(
+        "--phase-probe",
+        action="store_true",
+        help="with --breakdown: additionally time gather-only / "
+        "gather+gram / full-solve variants of the user half-iteration "
+        "to localize the per-iteration cost",
+    )
+    args = ap.parse_args(argv)
+    if args.phase_probe and not args.breakdown:
+        ap.error("--phase-probe requires --breakdown")
+    return args
 
 
 def _prepare(args):
@@ -231,6 +241,69 @@ def run_breakdown(args) -> None:
         "value": round(flops_iter / per_iter / 1e12, 3),
         "platform": str(jax.devices()[0].platform),
     }), flush=True)
+
+    if args.phase_probe:
+        _run_phase_probe(jax, trainer, Us, Vs, cfg, emit, rtt)
+
+
+def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
+    """Time truncated variants of the user half-iteration.
+
+    ``gather_only`` stops after the [B, K, R] gather+mask expansion,
+    ``gather_gram`` adds the Gram/rhs einsums and regularization,
+    ``full_half`` is the real `_half` including solves AND the
+    factor-table scatter.  The truncations run the REAL kernel
+    (`models/als._solve_buckets` with ``stop_after``), so implicit mode,
+    weighted-λ, precision, gather dtype, and solver choice are all
+    whatever the trainer is configured with — the deltas attribute the
+    per-iteration time to gather vs MXU vs solver vs scatter, the
+    decision data for docs/ARCHITECTURE.md 'Measured performance'.
+    """
+    import functools
+
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.als import _solve_buckets
+    from predictionio_tpu.parallel.mesh import fence
+
+    side = trainer._user_side
+
+    @functools.partial(jax.jit, static_argnames=("ks", "stop_after"))
+    def probe(opp, c_sorted, v_sorted, buckets, lam, alpha, *, ks,
+              stop_after):
+        return _solve_buckets(
+            None, opp, c_sorted, v_sorted, buckets, lam, alpha,
+            ks=ks, implicit=cfg.implicit,
+            weighted_lambda=cfg.weighted_lambda,
+            precision=cfg.matmul_precision, solver=cfg.solver,
+            gather_dtype=cfg.gather_dtype, stop_after=stop_after,
+        )
+
+    lam = jnp.asarray(cfg.lam, jnp.float32)
+    alpha = jnp.asarray(cfg.alpha, jnp.float32)
+
+    def timed(fn):
+        fence(fn())
+        t0 = time.time()
+        for _ in range(3):
+            out = fn()
+        fence(out)
+        return max(time.time() - t0 - rtt, 0.0) / 3
+
+    for stop in ("gather", "gram"):
+        emit(
+            f"user_half_probe_{stop}",
+            timed(lambda: probe(
+                V, side["c_sorted"], side["v_sorted"], side["buckets"],
+                lam, alpha, ks=side["ks"], stop_after=stop,
+            )),
+        )
+    # the full half-iteration donates its first argument; feed copies
+    emit(
+        "user_half_probe_full_half",
+        timed(lambda: trainer._half(jnp.array(U, copy=True), V,
+                                    trainer._user_side)),
+    )
 
 
 def run_inner(args) -> None:

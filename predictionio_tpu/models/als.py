@@ -321,8 +321,15 @@ def _solve_buckets(
     solver: str,
     gather_dtype: str = "float32",
     gram: Optional[jax.Array] = None,
+    stop_after: Optional[str] = None,
 ):
     """Shared bucket-solve math for the replicated and sharded paths.
+
+    ``stop_after`` ("gather" | "gram") truncates the per-bucket pipeline
+    and returns a scalar reduction instead of writing factors — used by
+    ``bench.py --phase-probe`` to attribute per-iteration cost to
+    gather vs MXU vs solver against the REAL kernel (no drift-prone
+    copy of this math in the bench).
 
     ``gram`` (implicit mode only) lets the sharded path supply the YtY
     matrix computed shard-locally + psum'd instead of redundantly from the
@@ -355,6 +362,9 @@ def _solve_buckets(
         val = jnp.where(valid, v_sorted[pos], 0.0)       # f32, masked
         maskf = valid.astype(f32)
         Vm = opp_g[idx] * valid[..., None].astype(opp_g.dtype)  # [B,K,R]
+        if stop_after == "gather":
+            out = (0.0 if out is None else out) + Vm.astype(f32).sum()
+            continue
         n_row = counts.astype(f32)                       # [B]
         # weight vectors are computed in f32 then cast to the gather dtype
         # right before the einsum, so a mixed-dtype contraction never
@@ -382,6 +392,9 @@ def _solve_buckets(
         else:
             reg = jnp.broadcast_to(lam_t, n_row.shape)
         A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)
+        if stop_after == "gram":
+            out = (0.0 if out is None else out) + A.sum() + b.sum()
+            continue
         if solver == "pallas":
             from ..ops.solve import cholesky_solve_batched
 

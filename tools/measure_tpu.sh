@@ -22,7 +22,7 @@ run() {
 
 # headline: device staging (the default at full scale), then the A/Bs
 run north_star          python bench.py --verbose
-run breakdown           python bench.py --breakdown --profile "$OUT/trace"
+run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"
 run breakdown_host_stage python bench.py --breakdown --staging host
 run breakdown_pallas    python bench.py --breakdown --solver pallas
 run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16

@@ -43,14 +43,18 @@ def _import_python_only(path, store, app_id, monkeypatch=None):
 
 def _canon(events):
     out = []
-    for e in sorted(events, key=lambda e: (e.entity_id, e.event,
-                                           str(e.target_entity_id))):
+    for e in events:
         out.append((
             e.event, e.entity_type, e.entity_id, e.target_entity_type,
             e.target_entity_id, dict(e.properties.to_json()),
             e.event_time.isoformat() if e.event_time else None,
             tuple(e.tags), e.pr_id,
         ))
+    # full-record sort key (minus event_time, whose import-time default
+    # legitimately differs between two import runs): events with identical
+    # partial keys but different payloads must still pair up
+    out.sort(key=lambda r: json.dumps((r[:6], r[7:]), sort_keys=True,
+                                      default=str))
     return out
 
 
@@ -202,3 +206,141 @@ def test_duplicate_event_id_last_line_wins_across_paths(tmp_path):
     (ea,) = list(nat.find(1))
     (eb,) = list(py.find(1))
     assert ea.properties.to_json() == eb.properties.to_json() == {"v": 2}
+
+
+def test_fuzz_parity_random_corpora(tmp_path):
+    """Randomized corpora: the native importer must agree with the Python
+    importer event-for-event, and never crash, whatever the line shape."""
+    import random
+
+    rng = random.Random(20260730)
+    evs = ["rate", "view", "$set", "$unset", "$delete", "pio_bad", "", "a b"]
+    etypes = ["user", "item", "pio_pr", "pio_x", "ümlaut", ""]
+
+    def rand_props(depth=0):
+        if depth > 2 or rng.random() < 0.3:
+            return rng.choice([
+                1, -2.5, True, False, None, "s", "with \"quote\"",
+                "unié", [1, 2, {"k": "v"}], 1e300,
+            ])
+        return {
+            rng.choice(["a", "b", "$r", "pio_k", "nested", "x y"]):
+                rand_props(depth + 1)
+            for _ in range(rng.randint(0, 3))
+        }
+
+    lines = []
+    for j in range(400):
+        d = {}
+        clean = j % 2 == 0   # half the corpus: well-formed core fields
+        if clean:
+            d["event"] = rng.choice(["rate", "view", "$set"])
+            d["entityType"] = "user"
+            d["entityId"] = rng.choice(["u1", "id with space", "漢字"])
+            if d["event"] != "$set" and rng.random() < 0.8:
+                d["targetEntityType"] = "item"
+                d["targetEntityId"] = "i1"
+        else:
+            if rng.random() < 0.95:
+                d["event"] = rng.choice(evs)
+            if rng.random() < 0.95:
+                d["entityType"] = rng.choice(etypes)
+            if rng.random() < 0.95:
+                d["entityId"] = rng.choice(["u1", "id with space", "漢字", ""])
+            if rng.random() < 0.5:
+                d["targetEntityType"] = rng.choice(etypes)
+            if rng.random() < 0.5:
+                d["targetEntityId"] = rng.choice(["i1", ""])
+        if rng.random() < 0.6:
+            d["properties"] = rand_props()
+        if rng.random() < 0.6:
+            d["eventTime"] = rng.choice([
+                "2021-06-01T12:34:56.789Z", "2021-06-01T12:34:56+09:00",
+                "1965-01-01T00:00:00Z", "not-a-time",
+                "2021-06-01T12:34:56", "2021-13-40T99:99:99Z",
+            ])
+        if rng.random() < 0.1:
+            d["tags"] = ["t1", "t2"]
+        if rng.random() < 0.1:
+            d["prId"] = "pr"
+        line = json.dumps(d, ensure_ascii=rng.random() < 0.5)
+        if rng.random() < 0.05:
+            line = line[:-1]  # truncated json
+        lines.append(line)
+
+    # import LINE BY LINE so every line exercises both paths even when
+    # earlier lines are invalid (a whole-file import aborts at the first
+    # bad line, leaving the rest of the corpus untested)
+    nat, py = _stores(tmp_path)
+    outcomes = []
+    for k, line in enumerate(lines):
+        path = tmp_path / f"line_{k}.json"
+        path.write_text(line + "\n")
+
+        def run(fn, store):
+            try:
+                return ("ok", fn(path, store, 9))
+            except Exception as e:  # noqa: BLE001 — comparing parity
+                return ("err", f"{type(e).__name__}: {e}")
+
+        o_nat = run(import_events, nat)
+        o_py = run(_import_python_only, py)
+        assert o_nat == o_py, f"line {k}: {line!r}\n{o_nat}\nvs\n{o_py}"
+        outcomes.append(o_nat[0])
+    assert outcomes.count("ok") > 50, "corpus too hostile to test success"
+    assert _compare_stores(nat, py, 9, expect_nonempty=True)
+
+
+def _compare_stores(a, b, app_id, expect_nonempty=False):
+    ca = _canon(a.find(app_id))
+    cb = _canon(b.find(app_id))
+    if expect_nonempty and not ca:
+        return False
+    if len(ca) != len(cb):
+        return False
+    for ra, rb in zip(ca, cb):
+        if ra[:6] != rb[:6] or ra[7:] != rb[7:]:
+            return False
+    return True
+
+
+def test_fuzz_parity_valid_corpus(tmp_path):
+    """All-valid randomized corpus: both importers succeed and store
+    identical events (the success-path complement of the failure fuzz)."""
+    import random
+
+    rng = random.Random(42)
+    lines = []
+    for k in range(500):
+        d = {
+            "event": rng.choice(["rate", "view", "buy"]),
+            "entityType": "user",
+            "entityId": rng.choice([f"u{k}", "id with space", "漢字",
+                                    "tab\there"]),
+            "targetEntityType": "item",
+            "targetEntityId": f"i{k % 50}",
+        }
+        if rng.random() < 0.7:
+            d["properties"] = {
+                "rating": rng.randint(1, 10) / 2,
+                "note": rng.choice(["plain", "esc\"aped", "uni é"]),
+                "nested": {"deep": [1, 2, 3]},
+            }
+        if rng.random() < 0.7:
+            d["eventTime"] = rng.choice([
+                "2021-06-01T12:34:56.789Z",
+                "2021-06-01T12:34:56+09:00",
+                "1965-01-01T00:00:00Z",
+                "2005-02-28T23:59:59.123456Z",
+            ])
+        if rng.random() < 0.2:
+            d["tags"] = ["t"]
+        if rng.random() < 0.2:
+            d["prId"] = f"pr{k}"
+        lines.append(json.dumps(d, ensure_ascii=rng.random() < 0.5))
+
+    path = _write(tmp_path, lines)
+    nat, py = _stores(tmp_path)
+    assert import_events(path, nat, 9) == 500
+    assert _import_python_only(path, py, 9) == 500
+    assert _compare_stores(nat, py, 9, expect_nonempty=True)

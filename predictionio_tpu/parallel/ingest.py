@@ -272,7 +272,9 @@ def read_ratings_distributed(
     n, pid = jax.process_count(), jax.process_index()
     frame = find_columnar_sharded(
         es, n_shards=n, shard_id=pid,
-        float_property=rating_property, **scan_kwargs,
+        float_property=rating_property,
+        minimal=True,   # only to_ratings fields are consumed downstream
+        **scan_kwargs,
     )
     # ids_exchange self-protects against stale files (per-run nonce +
     # post-sync cleanup) on the jax-managed path used here

@@ -147,9 +147,16 @@ class EventStore(abc.ABC):
         target_entity_id: TargetFilter = None,
         float_property: Optional[str] = None,
         float_default: float = float("nan"),
+        minimal: bool = False,
     ):
         """Bulk scan into column arrays (the `PEvents` analogue,
         reference `data/.../storage/PEvents.scala:30-138`).
+
+        ``minimal=True`` is an optimization HINT: the caller promises to
+        touch only ``entity_id``/``target_entity_id``/``event_time_ms``
+        (+ ``value``), letting backends skip the other columns.  This
+        generic implementation ignores it (a full frame satisfies the
+        contract).
 
         Generic implementation built on :meth:`find` +
         :func:`~predictionio_tpu.storage.columnar.events_to_frame`, so

@@ -21,6 +21,7 @@ from __future__ import annotations
 import contextlib
 import datetime as _dt
 import json
+import re
 import sqlite3
 import threading
 from pathlib import Path
@@ -379,55 +380,131 @@ class SQLiteEventStore(EventStore):
         target_entity_id: TargetFilter = None,
         float_property: Optional[str] = None,
         float_default: float = np.nan,
+        minimal: bool = False,
     ) -> EventFrame:
         """Bulk scan straight into column arrays.
 
-        When ``float_property`` is given, that property is extracted per event
-        into a float64 column (missing -> ``float_default``) with a cheap JSON
-        peek, skipping full property parsing — this is the training-data hot
-        path (ratings, weights).
+        When ``float_property`` is given, that property is extracted per
+        event into a float64 column (missing -> ``float_default``) by
+        sqlite's built-in JSON1 ``json_extract`` — no per-row Python JSON
+        parsing.  ``minimal=True`` additionally narrows the SELECT to the
+        columns the rating/training hot path consumes (entity_id,
+        target_entity_id, event_time, value): at ML-20M scale the scan
+        cost is Python-object creation in the sqlite cursor, so 3 columns
+        instead of 7 is ~2x (the other EventFrame fields come back
+        ``None``; ``to_ratings``/``select`` handle that).
         """
         t = self._ensure_table(app_id, channel_id)
-        sql, params = self._query(
-            t, start_time, until_time, entity_type, entity_id, event_names,
-            target_entity_type, target_entity_id, None, False,
-            columns="event, entity_type, entity_id, target_entity_type, "
-            "target_entity_id, event_time, properties",
+        # json_extract path syntax can't express arbitrary key names
+        # safely; only simple names take the SQL fast path.  NOTE: rows
+        # whose properties blob holds NaN/Infinity tokens (json.dumps
+        # emits them; strict JSON forbids them) make json_extract raise —
+        # _scan_columns retries those scans with extract_in_sql=False.
+        simple_prop = bool(
+            float_property is not None
+            and re.fullmatch(r"[A-Za-z0-9_]+", float_property)
         )
-        rows = self._conn.execute(sql, params).fetchall()
-        n = len(rows)
-        names = np.empty(n, dtype=object)
-        etypes = np.empty(n, dtype=object)
-        eids = np.empty(n, dtype=object)
-        ttypes = np.empty(n, dtype=object)
-        tids = np.empty(n, dtype=object)
-        times = np.empty(n, dtype=np.int64)
-        props: Optional[np.ndarray] = None
-        values = np.full(n, float_default, dtype=np.float64) if float_property else None
-        keep_props = float_property is None
-        if keep_props:
-            props = np.empty(n, dtype=object)
-        for i, r in enumerate(rows):
-            names[i] = r[0]
-            etypes[i] = r[1]
-            eids[i] = r[2]
-            ttypes[i] = r[3]
-            tids[i] = r[4]
-            times[i] = r[5]
-            if float_property is not None:
-                if r[6] != "{}":
-                    v = json.loads(r[6]).get(float_property)
+        try:
+            sel, cols_t, n = self._scan_columns(
+                t, minimal, float_property, simple_prop,
+                (start_time, until_time, entity_type, entity_id,
+                 event_names, target_entity_type, target_entity_id),
+            )
+            extracted = simple_prop
+        except sqlite3.OperationalError as e:
+            if not simple_prop or "JSON" not in str(e).upper():
+                raise
+            sel, cols_t, n = self._scan_columns(
+                t, minimal, float_property, False,
+                (start_time, until_time, entity_type, entity_id,
+                 event_names, target_entity_type, target_entity_id),
+            )
+            extracted = False
+
+        def obj(col):
+            a = np.empty(n, dtype=object)
+            if n:
+                a[:] = col
+            return a
+
+        def i64(col):
+            return (np.asarray(col, dtype=np.int64) if n
+                    else np.empty(0, np.int64))
+
+        def floats(col):
+            # col holds json_extract results: numbers or None
+            out = np.full(n, float_default, dtype=np.float64)
+            for i, v in enumerate(col):
+                if v is not None:
+                    out[i] = float(v)
+            return out
+
+        def peek(col):
+            # col holds raw properties blobs: python-side JSON peek
+            out = np.full(n, float_default, dtype=np.float64)
+            for i, blob in enumerate(col):
+                if blob != "{}":
+                    v = json.loads(blob).get(float_property)
                     if v is not None:
-                        values[i] = float(v)
-            else:
-                props[i] = json.loads(r[6])
+                        out[i] = float(v)
+            return out
+
+        values = props = None
+        if float_property is not None:
+            vcol = cols_t[-1]           # value/properties is always last
+            values = floats(vcol) if extracted else peek(vcol)
+        elif not minimal:
+            props = obj([json.loads(b) for b in cols_t[-1]])
+
+        if minimal:
+            return EventFrame(
+                event=None,
+                entity_type=None,
+                entity_id=obj(cols_t[0]),
+                target_entity_type=None,
+                target_entity_id=obj(cols_t[1]),
+                event_time_ms=i64(cols_t[2]),
+                properties=None,
+                value=values,
+            )
         return EventFrame(
-            event=names,
-            entity_type=etypes,
-            entity_id=eids,
-            target_entity_type=ttypes,
-            target_entity_id=tids,
-            event_time_ms=times,
+            event=obj(cols_t[0]),
+            entity_type=obj(cols_t[1]),
+            entity_id=obj(cols_t[2]),
+            target_entity_type=obj(cols_t[3]),
+            target_entity_id=obj(cols_t[4]),
+            event_time_ms=i64(cols_t[5]),
             properties=props,
             value=values,
         )
+
+    def _scan_columns(self, t, minimal, float_property, extract_in_sql,
+                      filters):
+        """Run the columnar SELECT; returns (select_list, columns, n).
+
+        The SELECT is built as a list so positions are structural, and the
+        value/properties expression — when present — is always LAST.
+        """
+        (start_time, until_time, entity_type, entity_id, event_names,
+         target_entity_type, target_entity_id) = filters
+        sel = (
+            ["entity_id", "target_entity_id", "event_time"] if minimal
+            else ["event", "entity_type", "entity_id",
+                  "target_entity_type", "target_entity_id", "event_time"]
+        )
+        if float_property is not None:
+            sel.append("json_extract(properties, ?)" if extract_in_sql
+                       else "properties")
+        elif not minimal:
+            sel.append("properties")
+        sql, params = self._query(
+            t, start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, None, False,
+            columns=", ".join(sel),
+        )
+        if extract_in_sql:
+            # SELECT placeholders precede WHERE placeholders positionally
+            params = [f'$."{float_property}"'] + list(params)
+        rows = self._conn.execute(sql, params).fetchall()
+        cols_t = list(zip(*rows)) if rows else [()] * len(sel)
+        return sel, cols_t, len(rows)

@@ -77,6 +77,7 @@ class ECommDataSource(DataSource):
             app_id=app_id, entity_type="user",
             event_names=list(p.view_events),
             float_property=p.rating_property,
+            minimal=True,   # only to_ratings fields are consumed
         )
         ratings = frame.to_ratings(
             rating_property=p.rating_property,

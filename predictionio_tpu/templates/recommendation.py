@@ -159,6 +159,7 @@ class RecommendationDataSource(DataSource):
             entity_type=p.entity_type,
             event_names=list(p.event_names),
             float_property=p.rating_property,
+            minimal=True,   # only to_ratings fields are consumed
         )
         return frame, self._read_items(es, app_id)
 

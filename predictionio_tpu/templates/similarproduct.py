@@ -87,6 +87,7 @@ class SimilarProductDataSource(DataSource):
         frame = es.find_columnar(
             app_id=app_id, entity_type="user",
             event_names=list(p.view_events),
+            minimal=True,   # only to_ratings fields are consumed
         )
         ratings = frame.to_ratings(dedup="sum")  # implicit view counts
         items = {

@@ -221,3 +221,59 @@ def test_bulk_scope_rolls_back_on_error(tmp_path):
     with es.bulk():
         es.insert_batch([ev], app_id=1, validate=False)
     assert len(list(es.find(app_id=1))) == 1
+
+
+def test_find_columnar_nan_property_blob(tmp_path):
+    """json.dumps stores NaN/Infinity tokens (invalid strict JSON); the
+    json_extract SQL fast path must fall back to the Python peek instead
+    of poisoning the whole scan with OperationalError."""
+    import math
+
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    store = SQLiteEventStore(str(tmp_path / "nan.db"))
+    store.insert(Event(event="rate", entity_type="user", entity_id="u1",
+                       target_entity_type="item", target_entity_id="i1",
+                       properties={"rating": float("nan")}), 1)
+    store.insert(Event(event="rate", entity_type="user", entity_id="u2",
+                       target_entity_type="item", target_entity_id="i2",
+                       properties={"rating": 4.5}), 1)
+    for minimal in (False, True):
+        fr = store.find_columnar(1, float_property="rating",
+                                 minimal=minimal)
+        vals = sorted(fr.value.tolist(), key=lambda v: (not math.isnan(v), v))
+        assert math.isnan(vals[0]) and vals[1] == 4.5
+
+
+def test_minimal_frame_with_event_names_clear_error(tmp_path):
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    store = SQLiteEventStore(str(tmp_path / "m.db"))
+    store.insert(Event(event="rate", entity_type="user", entity_id="u",
+                       target_entity_type="item", target_entity_id="i"), 1)
+    fr = store.find_columnar(1, minimal=True)
+    with pytest.raises(ValueError, match="minimal"):
+        fr.with_event_names(["rate"])
+
+
+def test_minimal_scan_matches_full_scan(tmp_path):
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    store = SQLiteEventStore(str(tmp_path / "p.db"))
+    for k in range(20):
+        store.insert(Event(event="rate", entity_type="user",
+                           entity_id=f"u{k % 5}", target_entity_type="item",
+                           target_entity_id=f"i{k % 3}",
+                           properties={"rating": k / 2}), 1)
+    full = store.find_columnar(1, float_property="rating")
+    mini = store.find_columnar(1, float_property="rating", minimal=True)
+    assert list(full.entity_id) == list(mini.entity_id)
+    assert list(full.target_entity_id) == list(mini.target_entity_id)
+    assert full.event_time_ms.tolist() == mini.event_time_ms.tolist()
+    assert full.value.tolist() == mini.value.tolist()
+    r_full = full.to_ratings(rating_property="rating")
+    r_mini = mini.to_ratings(rating_property="rating")
+    assert r_full.rating.tolist() == r_mini.rating.tolist()

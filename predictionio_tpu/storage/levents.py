@@ -148,6 +148,7 @@ class EventStore(abc.ABC):
         float_property: Optional[str] = None,
         float_default: float = float("nan"),
         minimal: bool = False,
+        cache: Optional[bool] = None,
     ):
         """Bulk scan into column arrays (the `PEvents` analogue,
         reference `data/.../storage/PEvents.scala:30-138`).
@@ -156,7 +157,8 @@ class EventStore(abc.ABC):
         touch only ``entity_id``/``target_entity_id``/``event_time_ms``
         (+ ``value``), letting backends skip the other columns.  This
         generic implementation ignores it (a full frame satisfies the
-        contract).
+        contract).  ``cache`` likewise: backends with a snapshot cache
+        (sqlite) honor it; others ignore it.
 
         Generic implementation built on :meth:`find` +
         :func:`~predictionio_tpu.storage.columnar.events_to_frame`, so

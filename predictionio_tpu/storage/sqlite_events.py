@@ -61,6 +61,10 @@ CREATE INDEX IF NOT EXISTS {table}_time ON {table} (event_time);
 CREATE INDEX IF NOT EXISTS {table}_entity
   ON {table} (entity_type, entity_id, event_time);
 CREATE INDEX IF NOT EXISTS {table}_name ON {table} (event, event_time);
+CREATE TABLE IF NOT EXISTS _scan_versions (
+  tbl TEXT PRIMARY KEY,
+  v INTEGER NOT NULL
+);
 """
 
 
@@ -111,6 +115,27 @@ class SQLiteEventStore(EventStore):
                 self._known_tables.add(t)
         return t
 
+    def _bump_version(self, t: str) -> None:
+        """Monotonic per-table write counter, bumped INSIDE each write's
+        transaction — the scan cache's change fingerprint.  (count,
+        max rowid) alone is not change-proof: sqlite reuses the max rowid
+        after its row is deleted, so a delete+insert pair could leave it
+        unchanged and serve a stale snapshot.  A rolled-back bulk scope
+        rolls its bump back too, keeping the counter consistent with the
+        visible data.
+        """
+        self._conn.execute(
+            "INSERT INTO _scan_versions VALUES (?, 1) "
+            "ON CONFLICT(tbl) DO UPDATE SET v = v + 1",
+            (t,),
+        )
+
+    def _version(self, t: str) -> int:
+        row = self._conn.execute(
+            "SELECT v FROM _scan_versions WHERE tbl=?", (t,)
+        ).fetchone()
+        return int(row[0]) if row else 0
+
     # -- lifecycle --------------------------------------------------------
     def init_channel(self, app_id: int, channel_id: int = 0) -> bool:
         self._ensure_table(app_id, channel_id)
@@ -120,6 +145,10 @@ class SQLiteEventStore(EventStore):
         t = _table_name(app_id, channel_id)
         with self._lock:
             self._conn.execute(f"DROP TABLE IF EXISTS {t}")
+            self._conn.execute(
+                "INSERT INTO _scan_versions VALUES (?, 1) "
+                "ON CONFLICT(tbl) DO UPDATE SET v = v + 1", (t,)
+            )
             self._conn.commit()
             self._known_tables.discard(t)
         return True
@@ -161,6 +190,7 @@ class SQLiteEventStore(EventStore):
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 self._row(event, eid),
             )
+            self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
         return eid
@@ -183,6 +213,7 @@ class SQLiteEventStore(EventStore):
             self._conn.executemany(
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows
             )
+            self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
         return ids
@@ -202,6 +233,7 @@ class SQLiteEventStore(EventStore):
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 rows,
             )
+            self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
 
@@ -275,6 +307,7 @@ class SQLiteEventStore(EventStore):
             cur = self._conn.execute(
                 f"DELETE FROM {t} WHERE event_id=?", (event_id,)
             )
+            self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
             return cur.rowcount > 0
@@ -288,6 +321,7 @@ class SQLiteEventStore(EventStore):
             cur = self._conn.executemany(
                 f"DELETE FROM {t} WHERE event_id=?", ids
             )
+            self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
             return cur.rowcount if cur.rowcount >= 0 else len(ids)
@@ -381,6 +415,7 @@ class SQLiteEventStore(EventStore):
         float_property: Optional[str] = None,
         float_default: float = np.nan,
         minimal: bool = False,
+        cache: Optional[bool] = None,
     ) -> EventFrame:
         """Bulk scan straight into column arrays.
 
@@ -393,8 +428,31 @@ class SQLiteEventStore(EventStore):
         cost is Python-object creation in the sqlite cursor, so 3 columns
         instead of 7 is ~2x (the other EventFrame fields come back
         ``None``; ``to_ratings``/``select`` handle that).
+
+        ``cache`` (default: env ``PIO_TPU_SCAN_CACHE=1``) snapshots the
+        result to an npz keyed by the table's (count, max rowid)
+        fingerprint, so repeat trains on an unchanged table read back at
+        numpy speed instead of re-paying the cursor scan (scan_cache.py).
         """
         t = self._ensure_table(app_id, channel_id)
+        from . import scan_cache
+
+        cache_key = None
+        v_before = None
+        if scan_cache.enabled(cache) and self._path != ":memory:":
+            v_before = self._version(t)
+            cache_key = scan_cache.key(
+                self._path, t, (v_before,),
+                [
+                    str(start_time), str(until_time), entity_type,
+                    entity_id, event_names, target_entity_type,
+                    target_entity_id, float_property, float_default,
+                    minimal,
+                ],
+            )
+            cached = scan_cache.load(cache_key)
+            if cached is not None:
+                return cached
         # json_extract path syntax can't express arbitrary key names
         # safely; only simple names take the SQL fast path.  NOTE: rows
         # whose properties blob holds NaN/Infinity tokens (json.dumps
@@ -405,7 +463,7 @@ class SQLiteEventStore(EventStore):
             and re.fullmatch(r"[A-Za-z0-9_]+", float_property)
         )
         try:
-            sel, cols_t, n = self._scan_columns(
+            cols_t, n = self._scan_columns(
                 t, minimal, float_property, simple_prop,
                 (start_time, until_time, entity_type, entity_id,
                  event_names, target_entity_type, target_entity_id),
@@ -414,7 +472,7 @@ class SQLiteEventStore(EventStore):
         except sqlite3.OperationalError as e:
             if not simple_prop or "JSON" not in str(e).upper():
                 raise
-            sel, cols_t, n = self._scan_columns(
+            cols_t, n = self._scan_columns(
                 t, minimal, float_property, False,
                 (start_time, until_time, entity_type, entity_id,
                  event_names, target_entity_type, target_entity_id),
@@ -457,7 +515,7 @@ class SQLiteEventStore(EventStore):
             props = obj([json.loads(b) for b in cols_t[-1]])
 
         if minimal:
-            return EventFrame(
+            frame = EventFrame(
                 event=None,
                 entity_type=None,
                 entity_id=obj(cols_t[0]),
@@ -467,20 +525,26 @@ class SQLiteEventStore(EventStore):
                 properties=None,
                 value=values,
             )
-        return EventFrame(
-            event=obj(cols_t[0]),
-            entity_type=obj(cols_t[1]),
-            entity_id=obj(cols_t[2]),
-            target_entity_type=obj(cols_t[3]),
-            target_entity_id=obj(cols_t[4]),
-            event_time_ms=i64(cols_t[5]),
-            properties=props,
-            value=values,
-        )
+        else:
+            frame = EventFrame(
+                event=obj(cols_t[0]),
+                entity_type=obj(cols_t[1]),
+                entity_id=obj(cols_t[2]),
+                target_entity_type=obj(cols_t[3]),
+                target_entity_id=obj(cols_t[4]),
+                event_time_ms=i64(cols_t[5]),
+                properties=props,
+                value=values,
+            )
+        if cache_key is not None and self._version(t) == v_before:
+            # store only when no write landed during the scan: the
+            # fingerprint then provably describes the snapshot's contents
+            scan_cache.store(cache_key, frame)
+        return frame
 
     def _scan_columns(self, t, minimal, float_property, extract_in_sql,
                       filters):
-        """Run the columnar SELECT; returns (select_list, columns, n).
+        """Run the columnar SELECT; returns (columns, n).
 
         The SELECT is built as a list so positions are structural, and the
         value/properties expression — when present — is always LAST.
@@ -507,4 +571,4 @@ class SQLiteEventStore(EventStore):
             params = [f'$."{float_property}"'] + list(params)
         rows = self._conn.execute(sql, params).fetchall()
         cols_t = list(zip(*rows)) if rows else [()] * len(sel)
-        return sel, cols_t, len(rows)
+        return cols_t, len(rows)

@@ -277,3 +277,80 @@ def test_minimal_scan_matches_full_scan(tmp_path):
     r_full = full.to_ratings(rating_property="rating")
     r_mini = mini.to_ratings(rating_property="rating")
     assert r_full.rating.tolist() == r_mini.rating.tolist()
+
+
+def test_scan_cache_roundtrip_and_invalidation(tmp_path, monkeypatch):
+    """PIO_TPU_SCAN_CACHE snapshots identical scans and invalidates on any
+    table change (count or max-rowid fingerprint)."""
+    from predictionio_tpu.storage import scan_cache
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path / "home"))
+    store = SQLiteEventStore(str(tmp_path / "c.db"))
+
+    def ev(k, rating, eid=None):
+        return Event(event="rate", entity_type="user", entity_id=f"u{k}",
+                     target_entity_type="item", target_entity_id=f"i{k}",
+                     properties={"rating": rating}, event_id=eid)
+
+    for k in range(10):
+        store.insert(ev(k, k / 2.0), 1)
+
+    f1 = store.find_columnar(1, float_property="rating", minimal=True,
+                             cache=True)
+    assert len(list(scan_cache.cache_dir().glob("*.npz"))) == 1
+    f2 = store.find_columnar(1, float_property="rating", minimal=True,
+                             cache=True)
+    assert f1.value.tolist() == f2.value.tolist()
+    assert list(f1.entity_id) == list(f2.entity_id)
+    r1 = f1.to_ratings(rating_property="rating")
+    r2 = f2.to_ratings(rating_property="rating")
+    assert r1.rating.tolist() == r2.rating.tolist()
+
+    # REPLACE an existing event (count unchanged) -> fingerprint changes
+    eid = next(iter(store.find(1))).event_id
+    store.insert(ev(0, 5.0, eid=eid), 1)
+    f3 = store.find_columnar(1, float_property="rating", minimal=True,
+                             cache=True)
+    assert sorted(f3.value.tolist()) != sorted(f1.value.tolist())
+    assert 5.0 in f3.value.tolist()
+
+    # different query params never share a snapshot
+    f4 = store.find_columnar(1, float_property="rating", cache=True)
+    assert f4.event is not None and len(f4) == 10
+
+    # cache disabled by default (no env, no flag)
+    monkeypatch.delenv("PIO_TPU_SCAN_CACHE", raising=False)
+    n_before = len(list(scan_cache.cache_dir().glob("*.npz")))
+    store.find_columnar(1, float_property="rating")
+    assert len(list(scan_cache.cache_dir().glob("*.npz"))) == n_before
+
+
+def test_scan_cache_survives_rowid_reuse(tmp_path, monkeypatch):
+    """Delete the max-rowid row then insert: (count, max rowid) would
+    repeat, but the write-version fingerprint must still invalidate."""
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path / "home"))
+    store = SQLiteEventStore(str(tmp_path / "r.db"))
+    ids = []
+    for k in range(5):
+        ids.append(store.insert(
+            Event(event="rate", entity_type="user", entity_id=f"u{k}",
+                  target_entity_type="item", target_entity_id="i",
+                  properties={"rating": 1.0}), 1))
+    f1 = store.find_columnar(1, float_property="rating", minimal=True,
+                             cache=True)
+    assert len(f1) == 5
+    # remove the LAST inserted row (max rowid), add a different one
+    assert store.delete(ids[-1], 1)
+    store.insert(Event(event="rate", entity_type="user", entity_id="uNEW",
+                       target_entity_type="item", target_entity_id="i",
+                       properties={"rating": 9.0}), 1)
+    f2 = store.find_columnar(1, float_property="rating", minimal=True,
+                             cache=True)
+    assert len(f2) == 5
+    assert "uNEW" in list(f2.entity_id)
+    assert 9.0 in f2.value.tolist()

@@ -278,6 +278,10 @@ def cmd_train(args, storage: Storage) -> int:
     from ..workflow.train import run_train
 
     enable_compilation_cache()
+    if getattr(args, "scan_cache", False):
+        import os
+
+        os.environ["PIO_TPU_SCAN_CACHE"] = "1"
     verify_template_min_version(Path(args.engine_json).parent)
     if args.coordinator or args.num_processes is not None:
         # multi-host bring-up: each host runs the same `pio-tpu train`
@@ -328,6 +332,10 @@ def cmd_deploy(args, storage: Storage) -> int:
     from ..tools.template_gallery import verify_template_min_version
 
     enable_compilation_cache()
+    if getattr(args, "scan_cache", False):
+        import os
+
+        os.environ["PIO_TPU_SCAN_CACHE"] = "1"
     verify_template_min_version(Path(args.engine_json).parent)
     engine, ep, variant = load_engine_from_variant(
         args.engine_json, args.engine_factory
@@ -388,6 +396,10 @@ def cmd_eval(args, storage: Storage) -> int:
     from ..workflow.evaluate import run_evaluation
 
     enable_compilation_cache()
+    if getattr(args, "scan_cache", False):
+        import os
+
+        os.environ["PIO_TPU_SCAN_CACHE"] = "1"
     evaluation = resolve_attr(args.evaluation)
     if callable(evaluation) and not hasattr(evaluation, "engine"):
         evaluation = evaluation()
@@ -659,8 +671,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="multi-host: coordinator address host:port")
     t.add_argument("--num-processes", type=int)
     t.add_argument("--process-id", type=int)
+    t.add_argument("--scan-cache", action="store_true",
+                   help="snapshot columnar event scans to npz keyed by a "
+                   "table write-version (storage/scan_cache.py); repeat "
+                   "trains on an unchanged table skip the sqlite scan")
 
     d = sub.add_parser("deploy", help="deploy an engine server")
+    d.add_argument("--scan-cache", action="store_true",
+                   help="snapshot columnar event scans to npz keyed by a "
+                   "table write-version (storage/scan_cache.py)")
     d.add_argument("--engine-json", default="engine.json")
     d.add_argument("--engine-factory")
     d.add_argument("--engine-instance-id")
@@ -679,6 +698,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--parallelism", type=int, default=1,
                    help="candidates scored concurrently (>1 disables "
                         "FastEval prefix caching)")
+    e.add_argument("--scan-cache", action="store_true",
+                   help="snapshot columnar event scans to npz keyed by a "
+                   "table write-version (storage/scan_cache.py)")
 
     ev = sub.add_parser("eventserver", help="run the event server")
     ev.add_argument("--ip", default="0.0.0.0")

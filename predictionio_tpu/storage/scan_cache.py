@@ -7,13 +7,15 @@ region scan per Spark job).  This cache snapshots the column arrays to
 one ``.npz`` per (database, table, query, table-state) and serves
 subsequent identical scans from disk at numpy mmap speed.
 
-Correctness: the cache key includes a **table fingerprint**
-``(row count, max rowid)``.  Any insert/delete changes the count; any
-``INSERT OR REPLACE`` of an existing event deletes + re-inserts, which
-bumps ``max(rowid)`` (sqlite allocates monotonically unless VACUUM runs
-— a VACUUM also rewrites rowids, changing the fingerprint).  A stale
-entry therefore cannot be served; it is simply never looked up again and
-eventually pruned.
+Correctness: the cache key includes a **monotonic per-table
+write-version counter** (bumped inside every write's transaction —
+``SQLiteEventStore._bump_version``; a rolled-back bulk scope rolls its
+bump back too) plus the **database file's identity** (inode + ctime, so
+deleting and recreating the db cannot alias the old file's counters).
+Snapshots are stored only when the version is unchanged across the scan
+and never from inside a bulk() scope, so a published snapshot always
+describes committed data.  A stale entry cannot be served; it is simply
+never looked up again and eventually pruned.
 
 Enabled via ``PIO_TPU_SCAN_CACHE=1`` (opt-in: the write amplification is
 only worth it for workflows that re-read), or per call with

@@ -145,10 +145,14 @@ class SQLiteEventStore(EventStore):
         t = _table_name(app_id, channel_id)
         with self._lock:
             self._conn.execute(f"DROP TABLE IF EXISTS {t}")
+            # the version table may not exist yet on a store that never
+            # ensured any event table; removal must still bump (cached
+            # scans of the dropped table die with it)
             self._conn.execute(
-                "INSERT INTO _scan_versions VALUES (?, 1) "
-                "ON CONFLICT(tbl) DO UPDATE SET v = v + 1", (t,)
+                "CREATE TABLE IF NOT EXISTS _scan_versions "
+                "(tbl TEXT PRIMARY KEY, v INTEGER NOT NULL)"
             )
+            self._bump_version(t)
             self._conn.commit()
             self._known_tables.discard(t)
         return True
@@ -430,19 +434,32 @@ class SQLiteEventStore(EventStore):
         ``None``; ``to_ratings``/``select`` handle that).
 
         ``cache`` (default: env ``PIO_TPU_SCAN_CACHE=1``) snapshots the
-        result to an npz keyed by the table's (count, max rowid)
-        fingerprint, so repeat trains on an unchanged table read back at
-        numpy speed instead of re-paying the cursor scan (scan_cache.py).
+        result to an npz keyed by the table's write-version counter (see
+        :meth:`_bump_version`) plus the database file's identity, so
+        repeat trains on an unchanged table read back at numpy speed
+        instead of re-paying the cursor scan (scan_cache.py).
         """
         t = self._ensure_table(app_id, channel_id)
         from . import scan_cache
 
         cache_key = None
         v_before = None
-        if scan_cache.enabled(cache) and self._path != ":memory:":
+        # no caching inside a bulk() scope: uncommitted rows must never be
+        # published, and a rollback would strand the snapshot
+        if (
+            scan_cache.enabled(cache)
+            and self._path != ":memory:"
+            and self._bulk_depth == 0
+        ):
+            st = __import__("os").stat(self._path)
             v_before = self._version(t)
             cache_key = scan_cache.key(
-                self._path, t, (v_before,),
+                self._path, t,
+                # db-file identity: deleting and recreating the database
+                # resets the version counter, so the inode/ctime must be
+                # part of the fingerprint or the old file's snapshots
+                # would be served for the new file's data
+                (v_before, st.st_ino, st.st_ctime_ns),
                 [
                     str(start_time), str(until_time), entity_type,
                     entity_id, event_names, target_entity_type,

@@ -514,3 +514,50 @@ def test_device_staging_sharded_placement():
     rU, _ = rep.run(*rep.init_factors(), cfg.num_iterations)
     np.testing.assert_allclose(np.asarray(sU)[:nu], np.asarray(rU),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_sweep_train_matches_independent_trains():
+    """vmapped lambda sweep == K independent trains, staging paid once."""
+    from predictionio_tpu.models.als import sweep_train_als
+
+    u, i, v, nu, ni = _toy(n_users=25, n_items=15, density=0.5)
+    lams = [0.01, 0.1, 1.0]
+    cfg = ALSConfig(rank=4, num_iterations=4, lam=-1.0)  # lam overridden
+    swept = sweep_train_als((u, i, v), nu, ni, cfg, lams=lams)
+    assert len(swept) == 3
+    for lam, got in zip(lams, swept):
+        solo = train_als((u, i, v), nu, ni,
+                         ALSConfig(rank=4, num_iterations=4, lam=lam))
+        np.testing.assert_allclose(got.user_factors, solo.user_factors,
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(got.item_factors, solo.item_factors,
+                                   rtol=2e-4, atol=2e-5)
+    # distinct lambdas must yield distinct models
+    assert not np.allclose(swept[0].user_factors, swept[2].user_factors)
+
+
+def test_sweep_train_rejects_unsupported_modes():
+    from predictionio_tpu.models.als import sweep_train_als
+
+    u, i, v, nu, ni = _toy()
+    with pytest.raises(ValueError, match="replicated"):
+        sweep_train_als((u, i, v), nu, ni,
+                        ALSConfig(factor_placement="sharded"), lams=[0.1])
+    with pytest.raises(ValueError, match="solver"):
+        sweep_train_als((u, i, v), nu, ni,
+                        ALSConfig(solver="pallas"), lams=[0.1])
+    assert sweep_train_als((u, i, v), nu, ni, ALSConfig(), lams=[]) == []
+
+
+def test_sweep_train_implicit_mode():
+    from predictionio_tpu.models.als import sweep_train_als
+
+    u, i, v, nu, ni = _toy(seed=2)
+    v = np.abs(v) + 1.0
+    cfg = ALSConfig(rank=3, num_iterations=3, implicit=True, alpha=2.0)
+    swept = sweep_train_als((u, i, v), nu, ni, cfg, lams=[0.05, 0.5])
+    solo = train_als((u, i, v), nu, ni,
+                     ALSConfig(rank=3, num_iterations=3, implicit=True,
+                               alpha=2.0, lam=0.5))
+    np.testing.assert_allclose(swept[1].user_factors, solo.user_factors,
+                               rtol=2e-4, atol=2e-5)

@@ -354,3 +354,58 @@ def test_scan_cache_survives_rowid_reuse(tmp_path, monkeypatch):
     assert len(f2) == 5
     assert "uNEW" in list(f2.entity_id)
     assert 9.0 in f2.value.tolist()
+
+
+def test_scan_cache_db_recreation_and_bulk_scope(tmp_path, monkeypatch):
+    """Recreating the db file must not serve the old file's snapshots, and
+    scans inside an uncommitted bulk() scope are never cached."""
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path / "home"))
+    db = tmp_path / "x.db"
+
+    def ev(k, rating):
+        return Event(event="rate", entity_type="user", entity_id=f"u{k}",
+                     target_entity_type="item", target_entity_id="i",
+                     properties={"rating": rating})
+
+    s1 = SQLiteEventStore(str(db))
+    for k in range(5):
+        s1.insert(ev(k, 1.0), 1)
+    f1 = s1.find_columnar(1, float_property="rating", minimal=True,
+                          cache=True)
+    assert f1.value.tolist() == [1.0] * 5
+    s1.close()
+    db.unlink()
+    for suffix in ("-wal", "-shm"):
+        p = db.with_name(db.name + suffix)
+        if p.exists():
+            p.unlink()
+
+    s2 = SQLiteEventStore(str(db))
+    s2.insert_batch([ev(k, 9.0) for k in range(5)], 1)
+    f2 = s2.find_columnar(1, float_property="rating", minimal=True,
+                          cache=True)
+    assert f2.value.tolist() == [9.0] * 5
+
+    # bulk scope: uncommitted rows must not be published to the cache
+    try:
+        with s2.bulk():
+            s2.insert(ev(99, 2.0), 1)
+            fb = s2.find_columnar(1, float_property="rating", minimal=True,
+                                  cache=True)
+            assert len(fb) == 6      # same-connection read sees it
+            raise RuntimeError("abort bulk")
+    except RuntimeError:
+        pass
+    f3 = s2.find_columnar(1, float_property="rating", minimal=True,
+                          cache=True)
+    assert len(f3) == 5 and f3.value.tolist() == [9.0] * 5
+
+
+def test_remove_channel_on_fresh_store(tmp_path):
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    store = SQLiteEventStore(str(tmp_path / "fresh.db"))
+    assert store.remove_channel(1) is True

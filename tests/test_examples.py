@@ -368,3 +368,16 @@ def test_trim_app_failed_copy_leaves_dst_empty(in_example, storage_memory):
     assert list(es.find(app_id=2)) == []  # cleaned up
     models = engine.train(ctx, ep)  # retry succeeds
     assert models[0].copied == 2
+
+
+def test_lambda_sweep(in_example, capsys):
+    m = in_example("lambda-sweep")
+    m.main()
+    out = capsys.readouterr().out
+    assert "best lambda" in out
+    # the winner must be an interior candidate (underfit/overfit extremes
+    # lose on holdout) and every candidate row must print
+    for lam in m.LAMBDAS:
+        assert f"{lam:>8}" in out
+    best = float(out.rsplit("best lambda = ", 1)[1].split()[0])
+    assert best in (0.05, 0.1)

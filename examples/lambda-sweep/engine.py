@@ -30,10 +30,12 @@ LAMBDAS = [0.01, 0.05, 0.1, 0.5, 1.0]
 def load_ratings(path: Path):
     rows = [ln.strip().split(",") for ln in path.read_text().splitlines()
             if ln.strip()]
-    users = StringIndex.from_values([r[0] for r in rows])
-    items = StringIndex.from_values([r[1] for r in rows])
-    u = users.encode(np.array([r[0] for r in rows], dtype=object))
-    i = items.encode(np.array([r[1] for r in rows], dtype=object))
+    us = [r[0] for r in rows]
+    its = [r[1] for r in rows]
+    users = StringIndex.from_values(us)
+    items = StringIndex.from_values(its)
+    u = users.encode(us)
+    i = items.encode(its)
     v = np.array([float(r[2]) for r in rows], dtype=np.float32)
     return u.astype(np.int32), i.astype(np.int32), v, len(users), len(items)
 

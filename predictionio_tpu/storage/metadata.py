@@ -195,6 +195,9 @@ class MetadataStore:
         self._path = str(path)
         self._lock = threading.RLock()
         self._conn = sqlite3.connect(self._path, check_same_thread=False)
+        # wait out cross-PROCESS contention (multi-host chief/peer reads,
+        # CLI + server sharing one metadata db) instead of SQLITE_BUSY
+        self._conn.execute("PRAGMA busy_timeout=10000")
         self._conn.executescript(_SCHEMA)
         self._conn.commit()
 

@@ -94,6 +94,11 @@ class SQLiteEventStore(EventStore):
         conn = sqlite3.connect(self._path, check_same_thread=False)
         conn.execute("PRAGMA journal_mode=WAL")
         conn.execute("PRAGMA synchronous=NORMAL")
+        # without a busy timeout sqlite raises SQLITE_BUSY *immediately*
+        # on any cross-connection contention (e.g. a WAL checkpoint racing
+        # a commit), which surfaced as rare 500s under the event server's
+        # concurrent posts; waiting is always the right call here
+        conn.execute("PRAGMA busy_timeout=10000")
         return conn
 
     @property

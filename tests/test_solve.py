@@ -36,3 +36,52 @@ def test_well_conditioned_large_batch():
     x = np.asarray(cholesky_solve_batched(A, b))
     res = np.einsum("bij,bj->bi", A, x) - b
     assert np.abs(res).max() < 1e-2
+
+
+@pytest.mark.parametrize("R", [10, 33, 100, 128])
+def test_odd_ranks(R):
+    """Non-power-of-two ranks exercise the lane/sublane padding and the
+    augmented column placement (W = R + 1)."""
+    A, b = _spd_batch(5, R, seed=4)
+    x = np.asarray(cholesky_solve_batched(A, b))
+    ref = np.stack([np.linalg.solve(A[i], b[i]) for i in range(5)])
+    np.testing.assert_allclose(x, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_ill_conditioned_regularized():
+    """ALS-shaped systems: rank-deficient Gram + lambda*n*I loading.
+    No-pivot Gauss-Jordan must stay stable at condition ~1e5."""
+    rng = np.random.default_rng(5)
+    B, R = 16, 32
+    # rank-deficient Gram (only 4 contributing vectors) + small ridge
+    V = rng.normal(size=(B, 4, R)).astype(np.float32)
+    A = np.einsum("bkr,bks->brs", V, V) + 1e-3 * np.eye(R, dtype=np.float32)
+    b = rng.normal(size=(B, R)).astype(np.float32)
+    x = np.asarray(cholesky_solve_batched(A, b))
+    ref = np.stack([
+        np.linalg.solve(A[i].astype(np.float64), b[i].astype(np.float64))
+        for i in range(B)
+    ])
+    # relative residual is the honest stability metric at this
+    # conditioning (~1e6).  Measured on this fixture: Gauss-Jordan
+    # 2.8e-3 vs f32 Cholesky 1.1e-3 — the expected mild no-pivot gap,
+    # same order of magnitude.
+    res = np.einsum("bij,bj->bi", A.astype(np.float64), x) - b
+    rel = np.abs(res).max() / max(np.abs(b).max(), 1.0)
+    assert rel < 1e-2
+    np.testing.assert_allclose(x, ref, rtol=5e-2, atol=5e-2)
+
+
+def test_wide_value_range():
+    """Pivot magnitudes spanning ~1e-3..1e3 (hot users vs cold users in
+    weighted-lambda ALS) must not blow up."""
+    rng = np.random.default_rng(6)
+    B, R = 8, 16
+    scales = np.logspace(-3, 3, B).astype(np.float32)
+    M = rng.normal(size=(B, R, R)).astype(np.float32)
+    A = (M @ M.transpose(0, 2, 1) + R * np.eye(R, dtype=np.float32))
+    A = A * scales[:, None, None]
+    b = rng.normal(size=(B, R)).astype(np.float32)
+    x = np.asarray(cholesky_solve_batched(A, b))
+    ref = np.stack([np.linalg.solve(A[i], b[i]) for i in range(B)])
+    np.testing.assert_allclose(x, ref, rtol=1e-3, atol=1e-3)

@@ -20,6 +20,8 @@ import secrets
 import re
 import sqlite3
 import threading
+
+from ._sqlite_util import SerializedConnection
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Optional
@@ -194,10 +196,14 @@ class MetadataStore:
             )
         self._path = str(path)
         self._lock = threading.RLock()
-        self._conn = sqlite3.connect(self._path, check_same_thread=False)
+        raw = sqlite3.connect(self._path, check_same_thread=False)
         # wait out cross-PROCESS contention (multi-host chief/peer reads,
         # CLI + server sharing one metadata db) instead of SQLITE_BUSY
-        self._conn.execute("PRAGMA busy_timeout=10000")
+        raw.execute("PRAGMA busy_timeout=10000")
+        # one shared connection, every statement serialized + materialized
+        # under the lock: bare sqlite3 connections break under interleaved
+        # multi-thread use (event-server auth reads raced training writes)
+        self._conn = SerializedConnection(raw, self._lock)
         self._conn.executescript(_SCHEMA)
         self._conn.commit()
 

@@ -29,6 +29,7 @@ from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
+from ._sqlite_util import SerializedConnection
 from .columnar import EventFrame
 from .event import (
     DataMap,
@@ -85,10 +86,14 @@ class SQLiteEventStore(EventStore):
         self._lock = threading.RLock()
         self._local = threading.local()
         self._known_tables: set[str] = set()
-        # :memory: must share one connection across threads
+        # :memory: must share one connection across threads; wrap it so
+        # interleaved multi-thread statements serialize under the lock
+        # (file-backed stores use per-thread connections instead)
         self._shared = self._path == ":memory:"
         if self._shared:
-            self._conn_shared = self._connect()
+            self._conn_shared = SerializedConnection(
+                self._connect(), self._lock
+            )
 
     def _connect(self) -> sqlite3.Connection:
         conn = sqlite3.connect(self._path, check_same_thread=False)
@@ -102,7 +107,7 @@ class SQLiteEventStore(EventStore):
         return conn
 
     @property
-    def _conn(self) -> sqlite3.Connection:
+    def _conn(self) -> "sqlite3.Connection | SerializedConnection":
         if self._shared:
             return self._conn_shared
         conn = getattr(self._local, "conn", None)

@@ -70,6 +70,26 @@ class BiMap(Generic[K, V]):
         return BiMap({k: i for i, k in enumerate(uniq)})
 
 
+def _pandas():
+    """pandas if importable (baked into this image), else None.
+
+    Its hash-table factorize/get_indexer run the 20M-id dictionary
+    builds at C speed (SURVEY §7 hard-part 3: measured 8.4 s vs 42 s for
+    the pure-dict path at ML-20M scale); every caller keeps a
+    pandas-free fallback.
+    """
+    try:
+        import pandas as pd
+
+        return pd
+    except Exception:  # pragma: no cover - image always has pandas
+        return None
+
+
+# below this many lookups the dict path wins (no pandas Index build)
+_BULK_ENCODE_MIN = 65_536
+
+
 class StringIndex:
     """Contiguous index over string ids with a vectorized decode path.
 
@@ -78,7 +98,7 @@ class StringIndex:
     back to ids via one NumPy gather.
     """
 
-    __slots__ = ("_to_ix", "_ids")
+    __slots__ = ("_to_ix", "_ids", "_pd_index")
 
     def __init__(self, ids: Sequence[str]):
         arr = np.asarray(list(ids), dtype=object)
@@ -86,11 +106,35 @@ class StringIndex:
             raise ValueError("StringIndex ids must be unique")
         self._ids = arr
         self._to_ix = {s: i for i, s in enumerate(arr.tolist())}
+        self._pd_index = None
 
     @staticmethod
     def from_values(values: Iterable[str]) -> "StringIndex":
         """Deterministic build: sorted unique (bulk-array path)."""
         return StringIndex(sorted(set(values)))
+
+    @staticmethod
+    def factorize(values) -> tuple["StringIndex", np.ndarray]:
+        """Index + int32 codes for ``values`` in one pass.
+
+        Equivalent to ``idx = from_values(values); idx.encode(values)``
+        (sorted-unique determinism) but hash-based at C speed when
+        pandas is available — the training-read hot path for string id
+        dictionaries at 20M-rating scale.
+        """
+        pd = _pandas()
+        if pd is not None:
+            arr = np.asarray(values, dtype=object)
+            codes, uniques = pd.factorize(arr, sort=True)
+            if len(arr) and (codes < 0).any():
+                # pd.factorize encodes None/NaN as -1; the pandas-free
+                # fallback raises on them (sorted() over mixed types) —
+                # keep the loud behavior so malformed events never get
+                # silently dropped
+                raise TypeError("id values must be non-null strings")
+            return StringIndex(uniques.tolist()), codes.astype(np.int32)
+        idx = StringIndex.from_values(values)
+        return idx, idx.encode(values)
 
     def __len__(self) -> int:
         return len(self._ids)
@@ -113,8 +157,22 @@ class StringIndex:
 
     def encode(self, values: Iterable[str]) -> np.ndarray:
         """ids -> int32 indices; unknown ids become -1."""
+        if isinstance(values, np.ndarray) and len(values) >= _BULK_ENCODE_MIN:
+            pd = _pandas()
+            if pd is not None:
+                # hash-join lookup at C speed; -1 for unknowns matches
+                # the dict path exactly
+                # getattr: instances unpickled from pre-_pd_index
+                # checkpoints restore only the slots they were saved with
+                if getattr(self, "_pd_index", None) is None:
+                    self._pd_index = pd.Index(self._ids)
+                return self._pd_index.get_indexer(
+                    np.asarray(values, dtype=object)
+                ).astype(np.int32)
         g = self._to_ix.get
-        return np.fromiter((g(v, -1) for v in values), dtype=np.int32)
+        return np.fromiter(
+            (g(v, -1) for v in values), dtype=np.int32,
+        )
 
     def decode(self, ixs: np.ndarray) -> np.ndarray:
         """int indices -> id object array (single gather)."""

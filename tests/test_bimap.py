@@ -41,3 +41,74 @@ def test_string_index_encode_decode():
 def test_string_index_unique_required():
     with pytest.raises(ValueError):
         StringIndex(["a", "a"])
+
+
+def test_factorize_matches_from_values_encode():
+    import numpy as np
+
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    vals = np.asarray(
+        ["b", "a", "c", "a", "b", "b", "ümlaut", "漢", "a"], dtype=object
+    )
+    idx, codes = StringIndex.factorize(vals)
+    ref = StringIndex.from_values(vals.tolist())
+    assert list(idx.ids) == list(ref.ids)          # sorted-unique order
+    np.testing.assert_array_equal(codes, ref.encode(vals))
+    assert codes.dtype == np.int32
+
+
+def test_bulk_encode_matches_dict_path_with_unknowns():
+    import numpy as np
+
+    from predictionio_tpu.storage import bimap
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    idx = StringIndex.from_values([f"id{k}" for k in range(100)])
+    rng = np.random.default_rng(0)
+    vals = np.asarray(
+        [f"id{k}" if k % 3 else "MISSING" for k in rng.integers(0, 150, 200_000)],
+        dtype=object,
+    )
+    fast = idx.encode(vals)                        # pandas hash path (bulk)
+    old = bimap._BULK_ENCODE_MIN
+    try:
+        bimap._BULK_ENCODE_MIN = 10**12            # force dict path
+        slow = idx.encode(vals)
+    finally:
+        bimap._BULK_ENCODE_MIN = old
+    np.testing.assert_array_equal(fast, slow)
+    assert (fast == -1).any()                      # unknowns exercised
+
+
+def test_factorize_rejects_null_ids():
+    import numpy as np
+    import pytest
+
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    with pytest.raises(TypeError):
+        StringIndex.factorize(np.asarray(["a", None, "b"], dtype=object))
+
+
+def test_encode_survives_pre_upgrade_pickle():
+    """Checkpoints pickled before the _pd_index slot existed restore only
+    the slots they were saved with; bulk encode must not crash."""
+    import numpy as np
+
+    from predictionio_tpu.storage import bimap
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    idx = StringIndex.from_values(["a", "b", "c"])
+    revived = StringIndex.__new__(StringIndex)  # old pickles: only the
+    revived._to_ix = idx._to_ix                 # slots that were saved
+    revived._ids = idx._ids                     # get restored; _pd_index
+    # stays unset, exactly like a pre-upgrade checkpoint
+    vals = np.asarray(["a", "c", "zz"] * 30_000, dtype=object)
+    old = bimap._BULK_ENCODE_MIN
+    try:
+        bimap._BULK_ENCODE_MIN = 1
+        out = revived.encode(vals)
+    finally:
+        bimap._BULK_ENCODE_MIN = old
+    assert out[0] == 0 and out[1] == 2 and out[2] == -1

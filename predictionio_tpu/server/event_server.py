@@ -200,7 +200,14 @@ class EventServer(HTTPServerBase):
                 if not isinstance(items, list):
                     raise ValueError("batch body must be a JSON array")
                 if len(items) > 50:
-                    raise ValueError("batch limited to 50 events")
+                    # the reference's limit (EventAPI.scala batch route);
+                    # the REST path is for live trickle ingest — bulk
+                    # loads belong on `pio-tpu import` (native scanner,
+                    # one transaction, 55-95k events/s)
+                    raise ValueError(
+                        "batch limited to 50 events; use `pio-tpu import` "
+                        "for bulk loads"
+                    )
                 results = []
                 for item in items:
                     try:

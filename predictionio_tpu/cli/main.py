@@ -452,14 +452,13 @@ def cmd_dashboard(args, storage: Storage) -> int:
 
 
 def cmd_import(args, storage: Storage) -> int:
-    from ..tools.import_export import import_events, import_events_columnar
+    from ..tools.import_export import import_events
 
     es = storage.get_event_store()
     es.init_channel(args.appid, args.channel)
-    if str(args.input).endswith(".npz"):
-        n = import_events_columnar(args.input, es, args.appid, args.channel)
-    else:
-        n = import_events(args.input, es, args.appid, args.channel)
+    # import_events infers the format (extension or content magic) and
+    # routes to the JSON-lines / columnar / parquet reader itself
+    n = import_events(args.input, es, args.appid, args.channel)
     _out(f"Imported {n} events.")
     return 0
 
@@ -471,9 +470,9 @@ def cmd_export(args, storage: Storage) -> int:
     es.init_channel(args.appid, args.channel)
     n = export_events(args.output, es, args.appid, args.channel,
                       fmt=args.format)
-    fmt = args.format or (
-        "columnar" if str(args.output).endswith(".npz") else "json"
-    )
+    from ..tools.import_export import infer_format
+
+    fmt = args.format or infer_format(args.output)
     written = columnar_path(args.output) if fmt == "columnar" else args.output
     _out(f"Exported {n} events to {written}.")
     return 0
@@ -715,7 +714,9 @@ def build_parser() -> argparse.ArgumentParser:
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
 
-    im = sub.add_parser("import", help="import events from JSON-lines file")
+    im = sub.add_parser("import",
+                        help="import events (JSON-lines, .npz columnar, "
+                        "or .parquet)")
     im.add_argument("--appid", type=int, required=True)
     im.add_argument("--channel", type=int, default=0)
     im.add_argument("--input", required=True)
@@ -724,8 +725,9 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--appid", type=int, required=True)
     ex.add_argument("--channel", type=int, default=0)
     ex.add_argument("--output", required=True)
-    ex.add_argument("--format", choices=["json", "columnar"],
-                    help="default: json, or columnar if output is .npz")
+    ex.add_argument("--format", choices=["json", "columnar", "parquet"],
+                    help="default: json; columnar if output is .npz, "
+                    "parquet if .parquet")
 
     tp = sub.add_parser("template", help="engine template gallery")
     tps = tp.add_subparsers(dest="template_command", required=True)

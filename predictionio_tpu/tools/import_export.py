@@ -1,10 +1,12 @@
 """Event export/import: JSON-lines files <-> event store.
 
-Parity with reference `tools/export/EventsToFile.scala:30-104` (JSON output;
-the Parquet variant is out of scope for an embedded store) and
-`tools/imprt/FileToEvents.scala:30-95`.  The reference runs these as Spark
-jobs; here they are streaming host loops over the embedded store with
-batched inserts.
+Parity with reference `tools/export/EventsToFile.scala:30-104` (JSON
+lines and, via pyarrow, the reference's SparkSQL-Parquet option) and
+`tools/imprt/FileToEvents.scala:30-95`.  The reference runs these as
+Spark jobs; here they are streaming host loops over the embedded store
+with batched inserts.  Formats are inferred from the file extension or
+content magic (`infer_format`), so any of JSON-lines / columnar npz /
+Parquet round-trip through the same two entry points.
 """
 
 from __future__ import annotations
@@ -21,8 +23,33 @@ __all__ = [
     "import_events_columnar",
     "export_events",
     "columnar_path",
+    "infer_format",
     "import_ratings_csv",
 ]
+
+
+def infer_format(path: str | Path, default: str = "json") -> str:
+    """File format from extension, else content magic, else ``default``.
+
+    One inference shared by the CLI and both library entry points, so a
+    Parquet file under any name (PAR1 magic) or an npz under any name
+    (zip magic) is recognized everywhere.
+    """
+    p = str(path)
+    if p.endswith(".npz"):
+        return "columnar"
+    if p.endswith(".parquet"):
+        return "parquet"
+    try:
+        with open(p, "rb") as f:
+            magic = f.read(4)
+        if magic == b"PAR1":
+            return "parquet"
+        if magic[:2] == b"PK":
+            return "columnar"
+    except OSError:
+        pass
+    return default
 
 _BATCH = 5000
 
@@ -40,6 +67,11 @@ def import_events(
     whole import runs in one ``store.bulk()`` scope (transactional
     backends commit once at the end, not per batch).
     """
+    fmt = infer_format(path)
+    if fmt == "parquet":
+        return _import_parquet(path, store, app_id, channel_id)
+    if fmt == "columnar":
+        return import_events_columnar(path, store, app_id, channel_id)
     # table DDL before the transaction scope: sqlite auto-commits DDL,
     # which would break the all-or-nothing rollback guarantee
     store.init_channel(app_id, channel_id)
@@ -163,13 +195,19 @@ def export_events(
 ) -> int:
     """Event store -> file; returns number exported.
 
-    ``fmt``: ``"json"`` (JSON lines, default) or ``"columnar"`` (npz of
-    per-field arrays — the analogue of the reference's Parquet option in
-    `export/EventsToFile.scala:30-104`, chosen for zero extra deps and a
-    zero-copy path into jax).  ``.npz`` extension implies columnar.
+    ``fmt``: ``"json"`` (JSON lines, default), ``"columnar"`` (npz of
+    per-field arrays with a zero-copy path into jax), or ``"parquet"``
+    (the reference's SparkSQL-Parquet option,
+    `export/EventsToFile.scala:30-104`, via pyarrow).  Extensions
+    ``.npz``/``.parquet`` imply their formats.
     """
     if fmt is None:
-        fmt = "columnar" if str(path).endswith(".npz") else "json"
+        # extension only: the file does not exist yet
+        p = str(path)
+        fmt = ("columnar" if p.endswith(".npz")
+               else "parquet" if p.endswith(".parquet") else "json")
+    if fmt == "parquet":
+        return _export_parquet(path, store, app_id, channel_id)
     if fmt == "columnar":
         # np.savez appends '.npz' itself; normalize up front so the
         # reported filename is the one actually written
@@ -219,6 +257,108 @@ def _export_columnar(
         path, **{k: np.asarray(v, dtype=np.str_) for k, v in cols.items()}
     )
     return n
+
+
+_PARQUET_COLUMNS = (
+    "eventId", "event", "entityType", "entityId", "targetEntityType",
+    "targetEntityId", "properties", "eventTime", "tags", "prId",
+    "creationTime",
+)
+
+
+def _export_parquet(
+    path: str | Path, store: EventStore, app_id: int, channel_id: int
+) -> int:
+    """Events -> one Parquet file (wire-format fields; `properties` and
+    `tags` as JSON text, times as ISO-8601 strings — readable by any
+    Parquet consumer, round-trips through :func:`_import_parquet`).
+    Streams in `_BATCH`-row record batches: event sets at this repo's
+    20M scale must never be resident as Python lists all at once."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    schema = pa.schema([(c, pa.string()) for c in _PARQUET_COLUMNS])
+    n = 0
+    cols: dict[str, list] = {c: [] for c in _PARQUET_COLUMNS}
+
+    def flush(writer):
+        nonlocal cols
+        if cols["event"]:
+            writer.write_batch(pa.record_batch(
+                [pa.array(cols[c], pa.string()) for c in _PARQUET_COLUMNS],
+                schema=schema,
+            ))
+            cols = {c: [] for c in _PARQUET_COLUMNS}
+
+    with pq.ParquetWriter(str(path), schema) as writer:
+        for e in store.find(app_id=app_id, channel_id=channel_id):
+            d = e.to_json()
+            cols["eventId"].append(d.get("eventId"))
+            cols["event"].append(d["event"])
+            cols["entityType"].append(d["entityType"])
+            cols["entityId"].append(d["entityId"])
+            cols["targetEntityType"].append(d.get("targetEntityType"))
+            cols["targetEntityId"].append(d.get("targetEntityId"))
+            cols["properties"].append(
+                json.dumps(d.get("properties") or {}, separators=(",", ":"))
+            )
+            cols["eventTime"].append(d["eventTime"])
+            cols["tags"].append(json.dumps(list(e.tags)))
+            cols["prId"].append(d.get("prId"))
+            cols["creationTime"].append(d["creationTime"])
+            n += 1
+            if len(cols["event"]) >= _BATCH:
+                flush(writer)
+        flush(writer)
+    return n
+
+
+def _import_parquet(
+    path: str | Path, store: EventStore, app_id: int, channel_id: int
+) -> int:
+    """Parquet -> event store.  Rows go through ``Event.from_json`` +
+    validation — external Parquet files get the same scrutiny as JSON
+    lines (the native fast path stays the JSON importer's)."""
+    import pyarrow.parquet as pq
+
+    _OPT = ("eventId", "targetEntityType", "targetEntityId", "eventTime",
+            "prId", "creationTime")
+    imported = 0
+    store.init_channel(app_id, channel_id)
+    pf = pq.ParquetFile(str(path))
+    with store.bulk():
+        for rb in pf.iter_batches(batch_size=_BATCH):
+            data = {name: rb.column(i).to_pylist()
+                    for i, name in enumerate(rb.schema.names)}
+            n = rb.num_rows
+            none_col = [None] * n
+            opt_cols = {name: data.get(name, none_col) for name in _OPT}
+            props_col = data.get("properties", none_col)
+            tags_col = data.get("tags", none_col)
+            batch: list[Event] = []
+            for k in range(n):
+                d = {
+                    "event": data["event"][k],
+                    "entityType": data["entityType"][k],
+                    "entityId": data["entityId"][k],
+                }
+                for name in _OPT:
+                    v = opt_cols[name][k]
+                    if v is not None:
+                        d[name] = v
+                props = props_col[k]
+                if props:
+                    d["properties"] = json.loads(props)
+                tags = tags_col[k]
+                if tags:
+                    d["tags"] = (json.loads(tags) if isinstance(tags, str)
+                                 else list(tags))
+                batch.append(Event.from_json(d))
+            if batch:
+                store.insert_batch(batch, app_id, channel_id,
+                                   validate=False)
+                imported += len(batch)
+    return imported
 
 
 def import_events_columnar(

@@ -36,6 +36,11 @@ class MaterializedCursor:
             return row
         return None
 
+    def fetchmany(self, size=1000):
+        rows = self._rows[self._i:self._i + size]
+        self._i += len(rows)
+        return rows
+
     def fetchall(self):
         if self._i == 0:
             self._i = len(self._rows)

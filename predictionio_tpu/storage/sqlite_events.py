@@ -251,6 +251,24 @@ class SQLiteEventStore(EventStore):
             if not self._bulk_depth:
                 self._conn.commit()
 
+    def iter_raw_rows(self, app_id: int, channel_id: int = 0):
+        """Yield raw 11-column storage rows (schema of :meth:`_row`).
+
+        The exporter fast path: composing wire JSON straight from stored
+        parts skips Event construction + re-serialization.  Not part of
+        the EventStore contract — callers feature-test with ``hasattr``.
+        """
+        t = self._ensure_table(app_id, channel_id)
+        # same ordering as find(): exports stay time-sorted
+        cur = self._conn.execute(
+            f"SELECT * FROM {t} ORDER BY event_time, event_id"
+        )
+        while True:
+            rows = cur.fetchmany(10_000)
+            if not rows:
+                return
+            yield from rows
+
     @property
     def _bulk_depth(self) -> int:
         return getattr(self._local, "bulk_depth", 0)

@@ -409,3 +409,60 @@ def test_remove_channel_on_fresh_store(tmp_path):
 
     store = SQLiteEventStore(str(tmp_path / "fresh.db"))
     assert store.remove_channel(1) is True
+
+
+def test_csv_import_validates_like_event_path(tmp_path):
+    """Pure-python path (no native skip): CSV raw-rows fast path keeps the
+    Event path's validation semantics."""
+    import pytest
+
+    from predictionio_tpu.storage.event import EventValidationError
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+    from predictionio_tpu.tools.import_export import import_ratings_csv
+
+    store = SQLiteEventStore(str(tmp_path / "csv.db"))
+    bad = tmp_path / "bad.csv"
+    bad.write_text("u1::i1::4.5\n::i2::3.0\n")
+    with pytest.raises(EventValidationError, match="entityId"):
+        import_ratings_csv(bad, store, 1)
+    with pytest.raises(EventValidationError, match="reserved"):
+        import_ratings_csv(bad, store, 1, event="pio_x")
+
+
+def test_fast_json_export_matches_portable_export(tmp_path):
+    """Raw-row JSON export is semantically identical, line for line and
+    in the same time-sorted order, to the Event.to_json path."""
+    import json
+
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+    from predictionio_tpu.tools.import_export import (
+        _export_json_fast, export_events,
+    )
+
+    store = SQLiteEventStore(str(tmp_path / "x.db"))
+    # insert OUT of time order so ordering is actually asserted
+    for k, ts in ((0, 3000), (1, 1000), (2, 2000)):
+        store.insert(Event(event="rate", entity_type="user",
+                           entity_id=f"u{k}", target_entity_type="item",
+                           target_entity_id=f"i{k}",
+                           properties={"rating": float(k), "uni": "caf\u00e9"},
+                           event_time=__import__("datetime").datetime.fromtimestamp(
+                               ts, tz=__import__("datetime").timezone.utc)), 6)
+    fast = tmp_path / "fast.json"
+    portable = tmp_path / "portable.json"
+    n1 = _export_json_fast(fast, store, 6, 0)
+    raw = SQLiteEventStore.iter_raw_rows
+    try:
+        del SQLiteEventStore.iter_raw_rows
+        n2 = export_events(portable, store, 6)
+    finally:
+        SQLiteEventStore.iter_raw_rows = raw
+    assert n1 == n2 == 3
+
+    def canon(p):
+        return [json.dumps(json.loads(ln), sort_keys=True)
+                for ln in p.read_text(encoding="utf-8").splitlines()]
+
+    # same ORDER (no sorting here): both exports are time-sorted
+    assert canon(fast) == canon(portable)

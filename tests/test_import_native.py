@@ -377,16 +377,3 @@ def test_parquet_import_by_magic_not_extension(tmp_path):
     export_events(odd_name, src, 2, fmt="parquet")
     assert import_events(odd_name, dst, 2) == 3
     assert _compare_stores(src, dst, 2, expect_nonempty=True)
-
-
-def test_csv_import_validates_like_event_path(tmp_path):
-    from predictionio_tpu.storage.event import EventValidationError
-    from predictionio_tpu.tools.import_export import import_ratings_csv
-
-    store, _ = _stores(tmp_path)
-    bad = tmp_path / "bad.csv"
-    bad.write_text("u1::i1::4.5\n::i2::3.0\n")
-    with pytest.raises(EventValidationError, match="entityId"):
-        import_ratings_csv(bad, store, 1)
-    with pytest.raises(EventValidationError, match="reserved"):
-        import_ratings_csv(bad, store, 1, event="pio_x")

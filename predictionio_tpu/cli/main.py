@@ -594,6 +594,19 @@ def cmd_status(args, storage: Storage) -> int:
     except Exception as e:
         _out(f"Error: storage verification failed: {e}")
         return 1
+    # optional accelerators: absent ones degrade to slower pure-Python
+    # paths, never to failures — status reports which are active
+    from ..native import native_available
+
+    caps = [f"native C++ runtime: {'OK' if native_available() else 'absent'}"]
+    for mod, what in (("pandas", "hash-based id dictionaries"),
+                      ("pyarrow", "Parquet import/export")):
+        try:
+            __import__(mod)
+            caps.append(f"{mod}: OK ({what})")
+        except Exception:
+            caps.append(f"{mod}: absent ({what} falls back)")
+    _out("Optional fast paths: " + "; ".join(caps))
     _out("Ready.")
     return 0
 

@@ -1,0 +1,114 @@
+"""bench.py orchestration contract: the driver runs the DEFAULT
+invocation at round end, so the attempt chain, budget clamping, and
+history fencing are load-bearing driver-facing behavior."""
+
+import json
+
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def patched(monkeypatch):
+    calls = {"probe": [], "inner": []}
+
+    def probe(timeout):
+        calls["probe"].append(timeout)
+        return ("tpu", None)
+
+    monkeypatch.setattr(bench, "_probe_accelerator", probe)
+    monkeypatch.setattr(bench, "_record_history", lambda line: None)
+    return calls
+
+
+def _run(monkeypatch, argv=None):
+    import sys
+
+    monkeypatch.setattr(sys, "argv", ["bench.py"] + (argv or []))
+    bench.main()
+
+
+def test_optimized_config_tried_first_then_safe(patched, monkeypatch, capsys):
+    def inner(extra, timeout, cpu_only=False):
+        patched["inner"].append(list(extra))
+        if "pallas" in extra:
+            return None, "simulated lowering failure"
+        return json.dumps({"metric": "m", "value": 1.0,
+                           "platform": "tpu", "scale": 1.0}), None
+
+    monkeypatch.setattr(bench, "_run_inner_subprocess", inner)
+    _run(monkeypatch)
+    a1, a2 = patched["inner"]
+    assert "--solver" in a1 and "pallas" in a1 and "high" in a1
+    assert "--solver" not in a2 and "--precision" not in a2
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["platform"] == "tpu"
+
+
+def test_explicit_solver_pins_single_attempt(patched, monkeypatch, capsys):
+    def inner(extra, timeout, cpu_only=False):
+        patched["inner"].append(list(extra))
+        return json.dumps({"metric": "m", "value": 1.0,
+                           "platform": "tpu", "scale": 1.0}), None
+
+    monkeypatch.setattr(bench, "_run_inner_subprocess", inner)
+    _run(monkeypatch, ["--solver", "xla"])
+    assert len(patched["inner"]) == 1
+    assert "pallas" not in patched["inner"][0]
+
+
+def test_timeouts_clamped_to_budget(patched, monkeypatch, capsys):
+    seen = []
+
+    def inner(extra, timeout, cpu_only=False):
+        seen.append(timeout)
+        return None, "fail"
+
+    monkeypatch.setattr(bench, "_run_inner_subprocess", inner)
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 300)
+    _run(monkeypatch)
+    # every stage timeout respects the shrunken budget (plus reserves)
+    assert patched["probe"][0] <= 300
+    assert all(60 <= t <= 300 for t in seen)
+    # the last stage (cpu fallback) still ran and a JSON line printed
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rec["metric"] == "ml20m_als_rank64_20iter_train_seconds"
+
+
+def test_unfenced_history_never_resurfaces(tmp_path, monkeypatch):
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text(
+        json.dumps({"metric": "m", "value": 2.6, "platform": "tpu",
+                    "scale": 1.0, "fenced": False}) + "\n"
+        + json.dumps({"metric": "m", "value": 99.0, "platform": "tpu",
+                      "scale": 0.1, "fenced": True}) + "\n"
+    )
+    monkeypatch.setattr(bench, "HISTORY_PATH", hist)
+    # unfenced full-scale and fenced small-scale records both excluded
+    assert bench._last_accelerator_measurement() is None
+    hist.write_text(
+        hist.read_text()
+        + json.dumps({"metric": "m", "value": 42.0, "platform": "tpu",
+                      "scale": 1.0, "fenced": True}) + "\n"
+    )
+    assert bench._last_accelerator_measurement()["value"] == 42.0
+
+
+def test_record_history_marks_fenced(tmp_path, monkeypatch):
+    hist = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(bench, "HISTORY_PATH", hist)
+    bench._record_history(json.dumps(
+        {"metric": "m", "value": 5.0, "platform": "tpu", "scale": 1.0}
+    ))
+    rec = json.loads(hist.read_text().strip())
+    assert rec["fenced"] is True and "recorded_at" in rec
+    # cpu and small-scale runs are never recorded
+    bench._record_history(json.dumps(
+        {"metric": "m", "value": 5.0, "platform": "cpu", "scale": 1.0}
+    ))
+    bench._record_history(json.dumps(
+        {"metric": "m", "value": 5.0, "platform": "tpu", "scale": 0.02}
+    ))
+    assert len(hist.read_text().strip().splitlines()) == 1

@@ -18,6 +18,7 @@ from .mesh import (
     data_sharding,
     distributed_init,
     enable_compilation_cache,
+    fence,
     make_mesh,
     pad_to_multiple,
     replicated,
@@ -37,6 +38,7 @@ __all__ = [
     "data_sharding",
     "distributed_init",
     "enable_compilation_cache",
+    "fence",
     "make_mesh",
     "pad_to_multiple",
     "replicated",

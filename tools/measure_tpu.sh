@@ -20,6 +20,23 @@ run() {
   echo "--- rc=$? -> $OUT/$name.json" | tee -a "$OUT/log.txt"
 }
 
+# first: does the Gauss-Jordan kernel LOWER on this chip at all?
+# (decides the solver A/Bs' interpretation; ~30 s)
+run solver_smoke        python -c "
+import numpy as np, jax.numpy as jnp
+from predictionio_tpu.ops.solve import spd_solve_batched
+from predictionio_tpu.parallel.mesh import fence
+rng = np.random.default_rng(0)
+for R in (10, 64, 128):
+    M = rng.normal(size=(257, R, R)).astype(np.float32)
+    A = jnp.asarray(M @ M.transpose(0,2,1) + 10*np.eye(R, dtype=np.float32))
+    b = jnp.asarray(rng.normal(size=(257, R)).astype(np.float32))
+    x = spd_solve_batched(A, b); fence(x)
+    r = float(jnp.abs(jnp.einsum('bij,bj->bi', A, x) - b).max())
+    print({'metric': 'gj_kernel_smoke', 'rank': R, 'max_resid': r})
+print({'metric': 'gj_kernel_smoke', 'lowered': True})
+"
+
 # headline: device staging (the default at full scale), then the A/Bs
 run north_star          python bench.py --verbose
 run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"

@@ -9,4 +9,4 @@ JAX/XLA over TPU device meshes (pjit/shard_map + Pallas kernels) instead of
 Apache Spark RDDs.
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"

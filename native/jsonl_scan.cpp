@@ -77,47 +77,118 @@ const char* scan_simple_string(const char* p, const char* end, Span* out) {
     return nullptr;
 }
 
-// skip a JSON value of any type; strings inside handle escapes (we don't
-// extract them, just need extents).  Returns past-the-value pointer or
-// nullptr on malformed input.
-const char* skip_value(const char* p, const char* end);
+// Strict JSON value skipper: accepts EXACTLY the grammar json.loads does
+// (minus \uXXXX surrogate-pair pairing, which cannot make loads fail on
+// the lenient decoder defaults json.loads uses).  Anything looser would
+// break the documented byte-for-byte import parity: a native-accepted
+// line the Python path rejects gets STORED, and the malformed properties
+// text later crashes reads.  nullptr -> caller falls back to the Python
+// parser, which raises (or accepts) canonically.
+const char* skip_value(const char* p, const char* end, int depth);
 
-const char* skip_string_any(const char* p, const char* end) {
+const char* skip_string_strict(const char* p, const char* end) {
     if (p >= end || *p != '"') return nullptr;
     ++p;
     while (p < end) {
-        if (*p == '\\') { p += 2; continue; }
-        if (*p == '"') return p + 1;
+        unsigned char c = (unsigned char)*p;
+        if (c == '"') return p + 1;
+        if (c < 0x20) return nullptr;  // raw control chars: loads rejects
+        if (c == '\\') {
+            ++p;
+            if (p >= end) return nullptr;
+            char esc = *p;
+            if (esc == 'u') {
+                if (end - p < 5) return nullptr;
+                for (int i = 1; i <= 4; ++i) {
+                    char h = p[i];
+                    if (!((h >= '0' && h <= '9') || (h >= 'a' && h <= 'f') ||
+                          (h >= 'A' && h <= 'F')))
+                        return nullptr;
+                }
+                p += 5;
+                continue;
+            }
+            if (esc != '"' && esc != '\\' && esc != '/' && esc != 'b' &&
+                esc != 'f' && esc != 'n' && esc != 'r' && esc != 't')
+                return nullptr;
+            ++p;
+            continue;
+        }
         ++p;
     }
     return nullptr;
 }
 
-const char* skip_container(const char* p, const char* end, char open, char close) {
-    int depth = 0;
+// number / true / false / null per the JSON grammar: rejects 1.2.3, 01,
+// ".5", "+1", bare words — all of which the old delimiter scan admitted
+const char* scan_scalar_strict(const char* p, const char* end) {
+    if (p >= end) return nullptr;
+    if (end - p >= 4 && std::memcmp(p, "true", 4) == 0) return p + 4;
+    if (end - p >= 5 && std::memcmp(p, "false", 5) == 0) return p + 5;
+    if (end - p >= 4 && std::memcmp(p, "null", 4) == 0) return p + 4;
+    if (*p == '-') ++p;
+    if (p >= end || *p < '0' || *p > '9') return nullptr;
+    if (*p == '0') ++p;
+    else while (p < end && *p >= '0' && *p <= '9') ++p;
+    if (p < end && *p == '.') {
+        ++p;
+        if (p >= end || *p < '0' || *p > '9') return nullptr;
+        while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+        ++p;
+        if (p < end && (*p == '+' || *p == '-')) ++p;
+        if (p >= end || *p < '0' || *p > '9') return nullptr;
+        while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    return p;
+}
+
+const char* skip_object_strict(const char* p, const char* end, int depth) {
+    ++p;  // past '{'
+    p = skip_ws(p, end);
+    if (p < end && *p == '}') return p + 1;
     while (p < end) {
-        char c = *p;
-        if (c == '"') { p = skip_string_any(p, end); if (!p) return nullptr; continue; }
-        if (c == open) ++depth;
-        else if (c == close) { --depth; if (depth == 0) return p + 1; }
-        ++p;
+        p = skip_string_strict(p, end);  // key (no trailing comma: a key
+        if (!p) return nullptr;          // MUST follow every comma)
+        p = skip_ws(p, end);
+        if (p >= end || *p != ':') return nullptr;
+        p = skip_value(p + 1, end, depth);
+        if (!p) return nullptr;
+        p = skip_ws(p, end);
+        if (p >= end) return nullptr;
+        if (*p == ',') { p = skip_ws(p + 1, end); continue; }
+        if (*p == '}') return p + 1;
+        return nullptr;  // missing comma between members
     }
     return nullptr;
 }
 
-const char* skip_value(const char* p, const char* end) {
+const char* skip_array_strict(const char* p, const char* end, int depth) {
+    ++p;  // past '['
+    p = skip_ws(p, end);
+    if (p < end && *p == ']') return p + 1;
+    while (p < end) {
+        p = skip_value(p, end, depth);
+        if (!p) return nullptr;
+        p = skip_ws(p, end);
+        if (p >= end) return nullptr;
+        if (*p == ',') { p = skip_ws(p + 1, end); continue; }
+        if (*p == ']') return p + 1;
+        return nullptr;
+    }
+    return nullptr;
+}
+
+const char* skip_value(const char* p, const char* end, int depth) {
+    if (depth > 64) return nullptr;  // absurd nesting -> python decides
     p = skip_ws(p, end);
     if (p >= end) return nullptr;
     char c = *p;
-    if (c == '"') return skip_string_any(p, end);
-    if (c == '{') return skip_container(p, end, '{', '}');
-    if (c == '[') return skip_container(p, end, '[', ']');
-    // number / true / false / null: scan to a delimiter
-    const char* s = p;
-    while (p < end && *p != ',' && *p != '}' && *p != ']' && *p != ' ' &&
-           *p != '\t' && *p != '\r')
-        ++p;
-    return p > s ? p : nullptr;
+    if (c == '"') return skip_string_strict(p, end);
+    if (c == '{') return skip_object_strict(p, end, depth + 1);
+    if (c == '[') return skip_array_strict(p, end, depth + 1);
+    return scan_scalar_strict(p, end);
 }
 
 // days-from-civil (Howard Hinnant's algorithm), for epoch-millis
@@ -274,7 +345,7 @@ int64_t pio_scan_events_jsonl(
 
             if (slot >= 0 || is_time || is_creation) {
                 if (*p == 'n') {  // null -> treat as absent
-                    const char* v = skip_value(p, e);
+                    const char* v = skip_value(p, e, 0);
                     if (!v) { ok = false; break; }
                     p = v;
                 } else {
@@ -290,23 +361,30 @@ int64_t pio_scan_events_jsonl(
             } else if (is_props) {
                 p = skip_ws(p, e);
                 if (p < e && *p == '{') {
-                    const char* v = skip_container(p, e, '{', '}');
+                    // strict: the substring is stored verbatim and later
+                    // json.loads'd by readers — it must BE valid JSON
+                    const char* v = skip_object_strict(p, e, 0);
                     if (!v) { ok = false; break; }
                     foff[F_PROPERTIES] = p - buf;
                     flen[F_PROPERTIES] = (int32_t)(v - p);
                     p = v;
                 } else if (p < e && *p == 'n') {  // null
-                    const char* v = skip_value(p, e);
+                    const char* v = skip_value(p, e, 0);
                     if (!v) { ok = false; break; }
                     p = v;
                 } else { ok = false; break; }
             } else {
-                const char* v = skip_value(p, e);
+                const char* v = skip_value(p, e, 0);
                 if (!v) { ok = false; break; }
                 p = v;
             }
             p = skip_ws(p, e);
-            if (p < e && *p == ',') { ++p; continue; }
+            if (p < e && *p == ',') {
+                p = skip_ws(p + 1, e);
+                // a key must follow: {"a":1,} is invalid JSON
+                if (p >= e || *p != '"') { ok = false; break; }
+                continue;
+            }
             if (p < e && *p == '}') { ++p; break; }
             ok = false;
         }
@@ -360,10 +438,13 @@ int64_t pio_scan_events_jsonl(
                 if (is_reserved_prefix(buf + k.off, k.len)) { bad_key = true; break; }
                 q = skip_ws(r, pe);
                 if (q >= pe || *q != ':') { bad_key = true; break; }
-                q = skip_value(q + 1, pe);
+                q = skip_value(q + 1, pe, 0);
                 if (!q) { bad_key = true; break; }
                 q = skip_ws(q, pe);
-                if (q < pe && *q == ',') q = skip_ws(q + 1, pe);
+                if (q < pe && *q == ',') {
+                    q = skip_ws(q + 1, pe);
+                    if (q >= pe || *q != '"') { bad_key = true; break; }
+                }
             }
             if (bad_key) continue;
         }

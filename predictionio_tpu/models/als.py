@@ -549,6 +549,16 @@ class ALSTrainer:
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         self.n_users = n_users
         self.n_items = n_items
+        # resolve the solver once: solver="pallas" is compile-probed and
+        # degrades to XLA with a warning if the kernel doesn't lower on
+        # this backend (round 2: a Mosaic regression was only caught on
+        # the real chip; a user's train must survive the next one)
+        self.solver = cfg.solver
+        if cfg.solver == "pallas":
+            from ..ops.solve import pallas_solver_ok
+
+            if not pallas_solver_ok(cfg.rank):
+                self.solver = "xla"
 
         n_dev = self.mesh.size if self.mesh is not None else 1
         # sharded factor tables need a real mesh and row counts divisible
@@ -591,7 +601,7 @@ class ALSTrainer:
                 implicit=cfg.implicit,
                 weighted_lambda=cfg.weighted_lambda,
                 precision=cfg.matmul_precision,
-                solver=cfg.solver,
+                solver=self.solver,
                 gather_dtype=cfg.gather_dtype,
             )
             self._sharded_user_half = build_sharded_half(
@@ -761,7 +771,7 @@ class ALSTrainer:
             implicit=cfg.implicit,
             weighted_lambda=cfg.weighted_lambda,
             precision=cfg.matmul_precision,
-            solver=cfg.solver,
+            solver=self.solver,
             gather_dtype=cfg.gather_dtype,
         )
 

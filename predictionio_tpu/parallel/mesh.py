@@ -171,10 +171,14 @@ def fence(*arrays) -> None:
 
     import jax.numpy as jnp
 
+    # index the first element directly (lowers to a 1-element slice):
+    # ravel()[:1] would dispatch a full reshape that materializes a copy
+    # of the whole array in eager mode — fencing a sharded full-scale
+    # factor table must not double its HBM footprint
     probes = [
-        a.ravel()[:1].astype(jnp.float32)
+        jnp.reshape(a[(0,) * a.ndim], (1,)).astype(jnp.float32)
         for a in jax.tree_util.tree_leaves(arrays)
-        if hasattr(a, "ravel") and getattr(a, "size", 0)
+        if hasattr(a, "ndim") and getattr(a, "size", 0)
     ]
     if probes:
         np.asarray(jnp.concatenate(probes))

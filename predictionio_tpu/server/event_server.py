@@ -96,12 +96,18 @@ class EventServer(HTTPServerBase):
         return ak.appid, channel_id, ak.events
 
     # -- handlers ----------------------------------------------------------
-    def insert_event(self, event: Event, app_id: int, channel_id: int,
-                     allowed: list[str]) -> str:
+    @staticmethod
+    def check_allowed(event: Event, allowed: list[str]) -> None:
+        """Access-key event whitelist (`AccessKeys.scala:27-54`); one
+        definition for the single-event and batch routes."""
         if allowed and event.event not in allowed:
             raise AuthError(
                 f"accessKey is not allowed to write event {event.event!r}"
             )
+
+    def insert_event(self, event: Event, app_id: int, channel_id: int,
+                     allowed: list[str]) -> str:
+        self.check_allowed(event, allowed)
         es = self.storage.get_event_store()
         es.init_channel(app_id, channel_id)
         return es.insert(event, app_id, channel_id)
@@ -208,21 +214,37 @@ class EventServer(HTTPServerBase):
                         "batch limited to 50 events; use `pio-tpu import` "
                         "for bulk loads"
                     )
-                results = []
-                for item in items:
+                es = server.storage.get_event_store()
+                es.init_channel(app_id, channel_id)
+                # Parse/validate first, then insert every valid event in
+                # ONE insert_batch (one executemany + one WAL commit):
+                # per-event inserts put this route at 7.3k ev/s vs 33k
+                # for the importer (SERVING_BENCH.md).  Statuses stay
+                # positional; invalid events don't block valid siblings;
+                # duplicate eventIds keep last-in-batch-wins order
+                # (executemany preserves row order).  from_json already
+                # validates, so validate=False skips the second pass —
+                # same contract the bulk importer relies on.
+                results: list[Optional[dict]] = [None] * len(items)
+                valid: list[tuple[int, Event]] = []
+                for k, item in enumerate(items):
                     try:
                         event = Event.from_json(item)
-                        eid = server.insert_event(
-                            event, app_id, channel_id, allowed
-                        )
-                        self._book(app_id, 201, event)
-                        results.append({"status": 201, "eventId": eid})
+                        server.check_allowed(event, allowed)
+                        valid.append((k, event))
                     except AuthError as e:
                         self._book(app_id, 401)
-                        results.append({"status": 401, "message": str(e)})
+                        results[k] = {"status": 401, "message": str(e)}
                     except (EventValidationError, ValueError) as e:
                         self._book(app_id, 400)
-                        results.append({"status": 400, "message": str(e)})
+                        results[k] = {"status": 400, "message": str(e)}
+                ids = es.insert_batch(
+                    [e for _, e in valid], app_id, channel_id,
+                    validate=False,
+                ) if valid else []
+                for (k, event), eid in zip(valid, ids):
+                    self._book(app_id, 201, event)
+                    results[k] = {"status": 201, "eventId": eid}
                 self._reply(200, results)
 
             def _post_webhook(self, path: str):

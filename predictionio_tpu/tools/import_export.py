@@ -112,23 +112,78 @@ def _import_events_native(
     and edge semantics match the portable importer byte for byte.
     Events without an eventTime get ONE shared import-time default
     rather than per-event ``now()`` calls.
+
+    The file is scanned in bounded chunks (``_NATIVE_CHUNK`` bytes, split
+    at line boundaries on the Python side) so peak memory stays flat at
+    GB-file scale instead of holding the whole buffer plus full per-line
+    offset arrays at once; all chunks flush inside ONE ``store.bulk()``
+    scope, so transactional semantics are unchanged.
     """
+    from ..native import scan_events_jsonl
+    from ..storage.event import now_utc, time_millis
+
+    if not native_scanner_available():
+        return None
+    now_ms = time_millis(now_utc())
+    imported = 0
+    with open(path, "rb") as fh, store.bulk():
+        leftover = b""
+        while True:
+            block = fh.read(_NATIVE_CHUNK)
+            if not block:
+                data, leftover = leftover, b""
+            else:
+                data = leftover + block
+                nl = data.rfind(b"\n")
+                if nl < 0:
+                    # no complete line in the buffer yet: keep reading (a
+                    # single line longer than the chunk size)
+                    leftover = data
+                    continue
+                # the scanner would treat a truncated trailing line as a
+                # whole line; split at the last newline and carry the rest
+                leftover = data[nl + 1:]
+                data = data[: nl + 1]
+            if data:
+                scan = scan_events_jsonl(data)
+                if scan is None:  # native lib vanished mid-import
+                    raise RuntimeError(
+                        "native scanner became unavailable during import"
+                    )
+                imported += _flush_scanned(
+                    data, scan, store, app_id, channel_id, now_ms
+                )
+            if not block:
+                break
+    return imported
+
+
+# chunk size for the native import scan; bounds peak host memory at
+# roughly chunk + its per-line offset arrays regardless of file size
+_NATIVE_CHUNK = 64 << 20
+
+
+def native_scanner_available() -> bool:
+    from ..native import _load
+
+    lib = _load()
+    return lib is not None and hasattr(lib, "pio_scan_events_jsonl")
+
+
+def _flush_scanned(
+    data: bytes, scan, store, app_id: int, channel_id: int, now_ms: int
+) -> int:
+    """Insert one scanned chunk's events (raw rows + python fallbacks)."""
     import numpy as np
 
     from ..native import (
         F_ENTITY_ID, F_ENTITY_TYPE, F_EVENT, F_EVENT_ID, F_PR_ID,
         F_PROPERTIES, F_TARGET_ENTITY_ID, F_TARGET_ENTITY_TYPE,
-        scan_events_jsonl,
     )
-    from ..storage.event import new_event_ids, now_utc, time_millis
+    from ..storage.event import new_event_ids
 
-    data = Path(path).read_bytes()
-    scan = scan_events_jsonl(data)
-    if scan is None:
-        return None
     n, foff, flen, ev_ms, cr_ms, loff, llen, status = scan
     time_none = np.iinfo(np.int64).min  # TIME_NONE in jsonl_scan.cpp
-    now_ms = time_millis(now_utc())
     ids = new_event_ids(n)
     imported = 0
     # ordered mixed buffer: INSERT OR REPLACE means a duplicate eventId is
@@ -153,36 +208,35 @@ def _import_events_native(
             i = j
         pending.clear()
 
-    with store.bulk():
-        for k in range(n):
-            if status[k]:
-                line = data[loff[k]: loff[k] + llen[k]].decode()
-                pending.append(("evt", Event.from_json(json.loads(line))))
-            else:
-                f, ln = foff[k], flen[k]
+    for k in range(n):
+        if status[k]:
+            line = data[loff[k]: loff[k] + llen[k]].decode()
+            pending.append(("evt", Event.from_json(json.loads(line))))
+        else:
+            f, ln = foff[k], flen[k]
 
-                def s(slot):
-                    return (
-                        data[f[slot]: f[slot] + ln[slot]].decode()
-                        if ln[slot] >= 0 else None
-                    )
+            def s(slot):
+                return (
+                    data[f[slot]: f[slot] + ln[slot]].decode()
+                    if ln[slot] >= 0 else None
+                )
 
-                pending.append(("raw", (
-                    s(F_EVENT_ID) or ids[k],
-                    s(F_EVENT),
-                    s(F_ENTITY_TYPE),
-                    s(F_ENTITY_ID),
-                    s(F_TARGET_ENTITY_TYPE),
-                    s(F_TARGET_ENTITY_ID),
-                    s(F_PROPERTIES) or "{}",
-                    int(ev_ms[k]) if ev_ms[k] != time_none else now_ms,
-                    "[]",
-                    s(F_PR_ID),
-                    int(cr_ms[k]) if cr_ms[k] != time_none else now_ms,
-                )))
-            if len(pending) >= _BATCH:
-                flush()
-        flush()
+            pending.append(("raw", (
+                s(F_EVENT_ID) or ids[k],
+                s(F_EVENT),
+                s(F_ENTITY_TYPE),
+                s(F_ENTITY_ID),
+                s(F_TARGET_ENTITY_TYPE),
+                s(F_TARGET_ENTITY_ID),
+                s(F_PROPERTIES) or "{}",
+                int(ev_ms[k]) if ev_ms[k] != time_none else now_ms,
+                "[]",
+                s(F_PR_ID),
+                int(cr_ms[k]) if cr_ms[k] != time_none else now_ms,
+            )))
+        if len(pending) >= _BATCH:
+            flush()
+    flush()
     return imported
 
 

@@ -112,3 +112,18 @@ def test_record_history_marks_fenced(tmp_path, monkeypatch):
         {"metric": "m", "value": 5.0, "platform": "tpu", "scale": 0.02}
     ))
     assert len(hist.read_text().strip().splitlines()) == 1
+
+
+def test_parity_mode_emits_zero_delta_line(capsys):
+    """`bench.py --parity` (quality half of the north star): our trainer
+    must match the dense MLlib-convention oracle to ~1e-3 RMSE on both
+    train and hold-out splits at the verifiable 400x250 scale."""
+    import bench
+
+    args = bench._parse_args(["--parity", "--platform", "cpu"])
+    bench.run_parity(args)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "als_rmse_parity_vs_mllib_oracle"
+    assert rec["holdout_delta"] < 1e-3
+    assert abs(rec["rmse_train_tpu"] - rec["rmse_train_oracle"]) < 1e-3

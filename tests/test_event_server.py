@@ -178,6 +178,22 @@ def test_batch_events(srv):
     assert [r["status"] for r in results] == [201, 400, 201]
 
 
+def test_batch_duplicate_event_id_last_wins(srv):
+    """The one-executemany batch insert must keep INSERT OR REPLACE
+    last-in-batch-wins semantics for duplicate eventIds."""
+    base, key, *_ = srv
+    eid = "d" * 32
+    batch = [
+        {**RATE, "eventId": eid, "properties": {"rating": 1.0}},
+        {**RATE, "eventId": eid, "properties": {"rating": 5.0}},
+    ]
+    status, results = _post(f"{base}/batch/events.json?accessKey={key}", batch)
+    assert status == 200
+    assert [r["status"] for r in results] == [201, 201]
+    _, got = _get(f"{base}/events/{eid}.json?accessKey={key}")
+    assert got["properties"]["rating"] == 5.0
+
+
 def test_stats_json(srv):
     base, key, *_ = srv
     _post(f"{base}/events.json?accessKey={key}", RATE)

@@ -377,3 +377,86 @@ def test_parquet_import_by_magic_not_extension(tmp_path):
     export_events(odd_name, src, 2, fmt="parquet")
     assert import_events(odd_name, dst, 2) == 3
     assert _compare_stores(src, dst, 2, expect_nonempty=True)
+
+
+def test_native_strict_json_matches_python(tmp_path):
+    """Lines json.loads rejects must behave identically through the native
+    path (ADVICE r2: skip_value admitted junk scalars like 1.2.3 and both
+    object loops tolerated trailing commas, silently storing corrupt
+    properties text that later crashed reads)."""
+    base = ('"event":"rate","entityType":"user","entityId":"u1",'
+            '"targetEntityType":"item","targetEntityId":"i1"')
+    bad_lines = [
+        '{%s,"junk":1.2.3}' % base,                      # junk scalar
+        '{%s,"properties":{"rating":4.5,}}' % base,      # props trailing ,
+        '{%s,}' % base,                                  # top trailing ,
+        '{%s,"properties":{"rating":01}}' % base,        # leading zero
+        '{%s,"properties":{"a":1 "b":2}}' % base,        # missing comma
+        '{%s,"junk":+1}' % base,                         # +1 not a number
+        '{%s,"properties":{"s":"bad\\x"}}' % base,       # invalid escape
+        '{%s,"properties":{"v":[1.2.3]}}' % base,        # junk in array
+        '{%s,"junk":truely}' % base,                     # bare word
+    ]
+    for k, line in enumerate(bad_lines):
+        with pytest.raises(json.JSONDecodeError):
+            json.loads(line)  # premise: python rejects every one
+        path = tmp_path / f"bad_{k}.json"
+        path.write_text(line + "\n")
+        nat = SQLiteEventStore(str(tmp_path / f"nat_{k}.db"))
+        py = SQLiteEventStore(str(tmp_path / f"py_{k}.db"))
+
+        def run(fn, store):
+            try:
+                return ("ok", fn(path, store, 5))
+            except Exception as e:  # noqa: BLE001 — comparing parity
+                return ("err", f"{type(e).__name__}: {e}")
+
+        o_nat = run(import_events, nat)
+        o_py = run(_import_python_only, py)
+        assert o_nat == o_py, f"line: {line!r}\n{o_nat}\nvs\n{o_py}"
+        assert o_nat[0] == "err"
+        assert list(nat.find(5)) == []  # nothing stored (rollback)
+
+
+def test_native_strict_json_still_fast_paths_valid_lines():
+    """Strictness must not demote clean lines: nested containers, exotic
+    numbers, and \\uXXXX escapes inside PROPERTY VALUES stay status=0."""
+    lines = [
+        json.dumps({"event": "rate", "entityType": "user", "entityId": "u1",
+                    "targetEntityType": "item", "targetEntityId": "i1",
+                    "properties": {"rating": 4.5, "neg": -1.5e-3, "z": 0,
+                                   "big": 1e300, "t": True, "n": None,
+                                   "deep": {"a": [1, 2, {"b": []}]}},
+                    "eventTime": "2021-06-01T12:34:56.789Z"}),
+    ]
+    data = ("\n".join(lines) + "\n").encode()
+    scan = scan_events_jsonl(data)
+    assert scan is not None
+    n, *_rest, status = scan
+    assert n == 1 and status[0] == 0
+
+
+def test_chunked_native_import_parity(tmp_path, monkeypatch):
+    """The bounded-chunk scan (ADVICE r2: whole-file read_bytes) must be
+    observationally identical to the one-shot scan: chunk boundaries fall
+    mid-line, lines longer than the chunk size occur, and the final line
+    has no trailing newline."""
+    import predictionio_tpu.tools.import_export as ie
+
+    monkeypatch.setattr(ie, "_NATIVE_CHUNK", 64)  # force many tiny chunks
+    lines = []
+    for k in range(60):
+        d = {"event": "rate", "entityType": "user", "entityId": f"u{k}",
+             "targetEntityType": "item", "targetEntityId": f"i{k % 7}",
+             "properties": {"rating": (k % 10) / 2,
+                            "pad": "x" * (k % 3) * 40},
+             "eventTime": f"2021-06-{k % 28 + 1:02d}T12:00:00.000Z"}
+        if k % 11 == 0:
+            d["properties"]["note"] = 'esc"aped'  # python fallback lines
+        lines.append(json.dumps(d))
+    path = tmp_path / "events.json"
+    path.write_text("\n".join(lines))  # NO trailing newline
+    nat, py = _stores(tmp_path)
+    assert ie.import_events(path, nat, 6) == 60
+    assert _import_python_only(path, py, 6) == 60
+    assert _compare_stores(nat, py, 6, expect_nonempty=True)

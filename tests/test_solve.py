@@ -88,3 +88,69 @@ def test_wide_value_range():
     x = np.asarray(cholesky_solve_batched(A, b))
     ref = np.stack([np.linalg.solve(A[i], b[i]) for i in range(B)])
     np.testing.assert_allclose(x, ref, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Fail-safe + VMEM-derived tile sizing (round-3 verdict item 4)
+# ---------------------------------------------------------------------------
+
+
+def test_tile_sizing_fits_probed_budget(monkeypatch):
+    """Every rank's tile footprint must fit the (half) VMEM budget the
+    sizing claims to target, and shrink under a tighter env budget."""
+    from predictionio_tpu.ops import solve as solve_mod
+
+    for r in (8, 10, 16, 32, 64, 100, 128):
+        tb = solve_mod._tile_rows(r)
+        assert tb >= 8
+        assert (
+            solve_mod.solver_tile_footprint(tb, r)
+            <= solve_mod.solver_vmem_budget() // 2
+        ), f"rank {r}: tile {tb} overruns the budget"
+    base_tb = solve_mod._tile_rows(64)
+    monkeypatch.setenv("PIO_TPU_VMEM_BYTES", str(4 << 20))
+    assert solve_mod.solver_vmem_budget() == 4 << 20
+    small_tb = solve_mod._tile_rows(64)
+    assert small_tb < base_tb
+    assert solve_mod.solver_tile_footprint(small_tb, 64) <= (4 << 20) // 2
+
+
+def test_als_trainer_falls_back_when_kernel_cannot_compile(
+    monkeypatch, caplog
+):
+    """A Mosaic regression (kernel fails to compile on a new chip
+    generation) must degrade ALSConfig(solver='pallas') to the XLA
+    solver with a warning, not fail the train (round-2's 'didn't lower
+    on hardware' episode, made safe)."""
+    import logging
+
+    from predictionio_tpu.models.als import ALSConfig, ALSTrainer
+    from predictionio_tpu.ops import solve as solve_mod
+
+    def boom(A, b, interpret=None):
+        raise RuntimeError("Mosaic lowering failed (injected)")
+
+    monkeypatch.setattr(solve_mod, "spd_solve_batched", boom)
+    monkeypatch.setattr(solve_mod, "_PROBE_CACHE", {})
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 30, 200).astype(np.int32)
+    i = rng.integers(0, 20, 200).astype(np.int32)
+    v = rng.uniform(1, 5, 200).astype(np.float32)
+    cfg = ALSConfig(rank=6, num_iterations=2, solver="pallas")
+    with caplog.at_level(logging.WARNING, logger="predictionio_tpu"):
+        trainer = ALSTrainer((u, i, v), 30, 20, cfg)
+        factors = trainer.train()
+    assert trainer.solver == "xla"
+    assert factors.user_factors.shape == (30, 6)
+    assert np.isfinite(factors.user_factors).all()
+    assert any("falling back to the XLA solver" in r.message
+               for r in caplog.records)
+
+
+def test_probe_passes_in_interpret_mode(monkeypatch):
+    """Off-TPU the kernel interprets fine, so the probe must say yes and
+    solver='pallas' must stay pallas."""
+    from predictionio_tpu.ops import solve as solve_mod
+
+    monkeypatch.setattr(solve_mod, "_PROBE_CACHE", {})
+    assert solve_mod.pallas_solver_ok(6)

@@ -45,6 +45,7 @@ run breakdown_pallas    python bench.py --breakdown --solver pallas
 run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16
 run breakdown_prec_high python bench.py --breakdown --precision high
 run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
+run parity              python bench.py --parity
 run solver_grid         python bench_solver.py
 run serving             python bench_serving.py --verbose --batch 64
 run ingest              python bench_ingest.py

@@ -86,19 +86,22 @@ def _parse_args(argv=None):
         "the JSON line carries rmse_holdout next to train_rmse (north "
         "star: RMSE parity, not just speed).  0 disables",
     )
-    ap.add_argument("--gather-dtype", default="float32",
+    ap.add_argument("--gather-dtype", default=None,
                     choices=("float32", "bfloat16"),
                     help="ALS opposite-table gather dtype; A/B the "
-                    "bandwidth optimization")
+                    "bandwidth optimization.  Unset = float32, except "
+                    "the orchestrated attempt chain may try bfloat16 "
+                    "first; an EXPLICIT value pins every attempt")
     ap.add_argument("--staging", default="auto",
                     choices=("auto", "host", "device"),
                     help="COO staging path: host counting-sort vs compact "
                     "transfer + on-device sort (auto: device at this "
                     "bench's full scale)")
     ap.add_argument("--solver", default=None,
-                    choices=("xla", "pallas"),
+                    choices=("xla", "pallas", "fused"),
                     help="batched SPD solver override (default: "
-                    "ALSConfig default)")
+                    "ALSConfig default); 'fused' = single-pass "
+                    "gather+Gram+solve kernel on VMEM-fitting sides")
     ap.add_argument("--precision", default=None,
                     choices=("highest", "high", "default"),
                     help="Gram-einsum MXU precision override "
@@ -135,6 +138,16 @@ def _parse_args(argv=None):
         "NumPy oracle that encodes the MLlib ALS conventions "
         "(tests/test_als.py) and print its JSON line; the quality half "
         "of the north star, as a recordable artifact",
+    )
+    ap.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="run the PRODUCT data path end to end — ratings file -> "
+        "native import -> sqlite -> columnar scan -> id encode -> "
+        "train — and print one JSON line with per-stage seconds; "
+        "proves the import/scan/train throughput claims compose at "
+        "scale (the in-memory synth of the default bench skips the "
+        "storage path)",
     )
     ap.add_argument(
         "--phase-probe",
@@ -182,7 +195,8 @@ def _prepare(args):
         extra["matmul_precision"] = args.precision
     cfg = ALSConfig(
         rank=args.rank, num_iterations=args.iters, lam=0.01,
-        seed=args.seed, gather_dtype=args.gather_dtype, **extra,
+        seed=args.seed, gather_dtype=args.gather_dtype or "float32",
+        **extra,
     )
     return jax, (u, i, v, n_users, n_items), mesh, cfg
 
@@ -391,6 +405,7 @@ def run_inner(args) -> None:
                 "staging": trainer.staging,
                 "solver": solver_used,
                 "precision": cfg.matmul_precision,
+                "gather_dtype": cfg.gather_dtype,
                 # the timed train covers the (1-holdout) split; recorded
                 # so the workload identity is explicit in every artifact
                 # (no fenced full-scale history predates this field, so
@@ -487,6 +502,101 @@ def run_parity(args) -> None:
         "rmse_holdout_oracle": round(ho_orc, 5),
         "holdout_delta": round(abs(ho_tpu - ho_orc), 5),
         "platform": jax.default_backend(),
+    }))
+
+
+def run_pipeline(args) -> None:
+    """The full product data path at bench scale, stage by stage.
+
+    The default bench synthesizes the COO in memory; users reach
+    training through import -> store -> scan (reference:
+    `tools/.../imprt/FileToEvents.scala:30-95` feeding HBase feeding
+    `PEventStore.find`).  This measures that path composed: a
+    MovieLens-format ratings file is imported through the native
+    scanner's raw-row fast path into sqlite, scanned columnar
+    (`minimal=True`), id-encoded, and trained.  One JSON line with
+    per-stage seconds so no stage can hide inside another's number.
+    """
+    import shutil
+    import tempfile
+
+    jax, (u, i, v, n_users, n_items), mesh, cfg = _prepare(args)
+    from predictionio_tpu.models.als import ALSTrainer, rmse
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+    from predictionio_tpu.tools.import_export import import_ratings_csv
+
+    stages: dict[str, float] = {}
+    tmp = tempfile.mkdtemp(prefix="pio_pipeline_bench_")
+    try:
+        # stage 0 (uncounted toward the pipeline: the user already has
+        # their file): write the synthetic ratings as MovieLens CSV
+        t0 = time.time()
+        csv = Path(tmp) / "ratings.csv"
+        with open(csv, "w") as f:
+            for s in range(0, len(v), 1 << 20):
+                e = min(s + (1 << 20), len(v))
+                np.savetxt(
+                    f,
+                    np.stack(
+                        [u[s:e], i[s:e], v[s:e]], axis=1
+                    ),
+                    fmt=["%d", "%d", "%.1f"],
+                    delimiter="::",
+                )
+        stages["write_source_file"] = round(time.time() - t0, 3)
+
+        t0 = time.time()
+        store = SQLiteEventStore(str(Path(tmp) / "events.db"))
+        n_imported = import_ratings_csv(csv, store, app_id=1)
+        stages["import"] = round(time.time() - t0, 3)
+
+        t0 = time.time()
+        frame = store.find_columnar(
+            app_id=1, event_names=["rate"], float_property="rating",
+            minimal=True,
+        )
+        stages["scan_columnar"] = round(time.time() - t0, 3)
+
+        t0 = time.time()
+        ratings = frame.to_ratings(rating_property="rating", dedup="last")
+        stages["encode_ids"] = round(time.time() - t0, 3)
+
+        t0 = time.time()
+        trainer = ALSTrainer(ratings, cfg=cfg, mesh=mesh,
+                             staging=args.staging)
+        U, V = trainer.init_factors()
+        U, V = trainer.run(U, V, cfg.num_iterations)
+        stages["train"] = round(time.time() - t0, 3)
+
+        from predictionio_tpu.models.als import ALSFactors
+
+        factors = ALSFactors(
+            user_factors=np.asarray(U)[: ratings.n_users],
+            item_factors=np.asarray(V)[: ratings.n_items],
+        )
+        err = rmse(factors, ratings.user_ix, ratings.item_ix,
+                   ratings.rating)
+        store.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    pipeline_total = sum(
+        sec for name, sec in stages.items() if name != "write_source_file"
+    )
+    print(json.dumps({
+        "metric": "ml20m_pipeline_file_to_model_seconds",
+        "value": round(pipeline_total, 3),
+        "unit": "s",
+        "stages": stages,
+        "n_events": int(n_imported),
+        "import_events_per_s": (
+            round(n_imported / stages["import"], 1)
+            if stages["import"] else None
+        ),
+        "train_rmse": round(err, 4),
+        "platform": jax.default_backend(),
+        "scale": args.scale,
+        "solver": trainer.solver,
     }))
 
 
@@ -599,6 +709,9 @@ def main() -> None:
     if args.parity:
         run_parity(args)
         return
+    if args.pipeline:
+        run_pipeline(args)
+        return
     if args.breakdown:
         run_breakdown(args)
         return
@@ -611,8 +724,10 @@ def main() -> None:
     common = [
         "--scale", str(args.scale), "--rank", str(args.rank),
         "--iters", str(args.iters), "--seed", str(args.seed),
-        "--gather-dtype", args.gather_dtype, "--staging", args.staging,
-    ] + (["--solver", args.solver] if args.solver else []) \
+        "--staging", args.staging, "--holdout", str(args.holdout),
+    ] + (["--gather-dtype", args.gather_dtype]
+         if args.gather_dtype else []) \
+      + (["--solver", args.solver] if args.solver else []) \
       + (["--precision", args.precision] if args.precision else []) \
       + (["--verbose"] if args.verbose else [])
 
@@ -625,15 +740,27 @@ def main() -> None:
         min(PROBE_TIMEOUT, remaining(2 * 60 + CPU_RESERVE))
     )
     if platform is not None:
-        # attempt the measured-best configuration first (Gauss-Jordan
-        # Pallas solves + bf16x3 Gram passes), then the conservative
-        # all-XLA/f32 config: a kernel that fails to lower on this
-        # backend must cost one bounded retry, never the whole number.
-        # Explicit --solver/--precision flags pin a single attempt.
+        # attempt the best configurations first — the fused
+        # gather+Gram+solve kernel (the cost model's answer to the
+        # measured gather wall), then Gauss-Jordan Pallas solves +
+        # bf16x3 Gram, then the conservative all-XLA/f32 config: a
+        # kernel that fails to lower on this backend must cost one
+        # bounded retry, never the whole number.  (The in-trainer
+        # compile probes make kernel failures cheap: a failed probe
+        # degrades to xla within the same attempt.)  Explicit
+        # --solver/--precision flags pin a single attempt.
         attempts = [common]
-        if args.solver is None and args.precision is None:
+        if (
+            args.solver is None
+            and args.precision is None
+            and args.gather_dtype is None  # explicit dtype pins attempts
+        ):
             attempts.insert(
                 0, common + ["--solver", "pallas", "--precision", "high"]
+            )
+            attempts.insert(
+                0, common + ["--solver", "fused", "--precision", "high",
+                             "--gather-dtype", "bfloat16"]
             )
         errs = []
         for extra in attempts:

@@ -90,8 +90,10 @@ class ALSConfig:
     # "default" (bf16).  RMSE parity wants "highest"; ranking-only workloads
     # can trade down.
     matmul_precision: str = "highest"
-    # batched SPD solver: "xla" (lax.linalg) or "pallas"
-    # (ops/solve.py batch-lane kernel)
+    # batched SPD solver: "xla" (lax.linalg), "pallas" (ops/solve.py
+    # Gauss-Jordan kernel for the solves alone), or "fused"
+    # (ops/fused_als.py single-pass gather+Gram+solve kernel on sides
+    # whose opposite table fits VMEM; other sides fall back to xla)
     solver: str = "xla"
     # dtype the opposite factor table is GATHERED in: "float32" (exact,
     # default) or "bfloat16" — the Gram einsums are gather-bandwidth-bound
@@ -156,6 +158,7 @@ def build_bucket_layout(
     max_per_row: int = 0,
     batch_multiple: int = 1,
     max_entries: Optional[int] = None,
+    starts_dtype: type = np.int32,
 ) -> BucketLayout:
     """Group rows by padded rating-count so the device solves static shapes.
 
@@ -163,14 +166,21 @@ def build_bucket_layout(
     MLlib which simply never solves them).  Oversized buckets are split so
     ``B*K <= max_entries``; batch dims are padded to ``batch_multiple``
     (the mesh size) for even sharding.
+
+    ``starts_dtype``: the replicated-COO path keeps int32 (those offsets
+    are gathered on device) and rejects COOs past the int32 range; the
+    sharded-COO path passes int64 — its device offsets are SHARD-LOCAL
+    (``_plan_shard_layout``), so the global layout may exceed 2^31
+    ratings as long as every per-device shard stays under it.
     """
-    if len(val) >= np.iinfo(np.int32).max:
+    if starts_dtype == np.int32 and len(val) >= np.iinfo(np.int32).max:
         # Bucket.starts (and the on-device gather positions) are int32;
         # beyond 2^31 ratings the offsets would wrap. A single-replica COO
-        # that large belongs on a sharded ingest path anyway.
+        # that large belongs on the sharded-COO path instead.
         raise ValueError(
             f"{len(val):,} ratings exceed the int32 offset range of a "
-            "single bucket layout; shard the COO across hosts first"
+            "replicated bucket layout; use factor_placement='sharded' "
+            "(sharded COO) or shard the COO across hosts first"
         )
     # O(n) native counting sort when the C++ runtime is available
     # (predictionio_tpu/native), NumPy argsort otherwise
@@ -184,7 +194,7 @@ def build_bucket_layout(
     )
     layout.buckets = _assemble_buckets(
         counts, starts, n_rows, min_k, max_per_row, batch_multiple,
-        max_entries,
+        max_entries, starts_dtype=starts_dtype,
     )
     return layout
 
@@ -197,6 +207,7 @@ def _assemble_buckets(
     max_per_row: int = 0,
     batch_multiple: int = 1,
     max_entries: Optional[int] = None,
+    starts_dtype: type = np.int32,
 ) -> list[Bucket]:
     """Bucket plan from per-row (counts, starts) alone.
 
@@ -236,7 +247,7 @@ def _assemble_buckets(
             # the scatter drops them, and uniqueness stays honest for
             # unique_indices=True
             rows_p = n_rows + np.arange(Bp, dtype=np.int32)
-            starts_p = np.zeros(Bp, dtype=np.int32)
+            starts_p = np.zeros(Bp, dtype=starts_dtype)
             counts_p = np.zeros(Bp, dtype=np.int32)
             rows_p[:B] = rows
             starts_p[:B] = starts[rows]
@@ -245,6 +256,86 @@ def _assemble_buckets(
                 Bucket(k=k, rows=rows_p, starts=starts_p, counts=counts_p)
             )
     return buckets
+
+
+def _plan_shard_layout(
+    buckets: list[Bucket], n_dev: int, build_perm: bool = True
+) -> tuple[Optional[np.ndarray], list[np.ndarray], int]:
+    """Shard-ordered COO plan: co-partition rating slices with the bucket
+    rows each device solves.
+
+    ``build_sharded_half`` splits every bucket's batch dim into ``n_dev``
+    contiguous chunks (shard_map ``P('data')`` semantics).  This plan
+    reorders the row-grouped COO so device ``d`` holds exactly the rating
+    slices of the rows in ITS chunks, concatenated bucket by bucket — the
+    TPU answer to MLlib's co-partitioned rating/factor blocks
+    (`org.apache.spark.ml.recommendation.ALS` block layout; SURVEY
+    §2.7(2)) and to ALX's sharded rating matrix (arXiv 2112.02194).
+
+    Returns ``(perm, local_starts, L)``:
+
+    * ``perm`` — ``[n_dev, L]`` int64 gather indices into the row-sorted
+      COO; position ``(d, j)`` names the global rating that lands at
+      shard-local offset ``j`` on device ``d`` (padding positions gather
+      index 0; never read — every device access is masked by counts).
+    * ``local_starts`` — per bucket, an int32 ``[Bp]`` array aligned with
+      ``bucket.rows`` whose entries are offsets into the OWNING DEVICE's
+      shard (replacing the global int32 starts whose range capped nnz).
+    * ``L`` — the padded per-shard length (max over devices).
+
+    The per-device nnz imbalance is bounded by one bucket row's worth of
+    ratings per bucket (chunks differ by at most the count spread inside
+    a bucket, and buckets group rows of similar padded size).
+
+    ``build_perm=False`` skips materializing ``perm`` (total-nnz-sized)
+    and returns ``None`` in its place — planning-only validation, e.g.
+    checking the per-shard int32 ceiling at >2^31 global nnz without
+    allocating the index.
+    """
+    offsets = np.zeros(n_dev, dtype=np.int64)
+    local_starts: list[np.ndarray] = []
+    starts_per_dev: list[list[np.ndarray]] = [[] for _ in range(n_dev)]
+    counts_per_dev: list[list[np.ndarray]] = [[] for _ in range(n_dev)]
+    for b in buckets:
+        Bp = len(b.rows)
+        assert Bp % n_dev == 0, "bucket batch dim not padded to mesh size"
+        chunk = Bp // n_dev
+        ls = np.zeros(Bp, dtype=np.int64)
+        for d in range(n_dev):
+            sl = slice(d * chunk, (d + 1) * chunk)
+            cnts = b.counts[sl].astype(np.int64)
+            ls[sl] = offsets[d] + np.concatenate(
+                ([0], np.cumsum(cnts)[:-1])
+            )
+            offsets[d] += int(cnts.sum())
+            starts_per_dev[d].append(np.asarray(b.starts[sl], np.int64))
+            counts_per_dev[d].append(cnts)
+        local_starts.append(ls)
+    L = int(offsets.max()) if n_dev else 0
+    if L >= np.iinfo(np.int32).max:
+        raise ValueError(
+            f"per-shard nnz {L:,} exceeds the int32 offset range; use "
+            "more devices or shard across hosts"
+        )
+    if not build_perm:
+        return None, [ls.astype(np.int32) for ls in local_starts], max(L, 1)
+    perm = np.zeros((n_dev, max(L, 1)), dtype=np.int64)
+    for d in range(n_dev):
+        if not starts_per_dev[d]:
+            continue
+        starts_d = np.concatenate(starts_per_dev[d])
+        counts_d = np.concatenate(counts_per_dev[d])
+        total = int(counts_d.sum())
+        if total:
+            # vectorized multi-slice gather: for each row j, positions
+            # starts_d[j] .. starts_d[j]+counts_d[j]-1 in order
+            base = np.repeat(
+                starts_d
+                - np.concatenate(([0], np.cumsum(counts_d)[:-1])),
+                counts_d,
+            )
+            perm[d, :total] = np.arange(total, dtype=np.int64) + base
+    return perm, [ls.astype(np.int32) for ls in local_starts], max(L, 1)
 
 
 @jax.jit
@@ -344,6 +435,13 @@ def _solve_buckets(
     half-iteration and feeds the MXU bf16 operands with f32 accumulation
     (``preferred_element_type``): the hot [B, K, R] gather moves half the
     HBM bytes.  The YtY gram, regularization, and solves stay f32.
+
+    ``solver="fused"`` routes buckets through the single-pass Pallas
+    kernel (`ops/fused_als.py`: table resident in VMEM, in-kernel
+    gather+Gram+regularize+Gauss-Jordan, ~12 B/rating of HBM traffic)
+    WHEN this side's opposite table fits the VMEM budget — the user
+    half at ML-20M rank 64; the item half (35 MB opposite table) and
+    any non-fitting side transparently keep the XLA path below.
     """
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]
@@ -358,6 +456,13 @@ def _solve_buckets(
         else opp
     )
     f32 = jnp.float32
+    fused_side = False
+    if solver == "fused" and stop_after is None and ks:
+        from ..ops.fused_als import fused_side_fits
+
+        fused_side = fused_side_fits(
+            opp_g.shape[0], r, max(ks), opp_g.dtype.itemsize
+        )
     out = None
     for (rows, starts, counts), k in zip(bucket_args, ks):
         iota = jnp.arange(k, dtype=jnp.int32)
@@ -366,6 +471,26 @@ def _solve_buckets(
         idx = jnp.where(valid, c_sorted[pos], 0)
         val = jnp.where(valid, v_sorted[pos], 0.0)       # f32, masked
         maskf = valid.astype(f32)
+        if fused_side:
+            from ..ops.fused_als import fused_gather_gram_solve
+
+            n_row = counts.astype(f32)
+            lam_t = lam.astype(f32)
+            if implicit:
+                cwk = alpha.astype(f32) * val * maskf
+                bwk = (1.0 + cwk) * maskf
+                g0 = gram
+            else:
+                cwk = maskf
+                bwk = val * maskf
+                g0 = None
+            if weighted_lambda:
+                reg = lam_t * jnp.maximum(n_row, 1.0)
+            else:
+                reg = jnp.broadcast_to(lam_t, n_row.shape)
+            x = fused_gather_gram_solve(opp_g, idx, cwk, bwk, reg, g0)
+            out = upd_write(out, rows, x)
+            continue
         Vm = opp_g[idx] * valid[..., None].astype(opp_g.dtype)  # [B,K,R]
         if stop_after == "gather":
             out = (0.0 if out is None else out) + Vm.astype(f32).sum()
@@ -440,8 +565,11 @@ def build_sharded_half(
       ICI (transient), solves its shard of every bucket's batch, then
       all-gathers the small solved blocks ``[B, R]`` and writes only the
       rows its own factor shard owns — updates never cross devices.
-    * Rating COO arrays are replicated (their sharding is the multi-host
-      ingest axis, not this one).
+    * Rating COO arrays are SHARDED ``P('data')``: each device holds only
+      the slices of the bucket rows it solves, in shard-local order with
+      shard-local starts (``_plan_shard_layout``) — rating capacity
+      scales with mesh HBM like MLlib's co-partitioned rating blocks,
+      and the int32-offset ceiling applies per shard.
 
     Requires row counts padded to a multiple of the mesh size; bucket
     padding rows carry ids >= the padded row count, so they drop out of
@@ -512,13 +640,36 @@ def build_sharded_half(
     P_ = P
     sharded2 = P_(axis, None)
     rep = P_()
+    # factor tables + the COO arrive sharded; only the scalars replicate
     in_specs = (
-        sharded2, sharded2, rep, rep, rep, rep,
+        sharded2, sharded2, P_(axis), P_(axis), rep, rep,
     ) + (P_(axis),) * (3 * len(ks))
     mapped = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=sharded2,
     )
     return jax.jit(mapped, donate_argnums=(0,))
+
+
+def _resolve_solver(cfg: ALSConfig) -> str:
+    """Compile-probe kernel-backed solvers; degrade to "xla" on failure.
+
+    ``"pallas"`` probes the Gauss-Jordan solve kernel at this rank;
+    ``"fused"`` probes the fused gather+Gram+solve kernel (whose
+    speculative op is the in-VMEM dynamic gather).  Both cache per
+    (backend, shape) so trainers after the first pay nothing.
+    """
+    if cfg.solver == "pallas":
+        from ..ops.solve import pallas_solver_ok
+
+        if not pallas_solver_ok(cfg.rank):
+            return "xla"
+    elif cfg.solver == "fused":
+        from ..ops.fused_als import fused_solver_ok
+
+        tb = 2 if cfg.gather_dtype == "bfloat16" else 4
+        if not fused_solver_ok(512, cfg.rank, tb):
+            return "xla"
+    return cfg.solver
 
 
 class ALSTrainer:
@@ -549,16 +700,12 @@ class ALSTrainer:
         self.mesh = mesh if (mesh is not None and mesh.size > 1) else None
         self.n_users = n_users
         self.n_items = n_items
-        # resolve the solver once: solver="pallas" is compile-probed and
-        # degrades to XLA with a warning if the kernel doesn't lower on
-        # this backend (round 2: a Mosaic regression was only caught on
-        # the real chip; a user's train must survive the next one)
-        self.solver = cfg.solver
-        if cfg.solver == "pallas":
-            from ..ops.solve import pallas_solver_ok
-
-            if not pallas_solver_ok(cfg.rank):
-                self.solver = "xla"
+        # resolve the solver once: kernel-backed solvers are
+        # compile-probed and degrade to XLA with a warning if the kernel
+        # doesn't lower on this backend (round 2: a Mosaic regression
+        # was only caught on the real chip; a user's train must survive
+        # the next one)
+        self.solver = _resolve_solver(cfg)
 
         n_dev = self.mesh.size if self.mesh is not None else 1
         # sharded factor tables need a real mesh and row counts divisible
@@ -574,42 +721,263 @@ class ALSTrainer:
             raise ValueError(
                 f"staging must be 'auto', 'host' or 'device', got {staging!r}"
             )
-        if staging == "auto":
+        if self.sharded:
+            # sharded placement stages a SHARDED COO: each device holds
+            # only the rating slices of the bucket rows it solves
+            # (_plan_shard_layout), so nnz capacity scales with mesh HBM
+            # and the int32-offset ceiling applies per shard, not
+            # globally.  device_put with a P('data') sharding moves each
+            # byte to exactly one device — there is no replicated copy
+            # to avoid, so the staging knob is moot here.
+            if staging != "auto":
+                logger.warning(
+                    "staging=%r is ignored under factor_placement="
+                    "'sharded': the sharded-COO layout has its own "
+                    "staging path (trainer.staging == 'sharded')",
+                    staging,
+                )
+            self.staging = "sharded"
+            self._user_side = self._stage_side_sharded(
+                build_bucket_layout(
+                    u, i, v, nu, cfg.min_bucket_k,
+                    cfg.max_ratings_per_row, batch_multiple=n_dev,
+                    starts_dtype=np.int64,
+                ),
+                n_dev,
+            )
+            self._item_side = self._stage_side_sharded(
+                build_bucket_layout(
+                    i, u, v, ni, cfg.min_bucket_k,
+                    cfg.max_ratings_per_row, batch_multiple=n_dev,
+                    starts_dtype=np.int64,
+                ),
+                n_dev,
+            )
+        elif staging == "auto":
             # device staging pays 2 extra argsort+gather programs; worth it
             # once the sorted-COO transfer dwarfs that (big datasets), not
             # for the small problems tests and templates mostly train
             staging = "device" if len(v) >= 2_000_000 else "host"
-        self.staging = staging
-        if staging == "device":
-            sides = self._stage_device(u, i, v, nu, ni, n_dev)
-            self._user_side, self._item_side = sides
-        else:
-            self._user_side = self._stage(
-                build_bucket_layout(
-                    u, i, v, nu, cfg.min_bucket_k,
-                    cfg.max_ratings_per_row, batch_multiple=n_dev,
+        if not self.sharded:
+            self.staging = staging
+            if staging == "device":
+                sides = self._stage_device(u, i, v, nu, ni, n_dev)
+                self._user_side, self._item_side = sides
+            else:
+                self._user_side = self._stage(
+                    build_bucket_layout(
+                        u, i, v, nu, cfg.min_bucket_k,
+                        cfg.max_ratings_per_row, batch_multiple=n_dev,
+                    )
                 )
-            )
-            self._item_side = self._stage(
-                build_bucket_layout(
-                    i, u, v, ni, cfg.min_bucket_k,
-                    cfg.max_ratings_per_row, batch_multiple=n_dev,
+                self._item_side = self._stage(
+                    build_bucket_layout(
+                        i, u, v, ni, cfg.min_bucket_k,
+                        cfg.max_ratings_per_row, batch_multiple=n_dev,
+                    )
                 )
-            )
         if self.sharded:
-            common = dict(
-                implicit=cfg.implicit,
-                weighted_lambda=cfg.weighted_lambda,
-                precision=cfg.matmul_precision,
-                solver=self.solver,
-                gather_dtype=cfg.gather_dtype,
+            self._build_sharded_halves()
+
+    def _build_sharded_halves(self) -> None:
+        cfg = self.cfg
+        common = dict(
+            implicit=cfg.implicit,
+            weighted_lambda=cfg.weighted_lambda,
+            precision=cfg.matmul_precision,
+            solver=self.solver,
+            gather_dtype=cfg.gather_dtype,
+        )
+        self._sharded_user_half = build_sharded_half(
+            self.mesh, ks=self._user_side["ks"], **common
+        )
+        self._sharded_item_half = build_sharded_half(
+            self.mesh, ks=self._item_side["ks"], **common
+        )
+
+    @classmethod
+    def distributed(
+        cls,
+        local_ratings,
+        n_users: Optional[int] = None,
+        n_items: Optional[int] = None,
+        cfg: ALSConfig = ALSConfig(factor_placement="sharded"),
+        mesh: Optional[Mesh] = None,
+        exchange_dir=None,
+        tag: str = "als-coo",
+        timeout: float = 120.0,
+    ) -> "ALSTrainer":
+        """Multi-host sharded-COO trainer.
+
+        Each process contributes only its LOCAL rating triples (e.g. the
+        entity-hash shard a `find_columnar_sharded` scan returned,
+        encoded against the global id index); the triples are exchanged
+        so every device receives exactly the slices of the bucket rows
+        it solves (`parallel/ingest.exchange_ratings_by_owner`).  **No
+        process ever materializes the full COO** — the round-2
+        `gather_ratings` all-gather is gone from this path, completing
+        the scaling story: factor tables shard over mesh HBM, the rating
+        matrix shards over mesh HBM, and the host-side COO shards over
+        cluster memory (the role HBase region-sharding played for the
+        reference, `storage/hbase/HBPEvents.scala:99-105`).
+
+        Only per-row COUNT vectors (a few hundred KB at ML-20M scale)
+        are all-gathered, to give every process the identical global
+        bucket plan.  Single-process (or no real mesh) falls back to the
+        ordinary constructor.
+        """
+        import jax
+
+        if isinstance(local_ratings, Ratings):
+            u, i, v = (
+                local_ratings.user_ix,
+                local_ratings.item_ix,
+                local_ratings.rating,
             )
-            self._sharded_user_half = build_sharded_half(
-                self.mesh, ks=self._user_side["ks"], **common
+            n_users = local_ratings.n_users
+            n_items = local_ratings.n_items
+        else:
+            u, i, v = local_ratings
+            assert n_users is not None and n_items is not None
+        if jax.process_count() <= 1 or mesh is None or mesh.size <= 1:
+            return cls((u, i, v), n_users, n_items, cfg, mesh=mesh)
+        if cfg.factor_placement != "sharded":
+            raise ValueError(
+                "ALSTrainer.distributed requires "
+                "factor_placement='sharded' (the sharded-COO layout)"
             )
-            self._sharded_item_half = build_sharded_half(
-                self.mesh, ks=self._item_side["ks"], **common
+        if exchange_dir is None:
+            raise ValueError("exchange_dir is required for multi-process")
+
+        from jax.experimental import multihost_utils
+
+        self = cls.__new__(cls)
+        self.cfg = cfg
+        self.mesh = mesh
+        self.n_users = n_users
+        self.n_items = n_items
+        self.solver = _resolve_solver(cfg)
+        n_dev = mesh.size
+        self.sharded = True
+        self.staging = "sharded-distributed"
+        self._pad_users = pad_to_multiple(n_users, n_dev)
+        self._pad_items = pad_to_multiple(n_items, n_dev)
+
+        def global_counts(rows, n_pad):
+            local = np.bincount(rows, minlength=n_pad).astype(np.int64)
+            return np.asarray(
+                multihost_utils.process_allgather(local)
+            ).reshape(jax.process_count(), n_pad).sum(axis=0)
+
+        device_proc = np.asarray(
+            [d.process_index for d in mesh.devices.reshape(-1)], np.int32
+        )
+        self._user_side = self._stage_side_distributed(
+            u, i, v, global_counts(u, self._pad_users), self._pad_users,
+            n_dev, device_proc, exchange_dir, f"{tag}-user", timeout,
+        )
+        self._item_side = self._stage_side_distributed(
+            i, u, v, global_counts(i, self._pad_items), self._pad_items,
+            n_dev, device_proc, exchange_dir, f"{tag}-item", timeout,
+        )
+        self._build_sharded_halves()
+        return self
+
+    def _stage_side_distributed(
+        self, rows, cols, vals, counts, n_rows_pad, n_dev, device_proc,
+        exchange_dir, tag, timeout,
+    ):
+        """One side's sharded staging from process-LOCAL triples.
+
+        Every process derives the identical global bucket/shard plan from
+        the (all-gathered) count vector, exchanges its triples to each
+        row's owning process, and builds shard arrays only for its own
+        addressable devices — assembled into the global sharded array
+        with ``make_array_from_single_device_arrays``.
+        """
+        import jax
+
+        from ..parallel.ingest import exchange_ratings_by_owner
+
+        cfg = self.cfg
+        starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+        buckets = _assemble_buckets(
+            counts, starts, n_rows_pad, cfg.min_bucket_k,
+            cfg.max_ratings_per_row, batch_multiple=n_dev,
+            starts_dtype=np.int64,
+        )
+        _, local_starts, L = _plan_shard_layout(
+            buckets, n_dev, build_perm=False
+        )
+        # row -> owning device / shard-local start / effective cap
+        row_dev = np.zeros(n_rows_pad, np.int32)
+        row_ls = np.zeros(n_rows_pad, np.int64)
+        row_cap = np.zeros(n_rows_pad, np.int64)
+        for b, ls in zip(buckets, local_starts):
+            chunk = len(b.rows) // n_dev
+            real = b.rows < n_rows_pad
+            rr = b.rows[real]
+            row_dev[rr] = (np.arange(len(b.rows))[real] // chunk).astype(
+                np.int32
             )
+            row_ls[rr] = ls[real]
+            row_cap[rr] = b.counts[real]
+
+        rows2, cols2, vals2 = exchange_ratings_by_owner(
+            rows, cols, vals, device_proc[row_dev], exchange_dir, tag,
+            timeout=timeout,
+        )
+        # deterministic within-row order (sources may interleave): sort
+        # by (row, col); occurrence index beyond the per-row cap
+        # (max_ratings_per_row) is dropped, like the single-host layout
+        order = np.lexsort((cols2, rows2))
+        rows2, cols2, vals2 = rows2[order], cols2[order], vals2[order]
+        rc = np.bincount(rows2, minlength=n_rows_pad).astype(np.int64)
+        rstart = np.concatenate(([0], np.cumsum(rc)[:-1]))
+        occ = np.arange(len(rows2), dtype=np.int64) - rstart[rows2]
+        keep = occ < row_cap[rows2]
+        rows2, cols2, vals2, occ = (
+            rows2[keep], cols2[keep], vals2[keep], occ[keep]
+        )
+        slot = (row_ls[rows2] + occ).astype(np.int64)
+        dev_of = row_dev[rows2]
+
+        pid = jax.process_index()
+        mesh_devs = list(self.mesh.devices.reshape(-1))
+        sh = NamedSharding(self.mesh, P(DATA_AXIS))
+        c_parts, v_parts = [], []
+        for di, d in enumerate(mesh_devs):
+            if d.process_index != pid:
+                continue
+            sel = dev_of == di
+            c_loc = np.zeros(L, np.int32)
+            v_loc = np.zeros(L, np.float32)
+            c_loc[slot[sel]] = cols2[sel]
+            v_loc[slot[sel]] = vals2[sel]
+            c_parts.append(jax.device_put(c_loc, d))
+            v_parts.append(jax.device_put(v_loc, d))
+        c_g = jax.make_array_from_single_device_arrays(
+            (n_dev * L,), sh, c_parts
+        )
+        v_g = jax.make_array_from_single_device_arrays(
+            (n_dev * L,), sh, v_parts
+        )
+        from ..parallel.mesh import shard_put
+
+        return {
+            "c_sorted": c_g,
+            "v_sorted": v_g,
+            "shard_len": L,
+            "ks": tuple(b.k for b in buckets),
+            "buckets": tuple(
+                (
+                    shard_put(b.rows, self.mesh, P(DATA_AXIS)),
+                    shard_put(ls, self.mesh, P(DATA_AXIS)),
+                    shard_put(b.counts, self.mesh, P(DATA_AXIS)),
+                )
+                for b, ls in zip(buckets, local_starts)
+            ),
+        }
 
     def _stage_device(self, u, i, v, nu, ni, n_dev):
         """Compact-transfer staging: sort/expand the COO **on device**.
@@ -723,6 +1091,29 @@ class ALSTrainer:
             ),
         }
 
+    def _stage_side_sharded(self, layout: BucketLayout, n_dev: int):
+        """Place one side's COO SHARDED: device ``d`` receives only the
+        rating slices of the bucket rows it solves, in shard-local order
+        (``_plan_shard_layout``).  The per-bucket starts arrays are the
+        shard-LOCAL offsets, so the device gather indexes its own shard.
+        """
+        perm, local_starts, L = _plan_shard_layout(layout.buckets, n_dev)
+        flat = perm.reshape(-1)
+        c_sh = np.ascontiguousarray(layout.col_sorted[flat])
+        v_sh = np.ascontiguousarray(layout.val_sorted[flat])
+        dp = NamedSharding(self.mesh, P(DATA_AXIS))
+        put_dp = lambda x: jax.device_put(x, dp)  # noqa: E731
+        return {
+            "c_sorted": put_dp(c_sh),
+            "v_sorted": put_dp(v_sh),
+            "shard_len": L,
+            "ks": tuple(b.k for b in layout.buckets),
+            "buckets": tuple(
+                (put_dp(b.rows), put_dp(ls), put_dp(b.counts))
+                for b, ls in zip(layout.buckets, local_starts)
+            ),
+        }
+
     def init_factors(self) -> tuple[jax.Array, jax.Array]:
         """MLlib-style init: N(0, 1)/sqrt(rank), fixed seed.
 
@@ -739,10 +1130,17 @@ class ALSTrainer:
         V = jax.random.normal(ki, (self.n_items, cfg.rank), dtype)
         V = V / jnp.sqrt(cfg.rank).astype(dtype)
         if self.sharded:
+            from ..parallel.mesh import shard_put
+
             U = jnp.pad(U, ((0, self._pad_users - self.n_users), (0, 0)))
             V = jnp.pad(V, ((0, self._pad_items - self.n_items), (0, 0)))
-            sh = NamedSharding(self.mesh, P(DATA_AXIS, None))
-            return jax.device_put(U, sh), jax.device_put(V, sh)
+            spec = P(DATA_AXIS, None)
+            # shard_put handles meshes spanning processes (device_put
+            # rejects non-addressable shardings)
+            return (
+                shard_put(np.asarray(U), self.mesh, spec),
+                shard_put(np.asarray(V), self.mesh, spec),
+            )
         if self.mesh is not None:
             U = jax.device_put(U, replicated(self.mesh))
             V = jax.device_put(V, replicated(self.mesh))
@@ -831,10 +1229,26 @@ class ALSTrainer:
         return self._factors(U, V)
 
     def _factors(self, U, V) -> ALSFactors:
-        """Host factor arrays; sharded runs drop the mesh-padding rows."""
-        U = np.asarray(U)[: self.n_users]
-        V = np.asarray(V)[: self.n_items]
-        return ALSFactors(user_factors=U, item_factors=V)
+        """Host factor arrays; sharded runs drop the mesh-padding rows.
+
+        On a multi-process mesh the trained tables span hosts; gather
+        them to every process (the deploy path loads full tables — the
+        reference's PAlgorithm models were likewise collected to the
+        driver before persisting)."""
+
+        def to_host(a):
+            if hasattr(a, "is_fully_addressable") and not a.is_fully_addressable:
+                from jax.experimental import multihost_utils
+
+                return np.asarray(
+                    multihost_utils.process_allgather(a, tiled=True)
+                )
+            return np.asarray(a)
+
+        return ALSFactors(
+            user_factors=to_host(U)[: self.n_users],
+            item_factors=to_host(V)[: self.n_items],
+        )
 
 
 def train_als(

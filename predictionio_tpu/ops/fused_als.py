@@ -1,0 +1,300 @@
+"""Fused gather+Gram+solve ALS half-iteration as one Pallas TPU kernel.
+
+The measured bottleneck of the ALS hot loop (docs/ARCHITECTURE.md
+"Measured performance", fenced on v5e): the ``[B, K, R]`` gathered
+factor expansion materializes ~5 GB/half in HBM and feeds the Gram
+einsums at an effective ~17 GB/s — 303 ms gather + 793 ms Gram per user
+half vs a ~10 ms MXU roofline.  At rank 64 the opposite (item) factor
+table is only ~7 MB f32 (~3.5 MB bf16): it FITS IN VMEM.  This kernel
+keeps the whole table resident and, per batch tile, streams only the
+``[TB, KC]`` rating-index/weight blocks from HBM:
+
+* grid ``(B/TB, K/KC)``; the K axis is innermost so the ``[TB, R, R]``
+  normal-equation accumulators live in VMEM scratch across K chunks;
+* per chunk: one **in-VMEM dynamic row gather** ``table[idx]``
+  (``jnp.take`` — the Mosaic-support question the round-2 perf plan
+  flagged; `interpret=True` proves the math, the on-chip probe in
+  `tools/measure_tpu.sh` proves the lowering), then two MXU
+  contractions accumulate ``A += (cw·rows)ᵀ rows`` and ``b += bw·rows``;
+* on the last chunk: regularize and solve in place with the same
+  augmented Gauss-Jordan used by ``ops/solve.py``, writing only
+  ``x[TB, R]``.
+
+HBM traffic drops from ~256 bytes/rating (the materialized expansion)
+to ~12 bytes/rating (idx + two weights).  The item-side half (opposite
+table = user factors, ~35 MB at ML-20M — beyond VMEM) stays on the XLA
+path; ``models/als._solve_buckets`` picks per side automatically under
+``ALSConfig(solver="fused")``.
+
+Reference provenance: this fuses what MLlib ALS does in separate stages
+per block (gather factors, accumulate YtY·normal equations, solve —
+`org.apache.spark.ml.recommendation.ALS` NormalEquation add/solve), the
+way a TPU wants it: one pass, VMEM-resident working set, MXU
+contractions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .solve import _EPS, solver_vmem_budget
+
+__all__ = [
+    "fused_gather_gram_solve",
+    "fused_side_fits",
+    "fused_solver_ok",
+    "fused_tile_plan",
+]
+
+
+def _pad8(n: int) -> int:
+    return max(-(-n // 8) * 8, 8)
+
+
+def _pad128(n: int) -> int:
+    return max(-(-n // 128) * 128, 128)
+
+
+def fused_tile_plan(m: int, r: int, k: int, table_bytes: int = 4):
+    """Choose ``(TB, KC)`` so the whole working set fits the VMEM budget.
+
+    Accounts for the PADDED footprints (Mosaic tiles the trailing two
+    dims to (8, 128) for f32): the resident ``[M, R]`` table, the
+    ``[TB, R, R]`` + ``[TB, R, R+1]`` + ``[TB, R]`` scratches, the
+    ``[TB, KC, R]`` gathered chunk, and the double-buffered
+    ``[TB, KC]`` input / ``[TB, R]`` output blocks.  Returns ``None``
+    when even the smallest tile cannot fit (caller falls back to the
+    XLA path).
+    """
+    budget = int(solver_vmem_budget() * 0.9)
+    table = m * _pad128(r) * table_bytes  # sublane dim M needs no pad >8
+    r8, r128, w128 = _pad8(r), _pad128(r), _pad128(r + 1)
+    for tb in (64, 32, 16, 8):
+        for kc in (512, 256, 128):
+            kc_eff = min(kc, max(-(-k // 128) * 128, 128))
+            a_scr = tb * r8 * r128 * 4
+            m_scr = tb * r8 * w128 * 4
+            b_scr = _pad8(tb) * r128 * 4
+            rows = tb * _pad8(kc_eff) * r128 * 4
+            io = 3 * 2 * _pad8(tb) * _pad128(kc_eff) * 4  # idx/cw/bw x2
+            out = 2 * _pad8(tb) * r128 * 4
+            gram0 = r8 * r128 * 4
+            total = (
+                table + a_scr + m_scr + b_scr + rows + io + out + gram0
+            )
+            if total <= budget:
+                return tb, kc_eff
+    return None
+
+
+def fused_side_fits(m: int, r: int, k_max: int, table_bytes: int = 4) -> bool:
+    """Can this side's opposite table + working set live in VMEM?"""
+    return fused_tile_plan(m, r, max(k_max, 1), table_bytes) is not None
+
+
+def _fused_kernel(
+    gram0_ref,   # [R, R] f32 (YtY for implicit mode; zeros otherwise)
+    table_ref,   # [M, R] resident opposite factor table (f32 or bf16)
+    idx_ref,     # [TB, KC] int32 (masked entries point at row 0)
+    cw_ref,      # [TB, KC] f32 Gram weights (0 at masked entries)
+    bw_ref,      # [TB, KC] f32 rhs weights (0 at masked entries)
+    reg_ref,     # [TB, 1] f32 ridge diagonal
+    x_ref,       # [TB, R] f32 out
+    a_scr,       # [TB, R, R] f32 normal-equation accumulator
+    b_scr,       # [TB, R] f32 rhs accumulator
+    m_scr,       # [TB, R, R+1] f32 augmented Gauss-Jordan scratch
+):
+    j = pl.program_id(1)
+    tb, kc = idx_ref.shape
+    r = table_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        a_scr[:] = jnp.broadcast_to(
+            gram0_ref[:][None], (tb, r, r)
+        ).astype(jnp.float32)
+        b_scr[:] = jnp.zeros((tb, r), jnp.float32)
+
+    # the in-VMEM dynamic row gather: [TB*KC] indices into the resident
+    # [M, R] table — the op whose Mosaic lowering the on-chip probe checks
+    rows = jnp.take(
+        table_ref[:], idx_ref[:].reshape(tb * kc), axis=0
+    ).reshape(tb, kc, r).astype(jnp.float32)
+    rw = rows * cw_ref[:][:, :, None]
+    # MXU: batched [KC, R]ᵀ[KC, R] -> [R, R] per tile row
+    a_scr[:] += jax.lax.dot_general(
+        rw, rows, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+    b_scr[:] += jax.lax.dot_general(
+        bw_ref[:], rows, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _solve():
+        w = r + 1
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+        rows_i = jax.lax.broadcasted_iota(jnp.int32, (1, r), 1)
+        eye = (
+            jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)
+            == jax.lax.broadcasted_iota(jnp.int32, (r, r), 1)
+        ).astype(jnp.float32)
+        m_scr[:, :, :r] = (
+            a_scr[:] + reg_ref[:][:, :, None] * eye[None]
+        )
+        m_scr[:, :, r:w] = b_scr[:][:, :, None]
+
+        def gj_step(p, _):
+            M = m_scr[:]
+            ohr = (rows_i == p).astype(M.dtype)
+            ohc = (lanes == p).astype(M.dtype)
+            pr = jnp.sum(M * ohr[:, :, None], axis=1)
+            d = jnp.sum(pr * ohc, axis=-1)
+            prn = pr / jnp.where(jnp.abs(d) > _EPS, d, _EPS)[:, None]
+            col = jnp.sum(M * ohc[:, None, :], axis=-1)
+            colz = jnp.where(rows_i == p, 0.0, col)
+            upd = M - colz[:, :, None] * prn[:, None, :]
+            m_scr[:] = jnp.where(ohr[:, :, None] > 0, prn[:, None, :], upd)
+            return 0
+
+        jax.lax.fori_loop(0, r, gj_step, 0)
+        x_ref[:] = m_scr[:, :, r]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tb", "kc", "interpret")
+)
+def _fused_padded(gram0, table, idx, cw, bw, reg, *, tb, kc, interpret):
+    bp, kp = idx.shape
+    m, r = table.shape
+    grid = (bp // tb, kp // kc)
+    return pl.pallas_call(
+        _fused_kernel,
+        out_shape=jax.ShapeDtypeStruct((bp, r), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r, r), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, r), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, kc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, kc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, kc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tb, r), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((tb, r, r), jnp.float32),
+            pltpu.VMEM((tb, r), jnp.float32),
+            pltpu.VMEM((tb, r, r + 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gram0, table, idx, cw, bw, reg)
+
+
+def fused_gather_gram_solve(
+    table,          # [M, R] opposite factor table (f32 or bf16)
+    idx,            # [B, K] int32 opposite ids, masked entries arbitrary
+    cw,             # [B, K] f32 Gram weights (0 where masked)
+    bw,             # [B, K] f32 rhs weights (0 where masked)
+    reg,            # [B]    f32 ridge diagonal
+    gram0=None,     # [R, R] f32 base Gram (implicit YtY); zeros if None
+    interpret: bool | None = None,
+):
+    """One fused normal-equation build + solve for a bucket of rows.
+
+    Returns ``x[B, R]`` solving ``(gram0 + Σₖ cwₖ·vₖvₖᵀ + reg·I) x =
+    Σₖ bwₖ·vₖ`` with ``vₖ = table[idx[:, k]]``.  Masking rides the
+    weights: a masked entry's ``cw = bw = 0`` makes its gathered row
+    irrelevant (so ``idx`` may safely point anywhere, conventionally 0).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, k = idx.shape
+    m, r = table.shape
+    plan = fused_tile_plan(m, r, k, table.dtype.itemsize)
+    if plan is None:
+        raise ValueError(
+            f"fused ALS kernel: table [{m}, {r}] + working set exceeds "
+            f"the VMEM budget ({solver_vmem_budget()} B)"
+        )
+    tb, kc = plan
+    bp = -(-b // tb) * tb
+    kp = -(-k // kc) * kc
+    if gram0 is None:
+        gram0 = jnp.zeros((r, r), jnp.float32)
+    idx = jnp.pad(idx, ((0, bp - b), (0, kp - k)))
+    cw = jnp.pad(cw.astype(jnp.float32), ((0, bp - b), (0, kp - k)))
+    bw = jnp.pad(bw.astype(jnp.float32), ((0, bp - b), (0, kp - k)))
+    # padded rows solve I·x = 0 -> sliced away
+    reg = jnp.pad(
+        reg.astype(jnp.float32), (0, bp - b), constant_values=1.0
+    )[:, None]
+    x = _fused_padded(
+        gram0.astype(jnp.float32), table, idx, cw, bw, reg,
+        tb=tb, kc=kc, interpret=bool(interpret),
+    )
+    return x[:b]
+
+
+# (backend, m, r) -> probe result; process-wide like the GJ solver probe
+_PROBE_CACHE: dict[tuple, bool] = {}
+
+
+def fused_solver_ok(m: int, r: int, table_bytes: int = 4) -> bool:
+    """Compile-and-run probe for the fused kernel at this table size.
+
+    The kernel's one speculative op is the in-VMEM dynamic gather
+    (``jnp.take`` on a resident table); round 2 proved kernels must be
+    probed ON the target backend before production use.  Cached per
+    (backend, m, r).
+    """
+    import logging
+
+    logger = logging.getLogger(__name__)
+    key = (jax.default_backend(), int(m), int(r), int(table_bytes))
+    cached = _PROBE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    if fused_tile_plan(m, r, 8, table_bytes) is None:
+        _PROBE_CACHE[key] = False
+        return False
+    try:
+        dtype = jnp.bfloat16 if table_bytes == 2 else jnp.float32
+        mm = min(m, 512)  # probe a small table; lowering doesn't depend on M
+        table = jnp.ones((mm, r), dtype)
+        idx = jnp.zeros((8, 8), jnp.int32)
+        one = jnp.ones((8, 8), jnp.float32)
+        reg = jnp.ones((8,), jnp.float32)
+        x = fused_gather_gram_solve(table, idx, one, one, reg)
+        # 8 ratings of weight 1 on the all-ones row: A = 8·J + I,
+        # b = 8·1 -> x = 8/(8r+1)·1
+        want = 8.0 / (8.0 * r + 1.0)
+        got = float(np.asarray(x[0, :1])[0])
+        ok = abs(got - want) < 1e-4
+        if not ok:
+            logger.warning(
+                "fused ALS kernel probe returned %g (want %g) at "
+                "m=%d r=%d; using the unfused path", got, want, m, r,
+            )
+    except Exception as e:  # noqa: BLE001 — any compile/lowering error
+        logger.warning(
+            "fused ALS kernel unavailable at m=%d r=%d on %r (%s); "
+            "using the unfused path",
+            m, r, jax.default_backend(), e,
+        )
+        ok = False
+    _PROBE_CACHE[key] = ok
+    return ok

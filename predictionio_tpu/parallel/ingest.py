@@ -18,12 +18,14 @@ data/storage/hbase/HBPEvents.scala:99-105`).  The TPU-native equivalent for a
   storage directory (the role HDFS played for the reference) and everyone
   deterministically builds the same sorted-unique :class:`StringIndex`
   (`ids_exchange`).
-* **All-gather the numeric COO** — once encoded against the global index,
-  the int/float rating triples are exchanged with a padded
-  ``process_allgather`` so every process holds the full training COO
-  (`gather_ratings`), which the replicated-COO ALS path consumes directly;
-  the factor tables themselves can stay sharded (``factor_placement=
-  "sharded"``).
+* **Exchange the numeric COO by row owner** — once encoded against the
+  global index, each rating triple is sent to the process whose mesh
+  devices solve its row (`exchange_ratings_by_owner`, a file-based
+  all-to-all riding the shared storage tree), so NO process ever
+  materializes the full COO and rating capacity scales with cluster
+  memory — the multi-host face of the sharded-COO layout
+  (`models/als._plan_shard_layout`).  The legacy `gather_ratings`
+  all-gather remains for the replicated-COO path (small datasets).
 
 Single-process runs short-circuit: shard 0 of 1 is the whole table and the
 gathers are identity.
@@ -44,7 +46,9 @@ __all__ = [
     "find_columnar_sharded",
     "ids_exchange",
     "gather_ratings",
+    "exchange_ratings_by_owner",
     "read_ratings_distributed",
+    "distributed_trainer",
 ]
 
 
@@ -254,6 +258,114 @@ def gather_ratings(ratings):
     )
 
 
+def _exchange_all_to_all(
+    exchange_dir,
+    tag: str,
+    payloads: dict[int, dict[str, np.ndarray]],
+    timeout: float = 120.0,
+) -> list[dict]:
+    """File-based all-to-all over the shared storage tree.
+
+    Every process writes one npz per destination process (EVERY
+    destination, even when empty — absence must mean "not published yet",
+    never "nothing to send") and reads the files addressed to it.  Same
+    self-protection as :func:`ids_exchange`: per-run nonce from process 0
+    folded into filenames, post-sync cleanup of own files, withdrawal on
+    failure.  Returns the loaded dicts ordered by source process.
+    """
+    import jax
+    import secrets
+
+    from jax.experimental import multihost_utils
+
+    pid, n = jax.process_index(), jax.process_count()
+    nonce = int(
+        multihost_utils.broadcast_one_to_all(np.int64(secrets.randbits(62)))
+    )
+    tag = f"{tag}-{nonce:016x}"
+    exchange_dir = Path(exchange_dir)
+    exchange_dir.mkdir(parents=True, exist_ok=True)
+    _sweep_stale(exchange_dir, age_s=max(_STALE_AGE_S, 2.0 * timeout))
+    mine: list[Path] = []
+    try:
+        for dst in range(n):
+            path = exchange_dir / f"{tag}-{pid}to{dst}.npz"
+            tmp = exchange_dir / f"{tag}-{pid}to{dst}.tmp.npz"
+            # uncompressed on purpose: this path exists for bulk numeric
+            # COO payloads, where single-threaded deflate would dominate
+            # the exchange wall-clock for little ratio (int32/f32 rating
+            # triples compress poorly); the small string id exchange
+            # keeps compression
+            np.savez(tmp, **payloads.get(dst, {}))
+            tmp.rename(path)  # atomic publish
+            mine.append(path)
+        out = []
+        deadline = time.time() + timeout
+        for src in range(n):
+            path = exchange_dir / f"{tag}-{src}to{pid}.npz"
+            while not path.exists():
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"exchange: shard file {path} not published "
+                        f"within {timeout}s"
+                    )
+                time.sleep(0.05)
+            with np.load(path, allow_pickle=False) as data:
+                out.append({k: data[k] for k in data.files})
+    except BaseException:
+        for p in mine:
+            p.unlink(missing_ok=True)
+        raise
+    multihost_utils.sync_global_devices(f"coo-exchange-{tag}")
+    for p in mine:
+        p.unlink(missing_ok=True)
+    return out
+
+
+def exchange_ratings_by_owner(
+    row_ix: np.ndarray,
+    col_ix: np.ndarray,
+    rating: np.ndarray,
+    owner_of_row: np.ndarray,
+    exchange_dir,
+    tag: str,
+    timeout: float = 120.0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Send each rating triple to the process owning its ROW and return
+    the triples this process received (concatenated over sources).
+
+    ``owner_of_row[r]`` names the destination process of row ``r``
+    (derived from the shard plan's row→device map plus the mesh's
+    device→process map; called once per ALS side with that side's row
+    ids).  The multi-host replacement for :func:`gather_ratings`:
+    afterwards each process holds exactly the ratings its devices solve
+    — cluster rating capacity scales with the number of hosts instead of
+    being capped by one host's memory, the way the reference's
+    region-sharded HBase scan never materialized the full event set in
+    one JVM (`storage/hbase/HBPEvents.scala:99-105`).
+    """
+    import jax
+
+    n = jax.process_count()
+    if n <= 1:
+        return row_ix, col_ix, rating
+    dest = np.asarray(owner_of_row)[row_ix]
+    payloads = {}
+    for dst in range(n):
+        sel = dest == dst
+        payloads[dst] = {
+            "r": np.ascontiguousarray(row_ix[sel]),
+            "c": np.ascontiguousarray(col_ix[sel]),
+            "v": np.ascontiguousarray(rating[sel]),
+        }
+    got = _exchange_all_to_all(exchange_dir, tag, payloads, timeout=timeout)
+    return (
+        np.concatenate([g["r"] for g in got]),
+        np.concatenate([g["c"] for g in got]),
+        np.concatenate([g["v"] for g in got]),
+    )
+
+
 def read_ratings_distributed(
     es,
     exchange_dir,
@@ -291,3 +403,53 @@ def read_ratings_distributed(
         dedup=dedup,
     )
     return gather_ratings(local)
+
+
+def distributed_trainer(
+    es,
+    exchange_dir,
+    cfg,
+    mesh,
+    tag: str = "ratings",
+    rating_property: Optional[str] = None,
+    dedup: str = "last",
+    timeout: float = 120.0,
+    **scan_kwargs,
+):
+    """End-to-end multi-host SHARDED training-data read.
+
+    sharded scan -> global id dictionaries -> locally-encoded COO ->
+    row-owner exchange -> :class:`~predictionio_tpu.models.als.ALSTrainer`
+    over the sharded-COO layout.  Unlike :func:`read_ratings_distributed`
+    (which all-gathers the full COO for the replicated path), no process
+    ever materializes the full rating set — both host memory and device
+    HBM scale with the cluster.  Single-process degenerates to the plain
+    trainer.
+    """
+    import jax
+
+    from ..models.als import ALSTrainer
+
+    n, pid = jax.process_count(), jax.process_index()
+    frame = find_columnar_sharded(
+        es, n_shards=n, shard_id=pid,
+        float_property=rating_property,
+        minimal=True,
+        **scan_kwargs,
+    )
+    users = ids_exchange(
+        frame.entity_id.tolist(), exchange_dir, f"{tag}-users"
+    )
+    items = ids_exchange(
+        frame.target_entity_id.tolist(), exchange_dir, f"{tag}-items"
+    )
+    local = frame.to_ratings(
+        rating_property=rating_property,
+        user_index=users,
+        item_index=items,
+        dedup=dedup,
+    )
+    return ALSTrainer.distributed(
+        local, cfg=cfg, mesh=mesh, exchange_dir=exchange_dir,
+        tag=f"{tag}-coo", timeout=timeout,
+    )

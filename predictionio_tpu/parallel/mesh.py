@@ -29,6 +29,7 @@ __all__ = [
     "force_platform",
     "data_sharding",
     "replicated",
+    "shard_put",
     "pad_to_multiple",
     "DATA_AXIS",
     "MODEL_AXIS",
@@ -149,6 +150,31 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def shard_put(arr, mesh: Mesh, spec) -> "jax.Array":
+    """``device_put`` onto a sharding that may span processes.
+
+    Single-process meshes take the plain ``device_put`` fast path.  On a
+    multi-process mesh, ``device_put`` rejects shardings with
+    non-addressable devices, so each ADDRESSABLE shard is sliced from the
+    host array and the global array assembled with
+    ``make_array_from_single_device_arrays`` — every process must hold a
+    consistent full host copy (fine for the small index vectors and
+    factor inits this serves; bulk data uses per-shard construction
+    directly, see ``models/als.ALSTrainer.distributed``).
+    """
+    sh = NamedSharding(mesh, spec)
+    if all(
+        d.process_index == jax.process_index() for d in mesh.devices.flat
+    ):
+        return jax.device_put(arr, sh)
+    arr = np.asarray(arr)
+    parts = [
+        jax.device_put(arr[idx], d)
+        for d, idx in sh.addressable_devices_indices_map(arr.shape).items()
+    ]
+    return jax.make_array_from_single_device_arrays(arr.shape, sh, parts)
+
+
 def pad_to_multiple(n: int, m: int) -> int:
     """Smallest multiple of ``m`` >= ``n`` (static-shape padding budgets)."""
     return ((n + m - 1) // m) * m
@@ -171,14 +197,20 @@ def fence(*arrays) -> None:
 
     import jax.numpy as jnp
 
-    # index the first element directly (lowers to a 1-element slice):
-    # ravel()[:1] would dispatch a full reshape that materializes a copy
-    # of the whole array in eager mode — fencing a sharded full-scale
-    # factor table must not double its HBM footprint
-    probes = [
-        jnp.reshape(a[(0,) * a.ndim], (1,)).astype(jnp.float32)
-        for a in jax.tree_util.tree_leaves(arrays)
-        if hasattr(a, "ndim") and getattr(a, "size", 0)
-    ]
+    # index the first element of a LOCAL shard directly (lowers to a
+    # 1-element slice): ravel()[:1] would dispatch a full reshape that
+    # materializes a copy of the whole array in eager mode, and global
+    # indexing would fail on multi-process arrays whose shard 0 lives on
+    # another host — the local shard is just as good a fence
+    probes = []
+    for a in jax.tree_util.tree_leaves(arrays):
+        if not (hasattr(a, "ndim") and getattr(a, "size", 0)):
+            continue
+        shards = getattr(a, "addressable_shards", None)
+        if shards:
+            a = shards[0].data
+            if not a.size:
+                continue
+        probes.append(jnp.reshape(a[(0,) * a.ndim], (1,)).astype(jnp.float32))
     if probes:
         np.asarray(jnp.concatenate(probes))

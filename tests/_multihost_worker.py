@@ -35,8 +35,11 @@ def main() -> None:
     )
     assert jax.process_count() == nprocs, jax.process_count()
 
+    mode = sys.argv[8] if len(sys.argv) > 8 else ""
     if home:
         return _run_train_end_to_end(pid, home, out)
+    if mode == "sharded":
+        return _run_sharded_trainer(pid, db, exch, out)
 
     from predictionio_tpu.models.als import ALSConfig, train_als
     from predictionio_tpu.parallel.ingest import (
@@ -71,6 +74,43 @@ def main() -> None:
         rating=ratings.rating[order],
         user_ids=ratings.users.ids.astype(str),
         item_ids=ratings.items.ids.astype(str),
+        user_factors=factors.user_factors,
+        item_factors=factors.item_factors,
+    )
+    print("WORKER_OK", pid, flush=True)
+
+
+def _run_sharded_trainer(pid: int, db: str, exch: str, out: str) -> None:
+    """Sharded-COO multi-host path: sharded scan -> id exchange ->
+    row-owner COO exchange -> ALSTrainer.distributed.  No process ever
+    holds the full COO; the parent asserts per-process rating bytes are
+    a strict subset and the model matches a single-process train."""
+    from predictionio_tpu.models.als import ALSConfig
+    from predictionio_tpu.parallel.ingest import distributed_trainer
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3,
+                    factor_placement="sharded")
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    es = SQLiteEventStore(db)
+    mesh = make_mesh()
+    tr = distributed_trainer(
+        es, exch, cfg, mesh, rating_property="rating",
+        app_id=1, event_names=["rate"],
+    )
+    assert tr.staging == "sharded-distributed", tr.staging
+    # rating bytes THIS process holds on its devices (the scaling claim)
+    local_nnz = sum(
+        s.data.shape[0]
+        for s in tr._user_side["c_sorted"].addressable_shards
+    )
+    factors = tr.train()
+    np.savez(
+        out,
+        local_nnz=np.int64(local_nnz),
+        shard_len=np.int64(tr._user_side["shard_len"]),
+        n_dev=np.int64(mesh.size),
         user_factors=factors.user_factors,
         item_factors=factors.item_factors,
     )

@@ -561,3 +561,111 @@ def test_sweep_train_implicit_mode():
                                alpha=2.0, lam=0.5))
     np.testing.assert_allclose(swept[1].user_factors, solo.user_factors,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_sharded_coo_is_actually_sharded():
+    """factor_placement='sharded' must shard the RATING COO too (round-3
+    verdict item 3): each device's shard holds ~1/d of the total rating
+    bytes, not a full replica — the property that lets nnz scale with
+    mesh HBM."""
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy(n_users=200, n_items=80, density=0.3, seed=9)
+    mesh = make_mesh()
+    assert mesh.size == 8
+    cfg = ALSConfig(rank=4, num_iterations=1, factor_placement="sharded")
+    tr = ALSTrainer((u, i, v), nu, ni, cfg, mesh=mesh)
+    assert tr.staging == "sharded"
+    nnz = len(v)
+    for side in (tr._user_side, tr._item_side):
+        cs = side["c_sorted"]
+        shard_sizes = [s.data.shape[0] for s in cs.addressable_shards]
+        assert len(shard_sizes) == 8
+        # every device holds the same (padded) shard length L, and the
+        # total padded size stays close to nnz — not 8x nnz
+        L = side["shard_len"]
+        assert set(shard_sizes) == {L}
+        assert 8 * L < 1.5 * nnz, (8 * L, nnz)
+        assert L < 0.3 * nnz  # one shard is nowhere near a full replica
+        # shard-local starts stay int32 (the per-shard offset contract)
+        for _rows, starts, _counts in side["buckets"]:
+            assert starts.dtype == np.int32
+
+
+def test_sharded_coo_slices_land_on_owning_device():
+    """Device d's shard must contain exactly the rating values of the
+    bucket rows in its chunks (co-partitioning, not just equal split)."""
+    from predictionio_tpu.models.als import _plan_shard_layout
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy(n_users=64, n_items=40, seed=3)
+    mesh = make_mesh()
+    n_dev = mesh.size
+    layout = build_bucket_layout(u, i, v, nu, min_k=4,
+                                 batch_multiple=n_dev,
+                                 starts_dtype=np.int64)
+    perm, local_starts, L = _plan_shard_layout(layout.buckets, n_dev)
+    # reconstruct every row's ratings from its owning shard and compare
+    # against the global row-grouped layout
+    counts = np.bincount(u, minlength=nu)
+    for b, ls in zip(layout.buckets, local_starts):
+        chunk = len(b.rows) // n_dev
+        for j, row in enumerate(b.rows):
+            if row >= nu:
+                continue
+            d = j // chunk
+            got = layout.val_sorted[perm[d, ls[j]: ls[j] + b.counts[j]]]
+            lo = int(np.sum(counts[:row]))
+            want = layout.val_sorted[lo: lo + b.counts[j]]
+            np.testing.assert_array_equal(got, want)
+
+
+def test_shard_plan_supports_beyond_int32_nnz():
+    """Plan-level smoke past the 2^31 rating ceiling: with the COO
+    sharded, only PER-SHARD offsets must fit int32.  Uses synthetic
+    per-row counts (no 17 GB array allocation) summing to >2^31."""
+    from predictionio_tpu.models.als import (
+        _assemble_buckets, _plan_shard_layout,
+    )
+
+    n_rows, per_row = 600_000, 4096
+    counts = np.full(n_rows, per_row, dtype=np.int64)
+    total = int(counts.sum())
+    assert total > np.iinfo(np.int32).max  # 2.46e9 > 2^31
+    starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    buckets = _assemble_buckets(
+        counts.astype(np.int64), starts, n_rows, min_k=8,
+        batch_multiple=8, starts_dtype=np.int64,
+    )
+    # planning-only (build_perm=False): the full perm would be ~17 GB —
+    # exactly the thing only the per-device slices of ever exist at once
+    # in a real sharded run; perm correctness itself is covered at small
+    # scale by test_sharded_coo_slices_land_on_owning_device
+    perm, local_starts, L = _plan_shard_layout(buckets, 8, build_perm=False)
+    assert perm is None
+    assert L < np.iinfo(np.int32).max          # per-shard fits int32
+    assert 8 * L >= total                      # plan covers every rating
+    for ls in local_starts:
+        assert ls.dtype == np.int32
+        assert int(ls.max()) < L
+
+
+def test_replicated_layout_still_guards_int32():
+    """The replicated path's int32 ceiling must still raise, and point at
+    the sharded path."""
+    with pytest.raises(ValueError, match="sharded"):
+        build_bucket_layout(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            _FakeLen(np.iinfo(np.int32).max), 1,
+        )
+
+
+class _FakeLen:
+    """Stands in for a >2^31-element value array (len() only — the guard
+    fires before any element access)."""
+
+    def __init__(self, n):
+        self._n = n
+
+    def __len__(self):
+        return self._n

@@ -29,19 +29,24 @@ def _run(monkeypatch, argv=None):
     bench.main()
 
 
-def test_optimized_config_tried_first_then_safe(patched, monkeypatch, capsys):
+def test_optimized_configs_tried_first_then_safe(patched, monkeypatch,
+                                                 capsys):
     def inner(extra, timeout, cpu_only=False):
         patched["inner"].append(list(extra))
-        if "pallas" in extra:
+        if "pallas" in extra or "fused" in extra:
             return None, "simulated lowering failure"
         return json.dumps({"metric": "m", "value": 1.0,
                            "platform": "tpu", "scale": 1.0}), None
 
     monkeypatch.setattr(bench, "_run_inner_subprocess", inner)
     _run(monkeypatch)
-    a1, a2 = patched["inner"]
-    assert "--solver" in a1 and "pallas" in a1 and "high" in a1
-    assert "--solver" not in a2 and "--precision" not in a2
+    a1, a2, a3 = patched["inner"]
+    # best first: fused kernel + bf16 gathers + bf16x3 Gram
+    assert "fused" in a1 and "high" in a1 and "bfloat16" in a1
+    # then the Gauss-Jordan solver config
+    assert "pallas" in a2 and "high" in a2
+    # then the conservative all-XLA/f32 config
+    assert "--solver" not in a3 and "--precision" not in a3
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(out)["platform"] == "tpu"
 
@@ -127,3 +132,22 @@ def test_parity_mode_emits_zero_delta_line(capsys):
     assert rec["metric"] == "als_rmse_parity_vs_mllib_oracle"
     assert rec["holdout_delta"] < 1e-3
     assert abs(rec["rmse_train_tpu"] - rec["rmse_train_oracle"]) < 1e-3
+
+
+def test_pipeline_mode_emits_stage_breakdown(capsys):
+    """`bench.py --pipeline` drives file -> native import -> sqlite ->
+    columnar scan -> encode -> train and reports every stage."""
+    import bench
+
+    args = bench._parse_args(
+        ["--pipeline", "--scale", "0.002", "--iters", "2",
+         "--platform", "cpu"]
+    )
+    bench.run_pipeline(args)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "ml20m_pipeline_file_to_model_seconds"
+    for stage in ("import", "scan_columnar", "encode_ids", "train"):
+        assert rec["stages"][stage] >= 0
+    assert rec["n_events"] > 0
+    assert rec["value"] > 0 and "train_rmse" in rec

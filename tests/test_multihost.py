@@ -1,12 +1,15 @@
-"""Multi-host sharded ingest: 2 real jax.distributed CPU processes.
+"""Multi-host sharded ingest: real jax.distributed CPU processes (2 and 4).
 
 The TPU-build analogue of the reference's region-parallel HBase scans
 (`data/.../storage/hbase/HBPEvents.scala:99-105`): each process reads only
 its entity-hash shard of the event store, id dictionaries are exchanged
-through the shared storage dir, and the numeric COO is all-gathered.  This
-suite launches two actual processes (the way `local[4]` stood in for a
-Spark cluster in the reference's tests, a 2-process CPU cluster stands in
-for 2 TPU hosts) and checks the union equals a single-process read.
+through the shared storage dir, and the numeric COO either all-gathers
+(replicated path) or is exchanged to each row's owning process so no
+process holds the full rating set (sharded-COO path,
+`ALSTrainer.distributed`).  The suite launches actual processes (the way
+`local[4]` stood in for a Spark cluster in the reference's tests, a small
+CPU cluster stands in for TPU hosts) and checks every path against a
+single-process read.
 """
 
 import datetime as dt
@@ -78,9 +81,52 @@ def test_shard_masks_partition_events(tmp_path):
     es.close()
 
 
-def test_two_process_ingest_and_train(tmp_path):
-    """Two jax.distributed CPU processes each read their shard; the gathered
-    COO and the model trained on it match a single-process run."""
+def _spawn_workers(nprocs, args_of, timeout=300, device_count=0):
+    """Launch nprocs worker processes; returns their loaded npz outputs.
+
+    ``device_count`` > 0 forces that many virtual CPU devices PER
+    process (mesh size = nprocs * device_count), exercising the
+    device→process mapping with more devices than processes."""
+    import os
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            f"--xla_force_host_platform_device_count={device_count}"
+            if device_count else ""
+        ),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER)] + [str(a) for a in args_of(p)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for p in range(nprocs)
+    ]
+    results = []
+    for p, proc in enumerate(procs):
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"worker {p} timed out")
+        assert proc.returncode == 0, (
+            f"worker {p} rc={proc.returncode}\n{stdout}\n{stderr}"
+        )
+        assert f"WORKER_OK {p}" in stdout
+        results.append(stdout)
+    return results
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multi_process_ingest_and_train(tmp_path, nprocs):
+    """jax.distributed CPU processes each read their shard; the gathered
+    COO and the model trained on it match a single-process run.  4
+    processes cover ids_exchange fan-in and uneven shard sizes beyond
+    the pairwise case."""
     db = tmp_path / "events.db"
     es = SQLiteEventStore(db)
     es.init_channel(1)
@@ -102,38 +148,12 @@ def test_two_process_ingest_and_train(tmp_path):
 
     coordinator = f"127.0.0.1:{_free_port()}"
     exch = tmp_path / "exchange"
-    outs = [tmp_path / f"out{p}.npz" for p in range(2)]
-    env = {
-        **__import__("os").environ,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "",  # one CPU device per process
-    }
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, str(WORKER), str(p), "2", coordinator,
-                str(db), str(exch), str(outs[p]),
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-        )
-        for p in range(2)
-    ]
-    results = []
-    for p, proc in enumerate(procs):
-        try:
-            stdout, stderr = proc.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"worker {p} timed out")
-        assert proc.returncode == 0, (
-            f"worker {p} rc={proc.returncode}\n{stdout}\n{stderr}"
-        )
-        assert f"WORKER_OK {p}" in stdout
-        results.append(np.load(outs[p], allow_pickle=False))
+    outs = [tmp_path / f"out{p}.npz" for p in range(nprocs)]
+    _spawn_workers(
+        nprocs,
+        lambda p: [p, nprocs, coordinator, db, exch, outs[p]],
+    )
+    results = [np.load(o, allow_pickle=False) for o in outs]
 
     # each worker saw a strict subset, together the whole set
     locals_ = [int(r["local_rows"]) for r in results]
@@ -213,3 +233,78 @@ def test_two_process_run_train_end_to_end(tmp_path):
         results[0]["predict_items"].tolist()
         == results[1]["predict_items"].tolist()
     )
+
+
+@pytest.mark.parametrize(
+    "nprocs,device_count",
+    [(2, 2), (4, 0)],
+    ids=["2proc_x_2dev", "4proc_x_1dev"],
+)
+def test_sharded_coo_distributed_trainer(tmp_path, nprocs, device_count):
+    """ALSTrainer.distributed over real processes: NO process holds the
+    full COO (per-process rating arrays are a strict subset), the mesh
+    spans processes (2x2 covers devices != processes), and the trained
+    model matches a single-process replicated train.  A pre-planted
+    stale exchange file from a 'crashed run' must be swept, never merged."""
+    import os
+    import time as _time
+
+    db = tmp_path / "events.db"
+    es = SQLiteEventStore(db)
+    es.init_channel(1)
+    for e in _make_events(n_users=24, n_items=16, seed=1):
+        es.insert(e, app_id=1)
+    frame = es.find_columnar(
+        app_id=1, event_names=["rate"], float_property="rating"
+    )
+    expected = frame.to_ratings(rating_property="rating")
+    es.close()
+
+    from predictionio_tpu.models.als import ALSConfig, train_als
+
+    exp_factors = train_als(
+        expected, cfg=ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3)
+    )
+
+    exch = tmp_path / "exchange"
+    exch.mkdir()
+    # crashed-run residue: an aged file with a colliding-looking name and
+    # a fresh one; the aged one must be swept, the fresh one left alone,
+    # and (nonce in the filename) neither can be merged into this run
+    stale = exch / "ratings-users-deadbeefdeadbeef-0.npz"
+    np.savez_compressed(stale, ids=np.asarray(["GHOST"], dtype=str))
+    os.utime(stale, (_time.time() - 7200, _time.time() - 7200))
+    fresh = exch / "unrelated-fresh.npz"
+    np.savez_compressed(fresh, ids=np.asarray(["KEEP"], dtype=str))
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [tmp_path / f"sh{p}.npz" for p in range(nprocs)]
+    _spawn_workers(
+        nprocs,
+        lambda p: [p, nprocs, coordinator, db, exch, outs[p], "",
+                   "sharded"],
+        device_count=device_count,
+    )
+    results = [np.load(o, allow_pickle=False) for o in outs]
+
+    assert not stale.exists(), "stale exchange file survived the sweep"
+    assert fresh.exists(), "fresh file was wrongly swept"
+
+    n_dev = int(results[0]["n_dev"])
+    assert n_dev == nprocs * max(device_count, 1)
+    nnz = len(expected)
+    for r in results:
+        # strict subset of the ratings on every process, padded total
+        # stays near nnz (sharded, not replicated)
+        assert 0 < int(r["local_nnz"]) < nnz
+        assert int(r["shard_len"]) * n_dev < 2 * nnz + n_dev * 64
+        # GHOST ids from the stale file never entered the dictionaries
+        assert r["user_factors"].shape == exp_factors.user_factors.shape
+        np.testing.assert_allclose(
+            r["user_factors"], exp_factors.user_factors,
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            r["item_factors"], exp_factors.item_factors,
+            rtol=1e-4, atol=1e-4,
+        )

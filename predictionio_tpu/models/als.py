@@ -929,7 +929,13 @@ class ALSTrainer:
         )
         # deterministic within-row order (sources may interleave): sort
         # by (row, col); occurrence index beyond the per-row cap
-        # (max_ratings_per_row) is dropped, like the single-host layout
+        # (max_ratings_per_row) is dropped.  NOTE the retained subset
+        # under a cap is deterministic but (row, col)-ordered, whereas a
+        # single-process train keeps the first cap entries in scan
+        # order — with hash-sharded multi-process scans no global scan
+        # order exists to reproduce, so capped distributed trains are
+        # reproducible against themselves, not bit-equal to a
+        # single-process capped train
         order = np.lexsort((cols2, rows2))
         rows2, cols2, vals2 = rows2[order], cols2[order], vals2[order]
         rc = np.bincount(rows2, minlength=n_rows_pad).astype(np.int64)
@@ -1101,8 +1107,13 @@ class ALSTrainer:
         flat = perm.reshape(-1)
         c_sh = np.ascontiguousarray(layout.col_sorted[flat])
         v_sh = np.ascontiguousarray(layout.val_sorted[flat])
-        dp = NamedSharding(self.mesh, P(DATA_AXIS))
-        put_dp = lambda x: jax.device_put(x, dp)  # noqa: E731
+        from ..parallel.mesh import shard_put
+
+        # shard_put, not device_put: a caller may hand the full COO to
+        # every process of a multi-process mesh (e.g. a sharded sweep
+        # after a replicated-path read); device_put would reject the
+        # non-addressable devices
+        put_dp = lambda x: shard_put(x, self.mesh, P(DATA_AXIS))  # noqa: E731
         return {
             "c_sorted": put_dp(c_sh),
             "v_sorted": put_dp(v_sh),
@@ -1146,8 +1157,9 @@ class ALSTrainer:
             V = jax.device_put(V, replicated(self.mesh))
         return U, V
 
-    def _half(self, upd, opp, side) -> jax.Array:
+    def _half(self, upd, opp, side, lam: Optional[float] = None) -> jax.Array:
         cfg = self.cfg
+        lam_t = jnp.asarray(cfg.lam if lam is None else lam, jnp.float32)
         if self.sharded:
             fn = (
                 self._sharded_user_half
@@ -1157,13 +1169,13 @@ class ALSTrainer:
             flat = [a for b in side["buckets"] for a in b]
             return fn(
                 upd, opp, side["c_sorted"], side["v_sorted"],
-                jnp.asarray(cfg.lam, jnp.float32),
+                lam_t,
                 jnp.asarray(cfg.alpha, jnp.float32),
                 *flat,
             )
         return _half_iteration(
             upd, opp, side["c_sorted"], side["v_sorted"], side["buckets"],
-            jnp.asarray(cfg.lam, jnp.float32),
+            lam_t,
             jnp.asarray(cfg.alpha, jnp.float32),
             ks=side["ks"],
             implicit=cfg.implicit,
@@ -1174,19 +1186,28 @@ class ALSTrainer:
         )
 
     def run(
-        self, U: jax.Array, V: jax.Array, num_iterations: int
+        self,
+        U: jax.Array,
+        V: jax.Array,
+        num_iterations: int,
+        lam: Optional[float] = None,
     ) -> tuple[jax.Array, jax.Array]:
         """Iterate; treats U/V functionally (the caller's arrays survive).
 
         The half-iterations donate their working buffers, so copy the
         inputs once up front — two [N, R] copies are noise next to one
         half-iteration, and callers keep usable arrays for warm restarts.
+
+        ``lam`` overrides the config's regularization for THIS run: λ is
+        a traced scalar, so sweeping it reuses the compiled executables
+        and the staged (possibly sharded) COO — the sweep path for
+        problems too big for the vmapped ``sweep_train_als``.
         """
         U = jnp.array(U, copy=True)
         V = jnp.array(V, copy=True)
         for it in range(num_iterations):
-            U = self._half(U, V, self._user_side)
-            V = self._half(V, U, self._item_side)
+            U = self._half(U, V, self._user_side, lam=lam)
+            V = self._half(V, U, self._item_side, lam=lam)
             logger.debug("ALS iteration %d/%d dispatched", it + 1,
                          num_iterations)
         # fence, not block_until_ready: the latter is a no-op on some
@@ -1282,16 +1303,29 @@ def sweep_train_als(
     also fuses the compute).
 
     Memory scales ×K (factor tables and the per-bucket gathered blocks),
-    so this fits evaluation-scale problems, not the full ML-20M train.
-    Replicated placement and the XLA solver only (the Pallas kernel's
-    grid does not batch under vmap).
+    so the VMAPPED form fits evaluation-scale problems, not the full
+    ML-20M train.  The vmapped form needs replicated placement and the
+    XLA solver (Pallas grids don't batch under vmap); **sharded
+    placement sweeps sequentially instead** — staging and the compiled
+    sharded halves are built once and reused across candidates (λ is a
+    traced scalar), so the sweep composes with the sharded-COO scaling
+    story at the cost of K sequential trains rather than one batched
+    one.
     """
     if not lams:
         return []
     if cfg.factor_placement == "sharded":
-        raise ValueError("sweep_train_als supports replicated placement only")
+        trainer = ALSTrainer(ratings, n_users, n_items, cfg, mesh=mesh)
+        out = []
+        for lam in lams:
+            U0, V0 = trainer.init_factors()
+            U, V = trainer.run(U0, V0, cfg.num_iterations, lam=float(lam))
+            out.append(trainer._factors(U, V))
+        return out
     if cfg.solver != "xla":
-        raise ValueError("sweep_train_als requires solver='xla'")
+        raise ValueError(
+            "sweep_train_als (vmapped form) requires solver='xla'"
+        )
     trainer = ALSTrainer(ratings, n_users, n_items, cfg, mesh=mesh)
     side_u, side_i = trainer._user_side, trainer._item_side
     K = len(lams)

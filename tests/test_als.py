@@ -540,9 +540,10 @@ def test_sweep_train_rejects_unsupported_modes():
     from predictionio_tpu.models.als import sweep_train_als
 
     u, i, v, nu, ni = _toy()
-    with pytest.raises(ValueError, match="replicated"):
-        sweep_train_als((u, i, v), nu, ni,
-                        ALSConfig(factor_placement="sharded"), lams=[0.1])
+    # the VMAPPED form needs the XLA solver (Pallas grids don't batch
+    # under vmap); sharded placement is no longer rejected — it sweeps
+    # sequentially over one staged trainer (see
+    # test_sweep_sharded_sequential_matches_vmapped)
     with pytest.raises(ValueError, match="solver"):
         sweep_train_als((u, i, v), nu, ni,
                         ALSConfig(solver="pallas"), lams=[0.1])
@@ -669,3 +670,27 @@ class _FakeLen:
 
     def __len__(self):
         return self._n
+
+
+def test_sweep_sharded_sequential_matches_vmapped():
+    """Sharded-placement sweeps reuse one staged trainer sequentially and
+    must produce the same per-candidate factors as the vmapped sweep
+    (composability of the sweep with the sharded-COO scaling story)."""
+    from predictionio_tpu.models.als import sweep_train_als
+    from predictionio_tpu.parallel import make_mesh
+
+    u, i, v, nu, ni = _toy(n_users=32, n_items=24)
+    mesh = make_mesh()
+    lams = (0.05, 0.5)
+    base = dict(rank=4, num_iterations=2)
+    vm = sweep_train_als((u, i, v), nu, ni, ALSConfig(**base), lams=lams)
+    sh = sweep_train_als(
+        (u, i, v), nu, ni,
+        ALSConfig(factor_placement="sharded", **base),
+        lams=lams, mesh=mesh,
+    )
+    assert len(vm) == len(sh) == 2
+    for a, b in zip(vm, sh):
+        np.testing.assert_allclose(
+            a.user_factors, b.user_factors, rtol=1e-4, atol=1e-4
+        )

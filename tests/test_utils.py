@@ -62,3 +62,20 @@ def test_profile_trace_enabled_writes(tmp_path, monkeypatch):
     with profile_trace("unit", enabled=True) as out:
         (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
     assert out is not None and any(out.rglob("*"))
+
+
+def test_plugin_env_scrubs_by_prefix():
+    """The wedged-tunnel survival story must not hinge on one hardcoded
+    trigger name (round-2 weak item): a renamed plugin var that keeps
+    the vendor prefix is still scrubbed."""
+    import plugin_env
+
+    env = {
+        "PALLAS_AXON_POOL_IPS": "1.2.3.4",
+        "PALLAS_AXON_SOME_FUTURE_TRIGGER": "x",
+        "AXON_LOOPBACK_RELAY": "1",
+        "JAX_PLATFORMS": "axon",
+        "PATH": "/bin",
+    }
+    plugin_env.scrub_plugin_env(env)
+    assert set(env) == {"JAX_PLATFORMS", "PATH"}

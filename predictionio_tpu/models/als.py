@@ -437,11 +437,13 @@ def _solve_buckets(
     HBM bytes.  The YtY gram, regularization, and solves stay f32.
 
     ``solver="fused"`` routes buckets through the single-pass Pallas
-    kernel (`ops/fused_als.py`: table resident in VMEM, in-kernel
-    gather+Gram+regularize+Gauss-Jordan, ~12 B/rating of HBM traffic)
-    WHEN this side's opposite table fits the VMEM budget — the user
-    half at ML-20M rank 64; the item half (35 MB opposite table) and
-    any non-fitting side transparently keep the XLA path below.
+    kernel (`ops/fused_als.py`: in-kernel gather+Gram+regularize+
+    Gauss-Jordan, ~12 B/rating of HBM traffic).  VMEM-fitting opposite
+    tables stay resident; bigger ones STREAM through the kernel's third
+    grid axis in id-range-masked chunks — both ML-20M halves fuse.
+    Only shapes with no tile plan at all (`fused_tile_plan` None:
+    pathological chunk counts or a tiny VMEM budget) keep the XLA path
+    below.
     """
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]

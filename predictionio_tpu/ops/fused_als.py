@@ -21,10 +21,19 @@ keeps the whole table resident and, per batch tile, streams only the
   ``x[TB, R]``.
 
 HBM traffic drops from ~256 bytes/rating (the materialized expansion)
-to ~12 bytes/rating (idx + two weights).  The item-side half (opposite
-table = user factors, ~35 MB at ML-20M — beyond VMEM) stays on the XLA
-path; ``models/als._solve_buckets`` picks per side automatically under
-``ALSConfig(solver="fused")``.
+to ~12 bytes/rating (idx + two weights).
+
+Tables BEYOND VMEM (the ML-20M user table, ~35 MB) run the same kernel
+TILED: a third grid axis streams the table through VMEM in chunks, and
+each chunk's contribution is masked by an id-range test before the
+accumulation.  The chunk reads are big contiguous DMAs at full HBM
+bandwidth — the opposite of the random-gather slow path the unfused
+expansion pays — so the item half's table traffic is
+``batch_tiles x |table|`` (~15 GB ≈ 20 ms at v5e bandwidth for ML-20M)
+instead of ~5 GB at the measured 17 GB/s gather rate (~300 ms).
+``models/als._solve_buckets`` routes any side through the kernel when a
+tile plan exists; ``fused_tile_plan`` caps the chunk count so
+pathological shapes fall back to XLA.
 
 Reference provenance: this fuses what MLlib ALS does in separate stages
 per block (gather factors, accumulate YtY·normal equations, solve —
@@ -61,20 +70,39 @@ def _pad128(n: int) -> int:
     return max(-(-n // 128) * 128, 128)
 
 
-def fused_tile_plan(m: int, r: int, k: int, table_bytes: int = 4):
-    """Choose ``(TB, KC)`` so the whole working set fits the VMEM budget.
+# Cap on streamed table chunks.  The per-chunk re-read of the
+# [TB, KC] index/weight blocks costs ~T x 12 B/rating — at T=64 that is
+# ~3x the unfused path's ~256 B/rating, BUT every streamed byte is a
+# big contiguous DMA at full HBM bandwidth (~800 GB/s on v5e) while the
+# unfused bytes move at the measured ~17 GB/s random-gather rate, so
+# streaming stays ~15x cheaper in time at the cap.  The cap guards the
+# truly pathological shapes (T in the hundreds), where the plan's
+# working-set math stops being the dominant consideration.
+_MAX_TABLE_CHUNKS = 64
 
-    Accounts for the PADDED footprints (Mosaic tiles the trailing two
-    dims to (8, 128) for f32): the resident ``[M, R]`` table, the
-    ``[TB, R, R]`` + ``[TB, R, R+1]`` + ``[TB, R]`` scratches, the
-    ``[TB, KC, R]`` gathered chunk, and the double-buffered
-    ``[TB, KC]`` input / ``[TB, R]`` output blocks.  Returns ``None``
-    when even the smallest tile cannot fit (caller falls back to the
-    XLA path).
+
+def fused_tile_plan(m: int, r: int, k: int, table_bytes: int = 4):
+    """Choose ``(TB, KC, MC)`` so the working set fits the VMEM budget.
+
+    ``MC`` is the table-chunk height: ``MC >= M`` means the whole table
+    is VMEM-resident (single chunk, no masking waste); smaller tables
+    stream through in ``ceil(M/MC)`` chunks along the kernel's third
+    grid axis.  Accounts for the PADDED footprints (Mosaic tiles the
+    trailing two dims to (8, 128) for f32): the double-buffered
+    ``[MC, R]`` table chunk, the ``[TB, R, R]`` + ``[TB, R, R+1]`` +
+    ``[TB, R]`` scratches, the ``[TB, KC, R]`` gathered chunk, and the
+    double-buffered ``[TB, KC]`` input / ``[TB, R]`` output blocks.
+    Returns ``None`` when no plan fits within ``_MAX_TABLE_CHUNKS``
+    (caller falls back to the XLA path).
     """
     budget = int(solver_vmem_budget() * 0.9)
-    table = m * _pad128(r) * table_bytes  # sublane dim M needs no pad >8
     r8, r128, w128 = _pad8(r), _pad128(r), _pad128(r + 1)
+    m8 = _pad8(m)
+    best_stream = None
+    # a RESIDENT table (fetched once, idx blocks read once) beats bigger
+    # batch tiles with a streamed table (T x index re-reads + table
+    # re-fetch per batch tile), so residency at any tile size wins over
+    # streaming at any tile size; within each mode, larger tiles first
     for tb in (64, 32, 16, 8):
         for kc in (512, 256, 128):
             kc_eff = min(kc, max(-(-k // 128) * 128, 128))
@@ -85,22 +113,30 @@ def fused_tile_plan(m: int, r: int, k: int, table_bytes: int = 4):
             io = 3 * 2 * _pad8(tb) * _pad128(kc_eff) * 4  # idx/cw/bw x2
             out = 2 * _pad8(tb) * r128 * 4
             gram0 = r8 * r128 * 4
-            total = (
-                table + a_scr + m_scr + b_scr + rows + io + out + gram0
-            )
-            if total <= budget:
-                return tb, kc_eff
-    return None
+            fixed = a_scr + m_scr + b_scr + rows + io + out + gram0
+            avail = budget - fixed
+            if avail <= 0:
+                continue
+            # whole table resident (single chunk, not double-buffered)?
+            if m8 * r128 * table_bytes <= avail:
+                return tb, kc_eff, m8
+            # else stream chunks (double-buffered by the pipeline);
+            # remember the largest-tile streaming plan as the fallback
+            if best_stream is None:
+                mc = (avail // 2 // (r128 * table_bytes)) // 8 * 8
+                if mc >= 8 and -(-m8 // mc) <= _MAX_TABLE_CHUNKS:
+                    best_stream = (tb, kc_eff, int(mc))
+    return best_stream
 
 
 def fused_side_fits(m: int, r: int, k_max: int, table_bytes: int = 4) -> bool:
-    """Can this side's opposite table + working set live in VMEM?"""
+    """Does a fused tile plan (resident or streamed table) exist?"""
     return fused_tile_plan(m, r, max(k_max, 1), table_bytes) is not None
 
 
 def _fused_kernel(
     gram0_ref,   # [R, R] f32 (YtY for implicit mode; zeros otherwise)
-    table_ref,   # [M, R] resident opposite factor table (f32 or bf16)
+    table_ref,   # [MC, R] opposite-table chunk (f32 or bf16)
     idx_ref,     # [TB, KC] int32 (masked entries point at row 0)
     cw_ref,      # [TB, KC] f32 Gram weights (0 at masked entries)
     bw_ref,      # [TB, KC] f32 rhs weights (0 at masked entries)
@@ -110,34 +146,40 @@ def _fused_kernel(
     b_scr,       # [TB, R] f32 rhs accumulator
     m_scr,       # [TB, R, R+1] f32 augmented Gauss-Jordan scratch
 ):
-    j = pl.program_id(1)
+    t, j = pl.program_id(1), pl.program_id(2)
+    nt, nj = pl.num_programs(1), pl.num_programs(2)
     tb, kc = idx_ref.shape
-    r = table_ref.shape[-1]
+    mc, r = table_ref.shape
 
-    @pl.when(j == 0)
+    @pl.when((t == 0) & (j == 0))
     def _init():
         a_scr[:] = jnp.broadcast_to(
             gram0_ref[:][None], (tb, r, r)
         ).astype(jnp.float32)
         b_scr[:] = jnp.zeros((tb, r), jnp.float32)
 
-    # the in-VMEM dynamic row gather: [TB*KC] indices into the resident
-    # [M, R] table — the op whose Mosaic lowering the on-chip probe checks
+    # ids owned by THIS table chunk contribute; the rest are masked out
+    # of the weights (single-chunk tables: the mask is all-true and the
+    # clip a no-op).  The in-VMEM dynamic row gather is the op whose
+    # Mosaic lowering the on-chip probe checks.
+    local = idx_ref[:] - t * mc
+    inr = ((local >= 0) & (local < mc)).astype(jnp.float32)
+    safe = jnp.clip(local, 0, mc - 1)
     rows = jnp.take(
-        table_ref[:], idx_ref[:].reshape(tb * kc), axis=0
+        table_ref[:], safe.reshape(tb * kc), axis=0
     ).reshape(tb, kc, r).astype(jnp.float32)
-    rw = rows * cw_ref[:][:, :, None]
+    rw = rows * (cw_ref[:] * inr)[:, :, None]
     # MXU: batched [KC, R]ᵀ[KC, R] -> [R, R] per tile row
     a_scr[:] += jax.lax.dot_general(
         rw, rows, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
     b_scr[:] += jax.lax.dot_general(
-        bw_ref[:], rows, (((1,), (1,)), ((0,), (0,))),
+        bw_ref[:] * inr, rows, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
 
-    @pl.when(j == pl.num_programs(1) - 1)
+    @pl.when((t == nt - 1) & (j == nj - 1))
     def _solve():
         w = r + 1
         lanes = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
@@ -169,31 +211,38 @@ def _fused_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tb", "kc", "interpret")
+    jax.jit, static_argnames=("tb", "kc", "mc", "interpret")
 )
-def _fused_padded(gram0, table, idx, cw, bw, reg, *, tb, kc, interpret):
+def _fused_padded(gram0, table, idx, cw, bw, reg, *, tb, kc, mc, interpret):
     bp, kp = idx.shape
-    m, r = table.shape
-    grid = (bp // tb, kp // kc)
+    mp, r = table.shape
+    grid = (bp // tb, mp // mc, kp // kc)
+    # constant index map when the table is resident (single chunk): a
+    # grid-invariant map is provably single-buffered, which is what the
+    # tile plan budgeted; the streamed map only appears when the plan
+    # ALSO budgeted the chunk double-buffered
+    table_map = (
+        (lambda i, t, j: (0, 0)) if mp == mc else (lambda i, t, j: (t, 0))
+    )
     return pl.pallas_call(
         _fused_kernel,
         out_shape=jax.ShapeDtypeStruct((bp, r), jnp.float32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((r, r), lambda i, j: (0, 0),
+            pl.BlockSpec((r, r), lambda i, t, j: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((m, r), lambda i, j: (0, 0),
+            pl.BlockSpec((mc, r), table_map,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, kc), lambda i, j: (i, j),
+            pl.BlockSpec((tb, kc), lambda i, t, j: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, kc), lambda i, j: (i, j),
+            pl.BlockSpec((tb, kc), lambda i, t, j: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, kc), lambda i, j: (i, j),
+            pl.BlockSpec((tb, kc), lambda i, t, j: (i, j),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tb, 1), lambda i, j: (i, 0),
+            pl.BlockSpec((tb, 1), lambda i, t, j: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((tb, r), lambda i, j: (i, 0),
+        out_specs=pl.BlockSpec((tb, r), lambda i, t, j: (i, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((tb, r, r), jnp.float32),
@@ -212,6 +261,7 @@ def fused_gather_gram_solve(
     reg,            # [B]    f32 ridge diagonal
     gram0=None,     # [R, R] f32 base Gram (implicit YtY); zeros if None
     interpret: bool | None = None,
+    plan: tuple | None = None,
 ):
     """One fused normal-equation build + solve for a bucket of rows.
 
@@ -219,22 +269,31 @@ def fused_gather_gram_solve(
     Σₖ bwₖ·vₖ`` with ``vₖ = table[idx[:, k]]``.  Masking rides the
     weights: a masked entry's ``cw = bw = 0`` makes its gathered row
     irrelevant (so ``idx`` may safely point anywhere, conventionally 0).
+
+    ``plan`` overrides the ``(TB, KC, MC)`` tile plan — used by the
+    compile probe to force the streamed multi-chunk grid on a small
+    table; production callers leave it None.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, k = idx.shape
     m, r = table.shape
-    plan = fused_tile_plan(m, r, k, table.dtype.itemsize)
+    if plan is None:
+        plan = fused_tile_plan(m, r, k, table.dtype.itemsize)
     if plan is None:
         raise ValueError(
-            f"fused ALS kernel: table [{m}, {r}] + working set exceeds "
-            f"the VMEM budget ({solver_vmem_budget()} B)"
+            f"fused ALS kernel: no tile plan for table [{m}, {r}] "
+            f"within the VMEM budget ({solver_vmem_budget()} B)"
         )
-    tb, kc = plan
+    tb, kc, mc = plan
     bp = -(-b // tb) * tb
     kp = -(-k // kc) * kc
+    mp = -(-m // mc) * mc
     if gram0 is None:
         gram0 = jnp.zeros((r, r), jnp.float32)
+    # zero-padded table rows are unreachable: valid ids are < m, masked
+    # entries carry zero weights
+    table = jnp.pad(table, ((0, mp - m), (0, 0)))
     idx = jnp.pad(idx, ((0, bp - b), (0, kp - k)))
     cw = jnp.pad(cw.astype(jnp.float32), ((0, bp - b), (0, kp - k)))
     bw = jnp.pad(bw.astype(jnp.float32), ((0, bp - b), (0, kp - k)))
@@ -244,7 +303,7 @@ def fused_gather_gram_solve(
     )[:, None]
     x = _fused_padded(
         gram0.astype(jnp.float32), table, idx, cw, bw, reg,
-        tb=tb, kc=kc, interpret=bool(interpret),
+        tb=tb, kc=kc, mc=mc, interpret=bool(interpret),
     )
     return x[:b]
 
@@ -254,12 +313,16 @@ _PROBE_CACHE: dict[tuple, bool] = {}
 
 
 def fused_solver_ok(m: int, r: int, table_bytes: int = 4) -> bool:
-    """Compile-and-run probe for the fused kernel at this table size.
+    """Compile-and-run probe for the fused kernel.
 
-    The kernel's one speculative op is the in-VMEM dynamic gather
-    (``jnp.take`` on a resident table); round 2 proved kernels must be
-    probed ON the target backend before production use.  Cached per
-    (backend, m, r).
+    The kernel's speculative ops are the in-VMEM dynamic gather
+    (``jnp.take`` on a VMEM table) and the streamed-table grid (a third
+    grid axis with an id-range-masked gather) — M selects between the
+    resident and streamed shapes in production, so BOTH are probed on
+    small tables (a forced multi-chunk plan stands in for the big-table
+    case; the pipeline shape, not the table height, is what lowering
+    depends on).  Round 2 proved kernels must be probed ON the target
+    backend before production use.  Cached per (backend, m, r, bytes).
     """
     import logging
 
@@ -273,22 +336,28 @@ def fused_solver_ok(m: int, r: int, table_bytes: int = 4) -> bool:
         return False
     try:
         dtype = jnp.bfloat16 if table_bytes == 2 else jnp.float32
-        mm = min(m, 512)  # probe a small table; lowering doesn't depend on M
-        table = jnp.ones((mm, r), dtype)
         idx = jnp.zeros((8, 8), jnp.int32)
         one = jnp.ones((8, 8), jnp.float32)
         reg = jnp.ones((8,), jnp.float32)
-        x = fused_gather_gram_solve(table, idx, one, one, reg)
         # 8 ratings of weight 1 on the all-ones row: A = 8·J + I,
         # b = 8·1 -> x = 8/(8r+1)·1
         want = 8.0 / (8.0 * r + 1.0)
-        got = float(np.asarray(x[0, :1])[0])
-        ok = abs(got - want) < 1e-4
-        if not ok:
-            logger.warning(
-                "fused ALS kernel probe returned %g (want %g) at "
-                "m=%d r=%d; using the unfused path", got, want, m, r,
+        ok = True
+        for probe_plan in (None, (8, 128, 64)):  # resident, streamed x2
+            table = jnp.ones((128, r), dtype)
+            x = fused_gather_gram_solve(
+                table, idx, one, one, reg, plan=probe_plan
             )
+            got = float(np.asarray(x[0, :1])[0])
+            if abs(got - want) >= 1e-4:
+                logger.warning(
+                    "fused ALS kernel probe (%s) returned %g (want %g) "
+                    "at r=%d; using the unfused path",
+                    "streamed" if probe_plan else "resident",
+                    got, want, r,
+                )
+                ok = False
+                break
     except Exception as e:  # noqa: BLE001 — any compile/lowering error
         logger.warning(
             "fused ALS kernel unavailable at m=%d r=%d on %r (%s); "

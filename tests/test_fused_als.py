@@ -87,6 +87,37 @@ def test_fused_bf16_gather_close_to_f32():
     )
 
 
+def test_fused_chunked_table_matches_resident(monkeypatch):
+    """A VMEM budget too small for the whole table forces the streamed
+    multi-chunk path (third grid axis + id-range masking); results must
+    match the dense reference exactly like the resident path."""
+    from predictionio_tpu.ops import fused_als as fmod
+
+    rng = np.random.default_rng(2)
+    # 20k x 8 table: ~10 MB padded (lane dim pads 8 -> 128), resident at
+    # the default 16 MB budget but forced to stream at 4 MB
+    M, R, B, K = 20000, 8, 11, 19
+    table = rng.normal(size=(M, R)).astype(np.float32)
+    idx = rng.integers(0, M, size=(B, K)).astype(np.int32)
+    mask = (rng.random((B, K)) < 0.8).astype(np.float32)
+    val = (rng.random((B, K)) * 3 + 1).astype(np.float32)
+    reg = rng.random(B).astype(np.float32) + 0.5
+
+    resident_plan = fmod.fused_tile_plan(M, R, K, 4)
+    assert resident_plan is not None and resident_plan[2] >= M
+    resident = np.asarray(fused_gather_gram_solve(
+        table, idx, mask, val * mask, reg
+    ))
+    monkeypatch.setenv("PIO_TPU_VMEM_BYTES", str(4 << 20))
+    plan = fmod.fused_tile_plan(M, R, K, 4)
+    assert plan is not None and plan[2] < M, plan
+    assert -(-M // plan[2]) > 1  # really multi-chunk
+    chunked = np.asarray(fused_gather_gram_solve(
+        table, idx, mask, val * mask, reg
+    ))
+    np.testing.assert_allclose(chunked, resident, rtol=1e-4, atol=1e-4)
+
+
 def test_fused_mixed_routing_when_one_side_too_big(monkeypatch):
     """Per-side routing: when only the smaller table fits VMEM, that
     side fuses and the other transparently keeps the XLA path — the
@@ -139,15 +170,19 @@ def test_fused_sharded_placement_matches():
 def test_fused_tile_plan_respects_budget(monkeypatch):
     plan = fused_tile_plan(26744, 64, 4096, 4)
     assert plan is not None and plan[0] >= 8 and plan[1] >= 128
-    # bf16 table frees VMEM -> at least as large a tile
-    plan_bf = fused_tile_plan(26744, 64, 4096, 2)
-    assert plan_bf is not None and plan_bf >= plan
-    # the ML-20M USER table (138k rows) must NOT claim to fit
-    assert fused_tile_plan(138493, 64, 4096, 4) is None
-    assert not fused_side_fits(138493, 64, 4096, 4)
+    # the ML-20M item table is small enough to stay VMEM-resident at
+    # bf16 (one chunk); f32 pads rank 64's lanes to 128 so it streams
+    tb, kc, mc = fused_tile_plan(26744, 64, 4096, 2)
+    assert mc >= 26744
+    # the ML-20M USER table (138k rows) STREAMS in bounded chunks
+    tb, kc, mc = fused_tile_plan(138493, 64, 4096, 4)
+    assert mc < 138493
+    assert -(-138493 // mc) <= 64
+    assert fused_side_fits(138493, 64, 4096, 4)
     # a tiny budget rejects everything
     monkeypatch.setenv("PIO_TPU_VMEM_BYTES", str(1 << 20))
     assert fused_tile_plan(26744, 64, 4096, 4) is None
+    assert not fused_side_fits(26744, 64, 4096, 4)
 
 
 def test_fused_probe_failure_degrades_to_xla(monkeypatch, caplog):

@@ -51,17 +51,19 @@ print({'metric': 'fused_probe_bf16_r64', 'ok': fused_solver_ok(512, 64, 2)})
 print({'metric': 'fused_tile_plan_ml20m_f32', 'plan': fused_tile_plan(26744, 64, 4096, 4)})
 print({'metric': 'fused_tile_plan_ml20m_bf16', 'plan': fused_tile_plan(26744, 64, 4096, 2)})
 rng = np.random.default_rng(0)
-M, R, B, K = 26744, 64, 4096, 128
-tbl = jnp.asarray(rng.normal(size=(M, R)).astype(np.float32)).astype(jnp.bfloat16)
-idx = jnp.asarray(rng.integers(0, M, size=(B, K)).astype(np.int32))
-w = jnp.ones((B, K), jnp.float32)
-reg = jnp.ones((B,), jnp.float32)
-x = fused_gather_gram_solve(tbl, idx, w, w, reg); fence(x)
-t0 = time.time()
-for _ in range(5):
-    x = fused_gather_gram_solve(tbl, idx, w, w, reg)
-fence(x)
-print({'metric': 'fused_bucket_seconds', 'B': B, 'K': K, 'value': (time.time()-t0)/5})
+for M, name in ((26744, 'item_table_resident'), (138493, 'user_table_streamed')):
+    R, B, K = 64, 4096, 128
+    tbl = jnp.asarray(rng.normal(size=(M, R)).astype(np.float32)).astype(jnp.bfloat16)
+    idx = jnp.asarray(rng.integers(0, M, size=(B, K)).astype(np.int32))
+    w = jnp.ones((B, K), jnp.float32)
+    reg = jnp.ones((B,), jnp.float32)
+    x = fused_gather_gram_solve(tbl, idx, w, w, reg); fence(x)
+    t0 = time.time()
+    for _ in range(5):
+        x = fused_gather_gram_solve(tbl, idx, w, w, reg)
+    fence(x)
+    print({'metric': 'fused_bucket_seconds', 'side': name, 'M': M, 'B': B, 'K': K,
+           'plan': fused_tile_plan(M, R, K, 2), 'value': (time.time()-t0)/5})
 "
 
 # headline: device staging (the default at full scale), then the A/Bs

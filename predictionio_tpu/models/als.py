@@ -103,12 +103,24 @@ class ALSConfig:
     gather_dtype: str = "float32"
 
     def __post_init__(self) -> None:
+        # checked here, not at use sites: the use sites test exact
+        # equality with an else-fallthrough, so a typo'd value would
+        # silently run the default path (and these strings now arrive
+        # straight from user engine.json files via the templates)
         if self.gather_dtype not in ("float32", "bfloat16"):
-            # checked here, not at use sites: the use sites only test
-            # == "bfloat16", so a typo would silently run the f32 path
             raise ValueError(
                 f"gather_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.gather_dtype!r}"
+            )
+        if self.solver not in ("xla", "pallas", "fused"):
+            raise ValueError(
+                f"solver must be 'xla', 'pallas' or 'fused', "
+                f"got {self.solver!r}"
+            )
+        if self.factor_placement not in ("replicated", "sharded"):
+            raise ValueError(
+                f"factor_placement must be 'replicated' or 'sharded', "
+                f"got {self.factor_placement!r}"
             )
     # factor-table placement on the mesh: "replicated" keeps both tables
     # on every device (fastest when they fit one chip's HBM); "sharded"

@@ -101,6 +101,12 @@ class ECommAlgorithmParams(Params):
     lam: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    # scaling knobs (models/als.py): "fused"/"pallas" kernels
+    # compile-probe and degrade to "xla"; "sharded" placement
+    # shards factor tables AND the rating COO over the mesh
+    solver: str = "xla"
+    factor_placement: str = "replicated"
+    gather_dtype: str = "float32"
     unseen_only: bool = False
     seen_events: tuple[str, ...] = ("view", "buy")
 
@@ -127,6 +133,8 @@ class ECommAlgorithm(Algorithm):
             cfg=ALSConfig(
                 rank=p.rank, num_iterations=p.num_iterations, lam=p.lam,
                 implicit=implicit, alpha=p.alpha, seed=p.seed,
+                solver=p.solver, factor_placement=p.factor_placement,
+                gather_dtype=p.gather_dtype,
             ),
             mesh=ctx.mesh,
         )

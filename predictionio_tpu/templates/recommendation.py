@@ -266,6 +266,13 @@ class ALSAlgorithmParams(Params):
     # train-time gather dtype for the opposite factor table ("bfloat16"
     # halves the hot gather's HBM bytes; solves stay f32 — models/als.py)
     gather_dtype: str = "float32"
+    # batched SPD solver: "xla" | "pallas" | "fused" (compile-probed;
+    # degrades to xla if the kernel doesn't lower on this backend)
+    solver: str = "xla"
+    # "replicated" (both factor tables + COO on every device) or
+    # "sharded" (tables AND rating COO block-sharded over the mesh —
+    # model and data capacity scale with total HBM)
+    factor_placement: str = "replicated"
 
 
 @dataclass
@@ -304,6 +311,8 @@ class ALSAlgorithm(Algorithm):
             alpha=p.alpha,
             weighted_lambda=p.weighted_lambda,
             gather_dtype=p.gather_dtype,
+            solver=p.solver,
+            factor_placement=p.factor_placement,
         )
 
     def _serve_dtype(self):

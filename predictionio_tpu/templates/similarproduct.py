@@ -108,6 +108,12 @@ class SimilarALSParams(Params):
     lam: float = 0.01
     alpha: float = 1.0
     seed: int = 3
+    # scaling knobs (models/als.py): "fused"/"pallas" kernels
+    # compile-probe and degrade to "xla"; "sharded" placement
+    # shards factor tables AND the rating COO over the mesh
+    solver: str = "xla"
+    factor_placement: str = "replicated"
+    gather_dtype: str = "float32"
 
 
 @dataclass
@@ -131,6 +137,8 @@ class SimilarProductAlgorithm(Algorithm):
             cfg=ALSConfig(
                 rank=p.rank, num_iterations=p.num_iterations, lam=p.lam,
                 implicit=True, alpha=p.alpha, seed=p.seed,
+                solver=p.solver, factor_placement=p.factor_placement,
+                gather_dtype=p.gather_dtype,
             ),
             mesh=ctx.mesh,
         )

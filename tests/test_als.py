@@ -694,3 +694,14 @@ def test_sweep_sharded_sequential_matches_vmapped():
         np.testing.assert_allclose(
             a.user_factors, b.user_factors, rtol=1e-4, atol=1e-4
         )
+
+
+def test_config_rejects_typo_knob_values():
+    """engine.json-reachable knobs must fail loudly, not silently run
+    the default path (the use sites test exact equality)."""
+    with pytest.raises(ValueError, match="solver"):
+        ALSConfig(solver="Fused")
+    with pytest.raises(ValueError, match="factor_placement"):
+        ALSConfig(factor_placement="Sharded")
+    with pytest.raises(ValueError, match="gather_dtype"):
+        ALSConfig(gather_dtype="fp32")

@@ -300,3 +300,34 @@ def test_bfloat16_serving_matches_f32_ranking(ctx):
         assert abs(sb.score - f32_score[sb.item]) < 0.05 * max(
             1.0, abs(f32_score[sb.item])
         )
+
+
+def test_engine_json_exposes_scaling_knobs(ctx):
+    """solver / factorPlacement / gatherDtype ride engine.json params to
+    the trainer — the reference's engine.json is the one config surface a
+    template user touches, so the scaling story must be reachable there."""
+    from predictionio_tpu.templates.recommendation import (
+        Query, recommendation_engine,
+    )
+
+    engine = recommendation_engine()
+    params = engine.params_from_variant({
+        "datasource": {"params": {"appName": "recapp",
+                                  "eventNames": ["rate"]}},
+        "algorithms": [{
+            "name": "als",
+            "params": {
+                "rank": 4, "numIterations": 2, "lambda": 0.1,
+                "solver": "fused", "factorPlacement": "sharded",
+                "gatherDtype": "float32",
+            },
+        }],
+    })
+    algo_params = params.algorithms[0][1]
+    assert algo_params.solver == "fused"
+    assert algo_params.factor_placement == "sharded"
+    algos, models = engine.train_components(ctx, params)
+    model = models[0]
+    assert np.isfinite(model.user_factors).all()
+    r = algos[0].predict(model, Query(user=model.users.ids[0], num=2))
+    assert len(r.item_scores) == 2

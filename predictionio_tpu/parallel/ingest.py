@@ -372,10 +372,16 @@ def read_ratings_distributed(
     tag: str = "ratings",
     rating_property: Optional[str] = None,
     dedup: str = "last",
+    gather: bool = True,
     **scan_kwargs,
 ):
     """End-to-end multi-host training-data read: sharded scan -> global id
     dictionaries -> globally-encoded COO -> all-gathered ratings.
+
+    ``gather=False`` skips the final all-gather and returns each
+    process's LOCAL shard (still encoded against the global id index) —
+    the input :meth:`ALSTrainer.distributed` wants, for trains whose
+    rating set must never be resident on one host.
 
     Single-process: equivalent to ``es.find_columnar(...).to_ratings(...)``.
     """
@@ -402,7 +408,7 @@ def read_ratings_distributed(
         item_index=items,
         dedup=dedup,
     )
-    return gather_ratings(local)
+    return gather_ratings(local) if gather else local
 
 
 def distributed_trainer(

@@ -101,15 +101,45 @@ class DataSourceParams(Params):
     item_entity_type: str = "item"
     eval_k: int = 0          # >0 enables k-fold read_eval
     eval_seed: int = 3
+    # multi-host COO handling: "gathered" (every process receives the
+    # full rating set — the replicated-placement path) or "local" (each
+    # process keeps only its scan shard, globally id-encoded; the
+    # algorithm then exchanges triples straight to each row's owning
+    # device via ALSTrainer.distributed — NO process ever holds the
+    # full COO, so rating capacity scales with the cluster.  Requires
+    # the algorithm side to set factorPlacement="sharded")
+    coo: str = "gathered"
+
+    def __post_init__(self) -> None:
+        if self.coo not in ("gathered", "local"):
+            raise ValueError(
+                f"coo must be 'gathered' or 'local', got {self.coo!r}"
+            )
 
 
 @dataclass
 class TrainingData:
     ratings: Ratings
     items: dict[str, dict] = field(default_factory=dict)  # item -> properties
+    # True when `ratings` is this PROCESS's shard of a multi-host read
+    # (globally id-encoded); algorithms must route through
+    # ALSTrainer.distributed instead of assuming a full COO
+    coo_local: bool = False
 
     def sanity_check(self) -> None:
-        if len(self.ratings) == 0:
+        n = len(self.ratings)
+        if self.coo_local:
+            # a local shard can legitimately be empty on skewed data;
+            # only GLOBAL emptiness is a real problem — sum the counts
+            # (sanity_check runs symmetrically on every process, so the
+            # collective pairs up)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                n = int(np.sum(np.asarray(
+                    multihost_utils.process_allgather(np.int64(n))
+                )))
+        if n == 0:
             raise ValueError("no rating events found — is the app empty?")
 
 
@@ -179,12 +209,15 @@ class RecommendationDataSource(DataSource):
                 tag=f"app{app_id}",
                 rating_property=p.rating_property,
                 dedup="last" if p.rating_property else "sum",
+                gather=(p.coo == "gathered"),
                 app_id=app_id,
                 entity_type=p.entity_type,
                 event_names=list(p.event_names),
             )
             return TrainingData(
-                ratings=ratings, items=self._read_items(es, app_id)
+                ratings=ratings,
+                items=self._read_items(es, app_id),
+                coo_local=(p.coo == "local"),
             )
         frame, items = self._read_frame(ctx)
         ratings = frame.to_ratings(
@@ -320,7 +353,27 @@ class ALSAlgorithm(Algorithm):
         return None if dt in ("float32", "", None) else dt
 
     def train(self, ctx: WorkflowContext, data: TrainingData) -> ALSModel:
-        factors = train_als(data.ratings, cfg=self._config(), mesh=ctx.mesh)
+        cfg = self._config()
+        if getattr(data, "coo_local", False):
+            # the DataSource kept each process's shard local (coo:
+            # "local"): exchange triples straight to each row's owning
+            # device — the full COO never exists anywhere
+            if cfg.factor_placement != "sharded":
+                raise ValueError(
+                    "datasource coo='local' requires the algorithm side "
+                    "to set factorPlacement='sharded' (the sharded-COO "
+                    "layout); 'replicated' needs the gathered read"
+                )
+            from ..models.als import ALSTrainer
+
+            trainer = ALSTrainer.distributed(
+                data.ratings, cfg=cfg, mesh=ctx.mesh,
+                exchange_dir=ctx.storage.model_data_dir() / "_ingest",
+                tag="als-coo",
+            )
+            factors = trainer.train()
+        else:
+            factors = train_als(data.ratings, cfg=cfg, mesh=ctx.mesh)
         return ALSModel(
             user_factors=factors.user_factors,
             item_factors=factors.item_factors,

@@ -37,7 +37,7 @@ def main() -> None:
 
     mode = sys.argv[8] if len(sys.argv) > 8 else ""
     if home:
-        return _run_train_end_to_end(pid, home, out)
+        return _run_train_end_to_end(pid, home, out, local=(mode == "local"))
     if mode == "sharded":
         return _run_sharded_trainer(pid, db, exch, out)
 
@@ -117,10 +117,16 @@ def _run_sharded_trainer(pid: int, db: str, exch: str, out: str) -> None:
     print("WORKER_OK", pid, flush=True)
 
 
-def _run_train_end_to_end(pid: int, home: str, out: str) -> None:
+def _run_train_end_to_end(pid: int, home: str, out: str,
+                          local: bool = False) -> None:
     """Full multi-host workflow over shared storage: run_train (sharded
     ingest + SPMD train + chief-only metadata/model writes) then deploy +
-    predict on BOTH processes from the persisted instance."""
+    predict on BOTH processes from the persisted instance.
+
+    ``local=True`` drives the no-full-COO configuration end to end:
+    datasource ``coo: "local"`` + algorithm ``factorPlacement:
+    "sharded"`` — the rating set is never resident on one process at any
+    point of the workflow."""
     os.environ["PIO_TPU_HOME"] = home
     import jax
 
@@ -133,13 +139,27 @@ def _run_train_end_to_end(pid: int, home: str, out: str) -> None:
     )
 
     engine = recommendation_engine()
+    ds_params = {"app_name": "mhapp"}
+    algo_params = {"rank": 4, "numIterations": 3, "lambda": 0.1}
+    if local:
+        ds_params["coo"] = "local"
+        algo_params["factorPlacement"] = "sharded"
     params = engine.params_from_variant({
-        "datasource": {"params": {"app_name": "mhapp"}},
-        "algorithms": [{
-            "name": "als",
-            "params": {"rank": 4, "numIterations": 3, "lambda": 0.1},
-        }],
+        "datasource": {"params": ds_params},
+        "algorithms": [{"name": "als", "params": algo_params}],
     })
+    local_rows = -1
+    if local:
+        # prove the read really is local (a strict per-process subset,
+        # globally encoded) before the workflow consumes it — a
+        # regression to the gathered read would double-count ratings
+        from predictionio_tpu.controller.base import WorkflowContext
+
+        td = engine._data_source(params).read_training(
+            WorkflowContext(mode="Training")
+        )
+        assert td.coo_local, "coo='local' read lost its marker"
+        local_rows = len(td.ratings)
     iid = run_train(engine, params)
 
     md = get_storage().get_metadata()
@@ -160,6 +180,7 @@ def _run_train_end_to_end(pid: int, home: str, out: str) -> None:
     np.savez(
         out,
         iid=np.array([iid], dtype=str),
+        local_rows=np.int64(local_rows),
         user_factors=np.asarray(models[0].user_factors),
         predict_items=np.array([s.item for s in r.item_scores], dtype=str),
         predict_scores=np.array(

@@ -451,12 +451,6 @@ def test_bf16_gather_implicit_and_sharded():
     assert np.isfinite(sharded.item_factors).all()
 
 
-def test_gather_dtype_typo_rejected():
-    import pytest
-
-    with pytest.raises(ValueError, match="gather_dtype"):
-        ALSConfig(gather_dtype="bf16")
-
 
 def test_device_staging_matches_host_staging():
     """staging="device" (compact transfer + on-device sort) must train to

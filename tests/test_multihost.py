@@ -308,3 +308,64 @@ def test_sharded_coo_distributed_trainer(tmp_path, nprocs, device_count):
             r["item_factors"], exp_factors.item_factors,
             rtol=1e-4, atol=1e-4,
         )
+
+
+def test_run_train_no_full_coo_end_to_end(tmp_path):
+    """The FULL workflow with datasource coo='local' + sharded placement:
+    run_train never gathers the rating set to any process, yet trains,
+    persists (chief-gated), deploys, and predicts identically on both
+    processes."""
+    import os
+
+    from predictionio_tpu.storage.registry import Storage
+
+    home = tmp_path / "home"
+    st = Storage({"PIO_TPU_HOME": str(home)})
+    app = st.get_metadata().app_insert("mhapp")
+    es = st.get_event_store()
+    for e in _make_events():
+        es.insert(e, app_id=app.id)
+    st.close()
+
+    # single-process expectation: same events, same conventions — the
+    # sorted-unique id union matches a single-process read's encoding
+    st2 = Storage({"PIO_TPU_HOME": str(tmp_path / "ref_home")})
+    app2 = st2.get_metadata().app_insert("mhapp")
+    es2 = st2.get_event_store()
+    for e in _make_events():
+        es2.insert(e, app_id=app2.id)
+    frame = es2.find_columnar(
+        app_id=app2.id, event_names=["rate"], float_property="rating"
+    )
+    expected = frame.to_ratings(rating_property="rating", dedup="last")
+    st2.close()
+
+    from predictionio_tpu.models.als import ALSConfig, train_als
+
+    exp_factors = train_als(
+        expected, cfg=ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3)
+    )
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [tmp_path / f"local_out{p}.npz" for p in range(2)]
+    _spawn_workers(
+        2,
+        lambda p: [p, 2, coordinator, "-", "-", outs[p], home, "local"],
+    )
+    results = [np.load(o, allow_pickle=False) for o in outs]
+    # the reads really were local: strict subsets covering the whole set
+    locals_ = [int(r["local_rows"]) for r in results]
+    assert all(0 < n < len(expected) for n in locals_), locals_
+    assert sum(locals_) == len(expected)
+    assert results[0]["iid"][0] == results[1]["iid"][0]
+    for r in results:
+        # and the distributed train equals the single-process model —
+        # a gathered-read regression would double-count every rating
+        np.testing.assert_allclose(
+            r["user_factors"], exp_factors.user_factors,
+            rtol=1e-4, atol=1e-4,
+        )
+    assert (
+        results[0]["predict_items"].tolist()
+        == results[1]["predict_items"].tolist()
+    )

@@ -104,11 +104,23 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         preparator_class_map,
         algorithm_class_map,
         serving_class_map,
+        params_validator=None,
     ):
         self.data_source_class_map = _as_class_map(data_source_class_map)
         self.preparator_class_map = _as_class_map(preparator_class_map)
         self.algorithm_class_map = _as_class_map(algorithm_class_map)
         self.serving_class_map = _as_class_map(serving_class_map)
+        # optional callable(EngineParams) raising on CROSS-component
+        # inconsistencies (per-component fields validate themselves in
+        # their dataclasses; couplings like the recommendation
+        # template's coo='local' <-> factorPlacement='sharded' need the
+        # whole tuple).  Runs at params construction — config errors
+        # surface at build/validate time, not after minutes of ingest
+        self.params_validator = params_validator
+
+    def validate_params(self, ep: EngineParams) -> None:
+        if self.params_validator is not None:
+            self.params_validator(ep)
 
     # -- component construction ------------------------------------------
     def _data_source(self, ep: EngineParams) -> DataSource:
@@ -266,12 +278,14 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             for spec in variant.get("algorithms", [])
         ] or [("", None)]
 
-        return EngineParams(
+        ep = EngineParams(
             data_source=comp("datasource", self.data_source_class_map),
             preparator=comp("preparator", self.preparator_class_map),
             algorithms=algorithms,
             serving=comp("serving", self.serving_class_map),
         )
+        self.validate_params(ep)
+        return ep
 
     def params_from_instance(self, instance) -> EngineParams:
         """EngineInstance record -> the exact EngineParams it was trained

@@ -1139,6 +1139,16 @@ class ALSTrainer:
             ),
         }
 
+    @property
+    def coo_shard_entries(self) -> Optional[int]:
+        """Per-device padded rating-slot count of the sharded COO layout
+        (the HBM-scaling observable: ~nnz/mesh_size + padding), or None
+        when the COO is replicated.  Public accessor — demos and
+        capacity planners should use this, not the staging internals."""
+        if not self.sharded:
+            return None
+        return int(self._user_side["shard_len"])
+
     def init_factors(self) -> tuple[jax.Array, jax.Array]:
         """MLlib-style init: N(0, 1)/sqrt(rank), fixed seed.
 

@@ -479,6 +479,27 @@ class RecommendationServing(FirstServing):
     pass
 
 
+def _validate_rec_params(ep) -> None:
+    """Cross-component coupling: datasource ``coo: "local"`` hands each
+    ALS algorithm a process-local shard, which only the sharded-COO
+    layout can train — catch the mismatch at config time, not after a
+    multi-host ingest."""
+    ds = ep.data_source[1]
+    if getattr(ds, "coo", "gathered") != "local":
+        return
+    bad = [
+        name or "als"
+        for name, p in ep.algorithms
+        if getattr(p, "factor_placement", None) != "sharded"
+    ]
+    if bad:
+        raise ValueError(
+            "datasource coo='local' requires factorPlacement='sharded' "
+            f"on every algorithm; offending: {bad} — 'replicated' "
+            "placement needs the gathered read (coo='gathered')"
+        )
+
+
 def recommendation_engine() -> Engine:
     """`EngineFactory` analogue for the recommendation template."""
     return Engine(
@@ -486,6 +507,7 @@ def recommendation_engine() -> Engine:
         IdentityPreparator,
         {"als": ALSAlgorithm, "": ALSAlgorithm},
         RecommendationServing,
+        params_validator=_validate_rec_params,
     )
 
 

@@ -381,3 +381,20 @@ def test_lambda_sweep(in_example, capsys):
         assert f"{lam:>8}" in out
     best = float(out.rsplit("best lambda = ", 1)[1].split()[0])
     assert best in (0.05, 0.1)
+
+
+def test_sharded_scale(in_example, capsys):
+    m = in_example("sharded-scale")
+    m.main()
+    out = capsys.readouterr().out
+    assert "sharded-scale OK" in out
+    assert "each device stores" in out
+    # the example's own assertion guarantees numeric agreement; the
+    # printed per-device count must be well under the replicated total
+    import re
+
+    stored = int(
+        re.search(r"each device stores ([\d,]+)", out).group(1)
+        .replace(",", "")
+    )
+    assert stored < 40_000 / 4

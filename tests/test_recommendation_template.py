@@ -331,3 +331,26 @@ def test_engine_json_exposes_scaling_knobs(ctx):
     assert np.isfinite(model.user_factors).all()
     r = algos[0].predict(model, Query(user=model.users.ids[0], num=2))
     assert len(r.item_scores) == 2
+
+
+def test_coo_local_placement_mismatch_rejected_at_config_time():
+    """coo='local' + replicated placement must fail at params
+    construction (build/validate time), not minutes into a multi-host
+    ingest."""
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+
+    engine = recommendation_engine()
+    with pytest.raises(ValueError, match="factorPlacement='sharded'"):
+        engine.params_from_variant({
+            "datasource": {"params": {"appName": "x", "coo": "local"}},
+            "algorithms": [{"name": "als", "params": {"rank": 4}}],
+        })
+    # the valid pairing still constructs
+    ep = engine.params_from_variant({
+        "datasource": {"params": {"appName": "x", "coo": "local"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "factorPlacement": "sharded"}}],
+    })
+    assert ep.algorithms[0][1].factor_placement == "sharded"

@@ -47,6 +47,15 @@ def main() -> None:
     u, i, v, n_users, n_items = synth()
     mesh = make_mesh()
     print(f"mesh: {mesh.size} device(s) over axis {mesh.axis_names}")
+    if mesh.size < 2:
+        print(
+            "only one device visible — sharded placement degenerates to "
+            "replicated, so there is nothing to demonstrate.  Re-run "
+            "with a multi-device mesh, e.g.:\n  JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "python engine.py"
+        )
+        return
 
     replicated = ALSTrainer(
         (u, i, v), n_users, n_items,

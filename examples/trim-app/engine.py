@@ -16,8 +16,6 @@ exist in this rebuild: ``pio-tpu app trim`` and bulk ``delete_batch``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
-
 from predictionio_tpu.controller import (
     Algorithm,
     DataSource,

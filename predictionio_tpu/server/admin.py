@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 import logging
 import urllib.parse
-from typing import Any, Optional
+from typing import Optional
 
 from ..storage.metadata import AccessKey
 from ..storage.registry import Storage

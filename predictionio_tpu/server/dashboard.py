@@ -12,8 +12,6 @@ import html as _html
 import json
 import logging
 import urllib.parse
-from typing import Optional
-
 from ..storage.registry import Storage
 from .http_base import HTTPServerBase, JsonRequestHandler
 

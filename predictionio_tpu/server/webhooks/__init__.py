@@ -9,7 +9,7 @@ standard wire-format validation.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Mapping
+from typing import Any, Mapping
 
 from ...storage.event import Event
 

@@ -7,7 +7,7 @@ it, so tests assert pipelines structurally.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from predictionio_tpu.controller import (
     Algorithm,

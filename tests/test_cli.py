@@ -1,7 +1,6 @@
 """CLI console tests (reference `console/Console.scala` command surface)."""
 
 import json
-from pathlib import Path
 
 import numpy as np
 import pytest

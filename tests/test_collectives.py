@@ -12,7 +12,7 @@ from predictionio_tpu.parallel.collectives import (
     reduce_scatter_sum,
     ring_shift,
 )
-from predictionio_tpu.parallel.mesh import DATA_AXIS, data_sharding
+from predictionio_tpu.parallel.mesh import data_sharding
 
 
 @pytest.fixture(scope="module")

@@ -83,7 +83,6 @@ def test_similarproduct_end_to_end(similar_ctx):
 
 def test_similarproduct_custom_persistence_roundtrip(similar_ctx, tmp_path):
     """The npz save/load path (PersistentModel demo) must round-trip."""
-    from predictionio_tpu.storage import Storage, reset_storage
     from predictionio_tpu.templates.similarproduct import (
         Query,
         similarproduct_engine,

@@ -221,7 +221,7 @@ def test_save_model_sees_trained_instance_state(ctx):
 def test_partial_retrain_only_missing(ctx):
     """Only NotPersisted algorithms retrain at deploy; persisted models
     are loaded, not recomputed."""
-    from predictionio_tpu.controller import Engine, FirstServing, IdentityPreparator
+    from predictionio_tpu.controller import Engine, IdentityPreparator
     from fixtures import Preparator0, Serving0
 
     calls = {"persisted": 0, "volatile": 0}

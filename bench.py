@@ -758,10 +758,13 @@ def main() -> None:
                              "--gather-dtype", "bfloat16"]
             )
         errs = []
-        for extra in attempts:
-            line, err = _run_inner_subprocess(
-                extra, min(TPU_RUN_TIMEOUT, remaining(CPU_RESERVE))
-            )
+        for k, extra in enumerate(attempts):
+            # split what's left evenly over the attempts still to run: a
+            # HANGING first attempt (vs a fast failure) must not starve
+            # the conservative configs of their shot at the number
+            left = len(attempts) - k
+            cap = min(TPU_RUN_TIMEOUT, remaining(CPU_RESERVE) // left)
+            line, err = _run_inner_subprocess(extra, max(cap, 60))
             if line is not None:
                 _record_history(line)
                 print(line)

@@ -145,6 +145,11 @@ def _fused_kernel(
     a_scr,       # [TB, R, R] f32 normal-equation accumulator
     b_scr,       # [TB, R] f32 rhs accumulator
     m_scr,       # [TB, R, R+1] f32 augmented Gauss-Jordan scratch
+    *,
+    precision,   # lax.Precision for the MXU contractions — the same
+                 # knob the unfused Gram einsums honor (RMSE parity
+                 # wants HIGHEST; a bf16 table already bounds operand
+                 # precision, so "default" is the natural pair there)
 ):
     t, j = pl.program_id(1), pl.program_id(2)
     nt, nj = pl.num_programs(1), pl.num_programs(2)
@@ -172,11 +177,11 @@ def _fused_kernel(
     # MXU: batched [KC, R]ᵀ[KC, R] -> [R, R] per tile row
     a_scr[:] += jax.lax.dot_general(
         rw, rows, (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
     b_scr[:] += jax.lax.dot_general(
         bw_ref[:] * inr, rows, (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
 
     @pl.when((t == nt - 1) & (j == nj - 1))

@@ -151,3 +151,27 @@ def test_pipeline_mode_emits_stage_breakdown(capsys):
         assert rec["stages"][stage] >= 0
     assert rec["n_events"] > 0
     assert rec["value"] > 0 and "train_rmse" in rec
+
+
+def test_attempt_budget_split_prevents_starvation(patched, monkeypatch,
+                                                  capsys):
+    """A first attempt that eats its whole timeout must still leave the
+    later attempts real time (the per-attempt cap splits what remains
+    instead of letting attempt 1 take everything)."""
+    seen = []
+
+    def inner(extra, timeout, cpu_only=False):
+        seen.append(timeout)
+        return None, "fail"
+
+    monkeypatch.setattr(bench, "_run_inner_subprocess", inner)
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 900)
+    _run(monkeypatch)
+    # 3 TPU attempts + 1 cpu fallback ran
+    assert len(seen) == 4
+    # first attempt got roughly a third of the available TPU window, not
+    # all of it
+    assert seen[0] <= bench.TPU_RUN_TIMEOUT
+    assert seen[0] < 700 - 100
+    # every attempt got a meaningful floor
+    assert all(t >= 60 for t in seen)

@@ -502,7 +502,9 @@ def _solve_buckets(
                 reg = lam_t * jnp.maximum(n_row, 1.0)
             else:
                 reg = jnp.broadcast_to(lam_t, n_row.shape)
-            x = fused_gather_gram_solve(opp_g, idx, cwk, bwk, reg, g0)
+            x = fused_gather_gram_solve(
+                opp_g, idx, cwk, bwk, reg, g0, precision=prec
+            )
             out = upd_write(out, rows, x)
             continue
         Vm = opp_g[idx] * valid[..., None].astype(opp_g.dtype)  # [B,K,R]
@@ -681,7 +683,10 @@ def _resolve_solver(cfg: ALSConfig) -> str:
         from ..ops.fused_als import fused_solver_ok
 
         tb = 2 if cfg.gather_dtype == "bfloat16" else 4
-        if not fused_solver_ok(512, cfg.rank, tb):
+        # probe the exact kernel variant production will run: precision
+        # is a static arg of the pallas lowering, so probing HIGHEST
+        # would not validate a "default"-precision train
+        if not fused_solver_ok(512, cfg.rank, tb, cfg.matmul_precision):
             return "xla"
     return cfg.solver
 

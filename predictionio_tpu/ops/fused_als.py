@@ -216,9 +216,11 @@ def _fused_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tb", "kc", "mc", "interpret")
+    jax.jit, static_argnames=("tb", "kc", "mc", "interpret", "precision")
 )
-def _fused_padded(gram0, table, idx, cw, bw, reg, *, tb, kc, mc, interpret):
+def _fused_padded(
+    gram0, table, idx, cw, bw, reg, *, tb, kc, mc, interpret, precision
+):
     bp, kp = idx.shape
     mp, r = table.shape
     grid = (bp // tb, mp // mc, kp // kc)
@@ -230,7 +232,7 @@ def _fused_padded(gram0, table, idx, cw, bw, reg, *, tb, kc, mc, interpret):
         (lambda i, t, j: (0, 0)) if mp == mc else (lambda i, t, j: (t, 0))
     )
     return pl.pallas_call(
-        _fused_kernel,
+        functools.partial(_fused_kernel, precision=precision),
         out_shape=jax.ShapeDtypeStruct((bp, r), jnp.float32),
         grid=grid,
         in_specs=[
@@ -267,6 +269,7 @@ def fused_gather_gram_solve(
     gram0=None,     # [R, R] f32 base Gram (implicit YtY); zeros if None
     interpret: bool | None = None,
     plan: tuple | None = None,
+    precision=None,
 ):
     """One fused normal-equation build + solve for a bucket of rows.
 
@@ -278,7 +281,17 @@ def fused_gather_gram_solve(
     ``plan`` overrides the ``(TB, KC, MC)`` tile plan — used by the
     compile probe to force the streamed multi-chunk grid on a small
     table; production callers leave it None.
+
+    ``precision`` is the MXU precision for the two in-kernel
+    contractions — the same ``lax.Precision`` knob the unfused Gram
+    einsums honor (``ALSConfig.matmul_precision``).  ``None`` means
+    HIGHEST: RMSE parity is the default contract, and callers feeding a
+    bf16 table opt down explicitly.
     """
+    if precision is None:
+        precision = jax.lax.Precision.HIGHEST
+    else:
+        precision = jax.lax.Precision(precision)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, k = idx.shape
@@ -309,6 +322,7 @@ def fused_gather_gram_solve(
     x = _fused_padded(
         gram0.astype(jnp.float32), table, idx, cw, bw, reg,
         tb=tb, kc=kc, mc=mc, interpret=bool(interpret),
+        precision=precision,
     )
     return x[:b]
 
@@ -317,7 +331,9 @@ def fused_gather_gram_solve(
 _PROBE_CACHE: dict[tuple, bool] = {}
 
 
-def fused_solver_ok(m: int, r: int, table_bytes: int = 4) -> bool:
+def fused_solver_ok(
+    m: int, r: int, table_bytes: int = 4, precision=None
+) -> bool:
     """Compile-and-run probe for the fused kernel.
 
     The kernel's speculative ops are the in-VMEM dynamic gather
@@ -326,13 +342,20 @@ def fused_solver_ok(m: int, r: int, table_bytes: int = 4) -> bool:
     resident and streamed shapes in production, so BOTH are probed on
     small tables (a forced multi-chunk plan stands in for the big-table
     case; the pipeline shape, not the table height, is what lowering
-    depends on).  Round 2 proved kernels must be probed ON the target
-    backend before production use.  Cached per (backend, m, r, bytes).
+    depends on).  ``precision`` must be the value production will run
+    with: it is a static arg of the pallas lowering, so a probe at a
+    different precision validates a different kernel variant.  Round 2
+    proved kernels must be probed ON the target backend before
+    production use.  Cached per (backend, m, r, bytes, precision).
     """
     import logging
 
     logger = logging.getLogger(__name__)
-    key = (jax.default_backend(), int(m), int(r), int(table_bytes))
+    prec = (
+        jax.lax.Precision.HIGHEST if precision is None
+        else jax.lax.Precision(precision)
+    )
+    key = (jax.default_backend(), int(m), int(r), int(table_bytes), prec)
     cached = _PROBE_CACHE.get(key)
     if cached is not None:
         return cached
@@ -351,7 +374,8 @@ def fused_solver_ok(m: int, r: int, table_bytes: int = 4) -> bool:
         for probe_plan in (None, (8, 128, 64)):  # resident, streamed x2
             table = jnp.ones((128, r), dtype)
             x = fused_gather_gram_solve(
-                table, idx, one, one, reg, plan=probe_plan
+                table, idx, one, one, reg, plan=probe_plan,
+                precision=prec,
             )
             got = float(np.asarray(x[0, :1])[0])
             if abs(got - want) >= 1e-4:

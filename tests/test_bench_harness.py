@@ -119,12 +119,83 @@ def test_record_history_marks_fenced(tmp_path, monkeypatch):
     assert len(hist.read_text().strip().splitlines()) == 1
 
 
-def test_parity_mode_emits_zero_delta_line(capsys):
+def test_probe_retry_ladder(monkeypatch, capsys):
+    """A transient tunnel flake (probe attempts 1-2 fail, 3 succeeds)
+    must still reach the accelerator attempt chain (round-3 verdict
+    weak #4: one expired probe ended the round)."""
+    import sys
+
+    attempts = []
+
+    def probe(timeout):
+        attempts.append(timeout)
+        if len(attempts) < 3:
+            return None, "timed out (injected)"
+        return "tpu", None
+
+    monkeypatch.setattr(bench, "_probe_accelerator", probe)
+    monkeypatch.setattr(bench, "_record_history", lambda line: None)
+    monkeypatch.setattr(
+        bench, "_run_inner_subprocess",
+        lambda extra, timeout, cpu_only=False: (
+            json.dumps({"metric": "m", "value": 1.0,
+                        "platform": "tpu", "scale": 1.0}), None),
+    )
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    bench.main()
+    assert len(attempts) == 3
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["platform"] == "tpu"
+
+
+def test_inner_reports_requested_vs_resolved_solver(monkeypatch, capsys):
+    """The JSON artifact must make solver degradation LOUD: when the
+    fused probe fails, the record carries solver=xla,
+    solver_requested=fused, degraded=true — and quality fields ride
+    every holdout-splitting record, not only full-scale ones."""
+    from predictionio_tpu.ops import fused_als as fmod
+
+    monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
+
+    def boom(*a, **k):
+        raise RuntimeError("injected lowering failure")
+
+    monkeypatch.setattr(fmod, "fused_gather_gram_solve", boom)
+    args = bench._parse_args(
+        ["--inner", "--scale", "0.001", "--rank", "6", "--iters", "1",
+         "--solver", "fused"]
+    )
+    bench.run_inner(args)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["solver"] == "xla"
+    assert rec["solver_requested"] == "fused"
+    assert rec["degraded"] is True
+    assert rec["train_rmse"] > 0 and rec["rmse_holdout"] > 0
+
+
+def test_inner_not_degraded_when_fused_engages(monkeypatch, capsys):
+    from predictionio_tpu.ops import fused_als as fmod
+
+    monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
+    args = bench._parse_args(
+        ["--inner", "--scale", "0.001", "--rank", "6", "--iters", "1",
+         "--solver", "fused"]
+    )
+    bench.run_inner(args)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["solver"] == rec["solver_requested"] == "fused"
+    assert "degraded" not in rec
+
+
+def test_parity_mode_emits_zero_delta_line(capsys, tmp_path, monkeypatch):
     """`bench.py --parity` (quality half of the north star): our trainer
     must match the dense MLlib-convention oracle to ~1e-3 RMSE on both
-    train and hold-out splits at the verifiable 400x250 scale."""
+    train and hold-out splits at the verifiable 400x250 scale — and
+    write the driver-readable BENCH_PARITY.json artifact."""
     import bench
 
+    out = tmp_path / "BENCH_PARITY.json"
+    monkeypatch.setattr(bench, "PARITY_PATH", out)
     args = bench._parse_args(["--parity", "--platform", "cpu"])
     bench.run_parity(args)
     line = capsys.readouterr().out.strip().splitlines()[-1]
@@ -132,6 +203,7 @@ def test_parity_mode_emits_zero_delta_line(capsys):
     assert rec["metric"] == "als_rmse_parity_vs_mllib_oracle"
     assert rec["holdout_delta"] < 1e-3
     assert abs(rec["rmse_train_tpu"] - rec["rmse_train_oracle"]) < 1e-3
+    assert json.loads(out.read_text())["holdout_delta"] < 1e-3
 
 
 def test_pipeline_mode_emits_stage_breakdown(capsys):

@@ -1,0 +1,53 @@
+"""Driver entry points (`__graft_entry__.py`) — the artifacts the
+driver actually runs.  Round 3 shipped a broken flagship because
+nothing in the suite executed the dryrun body; now the suite runs it on
+the same 8-device virtual CPU mesh the driver uses.
+"""
+
+import numpy as np
+import pytest
+
+
+def test_entry_forward_compiles_and_runs():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    vals, idxs = jax.jit(fn)(*args)
+    assert vals.shape == (32, 10) and idxs.shape == (32, 10)
+    # scores must be sorted descending (top-k contract)
+    v = np.asarray(vals)
+    assert (np.diff(v, axis=1) <= 1e-6).all()
+
+
+def test_require_fused_resolves_happy_path():
+    import __graft_entry__ as ge
+
+    cfg = ge._require_fused_resolves()
+    assert cfg.solver == "fused"
+
+
+def test_require_fused_fails_loud_on_degrade(monkeypatch):
+    """A fused kernel that stops compiling must FAIL the dryrun, not
+    silently fall back to XLA-vs-XLA (round-3 verdict weak #2)."""
+    from predictionio_tpu.ops import fused_als as fmod
+
+    import __graft_entry__ as ge
+
+    monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
+
+    def boom(*a, **k):
+        raise RuntimeError("injected lowering failure")
+
+    monkeypatch.setattr(fmod, "fused_gather_gram_solve", boom)
+    with pytest.raises(AssertionError, match="degraded"):
+        ge._require_fused_resolves()
+
+
+def test_dryrun_body_full_8_devices():
+    """The complete driver dryrun — sharded train, fused kernel,
+    collectives, 2D mesh, ring top-k — on the suite's virtual mesh."""
+    import __graft_entry__ as ge
+
+    ge._dryrun_body(8)

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Full-suite gate: run before any milestone/snapshot commit.
+# Exits nonzero if ANY test fails — never snapshot red (VERDICT r3 #6).
+set -euo pipefail
+cd "$(git rev-parse --show-toplevel)"
+exec python -m pytest tests/ -q "$@"

@@ -51,3 +51,51 @@ def test_missing_source_type_errors():
 
 def test_storage_fixture(storage_memory):
     storage_memory.verify_all_data_objects()
+
+
+def test_shipped_env_template_parses_and_boots(tmp_path):
+    """`conf/pio-env-tpu.template` is the ops on-ramp (reference
+    `conf/pio-env.sh.template:36-60`): every exported variable must be
+    one the registry actually honors, and the configuration it
+    describes must boot all three repositories."""
+    import re
+    from pathlib import Path
+
+    template = (
+        Path(__file__).parent.parent / "conf" / "pio-env-tpu.template"
+    ).read_text()
+    env = {}
+    for line in template.splitlines():
+        line = line.strip()
+        if line.startswith("# export "):
+            line = line[2:]  # commented-out optional knobs parse too
+        if not line.startswith("export "):
+            continue
+        key, _, val = line[len("export "):].partition("=")
+        env[key] = val
+    # substitute shell vars against a scratch home
+    env["PIO_TPU_HOME"] = str(tmp_path / "pio")
+    env["HOME"] = str(tmp_path)
+    for k, v in env.items():
+        env[k] = re.sub(
+            r"\$(\w+)", lambda m: env.get(m.group(1), m.group(0)), v
+        )
+    # every PIO_* key in the template is one the code reads
+    known = {
+        "PIO_TPU_HOME", "PIO_TPU_PLATFORM", "PIO_TPU_SCAN_CACHE",
+        "PIO_TPU_VMEM_BYTES", "PIO_TPU_PROFILE", "PIO_TPU_BENCH_BUDGET_S",
+    }
+    for key in env:
+        if key.startswith("PIO_TPU_"):
+            assert key in known, f"template documents unknown knob {key}"
+        elif key.startswith("PIO_"):
+            assert re.fullmatch(
+                r"PIO_STORAGE_(REPOSITORIES_(METADATA|EVENTDATA|MODELDATA)"
+                r"_(NAME|SOURCE)|SOURCES_\w+_(TYPE|PATH))", key
+            ), f"template documents unknown storage key {key}"
+    s = Storage(env={k: v for k, v in env.items() if k.startswith("PIO_")})
+    s.verify_all_data_objects()
+    # the template's explicit sources landed where it says they do
+    assert (tmp_path / "pio" / "eventdata.db").exists()
+    assert (tmp_path / "pio" / "models").is_dir()
+    s.close()

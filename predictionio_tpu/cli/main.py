@@ -363,6 +363,9 @@ def cmd_deploy(args, storage: Storage) -> int:
             feedback=args.feedback,
             event_server_url=args.event_server_url,
             access_key=args.accesskey,
+            log_url=args.log_url,
+            log_prefix=args.log_prefix,
+            microbatch=args.microbatch,
         ),
         engine_id=engine_id,
         engine_variant=str(args.engine_json),
@@ -480,7 +483,10 @@ def cmd_export(args, storage: Storage) -> int:
 
 def cmd_template(args, storage: Storage) -> int:
     """Offline gallery (`console/Template.scala:130-427` analogue)."""
-    from ..tools.template_gallery import list_templates, scaffold
+    from ..tools.template_gallery import (
+        TemplateVersionError, list_templates, scaffold,
+        scaffold_from_archive,
+    )
 
     if args.template_command == "list":
         for t in list_templates():
@@ -488,8 +494,14 @@ def cmd_template(args, storage: Storage) -> int:
         return 0
     if args.template_command == "get":
         try:
-            target = scaffold(args.name, args.directory or args.name)
-        except (KeyError, FileExistsError) as e:
+            if args.from_archive:
+                target = scaffold_from_archive(
+                    args.from_archive, args.directory or args.name
+                )
+            else:
+                target = scaffold(args.name, args.directory or args.name)
+        except (KeyError, FileExistsError, FileNotFoundError, ValueError,
+                TemplateVersionError) as e:
             _out(f"Error: {e}")
             return 1
         _out(f"Engine template '{args.name}' created at {target}/")
@@ -700,6 +712,17 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--feedback", action="store_true")
     d.add_argument("--event-server-url")
     d.add_argument("--accesskey")
+    d.add_argument("--log-url",
+                   help="ship serving errors to this URL via POST "
+                   "(reference CreateServer remoteLog)")
+    d.add_argument("--log-prefix", default="",
+                   help="string prepended to each shipped log payload")
+    d.add_argument("--microbatch", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="coalesce concurrent queries into one batched "
+                   "device call (auto: when the algorithm batch-"
+                   "predicts; off restores bitwise per-request "
+                   "determinism)")
 
     e = sub.add_parser("eval", help="run an evaluation sweep")
     e.add_argument("evaluation",
@@ -748,6 +771,10 @@ def build_parser() -> argparse.ArgumentParser:
     x = tps.add_parser("get")
     x.add_argument("name")
     x.add_argument("directory", nargs="?")
+    x.add_argument("--from-archive", metavar="PATH",
+                   help="scaffold from a local zip/tar engine archive "
+                   "instead of the built-in gallery (the egress-free "
+                   "half of the reference's template download)")
 
     b = sub.add_parser("build", help="validate + register an engine")
     b.add_argument("--engine-json", default="engine.json")

@@ -25,6 +25,7 @@ __all__ = [
     "TemplateMeta",
     "list_templates",
     "scaffold",
+    "scaffold_from_archive",
     "verify_template_min_version",
     "TemplateVersionError",
 ]
@@ -208,6 +209,131 @@ def scaffold(template_name: str, target_dir: str | Path) -> Path:
         )
     )
     return target
+
+
+def scaffold_from_archive(archive: str | Path, target_dir: str | Path) -> Path:
+    """Scaffold an engine directory from a LOCAL zip/tar archive.
+
+    The egress-free half of the reference's template download
+    (`tools/console/Template.scala:171-300`: fetch GitHub release
+    archive, extract, record metadata) — the fetch itself is out of
+    scope in a zero-egress deployment, but a user with an archive in
+    hand (shared drive, artifact store, `git archive` of a colleague's
+    engine) gets the same extract-and-validate flow:
+
+    * zip / tar / tar.gz / tgz by extension;
+    * member paths are validated — absolute paths, ``..`` traversal,
+      and symlink/hardlink members are rejected (the archive is
+      untrusted input; links could point outside the target);
+    * a single GitHub-style top-level directory is stripped;
+    * the result must contain ``engine.json`` (otherwise it is not a
+      runnable engine dir and the scaffold fails with the member list);
+    * ``template.json`` min-version metadata is honored if present
+      (checked now, and again by train/deploy) and created pinning the
+      current version if absent;
+    * extraction happens in a scratch dir renamed into place on
+      success — a rejected archive leaves no partial target behind, so
+      the user's retry after fixing it doesn't hit "not empty".
+    """
+    import shutil
+    import tempfile
+
+    archive = Path(archive)
+    if not archive.exists():
+        raise FileNotFoundError(f"archive not found: {archive}")
+    target = Path(target_dir)
+    if target.exists() and any(target.iterdir()):
+        raise FileExistsError(f"target directory {target} is not empty")
+    target.parent.mkdir(parents=True, exist_ok=True)
+    scratch = Path(tempfile.mkdtemp(
+        prefix=f".{target.name}.extract-", dir=target.parent
+    ))
+    try:
+        _extract_archive(archive, scratch)
+
+        # strip a single GitHub-style top-level directory
+        entries = list(scratch.iterdir())
+        if len(entries) == 1 and entries[0].is_dir():
+            inner = entries[0]
+            for child in list(inner.iterdir()):
+                child.rename(scratch / child.name)
+            inner.rmdir()
+
+        if not (scratch / "engine.json").exists():
+            found = sorted(
+                str(p.relative_to(scratch)) for p in scratch.rglob("*")
+            )[:20]
+            raise ValueError(
+                f"archive {archive.name} does not contain an engine.json "
+                f"at its root — not an engine template (contents: {found})"
+            )
+        tj = scratch / "template.json"
+        if not tj.exists():
+            tj.write_text(
+                json.dumps(
+                    {"pio": {"version": {"min": __version__}}}, indent=2
+                )
+                + "\n"
+            )
+        verify_template_min_version(scratch)
+        if target.exists():  # pre-existing EMPTY dir: replace it
+            target.rmdir()
+        scratch.rename(target)
+    except Exception:
+        shutil.rmtree(scratch, ignore_errors=True)
+        raise
+    return target
+
+
+def _extract_archive(archive: Path, dest: Path) -> None:
+    name = archive.name.lower()
+    if name.endswith(".zip"):
+        import zipfile
+
+        with zipfile.ZipFile(archive) as zf:
+            members = [m for m in zf.namelist() if not m.endswith("/")]
+            _check_members(members, archive)
+            for m in members:
+                out = dest / m
+                out.parent.mkdir(parents=True, exist_ok=True)
+                out.write_bytes(zf.read(m))
+    elif name.endswith((".tar", ".tar.gz", ".tgz")):
+        import tarfile
+
+        with tarfile.open(archive) as tf:
+            infos = tf.getmembers()
+            # links are rejected, not silently dropped: a skipped member
+            # would surface much later as a missing file at train time
+            for m in infos:
+                if m.issym() or m.islnk():
+                    raise ValueError(
+                        f"archive {archive.name} contains link member "
+                        f"{m.name!r}; refusing to extract"
+                    )
+            files = [m for m in infos if m.isfile()]
+            _check_members([m.name for m in files], archive)
+            for m in files:
+                out = dest / m.name
+                out.parent.mkdir(parents=True, exist_ok=True)
+                f = tf.extractfile(m)
+                assert f is not None
+                out.write_bytes(f.read())
+    else:
+        raise ValueError(
+            f"unsupported archive type {archive.name!r} "
+            "(expected .zip, .tar, .tar.gz or .tgz)"
+        )
+
+
+def _check_members(names: list[str], archive: Path) -> None:
+    """Reject absolute / traversal member paths (untrusted archives)."""
+    for m in names:
+        p = Path(m)
+        if p.is_absolute() or ".." in p.parts:
+            raise ValueError(
+                f"archive {archive.name} contains unsafe member path "
+                f"{m!r}; refusing to extract"
+            )
 
 
 class TemplateVersionError(RuntimeError):

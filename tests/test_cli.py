@@ -225,6 +225,90 @@ def test_template_list_and_get(cli, tmp_path):
     assert code == 1 and "unknown template" in out
 
 
+def test_template_get_from_archive(cli, tmp_path):
+    """`template get --from-archive x.zip` (the egress-free half of the
+    reference's template download, Template.scala:171-300): extract a
+    local archive, strip the GitHub-style top dir, validate it's an
+    engine dir, and the result must be trainable via `pio-tpu run`."""
+    import zipfile
+
+    run, s, _ = cli
+    # build a GitHub-archive-shaped zip of a scaffolded engine
+    from predictionio_tpu.tools.template_gallery import scaffold
+
+    src = tmp_path / "src"
+    scaffold("classification", src)
+    zpath = tmp_path / "engine-0.1.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        for p in src.rglob("*"):
+            if p.is_file():
+                zf.write(p, f"engine-0.1/{p.relative_to(src)}")
+
+    target = tmp_path / "from-zip"
+    code, out = run("template", "get", "archived", str(target),
+                    "--from-archive", str(zpath))
+    assert code == 0, out
+    assert (target / "engine.json").exists()
+    assert (target / "engine.py").exists()
+    # top-level dir was stripped
+    assert not (target / "engine-0.1").exists()
+    # the scaffolded dir registers like any engine dir (trainable)
+    code, out = run("build", "--engine-json", str(target / "engine.json"))
+    assert code == 0 and "registered" in out
+
+    # tarballs too
+    import tarfile
+
+    tpath = tmp_path / "engine.tar.gz"
+    with tarfile.open(tpath, "w:gz") as tf:
+        tf.add(src, arcname="engine-0.1")
+    target2 = tmp_path / "from-tar"
+    code, out = run("template", "get", "a2", str(target2),
+                    "--from-archive", str(tpath))
+    assert code == 0, out
+    assert (target2 / "engine.json").exists()
+
+    # a zip with no engine.json is rejected with a clear error — and
+    # leaves NO partial target behind, so a retry with a good archive
+    # succeeds instead of hitting "not empty"
+    bad = tmp_path / "bad.zip"
+    with zipfile.ZipFile(bad, "w") as zf:
+        zf.writestr("stuff/readme.txt", "hello")
+    code, out = run("template", "get", "b", str(tmp_path / "x1"),
+                    "--from-archive", str(bad))
+    assert code == 1 and "engine.json" in out
+    assert not (tmp_path / "x1").exists()
+    code, out = run("template", "get", "b", str(tmp_path / "x1"),
+                    "--from-archive", str(zpath))
+    assert code == 0 and (tmp_path / "x1" / "engine.json").exists()
+
+    # tar link members are rejected, never silently dropped
+    lpath = tmp_path / "links.tar"
+    with tarfile.open(lpath, "w") as tf:
+        tf.add(src / "engine.json", arcname="engine.json")
+        info = tarfile.TarInfo("data.json")
+        info.type = tarfile.SYMTYPE
+        info.linkname = "../outside.json"
+        tf.addfile(info)
+    code, out = run("template", "get", "l", str(tmp_path / "x4"),
+                    "--from-archive", str(lpath))
+    assert code == 1 and "link member" in out
+    assert not (tmp_path / "x4").exists()
+
+    # traversal member paths are refused (untrusted archive)
+    evil = tmp_path / "evil.zip"
+    with zipfile.ZipFile(evil, "w") as zf:
+        zf.writestr("../escape.py", "boom")
+    code, out = run("template", "get", "c", str(tmp_path / "x2"),
+                    "--from-archive", str(evil))
+    assert code == 1 and "unsafe" in out
+
+    # missing archive file
+    code, out = run("template", "get", "d", str(tmp_path / "x3"),
+                    "--from-archive", str(tmp_path / "nope.zip"))
+    assert code == 1 and "not found" in out
+
+
 def test_template_min_version_gate(cli, tmp_path):
     from predictionio_tpu.tools.template_gallery import (
         TemplateVersionError, verify_template_min_version)

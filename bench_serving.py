@@ -4,10 +4,15 @@ status page but publishes no targets; the working expectation for a rec
 server is a sub-100 ms query path, SURVEY §7 hard-part 5).
 
 Measures predict_json end-to-end (JSON decode -> device top-k -> JSON
-encode) after warmup, single-threaded. Prints ONE JSON line like bench.py.
+encode) after warmup.  Single-threaded by default; ``--threads N`` adds
+the concurrent-load measurement the reference's per-request-detach
+serving model implies (`CreateServer.scala:437,464`): N client threads
+hammer the same model and the line reports per-request p50/p99 plus
+aggregate QPS — the number that exposes GIL + single-device-queue
+serialization.  Prints ONE JSON line per measurement like bench.py.
 
 Usage: python bench_serving.py [--items 100000] [--rank 64] [--n 200]
-       [--platform cpu]
+       [--threads 16] [--platform cpu]
 """
 
 from __future__ import annotations
@@ -33,6 +38,9 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=0,
                     help="also measure batch_predict at this batch size "
                     "(the eval-path throughput)")
+    ap.add_argument("--threads", type=int, default=0,
+                    help="also measure under N concurrent client "
+                    "threads (p50/p99 per request + aggregate QPS)")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
@@ -94,6 +102,98 @@ def main() -> None:
             }
         )
     )
+
+    if args.threads > 0:
+        import concurrent.futures
+
+        from predictionio_tpu.server.microbatch import MicroBatcher
+
+        per_thread = max(args.n // args.threads, 20)
+        users_c = rng.integers(0, args.users, (args.threads, per_thread))
+
+        def run_clients(predict_one):
+            def client(tid):
+                lats = np.empty(per_thread)
+                for j in range(per_thread):
+                    t0 = time.perf_counter()
+                    r = predict_one(
+                        Query(user=f"u{users_c[tid, j]}", num=args.num)
+                    )
+                    lats[j] = time.perf_counter() - t0
+                    assert len(r.item_scores) == args.num
+                return lats
+
+            with concurrent.futures.ThreadPoolExecutor(args.threads) as ex:
+                # warm the pool/executables: ONE request per thread
+                # (not a full untimed workload)
+                list(ex.map(
+                    lambda t: predict_one(
+                        Query(user=f"u{users_c[t, 0]}", num=args.num)
+                    ),
+                    range(args.threads),
+                ))
+                if batcher is not None:
+                    batcher.reset_stats()  # counters = timed traffic only
+                t0 = time.perf_counter()
+                lats = np.concatenate(
+                    list(ex.map(client, range(args.threads)))
+                )
+                wall = time.perf_counter() - t0
+            return lats, wall
+
+        # A: per-request device dispatch (requests serialize on the
+        # single device queue); B: continuous micro-batching (the
+        # serving default when the algorithm batch-predicts).  Counters
+        # are reset after warmup so the JSON describes timed traffic.
+        batcher = None
+
+        def make_modes():
+            nonlocal batcher
+            yield ("serving_concurrent_query_p99_ms",
+                   lambda q: algo.predict(model, q))
+            batcher = MicroBatcher(
+                lambda qs: algo.batch_predict(model, qs), max_batch=64,
+                pad_batches=True,
+            )
+            # pre-compile the pow2 batch executables the padded batcher
+            # can dispatch (the serving warmup obligation)
+            bsz = 1
+            while bsz <= min(64, args.threads * 2):
+                algo.batch_predict(
+                    model,
+                    [Query(user="u0", num=args.num)] * bsz,
+                )
+                bsz *= 2
+            yield ("serving_microbatched_query_p99_ms", batcher.submit)
+
+        for metric, predict_one in make_modes():
+            lats, wall = run_clients(predict_one)
+            cp50, cp99 = np.percentile(lats, [50, 99])
+            if args.verbose:
+                print(
+                    f"# {metric} x{args.threads}: p50 {cp50*1e3:.2f}ms "
+                    f"p99 {cp99*1e3:.2f}ms qps {len(lats)/wall:.0f}",
+                    file=sys.stderr,
+                )
+            print(
+                json.dumps(
+                    {
+                        "metric": metric,
+                        "value": round(cp99 * 1e3, 3),
+                        "unit": "ms",
+                        "threads": args.threads,
+                        "p50_ms": round(cp50 * 1e3, 3),
+                        "qps": round(len(lats) / wall, 1),
+                        "single_thread_p50_ms": round(p50 * 1e3, 3),
+                        **(
+                            {"max_batch_seen": batcher.max_seen,
+                             "batches": batcher.batches}
+                            if metric.startswith("serving_microbatched")
+                            else {}
+                        ),
+                    }
+                )
+            )
 
     if args.batch > 0:
         qs = [Query(user=f"u{int(u)}", num=args.num)

@@ -1,0 +1,187 @@
+"""Continuous micro-batching for the serving hot path.
+
+The reference detaches one JVM actor per request
+(`workflow/CreateServer.scala:437,464`) and each predict is cheap CPU
+work, so concurrency alone scales it.  Here every predict is a device
+call, and a TPU has ONE execution queue: N concurrent requests that
+each dispatch their own top-k matmul serialize on the queue, so
+per-request latency grows ~linearly with concurrency while aggregate
+QPS stays flat (measured: 8 threads take p50 from ~1 ms to ~7.5 ms at
+unchanged QPS, bench_serving.py --threads).
+
+The TPU-shaped fix is to make concurrency *wider, not deeper*: coalesce
+the queries that arrive while a device call is in flight into ONE
+batched call (`Algorithm.batch_predict` — a [B, R] x [R, M] matmul
+costs barely more than the [R] x [R, M] one).  This is the
+leader/follower "continuous batching" pattern:
+
+* a request appends its query to the pending list; if no batch is
+  executing, it becomes the LEADER: it takes everything pending (up to
+  ``max_batch``) and runs the batch function *on its own thread*;
+* requests arriving meanwhile park as FOLLOWERS; the leader's
+  completion wakes them — their results are already set, or one of
+  them becomes the next leader with the batch that accumulated;
+* under no concurrency the pending list always has exactly one entry
+  and the batcher degenerates to a direct call: no dispatcher thread,
+  no timer, zero added latency at QPS where batching can't help.
+
+Batch size therefore adapts to the arrival rate with no tuning knob
+doing latency/throughput trades behind the operator's back
+(``max_wait_s`` exists for completeness but defaults to 0).
+
+Determinism note: a batched matmul compiles per batch size, so the same
+query served inside different batch compositions can differ at float
+ulp scale (different reduction order) — rankings are stable, scores may
+wobble ~1e-7.  Deployments that need bitwise per-request determinism
+set ``ServerConfig(microbatch="off")``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+__all__ = ["MicroBatcher"]
+
+
+class _Entry:
+    __slots__ = ("item", "done", "value", "error")
+
+    def __init__(self, item):
+        self.item = item
+        self.done = False
+        self.value = None
+        self.error: Exception | None = None
+
+
+class MicroBatcher:
+    """Coalesce concurrent ``submit(x)`` calls into ``batch_fn([x...])``.
+
+    ``batch_fn`` receives a list of items and must return a list of
+    results of the same length and order.  An exception from
+    ``batch_fn`` fails every request in that batch (callers see the
+    same exception a direct call would have raised).
+    """
+
+    def __init__(
+        self,
+        batch_fn: Callable[[Sequence[Any]], Sequence[Any]],
+        max_batch: int = 64,
+        max_wait_s: float = 0.0,
+        pad_batches: bool = False,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.batch_fn = batch_fn
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        # pad each batch to the next power of two by repeating the last
+        # item (results sliced off).  An XLA batch_fn compiles ONE
+        # executable per distinct batch size; continuous batching
+        # naturally produces every size 1..max_batch, which would pay a
+        # compile mid-traffic for each new size — measured as a p99
+        # spike on first exposure to load.  Padding bounds the
+        # executable count to log2(max_batch)+1.  Only valid when
+        # batch_fn is a pure per-item map (duplicated trailing items
+        # must be harmless), which predicts are.
+        self.pad_batches = pad_batches
+        self._cond = threading.Condition()
+        self._pending: list[_Entry] = []
+        self._running = False
+        # observability: how the batcher is actually coalescing
+        self.batches = 0
+        self.requests = 0
+        self.max_seen = 0
+
+    def reset_stats(self) -> None:
+        with self._cond:
+            self.batches = self.requests = self.max_seen = 0
+
+    def submit(self, item: Any) -> Any:
+        entry = _Entry(item)
+        with self._cond:
+            self._pending.append(entry)
+            # wake a leader sitting in its accumulation window (no-op
+            # for followers: they re-check state and wait again)
+            self._cond.notify_all()
+            while True:
+                if entry.done:
+                    break
+                if not self._running:
+                    # become the leader for everything pending now
+                    self._running = True
+                    batch = self._pending[: self.max_batch]
+                    del self._pending[: len(batch)]
+                    self._lead(batch)
+                    continue  # re-check: our entry is done (we led it)
+                self._cond.wait()
+        if entry.error is not None:
+            raise entry.error
+        return entry.value
+
+    def _lead(self, batch: list[_Entry]) -> None:
+        """Run one batch on the calling thread.  Called with the lock
+        HELD; releases it around the device call and re-acquires."""
+        if self.max_wait_s > 0 and len(batch) < self.max_batch:
+            # optional accumulation window (off by default): give
+            # near-simultaneous arrivals a chance to join this batch.
+            # Arrivals notify; absorb after EVERY wake (timeout
+            # included) so nothing queued during the window is left
+            # behind for the next leader.
+            deadline = time.monotonic() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(left)
+                take = self.max_batch - len(batch)
+                batch += self._pending[:take]
+                del self._pending[:take]
+        self._cond.release()
+        try:
+            self._run_batch(batch)
+        finally:
+            self._cond.acquire()
+            for e in batch:
+                e.done = True
+            self._running = False
+            self.batches += 1
+            self.requests += len(batch)
+            self.max_seen = max(self.max_seen, len(batch))
+            self._cond.notify_all()
+
+    def _run_batch(self, batch: list[_Entry]) -> None:
+        """Execute one batch; on failure, isolate the blast radius.
+
+        A batched device call is all-or-nothing, so one malformed query
+        would otherwise fail every innocent request coalesced with it
+        (per-request dispatch isolated such failures).  On a batch of
+        >1 failing, re-run each item ALONE: good requests succeed, the
+        bad one gets its own exception — same outcomes as unbatched
+        serving, paid only on the rare failure path.
+        """
+        try:
+            items = [e.item for e in batch]
+            n = len(items)
+            if self.pad_batches and n > 1:
+                padded = 1 << (n - 1).bit_length()
+                items = items + [items[-1]] * (padded - n)
+            results = self.batch_fn(items)
+            if len(results) != len(items):
+                raise RuntimeError(
+                    f"batch_fn returned {len(results)} results "
+                    f"for {len(items)} items"
+                )
+            for e, r in zip(batch, results):
+                e.value = r
+        except Exception as exc:  # noqa: BLE001 — propagate per caller
+            if len(batch) == 1:
+                batch[0].error = exc
+                return
+            for e in batch:
+                try:
+                    (r,) = self.batch_fn([e.item])
+                    e.value = r
+                except Exception as solo:  # noqa: BLE001
+                    e.error = solo

@@ -1,0 +1,175 @@
+"""Serving micro-batcher (`server/microbatch.py`): correctness under
+concurrency, leader/follower coalescing, failure propagation, and the
+EngineServer auto-gating."""
+
+import concurrent.futures
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.server.microbatch import MicroBatcher
+
+
+def test_sequential_results_match_direct():
+    b = MicroBatcher(lambda xs: [x * 2 for x in xs])
+    assert [b.submit(i) for i in range(10)] == [i * 2 for i in range(10)]
+    # no concurrency -> every batch was a single item (no added latency)
+    assert b.batches == b.requests == 10
+    assert b.max_seen == 1
+
+
+def test_concurrent_calls_coalesce():
+    calls = []
+    gate = threading.Event()
+
+    def batch_fn(xs):
+        calls.append(len(xs))
+        if len(calls) == 1:
+            gate.set()        # first (leader) batch entered
+            time.sleep(0.15)  # hold the "device" busy while others arrive
+        return [x + 100 for x in xs]
+
+    b = MicroBatcher(batch_fn)
+    with concurrent.futures.ThreadPoolExecutor(9) as ex:
+        first = ex.submit(b.submit, 0)
+        assert gate.wait(2.0)
+        rest = [ex.submit(b.submit, i) for i in range(1, 9)]
+        results = [first.result(5)] + [f.result(5) for f in rest]
+    assert results == [i + 100 for i in range(9)]
+    # the 8 requests that arrived while batch 1 ran coalesced into far
+    # fewer than 8 additional device calls
+    assert calls[0] == 1
+    assert sum(calls) == 9
+    assert len(calls) <= 4
+    assert b.max_seen > 1
+
+
+def test_max_batch_respected():
+    sizes = []
+
+    def batch_fn(xs):
+        sizes.append(len(xs))
+        time.sleep(0.02)
+        return list(xs)
+
+    b = MicroBatcher(batch_fn, max_batch=4)
+    with concurrent.futures.ThreadPoolExecutor(16) as ex:
+        assert sorted(ex.map(b.submit, range(16))) == list(range(16))
+    assert max(sizes) <= 4
+
+
+def test_exception_propagates_to_every_caller():
+    def batch_fn(xs):
+        raise RuntimeError("device fell over")
+
+    b = MicroBatcher(batch_fn)
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        futs = [ex.submit(b.submit, i) for i in range(4)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device fell over"):
+                f.result(5)
+    # the batcher recovers after a failed batch
+    b.batch_fn = lambda xs: list(xs)
+    assert b.submit(7) == 7
+
+
+def test_one_bad_item_does_not_poison_the_batch():
+    """A malformed query coalesced with good ones must fail ALONE: the
+    batcher retries the failed batch item-by-item so innocent callers
+    get their results, like per-request dispatch would have given."""
+    entered = threading.Event()
+
+    def batch_fn(xs):
+        if len(xs) > 1 and not entered.is_set():
+            entered.set()
+        if any(x == "bad" for x in xs):
+            raise TypeError(f"query {xs} is malformed")
+        time.sleep(0.05)  # hold the device so arrivals coalesce
+        return [f"ok:{x}" for x in xs]
+
+    b = MicroBatcher(batch_fn, max_wait_s=0.2)
+    with concurrent.futures.ThreadPoolExecutor(6) as ex:
+        futs = {x: ex.submit(b.submit, x)
+                for x in ["a", "bad", "c", "d", "e"]}
+        for x, f in futs.items():
+            if x == "bad":
+                with pytest.raises(TypeError, match="malformed"):
+                    f.result(5)
+            else:
+                assert f.result(5) == f"ok:{x}"
+
+
+def test_length_mismatch_is_an_error():
+    b = MicroBatcher(lambda xs: [1])
+    b2 = MicroBatcher(lambda xs: list(xs) + [99])
+    with pytest.raises(RuntimeError, match="returned"):
+        MicroBatcher(lambda xs: []).submit(1)
+    del b, b2
+
+
+def test_accumulation_window():
+    """The window must ABSORB arrivals into the leader's own batch (a
+    previous version slept the full window and then dispatched without
+    them — pure added latency)."""
+    sizes = []
+
+    def batch_fn(xs):
+        sizes.append(len(xs))
+        return list(xs)
+
+    b = MicroBatcher(batch_fn, max_batch=8, max_wait_s=0.5)
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        assert sorted(ex.map(b.submit, range(8))) == list(range(8))
+    # the FIRST batch (the only one whose window was open while the
+    # other submits raced in) picked up followers
+    assert sizes[0] > 1
+    # a full batch short-circuits the window: all 8 in <= 2 batches
+    assert len(sizes) <= 2
+
+
+def test_engine_server_auto_gating(storage_memory):
+    """"auto" batches only when every algorithm has a REAL
+    batch_predict; the base-class fallback would serialize inside the
+    leader for no gain."""
+    from predictionio_tpu.controller.base import (
+        Algorithm, DataSource, WorkflowContext,
+    )
+    from predictionio_tpu.controller.engine import SimpleEngine
+    from predictionio_tpu.server.serving import EngineServer, ServerConfig
+    from predictionio_tpu.workflow.train import run_train
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return 1
+
+    class PlainAlgo(Algorithm):
+        def train(self, ctx, data):
+            return {"w": 2}
+
+        def predict(self, model, query):
+            return {"y": model["w"] * query.get("x", 0)}
+
+    class BatchedAlgo(PlainAlgo):
+        def batch_predict(self, model, queries):
+            return [{"y": model["w"] * q.get("x", 0)} for q in queries]
+
+    ctx = WorkflowContext(storage=storage_memory)
+    for algo_cls, expect_batcher in ((PlainAlgo, False), (BatchedAlgo, True)):
+        engine = SimpleEngine(DS, algo_cls)
+        ep = engine.params_from_variant({})
+        iid = run_train(engine, ep, ctx=ctx)
+        srv = EngineServer(engine, ep, iid, ctx=ctx,
+                           config=ServerConfig(port=0))
+        assert (srv.batcher is not None) is expect_batcher
+        assert srv.predict_json({"x": 3}) == {"y": 6}
+        if expect_batcher:
+            assert srv.status_json()["microbatch"]["requests"] >= 1
+        # forced modes override the heuristic
+        srv_off = EngineServer(engine, ep, iid, ctx=ctx,
+                               config=ServerConfig(port=0, microbatch="off"))
+        assert srv_off.batcher is None
+        srv_on = EngineServer(engine, ep, iid, ctx=ctx,
+                              config=ServerConfig(port=0, microbatch="on"))
+        assert srv_on.batcher is not None
+        assert srv_on.predict_json({"x": 5}) == {"y": 10}

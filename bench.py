@@ -791,12 +791,19 @@ def main() -> None:
                              "--gather-dtype", "bfloat16"]
             )
         errs = []
+        # weighted split of what's left over the attempts still to run:
+        # the FIRST (best) config gets the biggest share — an even split
+        # left it ~260 s, tight against a legitimate full-scale run
+        # (staging + compile + 20 iters measured ~235 s through the
+        # tunnel), so a slow-but-healthy best attempt could time out.
+        # A HANGING attempt still can't starve the rest: later attempts
+        # keep their weighted share of whatever actually remains.
+        weights = [9, 6, 5][: len(attempts)] or [1]
         for k, extra in enumerate(attempts):
-            # split what's left evenly over the attempts still to run: a
-            # HANGING first attempt (vs a fast failure) must not starve
-            # the conservative configs of their shot at the number
-            left = len(attempts) - k
-            cap = min(TPU_RUN_TIMEOUT, remaining(CPU_RESERVE) // left)
+            share = weights[k] / sum(weights[k:])
+            cap = min(
+                TPU_RUN_TIMEOUT, int(remaining(CPU_RESERVE) * share)
+            )
             line, err = _run_inner_subprocess(extra, max(cap, 60))
             if line is not None:
                 _record_history(line)

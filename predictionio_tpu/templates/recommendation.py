@@ -143,6 +143,12 @@ class TrainingData:
             raise ValueError("no rating events found — is the app empty?")
 
 
+def _pow2_ceil(x: int) -> int:
+    """Next power of two >= x (min 1) — the k/batch-size rounding that
+    bounds the batched scorer's XLA executable key space."""
+    return 1 << (max(int(x), 1) - 1).bit_length()
+
+
 def decode_item_scores(items, vals, ixs) -> tuple:
     """ONE host sync for both top-k outputs (each separate readback costs
     a full RTT on a remote-attached accelerator), then decode to
@@ -395,16 +401,35 @@ class ALSAlgorithm(Algorithm):
 
     def warmup(self, model: ALSModel) -> None:
         """Compile the top-k scorers for the common ``num`` values (the
-        static k arg keys the executable) before the first real query."""
+        static k arg keys the executable) before the first real query.
+
+        Also pre-compiles BATCHED scorers: with the serving
+        micro-batcher on (the default), EVERY request — solo ones
+        included — routes through :meth:`batch_predict`, whose
+        executable key space is bounded to (pow2 B) x (pow2 k) x
+        (masked?) by the shape-stability contract there.  This warms
+        B in {1, 4, 16, 64} at the pow2-rounded default num (k=16)
+        plus the small-k sizes at B=1; remaining shapes compile once
+        under load and land in the persistent compilation cache."""
         n = len(model.items)
         if n == 0:
             return
         table = model.device_item_factors(self._serve_dtype())
-        vec = np.zeros(model.item_factors.shape[1], np.float32)
+        rank = model.item_factors.shape[1]
+        vec = np.zeros(rank, np.float32)
         bias = np.zeros(n, np.float32)
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, table, k)
             topk_scores(vec, table, k, bias=bias)
+        k_default = min(_pow2_ceil(10), n)  # num=10 -> k=16
+        for b in (1, 4, 16, 64):
+            vecs = np.zeros((b, rank), np.float32)
+            batch_topk_scores(vecs, table, k_default)
+            batch_topk_scores(
+                vecs, table, k_default, mask=np.zeros((b, n), np.float32)
+            )
+        for k in {min(_pow2_ceil(k), n) for k in (1, 4)}:
+            batch_topk_scores(np.zeros((1, rank), np.float32), table, k)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)
@@ -426,21 +451,37 @@ class ALSAlgorithm(Algorithm):
         )
 
     def batch_predict(self, model: ALSModel, queries: Sequence[Query]):
-        """Eval path: one batched matmul for all queries, honoring the same
-        per-query filters as :meth:`predict`."""
-        known = [(bi, model.users.get(q.user)) for bi, q in enumerate(queries)]
+        """Eval + micro-batched serving path: ONE batched matmul for all
+        queries, honoring the same per-query filters as :meth:`predict`.
+
+        Shape stability contract: the device call's batch size is
+        ``len(queries)`` regardless of how many queries are valid —
+        invalid ones (unknown user, num<=0) score a harmless row-0
+        duplicate that is discarded on the host.  Dropping them would
+        make the device batch size data-dependent, defeating the
+        serving micro-batcher's pow2 padding (every valid-count would
+        compile its own XLA executable mid-traffic).  ``k`` is likewise
+        rounded up to the next power of two, so the executable key
+        space is (pow2 B) x (pow2 k) x (masked?)."""
         out: list[PredictedResult] = [
             PredictedResult(item_scores=()) for _ in queries
         ]
-        idx = [(bi, u) for bi, u in known if u >= 0 and queries[bi].num > 0]
-        if not idx:
+        uix = np.array(
+            [model.users.get(q.user) for q in queries], dtype=np.int64
+        )
+        nums = np.array([q.num for q in queries], dtype=np.int64)
+        valid = (uix >= 0) & (nums > 0)
+        if not valid.any():
             return out
-        k = max(1, min(max(queries[bi].num for bi, _ in idx),
-                       len(model.items)))
-        uvecs = np.stack([model.user_factors[u] for _, u in idx])
-        masks = [self._allowed_mask(model, queries[bi]) for bi, _ in idx]
+        n_items = len(model.items)
+        k = min(_pow2_ceil(int(nums[valid].max())), n_items)
+        uvecs = model.user_factors[np.where(valid, uix, 0)]
+        masks = [
+            self._allowed_mask(model, q) if v else None
+            for q, v in zip(queries, valid)
+        ]
         if any(m is not None for m in masks):
-            zero = np.zeros(len(model.items), dtype=np.float32)
+            zero = np.zeros(n_items, dtype=np.float32)
             mask = np.stack([zero if m is None else m for m in masks])
         else:
             mask = None
@@ -449,14 +490,16 @@ class ALSAlgorithm(Algorithm):
             mask=mask,
         )
         vals, ixs = jax.device_get((vals, ixs))  # one host sync, see predict
-        for row, (bi, _) in enumerate(idx):
-            n = queries[bi].num
-            ok = np.isfinite(vals[row, :n])
-            ids = model.items.decode(ixs[row, :n][ok])
+        for bi, q in enumerate(queries):
+            if not valid[bi]:
+                continue
+            n = min(q.num, k)
+            ok = np.isfinite(vals[bi, :n])
+            ids = model.items.decode(ixs[bi, :n][ok])
             out[bi] = PredictedResult(
                 item_scores=tuple(
                     ItemScore(item=str(it), score=float(s))
-                    for it, s in zip(ids, vals[row, :n][ok])
+                    for it, s in zip(ids, vals[bi, :n][ok])
                 )
             )
         return out

@@ -157,6 +157,39 @@ def test_batch_predict_matches_single(ctx):
     assert batch[-1].item_scores == ()
 
 
+def test_batch_predict_shape_stable_under_invalid_queries(ctx,
+                                                          monkeypatch):
+    """The device batch size must equal len(queries) even when some
+    queries are invalid, and k must round to pow2 — the micro-batcher's
+    executable-count bound depends on it (a dropped row would compile a
+    fresh (B-1)-sized XLA executable mid-traffic)."""
+    from predictionio_tpu.templates import recommendation as rmod
+
+    e = recommendation_engine()
+    ep = e.params_from_variant(VARIANT)
+    models = e.train(ctx, ep)
+    algo = e._algorithms(ep)[0]
+    shapes = []
+    real = rmod.batch_topk_scores
+
+    def spy(vecs, table, k, mask=None):
+        shapes.append((vecs.shape[0], k))
+        return real(vecs, table, k, mask=mask)
+
+    monkeypatch.setattr(rmod, "batch_topk_scores", spy)
+    queries = [Query(user="u0", num=3), Query(user="ghost", num=3),
+               Query(user="u1", num=0), Query(user="u2", num=3)]
+    out = algo.batch_predict(models[0], queries)
+    # full batch went to the device; k=3 rounded up to 4
+    assert shapes == [(4, 4)]
+    assert out[1].item_scores == () and out[2].item_scores == ()
+    assert len(out[0].item_scores) == 3 and len(out[3].item_scores) == 3
+    single = algo.predict(models[0], queries[0])
+    assert [s.item for s in out[0].item_scores] == [
+        s.item for s in single.item_scores
+    ]
+
+
 def test_query_wire_format():
     q = Query.from_json({"user": "u1", "num": 4, "categories": ["a"]})
     assert q.user == "u1" and q.num == 4 and q.categories == ("a",)

@@ -5,6 +5,7 @@ import os
 import sys
 from pathlib import Path
 
+import numpy as np
 import pytest
 
 from predictionio_tpu.controller.base import WorkflowContext
@@ -398,3 +399,37 @@ def test_sharded_scale(in_example, capsys):
         .replace(",", "")
     )
     assert stored < 40_000 / 4
+
+
+def test_simrank(in_example):
+    m = in_example("simrank")
+    engine, ep, models = _train_and_params(m)
+    algo = engine._algorithms(ep)[0]
+    model = models[0]
+    # SimRank structure: s(a,a)=1, symmetric, decays with distance
+    S = model.scores
+    assert np.allclose(np.diag(S), 1.0)
+    assert np.allclose(S, S.T, atol=1e-5)
+    # 0 (nbrs {2,3,5}) and 4 (nbrs {2,3,5,9}) share three neighbors ->
+    # each other's top recommendation
+    res = algo.predict(model, m.Query(user="0", num=3))
+    assert res and res[0].user == "4"
+    res4 = algo.predict(model, m.Query(user="4", num=3))
+    assert res4 and res4[0].user == "0"
+    # unknown vertex -> empty, never a crash
+    assert algo.predict(model, m.Query(user="nope", num=3)) == []
+
+    # the sampling data sources produce valid sub-graphs the same
+    # algorithm trains on (reference's Node/ForestFire sampling sources)
+    for name in ("node", "forestfire"):
+        ep2 = engine.params_from_variant({
+            "datasource": {"name": name, "params": {
+                "graph_edgelist_path": "edge_list_small.txt",
+                "sample_fraction": 0.6}},
+            "algorithms": [{"name": "simrank",
+                            "params": {"num_iterations": 3}}],
+        })
+        sub = engine.train(WorkflowContext(), ep2)[0]
+        n_sub = len(sub.vertices)
+        assert 2 <= n_sub < 10
+        assert np.allclose(np.diag(sub.scores), 1.0)

@@ -45,6 +45,13 @@ class DataSourceParams(Params):
     sample_fraction: float = 0.5
     seed: int = 7
 
+    def __post_init__(self):
+        if not 0.0 < self.sample_fraction <= 1.0:
+            raise ValueError(
+                f"sample_fraction must be in (0, 1], got "
+                f"{self.sample_fraction}"
+            )
+
 
 @dataclass(frozen=True)
 class AlgoParams(Params):

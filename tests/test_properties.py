@@ -1,0 +1,187 @@
+"""Property-based tests (hypothesis) for the invariant-heavy surfaces.
+
+The reference proves these with hand-picked cases (`DataMapSpec`,
+`LEventAggregatorSpec`, `BiMapSpec`); generated inputs cover the same
+contracts over the whole input space — JSON wire round-trips, the
+$set/$unset/$delete fold semantics, id-index bijection, and the fused
+kernel's VMEM tile-plan accounting (a wrong plan silently degrades the
+solver, so the arithmetic is load-bearing).
+"""
+
+import datetime as dt
+import json
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from predictionio_tpu.storage.bimap import StringIndex
+from predictionio_tpu.storage.event import DataMap, Event, format_time
+from predictionio_tpu.storage.aggregate import aggregate_properties_single
+
+UTC = dt.timezone.utc
+
+# JSON-representable property values (reference: DataMap is Map[String,
+# JValue]); floats NaN/inf excluded — not valid JSON
+_scalar = (
+    st.none()
+    | st.booleans()
+    | st.integers(min_value=-(2**53), max_value=2**53)
+    | st.floats(allow_nan=False, allow_infinity=False, width=32)
+    | st.text(max_size=20)
+)
+_json_val = st.recursive(
+    _scalar,
+    lambda inner: st.lists(inner, max_size=4)
+    | st.dictionaries(st.text(max_size=8), inner, max_size=4),
+    max_leaves=8,
+)
+# property keys must not collide with the reserved pio_ prefix
+_prop_key = st.text(
+    alphabet=st.characters(min_codepoint=97, max_codepoint=122), min_size=1,
+    max_size=12,
+).filter(lambda s: not s.startswith("pio_"))
+_props = st.dictionaries(_prop_key, _json_val, max_size=5)
+_entity = st.text(
+    alphabet=st.characters(min_codepoint=97, max_codepoint=122), min_size=1,
+    max_size=8,
+)
+_times = st.datetimes(
+    min_value=dt.datetime(2000, 1, 1),
+    max_value=dt.datetime(2030, 1, 1),
+    timezones=st.just(UTC),
+).map(lambda t: t.replace(microsecond=(t.microsecond // 1000) * 1000))
+
+
+@given(props=_props, ent=_entity, t=_times)
+@settings(max_examples=60, deadline=None)
+def test_event_api_json_round_trip(props, ent, t):
+    """Event -> wire JSON -> Event preserves every field, and the wire
+    form survives an actual json.dumps/loads cycle (the reference's
+    APISerializer contract)."""
+    e = Event(
+        event="rate", entity_type="user", entity_id=ent,
+        target_entity_type="item", target_entity_id=ent,
+        properties=DataMap(props), event_time=t, event_id="abc123",
+    )
+    wire = json.loads(json.dumps(e.to_json()))
+    back = Event.from_json(wire)
+    assert back.event == e.event
+    assert back.entity_id == e.entity_id
+    assert back.properties == e.properties
+    assert back.event_time == e.event_time
+    assert back.target_entity_id == e.target_entity_id
+    assert format_time(back.event_time) == format_time(e.event_time)
+
+
+@given(sets=st.lists(_props, min_size=1, max_size=5))
+@settings(max_examples=40, deadline=None)
+def test_aggregate_last_set_wins(sets):
+    """A sequence of $set events folds to the union with the LAST write
+    per key winning (reference LEventAggregator semantics)."""
+    base = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    evs = [
+        Event(event="$set", entity_type="user", entity_id="u",
+              properties=DataMap(p),
+              event_time=base + dt.timedelta(seconds=i))
+        for i, p in enumerate(sets)
+    ]
+    got = aggregate_properties_single(evs)
+    want: dict = {}
+    for p in sets:
+        want.update(p)
+    assert got is not None
+    assert got.fields == want
+    assert got.first_updated == evs[0].event_time
+    assert got.last_updated == evs[-1].event_time
+
+
+@given(props=_props.filter(lambda p: p), drop=st.data())
+@settings(max_examples=40, deadline=None)
+def test_aggregate_unset_removes_and_delete_kills(props, drop):
+    base = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    key = drop.draw(st.sampled_from(sorted(props)))
+    evs = [
+        Event(event="$set", entity_type="user", entity_id="u",
+              properties=DataMap(props), event_time=base),
+        Event(event="$unset", entity_type="user", entity_id="u",
+              properties=DataMap({key: None}),
+              event_time=base + dt.timedelta(seconds=1)),
+    ]
+    got = aggregate_properties_single(evs)
+    remaining = {k: v for k, v in props.items() if k != key}
+    if remaining:
+        assert got is not None and got.fields == remaining
+    # $delete after everything kills the entity regardless of history
+    evs.append(
+        Event(event="$delete", entity_type="user", entity_id="u",
+              event_time=base + dt.timedelta(seconds=2))
+    )
+    assert aggregate_properties_single(evs) is None
+
+
+@given(ids=st.lists(_entity, min_size=1, max_size=40))
+@settings(max_examples=40, deadline=None)
+def test_string_index_bijection(ids):
+    """encode/decode round-trips; indexes are a contiguous 0..n-1
+    bijection (the BiMap.stringInt contract; this build assigns them in
+    SORTED id order — the vectorized dictionary build)."""
+    import numpy as np
+
+    ix = StringIndex.from_values(ids)
+    uniq = sorted(set(ids))
+    assert len(ix) == len(uniq)
+    codes = ix.encode(uniq)
+    assert sorted(int(c) for c in codes) == list(range(len(uniq)))
+    assert list(ix.decode(codes)) == uniq
+    for s in uniq:
+        assert ix.id_of(ix[s]) == s
+    assert ix.get("§never-an-id§") == -1
+    np.testing.assert_array_equal(
+        ix.decode(ix.encode(ids)), np.asarray(ids)
+    )
+
+
+@given(
+    m=st.integers(min_value=8, max_value=200_000),
+    r=st.integers(min_value=2, max_value=128),
+    k=st.integers(min_value=1, max_value=4096),
+    table_bytes=st.sampled_from([2, 4]),
+    budget_mib=st.integers(min_value=2, max_value=64),
+)
+@settings(max_examples=60, deadline=None)
+def test_fused_tile_plan_accounting(m, r, k, table_bytes, budget_mib):
+    """Any plan the planner returns must actually FIT the budget it was
+    given: padded scratch + double-buffered IO + the table chunk stay
+    within 90% of VMEM, chunk counts respect the cap, and dimensions
+    tile (8, 128).  A wrong plan is a silent solver degrade in
+    production, so the arithmetic is a contract, not a heuristic."""
+    from predictionio_tpu.ops.fused_als import (
+        _MAX_TABLE_CHUNKS, _pad8, _pad128, fused_tile_plan,
+    )
+
+    import pytest
+
+    budget = budget_mib << 20
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("PIO_TPU_VMEM_BYTES", str(budget))
+        plan = fused_tile_plan(m, r, k, table_bytes)
+    if plan is None:
+        return
+    tb, kc, mc = plan
+    assert tb >= 8 and kc >= 128 and mc >= 8
+    assert tb % 8 == 0 and kc % 128 == 0 and mc % 8 == 0
+    assert -(-_pad8(m) // mc) <= _MAX_TABLE_CHUNKS
+    r8, r128, w128 = _pad8(r), _pad128(r), _pad128(r + 1)
+    fixed = (
+        tb * r8 * r128 * 4          # A scratch
+        + tb * r8 * w128 * 4        # GJ scratch
+        + _pad8(tb) * r128 * 4      # b scratch
+        + tb * _pad8(kc) * r128 * 4  # gathered rows
+        + 3 * 2 * _pad8(tb) * _pad128(kc) * 4  # idx/cw/bw double-buffered
+        + 2 * _pad8(tb) * r128 * 4  # out double-buffered
+        + r8 * r128 * 4             # gram0
+    )
+    table_cost = mc * r128 * table_bytes
+    if mc < _pad8(m):               # streamed: double-buffered chunk
+        table_cost *= 2
+    assert fixed + table_cost <= int(budget * 0.9)

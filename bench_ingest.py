@@ -5,7 +5,16 @@ on HBase); this measures ours end-to-end — HTTP parse -> auth -> validate
 -> sqlite insert — plus the offline importer for contrast.  Prints one
 JSON line per mode.
 
-Usage: python bench_ingest.py [--n 2000]
+Usage: python bench_ingest.py [--n 2000] [--threads 16]
+
+``--threads N`` adds the concurrent-writer measurement: N clients
+hammering ``POST /events.json`` simultaneously.  (A store-level write
+coalescer — insert_batch across concurrent requests, the serving
+micro-batcher's shape — was built and MEASURED SLOWER here: at 16
+clients the wall is per-request HTTP+JSON handling under the GIL, not
+the WAL commit, so it was removed.  Throughput writers should use
+``/batch/events.json`` — amortizes the whole request path — or the
+offline importer.)
 """
 
 from __future__ import annotations
@@ -24,6 +33,9 @@ sys.path.insert(0, str(Path(__file__).parent))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2000)
+    ap.add_argument("--threads", type=int, default=0,
+                    help="also measure N concurrent single-event "
+                    "writers, with and without write coalescing")
     args = ap.parse_args()
 
     from predictionio_tpu.server.event_server import (
@@ -85,6 +97,27 @@ def main() -> None:
         "metric": "ingest_batch50_events_per_s",
         "value": round(batches * 50 / dt, 1), "unit": "events/s",
     }), flush=True)
+
+    if args.threads > 0:
+        import concurrent.futures
+
+        per_thread = max(args.n // args.threads, 25)
+
+        def client(tid):
+            for j in range(per_thread):
+                post("/events.json", ev(tid * per_thread + j))
+
+        with concurrent.futures.ThreadPoolExecutor(args.threads) as ex:
+            list(ex.map(client, range(min(args.threads, 2))))  # warm
+            t0 = time.perf_counter()
+            list(ex.map(client, range(args.threads)))
+            dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "ingest_concurrent_events_per_s",
+            "value": round(args.threads * per_thread / dt, 1),
+            "unit": "events/s",
+            "threads": args.threads,
+        }), flush=True)
 
     server.stop()
 

@@ -34,8 +34,7 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2000)
     ap.add_argument("--threads", type=int, default=0,
-                    help="also measure N concurrent single-event "
-                    "writers, with and without write coalescing")
+                    help="also measure N concurrent single-event writers")
     args = ap.parse_args()
 
     from predictionio_tpu.server.event_server import (

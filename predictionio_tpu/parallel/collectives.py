@@ -49,6 +49,7 @@ from .mesh import DATA_AXIS
 __all__ = [
     "all_reduce_sum",
     "all_gather_blocks",
+    "all_to_all_blocks",
     "reduce_scatter_sum",
     "ring_shift",
 ]
@@ -78,6 +79,41 @@ def all_gather_blocks(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS):
         return jax.lax.all_gather(shard, axis, tiled=True)
 
     return _gather(x)
+
+
+def all_to_all_blocks(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS):
+    """Shard-transpose: device i's j-th block becomes device j's i-th
+    block (the shuffle primitive — Spark's repartition-by-key fabric
+    collapsed to one XLA collective over ICI).
+
+    ``x`` is sharded on its leading dim, and each device's shard is
+    itself organized as ``d`` destination blocks: ``[d*B, ...]`` sharded
+    -> ``[d*B, ...]`` sharded, where the returned device-j shard is
+    ``concat(block j of every device i, over i)``.  This is the device-
+    side form of the owner-exchange the multi-host ingest does over the
+    wire (`parallel/ingest.exchange_ratings_by_owner`): rows grouped by
+    owning shard on the way in, landing grouped by origin on the way
+    out.  Block sizes must be equal (pad the trailing block — the same
+    contract as the host exchange)."""
+    d = mesh.shape[axis]
+    if x.shape[0] % (d * d) != 0:
+        raise ValueError(
+            f"all_to_all_blocks needs leading dim divisible by "
+            f"mesh_size^2 = {d * d} (d equal blocks per device shard); "
+            f"got shape {x.shape}"
+        )
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+    )
+    def _a2a(shard):  # [d*B, ...] per device
+        blocks = shard.reshape((d, shard.shape[0] // d) + shard.shape[1:])
+        out = jax.lax.all_to_all(
+            blocks, axis, split_axis=0, concat_axis=0, tiled=False
+        )
+        return out.reshape(shard.shape)
+
+    return _a2a(x)
 
 
 def reduce_scatter_sum(x: jax.Array, mesh: Mesh, axis: str = DATA_AXIS):

@@ -128,6 +128,39 @@ def test_reload_swaps_to_latest(deployed):
     assert server.instance_id == new_iid
 
 
+def test_reload_under_concurrent_load(deployed):
+    """Hot-swap while queries are in flight: the micro-batcher is
+    rebuilt for the new (algorithms, models) snapshot under the lock;
+    every response during the swap must be a valid prediction from ONE
+    coherent model — no errors, no torn state."""
+    import concurrent.futures
+
+    server, ctx, engine, ep = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    new_iid = run_train(engine, ep, ctx=ctx, engine_variant="srv.json")
+    stop = False
+
+    def hammer(tid):
+        n = 0
+        while not stop:
+            status, body = _post(f"{base}/queries.json",
+                                 {"user": f"u{tid % 8}", "num": 3})
+            assert status == 200 and len(body["itemScores"]) == 3
+            scores = [s["score"] for s in body["itemScores"]]
+            assert scores == sorted(scores, reverse=True)
+            n += 1
+        return n
+
+    with concurrent.futures.ThreadPoolExecutor(6) as ex:
+        futs = [ex.submit(hammer, t) for t in range(4)]
+        for _ in range(3):
+            status, body = _get(f"{base}/reload")
+            assert status == 200 and body["reloaded"] == new_iid
+        stop = True
+        assert sum(f.result(30) for f in futs) > 0
+    assert server.instance_id == new_iid
+
+
 def test_unknown_route_404(deployed):
     server, *_ = deployed
     base = f"http://127.0.0.1:{server.config.port}"

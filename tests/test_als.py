@@ -6,6 +6,7 @@ The NumPy reference implements the same normal equations MLlib solves
 matching it is the RMSE-parity contract of BASELINE.md.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -699,3 +700,42 @@ def test_config_rejects_typo_knob_values():
         ALSConfig(factor_placement="Sharded")
     with pytest.raises(ValueError, match="gather_dtype"):
         ALSConfig(gather_dtype="fp32")
+
+
+def test_device_expand_sides_reconstruction():
+    """`_device_expand_sides` contract: the row side IS the transfer
+    order, row ids are rebuilt on device from counts alone (the row-id
+    column is never transferred), and the opposite side's per-row
+    (row, value) multisets match a host reference grouping."""
+    from predictionio_tpu.models.als import _device_expand_sides
+    from predictionio_tpu.native import sort_coo_by_row
+
+    rng = np.random.default_rng(11)
+    nu, ni, nnz = 17, 13, 300
+    u = rng.integers(0, nu, nnz).astype(np.int32)
+    i = rng.integers(0, ni, nnz).astype(np.int32)
+    v = (rng.integers(1, 11, nnz) * 0.5).astype(np.float32)
+    i_by_u, v_by_u, counts, starts = sort_coo_by_row(u, i, v, nu)
+
+    cs_u, vs_u, cs_i, vs_i = _device_expand_sides(
+        jnp.asarray(i_by_u.astype(np.uint16)),
+        jnp.asarray((v_by_u * 2).astype(np.uint8)),
+        jnp.asarray(np.asarray(counts, np.int32)),
+        jnp.asarray(0.5, jnp.float32),
+    )
+    # user side: exactly the transfer order, decoded
+    np.testing.assert_array_equal(np.asarray(cs_u), i_by_u)
+    np.testing.assert_allclose(np.asarray(vs_u), v_by_u)
+    # item side: grouped by item; each item's (user, value) multiset
+    # matches the original COO
+    cs_i, vs_i = np.asarray(cs_i), np.asarray(vs_i)
+    ci2, vi2, counts_i, starts_i = sort_coo_by_row(i, u, v, ni)
+    pos = 0
+    for r in range(ni):
+        n = int(counts_i[r])
+        got = sorted(zip(cs_i[pos:pos + n].tolist(),
+                         vs_i[pos:pos + n].tolist()))
+        want = sorted(zip(ci2[starts_i[r]:starts_i[r] + n].tolist(),
+                          vi2[starts_i[r]:starts_i[r] + n].tolist()))
+        assert got == want, f"item {r}"
+        pos += n

@@ -153,10 +153,12 @@ def test_reload_under_concurrent_load(deployed):
 
     with concurrent.futures.ThreadPoolExecutor(6) as ex:
         futs = [ex.submit(hammer, t) for t in range(4)]
-        for _ in range(3):
-            status, body = _get(f"{base}/reload")
-            assert status == 200 and body["reloaded"] == new_iid
-        stop = True
+        try:
+            for _ in range(3):
+                status, body = _get(f"{base}/reload")
+                assert status == 200 and body["reloaded"] == new_iid
+        finally:
+            stop = True  # always release the hammers, or shutdown hangs
         assert sum(f.result(30) for f in futs) > 0
     assert server.instance_id == new_iid
 

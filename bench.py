@@ -231,7 +231,13 @@ def run_breakdown(args) -> None:
     trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
                          staging=args.staging)
     emit("bucketize_and_stage_dispatch", time.time() - t0,
-         staging=trainer.staging)
+         staging=trainer.staging,
+         **(
+             {"transfer_bytes": trainer.staged_transfer_bytes,
+              "bytes_per_rating": round(
+                  trainer.staged_transfer_bytes / max(len(v), 1), 2)}
+             if getattr(trainer, "staged_transfer_bytes", None) else {}
+         ))
 
     t0 = time.time()
     U, V = trainer.init_factors()

@@ -1103,9 +1103,16 @@ class ALSTrainer:
             put = lambda x: jax.device_put(x, replicated(self.mesh))  # noqa: E731
         else:
             put = jax.device_put
-        i_dev = put(compact_ids(i_by_u, ni))
+        i_enc = compact_ids(i_by_u, ni)
+        counts_enc = np.asarray(counts_u, np.int32)
+        # observability: the bytes this path actually moves host->device
+        # (the claim the on-chip battery checks; buckets are ~KB noise)
+        self.staged_transfer_bytes = (
+            i_enc.nbytes + v_enc.nbytes + counts_enc.nbytes
+        )
+        i_dev = put(i_enc)
         v_dev = put(v_enc)
-        counts_dev = put(np.asarray(counts_u, np.int32))
+        counts_dev = put(counts_enc)
         scale = jnp.asarray(v_scale, jnp.float32)
         cs_u, vs_u, cs_i, vs_i = _device_expand_sides(
             i_dev, v_dev, counts_dev, scale

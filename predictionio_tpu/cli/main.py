@@ -598,35 +598,40 @@ def cmd_status(args, storage: Storage) -> int:
     # the command an operator runs to diagnose that — it must answer
     import subprocess
 
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "x = jnp.ones((8, 8))\n"
-        "assert float((x @ x)[0, 0]) == 8.0\n"
-        "print('DEVICES=' + repr(jax.devices()))\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True,
-            text=True, timeout=args.probe_timeout,
+    if args.probe_timeout <= 0:
+        _out("JAX devices: probe skipped (--probe-timeout 0)")
+    else:
+        code = (
+            "import jax, jax.numpy as jnp\n"
+            "x = jnp.ones((8, 8))\n"
+            "assert float((x @ x)[0, 0]) == 8.0\n"
+            "print('DEVICES=' + repr(jax.devices()))\n"
         )
-        for line in proc.stdout.splitlines():
-            if line.startswith("DEVICES="):
-                _out(f"JAX devices: {line[len('DEVICES='):]}")
-                break
-        else:
-            lines = proc.stderr.strip().splitlines()
-            # the actual raised error, not jax's traceback-filter notice
-            errs = [ln for ln in lines if "Error" in ln or "error" in ln]
-            err = (errs or lines or ["backend init failed"])[-1]
-            _out(f"Warning: JAX backend unavailable: {err}")
-    except subprocess.TimeoutExpired:
-        _out(
-            f"Warning: JAX backend init did not answer within "
-            f"{args.probe_timeout}s (accelerator tunnel down?); "
-            "CPU-only workflows unaffected"
-        )
-    except Exception as e:  # status must never crash on its own probe
-        _out(f"Warning: JAX backend probe failed to run: {e}")
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=args.probe_timeout,
+            )
+            for line in proc.stdout.splitlines():
+                if line.startswith("DEVICES="):
+                    _out(f"JAX devices: {line[len('DEVICES='):]}")
+                    break
+            else:
+                lines = proc.stderr.strip().splitlines()
+                # the raised error, not jax's traceback-filter notice
+                errs = [
+                    ln for ln in lines if "Error" in ln or "error" in ln
+                ]
+                err = (errs or lines or ["backend init failed"])[-1]
+                _out(f"Warning: JAX backend unavailable: {err}")
+        except subprocess.TimeoutExpired:
+            _out(
+                f"Warning: JAX backend init did not answer within "
+                f"{args.probe_timeout}s (accelerator tunnel down?); "
+                "CPU-only workflows unaffected"
+            )
+        except Exception as e:  # status must never crash on its own probe
+            _out(f"Warning: JAX backend probe failed to run: {e}")
     try:
         storage.verify_all_data_objects()
         _out("Storage: OK (metadata, event store, model data verified)")

@@ -210,3 +210,24 @@ def test_probe_ok_in_interpret_mode(monkeypatch):
 
     monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
     assert fused_solver_ok(512, 8)
+
+
+@pytest.mark.parametrize("r", [96, 128])
+def test_fused_kernel_high_ranks(r):
+    """Ranks up to 128 (the GJ augmented column rides lane padding only
+    below 128, so 128 exercises the widened [TB, R, R+1] scratch) must
+    plan within budget and match the dense solve."""
+    plan = fused_tile_plan(2000, r, 64, 4)
+    assert plan is not None
+    rng = np.random.default_rng(0)
+    M, B, K = 500, 5, 9
+    table = rng.normal(size=(M, r)).astype(np.float32)
+    idx = rng.integers(0, M, size=(B, K)).astype(np.int32)
+    w = np.ones((B, K), np.float32)
+    reg = np.ones(B, np.float32)
+    x = np.asarray(fused_gather_gram_solve(table, idx, w, w, reg))
+    A = sum(np.outer(table[j], table[j]) for j in idx[0]) + np.eye(r)
+    b = sum(table[j] for j in idx[0])
+    np.testing.assert_allclose(
+        x[0], np.linalg.solve(A, b), rtol=3e-3, atol=3e-3
+    )

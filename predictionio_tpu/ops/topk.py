@@ -14,7 +14,16 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["topk_scores", "batch_topk_scores", "cosine_topk"]
+__all__ = ["topk_scores", "batch_topk_scores", "cosine_topk", "pow2_ceil"]
+
+
+def pow2_ceil(x: int) -> int:
+    """Next power of two >= x (min 1).
+
+    Serving paths round batch sizes AND k up to powers of two so the
+    (B, k)-keyed XLA executables stay bounded at log2 each instead of
+    compiling mid-traffic for every observed value."""
+    return 1 << (max(int(x), 1) - 1).bit_length()
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

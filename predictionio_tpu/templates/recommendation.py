@@ -34,7 +34,7 @@ from ..controller import (
     WorkflowContext,
 )
 from ..models.als import ALSConfig, train_als
-from ..ops.topk import batch_topk_scores, topk_scores
+from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
 from ..storage.columnar import Ratings
 from ._common import DeviceTableMixin, filter_bias_mask
 from ..storage.levents import EventStore
@@ -143,12 +143,6 @@ class TrainingData:
             raise ValueError("no rating events found — is the app empty?")
 
 
-def _pow2_ceil(x: int) -> int:
-    """Next power of two >= x (min 1) — the k/batch-size rounding that
-    bounds the batched scorer's XLA executable key space."""
-    return 1 << (max(int(x), 1) - 1).bit_length()
-
-
 def decode_item_scores(items, vals, ixs) -> tuple:
     """ONE host sync for both top-k outputs (each separate readback costs
     a full RTT on a remote-attached accelerator), then decode to
@@ -160,6 +154,26 @@ def decode_item_scores(items, vals, ixs) -> tuple:
         ItemScore(item=str(i), score=float(s))
         for i, s in zip(ids, vals[ok])
     )
+
+
+def decode_batch_item_scores(items, vals, ixs, nums, valid, k):
+    """Host-side decode for a shape-stable batched top-k: ONE device
+    fetch for the whole batch, then per-query slicing to ``min(num, k)``
+    with -inf-masked entries dropped.  Shared by every template
+    ``batch_predict`` so the filtering/decode contract cannot diverge."""
+    vals, ixs = jax.device_get((vals, ixs))
+    out = [()] * len(nums)
+    for bi, (num, ok_q) in enumerate(zip(nums, valid)):
+        if not ok_q:
+            continue
+        m = min(num, k)
+        ok = np.isfinite(vals[bi, :m])
+        ids = items.decode(ixs[bi, :m][ok])
+        out[bi] = tuple(
+            ItemScore(item=str(it), score=float(s))
+            for it, s in zip(ids, vals[bi, :m][ok])
+        )
+    return out
 
 
 def _resolve_app_id(ctx: WorkflowContext, p: DataSourceParams) -> int:
@@ -421,14 +435,14 @@ class ALSAlgorithm(Algorithm):
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, table, k)
             topk_scores(vec, table, k, bias=bias)
-        k_default = min(_pow2_ceil(10), n)  # num=10 -> k=16
+        k_default = min(pow2_ceil(10), n)  # num=10 -> k=16
         for b in (1, 4, 16, 64):
             vecs = np.zeros((b, rank), np.float32)
             batch_topk_scores(vecs, table, k_default)
             batch_topk_scores(
                 vecs, table, k_default, mask=np.zeros((b, n), np.float32)
             )
-        for k in {min(_pow2_ceil(k), n) for k in (1, 4)}:
+        for k in {min(pow2_ceil(k), n) for k in (1, 4)}:
             batch_topk_scores(np.zeros((1, rank), np.float32), table, k)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
@@ -474,7 +488,7 @@ class ALSAlgorithm(Algorithm):
         if not valid.any():
             return out
         n_items = len(model.items)
-        k = min(_pow2_ceil(int(nums[valid].max())), n_items)
+        k = min(pow2_ceil(int(nums[valid].max())), n_items)
         uvecs = model.user_factors[np.where(valid, uix, 0)]
         masks = [
             self._allowed_mask(model, q) if v else None
@@ -489,20 +503,12 @@ class ALSAlgorithm(Algorithm):
             uvecs, model.device_item_factors(self._serve_dtype()), k,
             mask=mask,
         )
-        vals, ixs = jax.device_get((vals, ixs))  # one host sync, see predict
-        for bi, q in enumerate(queries):
-            if not valid[bi]:
-                continue
-            n = min(q.num, k)
-            ok = np.isfinite(vals[bi, :n])
-            ids = model.items.decode(ixs[bi, :n][ok])
-            out[bi] = PredictedResult(
-                item_scores=tuple(
-                    ItemScore(item=str(it), score=float(s))
-                    for it, s in zip(ids, vals[bi, :n][ok])
-                )
-            )
-        return out
+        decoded = decode_batch_item_scores(
+            model.items, vals, ixs, [q.num for q in queries], valid, k
+        )
+        return [
+            PredictedResult(item_scores=scores) for scores in decoded
+        ]
 
     def predict_rating(self, model: ALSModel, user: str, item: str) -> float:
         """Point prediction for RMSE-style evaluation."""

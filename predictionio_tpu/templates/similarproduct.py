@@ -29,12 +29,13 @@ from ..controller import (
     WorkflowContext,
 )
 from ..models.als import ALSConfig, train_als
-from ..ops.topk import topk_scores
+from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
 
 from ._common import DeviceTableMixin, filter_bias_mask
 from .recommendation import (
     PredictedResult,
     _resolve_app_id,
+    decode_batch_item_scores,
     decode_item_scores,
 )
 
@@ -179,37 +180,94 @@ class SimilarProductAlgorithm(Algorithm):
     # -- serving -----------------------------------------------------------
     def warmup(self, model: SimilarALSModel) -> None:
         """Pre-compile the cosine top-k scorer (and pre-normalize the
-        device table) for the common ``num`` values."""
+        device table) for the common ``num`` values — single-query AND
+        the pow2 batched shapes the serving micro-batcher dispatches."""
         n = len(model.items)
         if n == 0:
             return
         tn = model.device_item_factors_normalized()
-        vec = np.zeros(model.item_factors.shape[1], np.float32)
+        rank = model.item_factors.shape[1]
+        vec = np.zeros(rank, np.float32)
         bias = np.zeros(n, np.float32)
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, tn, k, bias=bias)
+        k_default = min(pow2_ceil(10), n)
+        for b in (1, 4, 16, 64):
+            batch_topk_scores(
+                np.zeros((b, rank), np.float32), tn, k_default,
+                mask=np.zeros((b, n), np.float32),
+            )
+        for k in {min(pow2_ceil(k), n) for k in (1, 4)}:
+            batch_topk_scores(
+                np.zeros((1, rank), np.float32), tn, k,
+                mask=np.zeros((1, n), np.float32),
+            )
 
-    def predict(self, model: SimilarALSModel, query: Query) -> PredictedResult:
+    def _query_vec_and_mask(self, model: SimilarALSModel, query: Query):
+        """Per-query host work shared by predict/batch_predict: mean of
+        the known query-item factors (normalized) + the filter mask.
+        Returns (None, None) for unanswerable queries."""
         known = [model.items.get(i) for i in query.items]
         known = [i for i in known if i >= 0]
         if not known or query.num <= 0:
-            return PredictedResult(item_scores=())
+            return None, None
         qvec = model.item_factors[known].mean(axis=0)
+        qn = qvec / (np.linalg.norm(qvec) + 1e-9)
         # exclude the query items themselves plus any filters
         mask = filter_bias_mask(
             model.items, model.item_props,
             categories=query.categories, whitelist=query.whitelist,
             blacklist=query.blacklist or (), exclude_ix=known,
         )
+        return np.asarray(qn, np.float32), mask
+
+    def predict(self, model: SimilarALSModel, query: Query) -> PredictedResult:
+        qn, mask = self._query_vec_and_mask(model, query)
+        if qn is None:
+            return PredictedResult(item_scores=())
         k = min(query.num, len(model.items))
         # cosine: both sides normalized; the table normalization is cached
         # on the model (computed once, reused every request)
-        qn = qvec / (np.linalg.norm(qvec) + 1e-9)
         tn = model.device_item_factors_normalized()
-        vals, ixs = topk_scores(np.asarray(qn, np.float32), tn, k, bias=mask)
+        vals, ixs = topk_scores(qn, tn, k, bias=mask)
         return PredictedResult(
             item_scores=decode_item_scores(model.items, vals, ixs)
         )
+
+    def batch_predict(self, model: SimilarALSModel, queries):
+        """Eval + micro-batched serving path: one batched cosine matmul
+        for the whole query set.  Same shape-stability contract as the
+        recommendation template: the device batch stays len(queries)
+        (unanswerable queries score a zero vector, discarded on host)
+        and k rounds up to pow2, bounding the XLA executable key space."""
+        out = [PredictedResult(item_scores=()) for _ in queries]
+        n = len(model.items)
+        if n == 0 or not queries:
+            return out
+        rank = model.item_factors.shape[1]
+        qvecs = np.zeros((len(queries), rank), np.float32)
+        masks = np.zeros((len(queries), n), np.float32)
+        valid = np.zeros(len(queries), bool)
+        for bi, q in enumerate(queries):
+            qn, mask = self._query_vec_and_mask(model, q)
+            if qn is None:
+                continue
+            valid[bi] = True
+            qvecs[bi] = qn
+            masks[bi] = mask
+        if not valid.any():
+            return out
+        k = min(
+            pow2_ceil(max(q.num for q, v in zip(queries, valid) if v)), n
+        )
+        tn = model.device_item_factors_normalized()
+        vals, ixs = batch_topk_scores(qvecs, tn, k, mask=masks)
+        decoded = decode_batch_item_scores(
+            model.items, vals, ixs, [q.num for q in queries], valid, k
+        )
+        return [
+            PredictedResult(item_scores=scores) for scores in decoded
+        ]
 
 
 def similarproduct_engine() -> Engine:

@@ -393,3 +393,50 @@ def test_classification_batch_predict_matches_scalar():
         singles = [algo.predict(model, q) for q in queries]
         assert [b.label for b in batch] == [s.label for s in singles], cls
         assert algo.batch_predict(model, []) == []
+
+
+def test_similarproduct_batch_predict_matches_single(similar_ctx):
+    """batch_predict (the micro-batched serving + eval path) must match
+    per-query predict, honor filters, keep the device batch at
+    len(queries) despite unanswerable entries, and round k to pow2."""
+    from predictionio_tpu.templates import similarproduct as smod
+
+    engine = smod.similarproduct_engine()
+    ep = engine.params_from_variant(SIM_VARIANT)
+    models = engine.train(similar_ctx, ep)
+    algo = engine._algorithms(ep)[0]
+    model = models[0]
+
+    shapes = []
+    real = smod.batch_topk_scores
+
+    def spy(vecs, table, k, mask=None):
+        shapes.append((vecs.shape[0], k))
+        return real(vecs, table, k, mask=mask)
+
+    import unittest.mock as mock
+
+    queries = [
+        smod.Query(items=("i0",), num=3),
+        smod.Query(items=("nope",), num=3),          # unanswerable
+        smod.Query(items=("i1", "i3"), num=5),
+        smod.Query(items=("i2",), num=3, categories=("even",)),
+        smod.Query(items=("i4",), num=0),            # unanswerable
+    ]
+    with mock.patch.object(smod, "batch_topk_scores", spy):
+        batch = algo.batch_predict(model, queries)
+    assert shapes == [(5, 8)]  # full batch; k=5 -> pow2 8
+    assert batch[1].item_scores == () and batch[4].item_scores == ()
+    for q, b in zip(queries, batch):
+        single = algo.predict(model, q)
+        assert [s.item for s in b.item_scores] == [
+            s.item for s in single.item_scores
+        ], q
+    # category filter respected in the batched path
+    assert all(
+        int(s.item[1:]) % 2 == 0 for s in batch[3].item_scores
+    )
+    # the serving layer now auto-enables the micro-batcher for this algo
+    from predictionio_tpu.controller.base import Algorithm
+
+    assert type(algo).batch_predict is not Algorithm.batch_predict

@@ -4,7 +4,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-__all__ = ["DeviceTableMixin", "filter_bias_mask"]
+import numpy as np
+
+__all__ = ["DeviceTableMixin", "filter_bias_mask", "warm_batched_topk"]
 
 
 class DeviceTableMixin:
@@ -96,3 +98,28 @@ def filter_bias_mask(
         allowed &= ~np.isin(items.ids.astype(str),
                             np.array(sorted(blacklist), dtype=str))
     return np.where(allowed, 0.0, -np.inf).astype(np.float32)
+
+
+def warm_batched_topk(table, rank: int, n: int,
+                      unmasked_too: bool = False) -> None:
+    """Pre-compile the pow2 batched top-k shapes the serving
+    micro-batcher dispatches (server/microbatch.py pads batches to
+    powers of two; templates round k to pow2): B in {1, 4, 16, 64} at
+    the pow2-rounded default num, plus the small-k shapes at B=1.  ONE
+    definition so the warmup ladder cannot drift from the padding
+    scheme template-by-template."""
+    from ..ops.topk import batch_topk_scores, pow2_ceil
+
+    k_default = min(pow2_ceil(10), n)
+    for b in (1, 4, 16, 64):
+        vecs = np.zeros((b, rank), np.float32)
+        batch_topk_scores(vecs, table, k_default,
+                          mask=np.zeros((b, n), np.float32))
+        if unmasked_too:
+            batch_topk_scores(vecs, table, k_default)
+    for k in {min(pow2_ceil(k), n) for k in (1, 4)}:
+        vecs = np.zeros((1, rank), np.float32)
+        batch_topk_scores(vecs, table, k,
+                          mask=np.zeros((1, n), np.float32))
+        if unmasked_too:
+            batch_topk_scores(vecs, table, k)

@@ -34,13 +34,14 @@ from ..controller import (
     WorkflowContext,
 )
 from ..models.als import ALSConfig, train_als
-from ..ops.topk import topk_scores
+from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
 
-from ._common import DeviceTableMixin, filter_bias_mask
+from ._common import DeviceTableMixin, filter_bias_mask, warm_batched_topk
 from .recommendation import (
     PredictedResult,
     Query,
     _resolve_app_id,
+    decode_batch_item_scores,
     decode_item_scores,
 )
 
@@ -191,30 +192,47 @@ class ECommAlgorithm(Algorithm):
 
     def warmup(self, model: ECommModel) -> None:
         """Pre-compile the biased top-k scorer for the common ``num``
-        values (every e-comm query carries a filter mask)."""
+        values (every e-comm query carries a filter mask), single-query
+        AND the pow2 batched shapes the serving micro-batcher
+        dispatches."""
         n = len(model.items)
         if n == 0:
             return
         table = model.device_item_factors()
-        vec = np.zeros(model.item_factors.shape[1], np.float32)
+        rank = model.item_factors.shape[1]
+        vec = np.zeros(rank, np.float32)
         bias = np.zeros(n, np.float32)
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, table, k, bias=bias)
+        warm_batched_topk(table, rank, n)
+
+    def _query_mask(self, model: ECommModel, query: Query,
+                    unavailable: Optional[set] = None):
+        """Serve-time filter for one query: blacklist + (optionally)
+        the user's SEEN events read from the live event store + the
+        unavailable-items constraint — the reference's predict-time
+        LEventStore reads (`ECommAlgorithm.scala` predict).
+
+        ``unavailable`` lets batch_predict read the batch-invariant
+        constraint entity ONCE instead of once per coalesced query."""
+        black = set(query.blacklist or ())
+        if self.params.unseen_only:
+            black |= self._seen_items(model, query.user)
+        black |= (
+            self._unavailable_items(model)
+            if unavailable is None else unavailable
+        )
+        return filter_bias_mask(
+            model.items, model.item_props,
+            categories=query.categories, whitelist=query.whitelist,
+            blacklist=black,
+        )
 
     def predict(self, model: ECommModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)
         if uix < 0 or query.num <= 0:
             return PredictedResult(item_scores=())
-        black = set(query.blacklist or ())
-        if self.params.unseen_only:
-            black |= self._seen_items(model, query.user)
-        black |= self._unavailable_items(model)
-
-        mask = filter_bias_mask(
-            model.items, model.item_props,
-            categories=query.categories, whitelist=query.whitelist,
-            blacklist=black,
-        )
+        mask = self._query_mask(model, query)
         k = min(query.num, len(model.items))
         vals, ixs = topk_scores(
             np.asarray(model.user_factors[uix], np.float32),
@@ -223,6 +241,42 @@ class ECommAlgorithm(Algorithm):
         return PredictedResult(
             item_scores=decode_item_scores(model.items, vals, ixs)
         )
+
+    def batch_predict(self, model: ECommModel, queries):
+        """Micro-batched serving + eval path: the per-query event-store
+        reads (seen/unavailable) stay host work, the scoring collapses
+        to one batched masked matmul under the same shape-stability
+        contract as the other templates (device batch = len(queries),
+        k rounded to pow2)."""
+        out = [PredictedResult(item_scores=()) for _ in queries]
+        n = len(model.items)
+        if n == 0 or not queries:
+            return out
+        uix = np.array(
+            [model.users.get(q.user) for q in queries], dtype=np.int64
+        )
+        nums = np.array([q.num for q in queries], dtype=np.int64)
+        valid = (uix >= 0) & (nums > 0)
+        if not valid.any():
+            return out
+        masks = np.zeros((len(queries), n), np.float32)
+        unavailable = self._unavailable_items(model)  # batch-invariant
+        for bi, q in enumerate(queries):
+            if valid[bi]:
+                masks[bi] = self._query_mask(model, q, unavailable)
+        k = min(pow2_ceil(int(nums[valid].max())), n)
+        uvecs = np.asarray(
+            model.user_factors[np.where(valid, uix, 0)], np.float32
+        )
+        vals, ixs = batch_topk_scores(
+            uvecs, model.device_item_factors(), k, mask=masks
+        )
+        decoded = decode_batch_item_scores(
+            model.items, vals, ixs, [q.num for q in queries], valid, k
+        )
+        return [
+            PredictedResult(item_scores=scores) for scores in decoded
+        ]
 
 
 def ecommerce_engine() -> Engine:

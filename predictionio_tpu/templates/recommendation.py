@@ -36,7 +36,7 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
 from ..storage.columnar import Ratings
-from ._common import DeviceTableMixin, filter_bias_mask
+from ._common import DeviceTableMixin, filter_bias_mask, warm_batched_topk
 from ..storage.levents import EventStore
 
 
@@ -435,15 +435,7 @@ class ALSAlgorithm(Algorithm):
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, table, k)
             topk_scores(vec, table, k, bias=bias)
-        k_default = min(pow2_ceil(10), n)  # num=10 -> k=16
-        for b in (1, 4, 16, 64):
-            vecs = np.zeros((b, rank), np.float32)
-            batch_topk_scores(vecs, table, k_default)
-            batch_topk_scores(
-                vecs, table, k_default, mask=np.zeros((b, n), np.float32)
-            )
-        for k in {min(pow2_ceil(k), n) for k in (1, 4)}:
-            batch_topk_scores(np.zeros((1, rank), np.float32), table, k)
+        warm_batched_topk(table, rank, n, unmasked_too=True)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)

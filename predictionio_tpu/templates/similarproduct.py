@@ -31,7 +31,7 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
 
-from ._common import DeviceTableMixin, filter_bias_mask
+from ._common import DeviceTableMixin, filter_bias_mask, warm_batched_topk
 from .recommendation import (
     PredictedResult,
     _resolve_app_id,
@@ -191,17 +191,7 @@ class SimilarProductAlgorithm(Algorithm):
         bias = np.zeros(n, np.float32)
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, tn, k, bias=bias)
-        k_default = min(pow2_ceil(10), n)
-        for b in (1, 4, 16, 64):
-            batch_topk_scores(
-                np.zeros((b, rank), np.float32), tn, k_default,
-                mask=np.zeros((b, n), np.float32),
-            )
-        for k in {min(pow2_ceil(k), n) for k in (1, 4)}:
-            batch_topk_scores(
-                np.zeros((1, rank), np.float32), tn, k,
-                mask=np.zeros((1, n), np.float32),
-            )
+        warm_batched_topk(tn, rank, n)
 
     def _query_vec_and_mask(self, model: SimilarALSModel, query: Query):
         """Per-query host work shared by predict/batch_predict: mean of

@@ -440,3 +440,56 @@ def test_similarproduct_batch_predict_matches_single(similar_ctx):
     from predictionio_tpu.controller.base import Algorithm
 
     assert type(algo).batch_predict is not Algorithm.batch_predict
+
+
+def test_ecommerce_batch_predict_matches_single(ecomm_ctx):
+    """Ecommerce batch_predict: per-query event-store filters stay host
+    work (seen/unavailable read per query), scoring collapses to one
+    shape-stable batched matmul; results match per-query predict."""
+    from predictionio_tpu.templates import ecommerce as emod
+
+    ctx, app_id = ecomm_ctx
+    engine = emod.ecommerce_engine()
+    ep = engine.params_from_variant({
+        "datasource": {"params": {"appName": "ecomm"}},
+        "algorithms": [{"name": "ecomm", "params": {
+            "rank": 6, "numIterations": 5, "lambda": 0.1,
+            "unseenOnly": True, "seenEvents": ["view"]}}],
+    })
+    models = engine.train(ctx, ep)
+    algo = engine._algorithms(ep)[0]
+    model = models[0]
+
+    shapes = []
+    real = emod.batch_topk_scores
+
+    def spy(vecs, table, k, mask=None):
+        shapes.append((vecs.shape[0], k))
+        return real(vecs, table, k, mask=mask)
+
+    import unittest.mock as mock
+
+    from predictionio_tpu.templates.recommendation import Query
+
+    queries = [
+        Query(user="u0", num=3),
+        Query(user="ghost", num=3),       # unknown user
+        Query(user="u1", num=5),
+        Query(user="u2", num=3, blacklist=("i0", "i2")),
+    ]
+    with mock.patch.object(emod, "batch_topk_scores", spy):
+        batch = algo.batch_predict(model, queries)
+    assert shapes == [(4, 8)]  # full batch, k=5 -> pow2 8
+    assert batch[1].item_scores == ()
+    for q, b in zip(queries, batch):
+        single = algo.predict(model, q)
+        assert [s.item for s in b.item_scores] == [
+            s.item for s in single.item_scores
+        ], q
+    # unseen-only honored in the batched path: u0 viewed items never
+    # come back
+    seen = algo._seen_items(model, "u0")
+    assert seen and not (
+        {s.item for s in batch[0].item_scores} & seen
+    )
+    assert not {s.item for s in batch[3].item_scores} & {"i0", "i2"}

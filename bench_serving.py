@@ -41,9 +41,16 @@ def main() -> None:
     ap.add_argument("--threads", type=int, default=0,
                     help="also measure under N concurrent client "
                     "threads (p50/p99 per request + aggregate QPS)")
+    ap.add_argument("--http", action="store_true",
+                    help="with --threads: drive a REAL deployed "
+                    "EngineServer over HTTP (full product path: JSON "
+                    "-> auth-free route -> micro-batcher -> device -> "
+                    "JSON), A/B'ing microbatch on vs off")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+    if args.http and args.threads <= 0:
+        ap.error("--http requires --threads N")
 
     if args.platform:
         import os
@@ -103,7 +110,7 @@ def main() -> None:
         )
     )
 
-    if args.threads > 0:
+    if args.threads > 0 and not args.http:
         import concurrent.futures
 
         from predictionio_tpu.server.microbatch import MicroBatcher
@@ -215,6 +222,128 @@ def main() -> None:
                 }
             )
         )
+
+    if args.http:
+        _bench_http(args, model, rng)
+
+
+def _bench_http(args, model, rng) -> None:
+    """Full product path under concurrent HTTP load: a deployed
+    EngineServer with the recommendation algorithm serving the
+    synthetic model, N urllib clients, microbatch on vs off."""
+    import concurrent.futures
+    import json as _json
+    import urllib.request
+
+    from predictionio_tpu.controller.base import DataSource, WorkflowContext
+    from predictionio_tpu.controller.engine import SimpleEngine
+    from predictionio_tpu.server.serving import EngineServer, ServerConfig
+    from predictionio_tpu.storage.registry import Storage
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm, Query as RecQuery,
+    )
+    from predictionio_tpu.workflow.params import WorkflowParams
+    from predictionio_tpu.workflow.train import run_train
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return None
+
+    class PrebuiltALS(ALSAlgorithm):
+        """Serve the prebuilt synthetic model (training is not what
+        this bench measures).  query_class is explicit because the
+        decoder's module-level Query convention resolves against THIS
+        module, not the template's."""
+
+        query_class = RecQuery
+
+        def train(self, ctx, data):
+            return model
+
+    storage = Storage({
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM2",
+        "PIO_STORAGE_SOURCES_MEM2_TYPE": "memory",
+    })
+    ctx = WorkflowContext(storage=storage)
+    engine = SimpleEngine(DS, PrebuiltALS)
+    ep = engine.params_from_variant({})
+    # save_model=False: deploy "retrains" via PrebuiltALS.train, which
+    # hands back the in-memory model — no orphaned ~28 MB pickle in the
+    # user's model dir per bench run
+    iid = run_train(engine, ep, ctx=ctx, engine_variant="bench.json",
+                    workflow_params=WorkflowParams(save_model=False))
+
+    per_thread = max(args.n // args.threads, 25)
+    users = rng.integers(0, args.users, (args.threads, per_thread))
+
+    def measure(microbatch):
+        srv = EngineServer(
+            engine, ep, iid, ctx=ctx,
+            config=ServerConfig(port=0, microbatch=microbatch),
+            engine_variant="bench.json",
+        )
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.config.port}"
+
+        def one(u):
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=_json.dumps(
+                    {"user": f"u{u}", "num": args.num}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = _json.loads(r.read().decode())
+            assert len(body["itemScores"]) == args.num
+            return body
+
+        def client(tid):
+            lats = np.empty(per_thread)
+            for j in range(per_thread):
+                t0 = time.perf_counter()
+                one(int(users[tid, j]))
+                lats[j] = time.perf_counter() - t0
+            return lats
+
+        # warm every pow2 batch size the padded batcher can dispatch
+        # (a mid-run first-compile would land in the reported p99), then
+        # one HTTP round per thread; stats reset so the JSON describes
+        # timed traffic only
+        if srv.batcher is not None:
+            dq = srv.query_decoder({"user": "u0", "num": args.num})
+            bsz = 1
+            while bsz <= min(64, args.threads * 2):
+                srv.batcher.batch_fn([dq] * bsz)
+                bsz *= 2
+        with concurrent.futures.ThreadPoolExecutor(args.threads) as ex:
+            list(ex.map(lambda t: one(int(users[t, 0])),
+                        range(args.threads)))  # warm
+            if srv.batcher is not None:
+                srv.batcher.reset_stats()
+            t0 = time.perf_counter()
+            lats = np.concatenate(list(ex.map(client, range(args.threads))))
+            wall = time.perf_counter() - t0
+        stats = srv.status_json().get("microbatch")
+        srv.stop()
+        p50, p99 = np.percentile(lats, [50, 99])
+        return p50, p99, len(lats) / wall, stats
+
+    for mode in ("off", "auto"):
+        p50, p99, qps, stats = measure(mode)
+        print(json.dumps({
+            "metric": "serving_http_concurrent_p99_ms",
+            "value": round(p99 * 1e3, 3),
+            "unit": "ms",
+            "threads": args.threads,
+            "microbatch": mode,
+            "p50_ms": round(p50 * 1e3, 3),
+            "qps": round(qps, 1),
+            **({"max_batch_seen": stats["maxBatchSeen"]} if stats else {}),
+        }), flush=True)
 
 
 if __name__ == "__main__":

@@ -38,8 +38,10 @@ def main() -> None:
     mode = sys.argv[8] if len(sys.argv) > 8 else ""
     if home:
         return _run_train_end_to_end(pid, home, out, local=(mode == "local"))
-    if mode == "sharded":
-        return _run_sharded_trainer(pid, db, exch, out)
+    if mode.startswith("sharded"):
+        # "sharded" or "sharded:<solver>" (e.g. sharded:fused)
+        _, _, solver = mode.partition(":")
+        return _run_sharded_trainer(pid, db, exch, out, solver or "xla")
 
     from predictionio_tpu.models.als import ALSConfig, train_als
     from predictionio_tpu.parallel.ingest import (
@@ -80,7 +82,8 @@ def main() -> None:
     print("WORKER_OK", pid, flush=True)
 
 
-def _run_sharded_trainer(pid: int, db: str, exch: str, out: str) -> None:
+def _run_sharded_trainer(pid: int, db: str, exch: str, out: str,
+                         solver: str = "xla") -> None:
     """Sharded-COO multi-host path: sharded scan -> id exchange ->
     row-owner COO exchange -> ALSTrainer.distributed.  No process ever
     holds the full COO; the parent asserts per-process rating bytes are
@@ -90,7 +93,7 @@ def _run_sharded_trainer(pid: int, db: str, exch: str, out: str) -> None:
     from predictionio_tpu.parallel.mesh import make_mesh
 
     cfg = ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3,
-                    factor_placement="sharded")
+                    factor_placement="sharded", solver=solver)
     from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
 
     es = SQLiteEventStore(db)
@@ -100,6 +103,10 @@ def _run_sharded_trainer(pid: int, db: str, exch: str, out: str) -> None:
         app_id=1, event_names=["rate"],
     )
     assert tr.staging == "sharded-distributed", tr.staging
+    # a requested kernel solver must actually RESOLVE (the loud-degrade
+    # contract): multi-process is exactly where a silent fallback would
+    # otherwise hide
+    assert tr.solver == solver, (tr.solver, solver)
     # rating bytes THIS process holds on its devices (the scaling claim)
     local_nnz = sum(
         s.data.shape[0]

@@ -51,6 +51,9 @@ class ForestModel:
     threshold: np.ndarray   # float32; go left if x[f] <= thr
     label: np.ndarray       # int32 majority label at every node
     num_classes: int
+    # input feature width (for deploy-time warmup of the jitted batch
+    # walk); -1 on models persisted before this field existed
+    n_features: int = -1
 
     @property
     def max_depth(self) -> int:
@@ -168,7 +171,7 @@ def train_forest(
         )
     return ForestModel(
         feature=feature, threshold=threshold, label=label,
-        num_classes=cfg.num_classes,
+        num_classes=cfg.num_classes, n_features=X.shape[1],
     )
 
 

@@ -195,6 +195,18 @@ class RandomForestAlgorithm(Algorithm):
         )
         return {"forest": forest, "classes": classes}
 
+    def warmup(self, model) -> None:
+        """Pre-compile the jitted forest walk for the pow2 batch sizes
+        the serving micro-batcher dispatches (the walk's executable is
+        keyed on batch size; every other classification algorithm here
+        is pure numpy and needs no warmup).  Models persisted before
+        n_features existed skip it (first query compiles instead)."""
+        f = model["forest"].n_features
+        if f <= 0:
+            return
+        for b in (1, 4, 16, 64):
+            forest_predict(model["forest"], np.zeros((b, f), np.float32))
+
     def predict(self, model, query: Query) -> PredictedResult:
         ix = forest_predict(
             model["forest"], np.asarray([query.features], np.float32)

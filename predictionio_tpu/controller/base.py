@@ -146,11 +146,14 @@ class Algorithm(Generic[PD, M, Q, P]):
     def train(self, ctx: WorkflowContext, prepared_data: PD) -> M:
         raise NotImplementedError
 
-    def warmup(self, model: M) -> None:  # noqa: B027 — optional hook
+    def warmup(self, model: M,  # noqa: B027 — optional hook
+               max_batch: int = 64) -> None:
         """Pre-compile the scoring path at deploy time so the first real
         query doesn't pay XLA compilation (the AOT-dispatch obligation of
         a <100 ms-class rec server; reference deploys are warm because
-        JVM models need no compile)."""
+        JVM models need no compile).  ``max_batch`` is the serving
+        micro-batcher's configured maximum, so batched warmups can cover
+        every batch size its pow2 padding will dispatch."""
 
     def predict(self, model: M, query: Q) -> P:
         raise NotImplementedError

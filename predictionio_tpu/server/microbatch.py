@@ -42,7 +42,39 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
-__all__ = ["MicroBatcher"]
+__all__ = ["MicroBatcher", "dispatchable_sizes"]
+
+# distinguishes "no result produced" from a legitimate None result —
+# batch_fns whose valid outputs include None must not have them
+# clobbered by the leader-abort guard
+_UNSET = object()
+
+
+def _pad_size(n: int) -> int:
+    """The batch size ``n`` items actually dispatch as under pow2
+    padding — THE definition; the warmup ladder derives from it."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def dispatchable_sizes(max_batch: int) -> list[int]:
+    """Every batch size a padding batcher with this ``max_batch`` can
+    dispatch: 1, 2, 4, ..., _pad_size(max_batch).  Template warmups
+    build their compile ladders from THIS (templates/_common.pow2_ladder
+    delegates here) so a change to the padding scheme cannot silently
+    desynchronize warmup from dispatch.
+
+    ``max_batch <= 0`` means "no batcher at all" (serving passes 0 when
+    micro-batching is off or auto-gated off): the ladder is EMPTY —
+    every request then runs the per-query predict path, and compiling
+    batched executables would be pure wasted XLA work at deploy/reload."""
+    if max_batch <= 0:
+        return []
+    top = _pad_size(max_batch)
+    b, sizes = 1, []
+    while b <= top:
+        sizes.append(b)
+        b <<= 1
+    return sizes
 
 
 class _Entry:
@@ -51,7 +83,7 @@ class _Entry:
     def __init__(self, item):
         self.item = item
         self.done = False
-        self.value = None
+        self.value = _UNSET
         self.error: Exception | None = None
 
 
@@ -118,32 +150,53 @@ class MicroBatcher:
                 self._cond.wait()
         if entry.error is not None:
             raise entry.error
-        return entry.value
+        return entry.value if entry.value is not _UNSET else None
 
     def _lead(self, batch: list[_Entry]) -> None:
         """Run one batch on the calling thread.  Called with the lock
-        HELD; releases it around the device call and re-acquires."""
-        if self.max_wait_s > 0 and len(batch) < self.max_batch:
-            # optional accumulation window (off by default): give
-            # near-simultaneous arrivals a chance to join this batch.
-            # Arrivals notify; absorb after EVERY wake (timeout
-            # included) so nothing queued during the window is left
-            # behind for the next leader.
-            deadline = time.monotonic() + self.max_wait_s
-            while len(batch) < self.max_batch:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    break
-                self._cond.wait(left)
-                take = self.max_batch - len(batch)
-                batch += self._pending[:take]
-                del self._pending[:take]
-        self._cond.release()
+        HELD; releases it around the device call and re-acquires.
+
+        The ENTIRE leader turn — accumulation window included — sits
+        inside one try/finally: a BaseException landing anywhere in it
+        (``Condition.wait`` re-acquires the lock before raising, so the
+        lock state is consistent) must still mark every claimed entry
+        done and clear ``_running``, or the followers block forever and
+        every future ``submit`` hangs behind a leaderless batcher."""
+        completed = False
         try:
-            self._run_batch(batch)
+            if self.max_wait_s > 0 and len(batch) < self.max_batch:
+                # optional accumulation window (off by default): give
+                # near-simultaneous arrivals a chance to join this batch.
+                # Arrivals notify; absorb after EVERY wake (timeout
+                # included) so nothing queued during the window is left
+                # behind for the next leader.
+                deadline = time.monotonic() + self.max_wait_s
+                while len(batch) < self.max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                    take = self.max_batch - len(batch)
+                    batch += self._pending[:take]
+                    del self._pending[:take]
+            self._cond.release()
+            try:
+                self._run_batch(batch)
+            finally:
+                self._cond.acquire()
+            completed = True
         finally:
-            self._cond.acquire()
             for e in batch:
+                if not completed and e.value is _UNSET and e.error is None:
+                    # a BaseException (KeyboardInterrupt/SystemExit) tore
+                    # through the leader: _run_batch's except clause only
+                    # handles Exception, so coalesced followers would
+                    # otherwise wake with value=None and serve garbage.
+                    # The interrupt propagates to the leader's caller;
+                    # followers re-raise this instead.
+                    e.error = RuntimeError(
+                        "batch leader aborted before producing results"
+                    )
                 e.done = True
             self._running = False
             self.batches += 1
@@ -165,8 +218,7 @@ class MicroBatcher:
             items = [e.item for e in batch]
             n = len(items)
             if self.pad_batches and n > 1:
-                padded = 1 << (n - 1).bit_length()
-                items = items + [items[-1]] * (padded - n)
+                items = items + [items[-1]] * (_pad_size(n) - n)
             results = self.batch_fn(items)
             if len(results) != len(items):
                 raise RuntimeError(

@@ -62,6 +62,22 @@ class ServerConfig:
         self.microbatch_max = microbatch_max
 
 
+def _takes_max_batch(fn: Callable) -> bool:
+    """Whether a warmup hook accepts the ``max_batch`` keyword (older
+    third-party algorithms may still have the one-arg signature).
+    Hooks taking ``**kwargs`` (or whose visible signature is erased by
+    a plain decorator) count as accepting it."""
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return "max_batch" in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
 def _default_query_decoder(engine: Engine, engine_params: EngineParams):
     name, _ = engine_params.algorithms[0]
     cls = engine._lookup(engine.algorithm_class_map, name, "algorithm")
@@ -155,10 +171,32 @@ class EngineServer(HTTPServerBase):
         algorithms, models, serving = prepare_deploy_components(
             self.engine, engine_params, instance_id, ctx=self.ctx
         )
+        # the batcher decides which batch sizes serving can dispatch, so
+        # build it BEFORE warmup: with batching off (or auto-gated off)
+        # every request runs B=1 and compiling the batched ladder at
+        # deploy/reload time would be pure wasted XLA work
+        batcher = self._make_batcher(algorithms, models)
+        # 0 = "no batched path at all" (empty warmup ladder); a real
+        # batcher with microbatch_max=1 still needs its B=1 shapes
+        warm_max = self.config.microbatch_max if batcher is not None else 0
         for algo, model in zip(algorithms, models):
             t0 = time.time()
             try:
-                algo.warmup(model)
+                # pass the batcher's real maximum so the warmup ladder
+                # covers every pow2 size the padding can dispatch; algos
+                # with the pre-max_batch one-arg signature still work
+                if _takes_max_batch(algo.warmup):
+                    try:
+                        algo.warmup(model, max_batch=warm_max)
+                    except TypeError:
+                        # a decorator-erased signature (*args/**kwargs
+                        # wrapper around an old one-arg hook) can lie
+                        # about accepting max_batch; retry plain once
+                        # rather than regress a hook that warmed fine
+                        # before max_batch existed
+                        algo.warmup(model)
+                else:
+                    algo.warmup(model)
             except Exception:
                 logger.exception(
                     "warmup failed for %s (first query will compile)",
@@ -169,7 +207,6 @@ class EngineServer(HTTPServerBase):
                 if dt > 0.05:
                     logger.info("%s warmed up in %.2fs",
                                 type(algo).__name__, dt)
-        batcher = self._make_batcher(algorithms, models)
         with self._lock:
             self.models = models
             self.algorithms = algorithms

@@ -6,7 +6,8 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["DeviceTableMixin", "filter_bias_mask", "warm_batched_topk"]
+__all__ = ["DeviceTableMixin", "filter_bias_mask", "pow2_ladder",
+           "warm_batched_topk"]
 
 
 class DeviceTableMixin:
@@ -100,18 +101,38 @@ def filter_bias_mask(
     return np.where(allowed, 0.0, -np.inf).astype(np.float32)
 
 
+def pow2_ladder(max_batch: int) -> list[int]:
+    """Every batch size the micro-batcher's pow2 padding can dispatch
+    for a given ``max_batch`` — including the pow2 CEILING of a
+    non-pow2 max_batch (a 33..48-item batch under max_batch=48 pads to
+    64, so 64 is dispatchable).  Delegates to the batcher's own
+    ``dispatchable_sizes`` so the warmup ladder is derived from the
+    padding scheme, not a parallel re-implementation of it."""
+    from ..server.microbatch import dispatchable_sizes
+
+    return dispatchable_sizes(max_batch)
+
+
 def warm_batched_topk(table, rank: int, n: int,
-                      unmasked_too: bool = False) -> None:
+                      unmasked_too: bool = False,
+                      max_batch: int = 64) -> None:
     """Pre-compile the pow2 batched top-k shapes the serving
     micro-batcher dispatches (server/microbatch.py pads batches to
-    powers of two; templates round k to pow2): B in {1, 4, 16, 64} at
-    the pow2-rounded default num, plus the small-k shapes at B=1.  ONE
-    definition so the warmup ladder cannot drift from the padding
-    scheme template-by-template."""
+    powers of two; templates round k to pow2): EVERY B in
+    ``pow2_ladder(max_batch)`` at the pow2-rounded default num, plus
+    the small-k shapes at B=1.  Every pow2 rung, not a subset — a size
+    the padding can produce but the warmup skipped compiles on first
+    exposure mid-traffic, which is exactly the p99 spike the padding
+    exists to avoid (ADVICE r4).  ``max_batch <= 0`` (no batcher: the
+    per-query predict path serves everything) skips the batched warms
+    entirely — they would compile executables nothing dispatches."""
     from ..ops.topk import batch_topk_scores, pow2_ceil
 
+    ladder = pow2_ladder(max_batch)
+    if not ladder:
+        return
     k_default = min(pow2_ceil(10), n)
-    for b in (1, 4, 16, 64):
+    for b in ladder:
         vecs = np.zeros((b, rank), np.float32)
         batch_topk_scores(vecs, table, k_default,
                           mask=np.zeros((b, n), np.float32))

@@ -195,16 +195,20 @@ class RandomForestAlgorithm(Algorithm):
         )
         return {"forest": forest, "classes": classes}
 
-    def warmup(self, model) -> None:
+    def warmup(self, model, max_batch: int = 64) -> None:
         """Pre-compile the jitted forest walk for the pow2 batch sizes
         the serving micro-batcher dispatches (the walk's executable is
         keyed on batch size; every other classification algorithm here
         is pure numpy and needs no warmup).  Models persisted before
         n_features existed skip it (first query compiles instead)."""
+        from ._common import pow2_ladder
+
         f = model["forest"].n_features
         if f <= 0:
             return
-        for b in (1, 4, 16, 64):
+        # solo predicts also run the jitted walk at B=1, so B=1 stays
+        # warmed even with the batcher off (empty ladder)
+        for b in pow2_ladder(max_batch) or [1]:
             forest_predict(model["forest"], np.zeros((b, f), np.float32))
 
     def predict(self, model, query: Query) -> PredictedResult:

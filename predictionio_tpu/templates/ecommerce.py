@@ -190,7 +190,7 @@ class ECommAlgorithm(Algorithm):
             logger.error("error reading unavailableItems: %s", e)
             return set()
 
-    def warmup(self, model: ECommModel) -> None:
+    def warmup(self, model: ECommModel, max_batch: int = 64) -> None:
         """Pre-compile the biased top-k scorer for the common ``num``
         values (every e-comm query carries a filter mask), single-query
         AND the pow2 batched shapes the serving micro-batcher
@@ -204,7 +204,7 @@ class ECommAlgorithm(Algorithm):
         bias = np.zeros(n, np.float32)
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, table, k, bias=bias)
-        warm_batched_topk(table, rank, n)
+        warm_batched_topk(table, rank, n, max_batch=max_batch)
 
     def _query_mask(self, model: ECommModel, query: Query,
                     unavailable: Optional[set] = None):

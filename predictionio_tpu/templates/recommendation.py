@@ -413,7 +413,7 @@ class ALSAlgorithm(Algorithm):
             blacklist=query.blacklist or (), none_if_empty=True,
         )
 
-    def warmup(self, model: ALSModel) -> None:
+    def warmup(self, model: ALSModel, max_batch: int = 64) -> None:
         """Compile the top-k scorers for the common ``num`` values (the
         static k arg keys the executable) before the first real query.
 
@@ -422,9 +422,10 @@ class ALSAlgorithm(Algorithm):
         included — routes through :meth:`batch_predict`, whose
         executable key space is bounded to (pow2 B) x (pow2 k) x
         (masked?) by the shape-stability contract there.  This warms
-        B in {1, 4, 16, 64} at the pow2-rounded default num (k=16)
-        plus the small-k sizes at B=1; remaining shapes compile once
-        under load and land in the persistent compilation cache."""
+        every pow2 B the batcher's padding can dispatch up to
+        ``max_batch`` at the pow2-rounded default num (k=16) plus the
+        small-k sizes at B=1; remaining shapes compile once under load
+        and land in the persistent compilation cache."""
         n = len(model.items)
         if n == 0:
             return
@@ -435,7 +436,8 @@ class ALSAlgorithm(Algorithm):
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, table, k)
             topk_scores(vec, table, k, bias=bias)
-        warm_batched_topk(table, rank, n, unmasked_too=True)
+        warm_batched_topk(table, rank, n, unmasked_too=True,
+                          max_batch=max_batch)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)

@@ -178,7 +178,7 @@ class SimilarProductAlgorithm(Algorithm):
         )
 
     # -- serving -----------------------------------------------------------
-    def warmup(self, model: SimilarALSModel) -> None:
+    def warmup(self, model: SimilarALSModel, max_batch: int = 64) -> None:
         """Pre-compile the cosine top-k scorer (and pre-normalize the
         device table) for the common ``num`` values — single-query AND
         the pow2 batched shapes the serving micro-batcher dispatches."""
@@ -191,7 +191,7 @@ class SimilarProductAlgorithm(Algorithm):
         bias = np.zeros(n, np.float32)
         for k in {min(k, n) for k in (1, 4, 10, 20)}:
             topk_scores(vec, tn, k, bias=bias)
-        warm_batched_topk(tn, rank, n)
+        warm_batched_topk(tn, rank, n, max_batch=max_batch)
 
     def _query_vec_and_mask(self, model: SimilarALSModel, query: Query):
         """Per-query host work shared by predict/batch_predict: mean of

@@ -14,6 +14,7 @@ that `pio-tpu train`/`deploy` consume directly.
 from __future__ import annotations
 
 import json
+import stat
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Optional
@@ -291,10 +292,20 @@ def _extract_archive(archive: Path, dest: Path) -> None:
         import zipfile
 
         with zipfile.ZipFile(archive) as zf:
-            members = [m for m in zf.namelist() if not m.endswith("/")]
-            _check_members(members, archive)
-            for m in members:
-                out = dest / m
+            infos = [m for m in zf.infolist()
+                     if not m.filename.endswith("/")]
+            # zip stores unix mode bits in the high 16 of external_attr;
+            # a symlink entry would otherwise materialize as a regular
+            # file holding the link target — reject like the tar path
+            for m in infos:
+                if stat.S_ISLNK(m.external_attr >> 16):
+                    raise ValueError(
+                        f"archive {archive.name} contains link member "
+                        f"{m.filename!r}; refusing to extract"
+                    )
+            _check_members([m.filename for m in infos], archive)
+            for m in infos:
+                out = dest / m.filename
                 out.parent.mkdir(parents=True, exist_ok=True)
                 out.write_bytes(zf.read(m))
     elif name.endswith((".tar", ".tar.gz", ".tgz")):
@@ -326,10 +337,22 @@ def _extract_archive(archive: Path, dest: Path) -> None:
 
 
 def _check_members(names: list[str], archive: Path) -> None:
-    """Reject absolute / traversal member paths (untrusted archives)."""
+    """Reject absolute / traversal member paths (untrusted archives).
+
+    Split on BOTH separators, not the host convention: on POSIX,
+    ``Path('..\\x')`` is one component, so a Windows-style traversal
+    member would pass a pathlib-only check (harmless here, traversal if
+    this ever runs on Windows).  Drive-letter prefixes likewise."""
     for m in names:
-        p = Path(m)
-        if p.is_absolute() or ".." in p.parts:
+        parts = m.replace("\\", "/").split("/")
+        if (
+            m.startswith(("/", "\\"))
+            or ".." in parts
+            # Windows drive prefix: single letter + ':' at the START
+            # only — a POSIX member like '10:30.txt' or 'ab:c' stays
+            # extractable; 'c:…' is rejected as a possible drive path
+            or (len(m) >= 2 and m[0].isalpha() and m[1] == ":")
+        ):
             raise ValueError(
                 f"archive {archive.name} contains unsafe member path "
                 f"{m!r}; refusing to extract"

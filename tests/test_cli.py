@@ -313,6 +313,31 @@ def test_template_get_from_archive(cli, tmp_path):
     assert code == 1 and "not found" in out
 
 
+def test_template_archive_windows_and_symlink_members(tmp_path):
+    """Backslash traversal, drive-letter prefixes, and zip symlink
+    entries are rejected regardless of host OS (ADVICE r4: a
+    pathlib-only check treats '..\\x' as one component on POSIX, and a
+    zip symlink would materialize as a file holding the link target)."""
+    import zipfile
+
+    from predictionio_tpu.tools.template_gallery import _extract_archive
+
+    for member in ("..\\escape.py", "C:/x.py", "C:\\x.py", "\\abs.py"):
+        evil = tmp_path / "evil.zip"
+        with zipfile.ZipFile(evil, "w") as zf:
+            zf.writestr(member, "boom")
+        with pytest.raises(ValueError, match="unsafe"):
+            _extract_archive(evil, tmp_path / "out")
+
+    link = tmp_path / "link.zip"
+    with zipfile.ZipFile(link, "w") as zf:
+        info = zipfile.ZipInfo("engine.json")
+        info.external_attr = 0o120777 << 16  # S_IFLNK | 0777
+        zf.writestr(info, "/etc/passwd")
+    with pytest.raises(ValueError, match="link member"):
+        _extract_archive(link, tmp_path / "out2")
+
+
 def test_template_min_version_gate(cli, tmp_path):
     from predictionio_tpu.tools.template_gallery import (
         TemplateVersionError, verify_template_min_version)

@@ -100,6 +100,59 @@ def test_one_bad_item_does_not_poison_the_batch():
                 assert f.result(5) == f"ok:{x}"
 
 
+def test_base_exception_fails_followers_not_none():
+    """A BaseException (KeyboardInterrupt) tearing through the leader
+    must surface as an ERROR to coalesced followers — not as a silent
+    value=None result that downstream serving would treat as a
+    prediction (ADVICE r4)."""
+    started, release = threading.Event(), threading.Event()
+    calls = []
+
+    def batch_fn(xs):
+        calls.append(len(xs))
+        if len(calls) == 1:  # hold the device so arrivals coalesce
+            started.set()
+            release.wait(5)
+            return [f"ok:{x}" for x in xs]
+        if len(calls) == 2:  # the coalesced batch's leader is killed
+            raise KeyboardInterrupt
+        return [f"ok:{x}" for x in xs]
+
+    b = MicroBatcher(batch_fn)
+    with concurrent.futures.ThreadPoolExecutor(4) as ex:
+        f0 = ex.submit(b.submit, 0)
+        assert started.wait(5)
+        futs = [ex.submit(b.submit, i) for i in (1, 2)]
+        # wait (deterministically) until both are queued behind the
+        # in-flight batch, so one will lead the other as a follower
+        deadline = time.time() + 5
+        while True:
+            with b._cond:
+                if len(b._pending) == 2:
+                    break
+            assert time.time() < deadline, "arrivals never queued"
+            time.sleep(0.005)
+        release.set()
+        assert f0.result(5) == "ok:0"
+        excs = []
+        for f in futs:
+            try:
+                f.result(5)
+                excs.append(None)
+            except BaseException as e:  # noqa: BLE001 — the assertion
+                excs.append(e)
+    # the leader re-raises the interrupt; the follower gets a loud
+    # error, never a None result
+    assert None not in excs
+    kinds = {type(e) for e in excs}
+    assert KeyboardInterrupt in kinds
+    for e in excs:
+        if isinstance(e, RuntimeError):
+            assert "aborted" in str(e)
+    # the batcher recovers
+    assert b.submit(9) == "ok:9"
+
+
 def test_length_mismatch_is_an_error():
     b = MicroBatcher(lambda xs: [1])
     b2 = MicroBatcher(lambda xs: list(xs) + [99])

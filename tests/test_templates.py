@@ -493,3 +493,47 @@ def test_ecommerce_batch_predict_matches_single(ecomm_ctx):
         {s.item for s in batch[0].item_scores} & seen
     )
     assert not {s.item for s in batch[3].item_scores} & {"i0", "i2"}
+
+
+def test_warmup_ladder_covers_batcher_padding():
+    """The warmup ladder must cover EVERY batch size the micro-batcher's
+    pow2 padding can dispatch — including the pow2 CEILING of a
+    non-pow2 max_batch (a 33..48-item batch under max_batch=48 pads to
+    64), and the server must thread its configured microbatch_max into
+    the warmup hook (ADVICE r4: sizes skipped by warmup compile
+    mid-traffic, the exact p99 spike the padding exists to avoid)."""
+    import inspect
+
+    from predictionio_tpu.server.serving import _takes_max_batch
+    from predictionio_tpu.templates._common import pow2_ladder
+
+    assert pow2_ladder(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert pow2_ladder(48) == [1, 2, 4, 8, 16, 32, 64]
+    assert pow2_ladder(1) == [1]
+    assert pow2_ladder(0) == []  # no batcher -> no batched warms
+
+    # every template warmup accepts the server's max_batch
+    from predictionio_tpu.templates.classification import (
+        RandomForestAlgorithm,
+    )
+    from predictionio_tpu.templates.ecommerce import ECommAlgorithm
+    from predictionio_tpu.templates.recommendation import ALSAlgorithm
+    from predictionio_tpu.templates.similarproduct import (
+        SimilarProductAlgorithm,
+    )
+
+    for cls in (ALSAlgorithm, SimilarProductAlgorithm, ECommAlgorithm,
+                RandomForestAlgorithm):
+        assert "max_batch" in inspect.signature(cls.warmup).parameters, cls
+
+    # the server-side dispatch recognizes old one-arg hooks
+    class OldStyle:
+        def warmup(self, model):
+            pass
+
+    class NewStyle:
+        def warmup(self, model, max_batch=64):
+            pass
+
+    assert not _takes_max_batch(OldStyle().warmup)
+    assert _takes_max_batch(NewStyle().warmup)

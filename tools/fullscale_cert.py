@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Full-scale CPU certification: the 20M-rating path end to end, once.
+
+VERDICT r4 #2: every round-4 artifact was <= 2% scale or a component
+benchmark; the 20M-rating path — import -> store -> columnar scan ->
+bucketize -> 20-iteration train -> checkpoint -> deploy smoke — had
+never been executed end-to-end by the code as it stands.  This runs it
+at scale 1.0 on CPU, untimed *against the <60 s target* (that target is
+a TPU number) but with every stage's wall time, peak host RSS, staging
+bytes, and holdout RMSE recorded, so the host-side claims (import
+throughput, columnar scan, id encode, bucketize memory) are certified
+independent of the tunnel.
+
+Reference behavior being matched: the quickstart train path of
+`examples/scala-parallel-recommendation/custom-query/src/main/scala/
+ALSAlgorithm.scala:24-77` (read events -> MLlib ALS train -> persist),
+at the ML-20M scale of BASELINE.md.
+
+Run detached (it is a background certification, not a benchmark):
+
+    JAX_PLATFORMS=cpu nohup python tools/fullscale_cert.py \
+        > fullscale_cert.log 2>&1 &
+
+Writes BENCH_FULLSCALE_CPU.json at the repo root and prints the same
+JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import resource
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+OUT_PATH = REPO / "BENCH_FULLSCALE_CPU.json"
+
+
+def peak_rss_gb() -> float:
+    # ru_maxrss is KiB on Linux
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1024**2)
+
+
+def log(msg: str) -> None:
+    print(f"# {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--holdout", type=float, default=0.05)
+    ap.add_argument("--checkpoint-every", type=int, default=5)
+    ap.add_argument("--out", type=Path, default=OUT_PATH)
+    args = ap.parse_args()
+
+    from predictionio_tpu.parallel.mesh import force_platform
+
+    force_platform("cpu")
+    import jax
+
+    from bench import synth_ml20m
+    from predictionio_tpu.models.als import ALSConfig, ALSTrainer, rmse
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+    from predictionio_tpu.tools.import_export import import_ratings_csv
+    from predictionio_tpu.workflow.checkpoint import StepCheckpointer
+
+    t_run0 = time.time()
+    stages: dict[str, float] = {}
+    rec: dict = {
+        "metric": "fullscale_cpu_certification",
+        "unit": "s",
+        "scale": args.scale,
+        "rank": args.rank,
+        "iters": args.iters,
+        "platform": jax.default_backend(),
+        "nproc": 1,
+    }
+
+    u, i, v, n_users, n_items = synth_ml20m(args.scale)
+    rec["n_ratings"] = int(len(v))
+    rec["n_users"] = int(n_users)
+    rec["n_items"] = int(n_items)
+    log(f"synth: {len(v):,} ratings, {n_users:,}x{n_items:,}")
+
+    tmp = tempfile.mkdtemp(prefix="pio_fullscale_cert_")
+    try:
+        # -- source file (uncounted: the user already has their file) --
+        t0 = time.time()
+        csv = Path(tmp) / "ratings.csv"
+        with open(csv, "w") as f:
+            for s in range(0, len(v), 1 << 20):
+                e = min(s + (1 << 20), len(v))
+                np.savetxt(
+                    f,
+                    np.stack([u[s:e], i[s:e], v[s:e]], axis=1),
+                    fmt=["%d", "%d", "%.1f"],
+                    delimiter="::",
+                )
+        stages["write_source_file"] = round(time.time() - t0, 2)
+        rec["source_file_mb"] = round(csv.stat().st_size / 1e6, 1)
+        log(f"source file written: {rec['source_file_mb']} MB")
+
+        # -- import: file -> event store (native scanner fast path) --
+        t0 = time.time()
+        store = SQLiteEventStore(str(Path(tmp) / "events.db"))
+        n_imported = import_ratings_csv(csv, store, app_id=1)
+        stages["import"] = round(time.time() - t0, 2)
+        rec["n_events_imported"] = int(n_imported)
+        rec["import_events_per_s"] = round(n_imported / stages["import"], 1)
+        rec["events_db_mb"] = round(
+            (Path(tmp) / "events.db").stat().st_size / 1e6, 1
+        )
+        log(f"imported {n_imported:,} events "
+            f"({rec['import_events_per_s']:,.0f}/s, "
+            f"db {rec['events_db_mb']} MB)")
+
+        # -- columnar scan --
+        t0 = time.time()
+        frame = store.find_columnar(
+            app_id=1, event_names=["rate"], float_property="rating",
+            minimal=True,
+        )
+        stages["scan_columnar"] = round(time.time() - t0, 2)
+        log(f"columnar scan: {stages['scan_columnar']} s")
+
+        # -- id encode --
+        t0 = time.time()
+        ratings = frame.to_ratings(rating_property="rating", dedup="last")
+        stages["encode_ids"] = round(time.time() - t0, 2)
+        store.close()
+        log(f"encoded: {len(ratings.rating):,} deduped ratings")
+
+        # -- holdout split on the encoded COO (deterministic) --
+        rng = np.random.default_rng(11)
+        hold = rng.random(len(ratings.rating)) < args.holdout
+        ut, it_ = ratings.user_ix[~hold], ratings.item_ix[~hold]
+        vt = ratings.rating[~hold]
+        uh, ih, vh = (ratings.user_ix[hold], ratings.item_ix[hold],
+                      ratings.rating[hold])
+        rec["n_train"] = int(len(vt))
+        rec["n_holdout"] = int(len(vh))
+
+        # -- train (bucketize + stage + 20 iters), checkpointing every 5 --
+        cfg = ALSConfig(rank=args.rank, num_iterations=args.iters,
+                        lam=0.01, seed=3)
+        ckpt_dir = Path(tmp) / "ckpt"
+        t0 = time.time()
+        trainer = ALSTrainer(
+            (ut, it_, vt), ratings.n_users, ratings.n_items, cfg,
+        )
+        stages["bucketize_and_stage"] = round(time.time() - t0, 2)
+        rec["staging"] = trainer.staging
+        if getattr(trainer, "staged_transfer_bytes", None):
+            rec["staged_transfer_bytes"] = int(trainer.staged_transfer_bytes)
+            rec["staged_bytes_per_rating"] = round(
+                trainer.staged_transfer_bytes / max(len(vt), 1), 2
+            )
+        log(f"staged ({trainer.staging}): "
+            f"{stages['bucketize_and_stage']} s")
+
+        t0 = time.time()
+        ckpt = StepCheckpointer(ckpt_dir, keep=2)
+        factors = trainer.train(
+            checkpointer=ckpt, checkpoint_every=args.checkpoint_every,
+            resume=False,
+        )
+        stages["train_and_checkpoint"] = round(time.time() - t0, 2)
+        rec["solver"] = trainer.solver
+        log(f"trained {args.iters} iters: "
+            f"{stages['train_and_checkpoint']} s")
+
+        t0 = time.time()
+        rec["train_rmse"] = round(rmse(factors, ut, it_, vt), 4)
+        rec["rmse_holdout"] = round(rmse(factors, uh, ih, vh), 4)
+        stages["rmse_eval"] = round(time.time() - t0, 2)
+        log(f"rmse train={rec['train_rmse']} "
+            f"holdout={rec['rmse_holdout']}")
+
+        # -- deploy smoke: restore the LAST CHECKPOINT (not the live
+        # factors) and serve top-10 for a handful of users — proves the
+        # persisted state is servable, the resume/deploy contract --
+        t0 = time.time()
+        latest = ckpt.latest_step()
+        assert latest == args.iters, (latest, args.iters)
+        state = ckpt.restore(latest)
+        U = np.asarray(state["U"])[: ratings.n_users]
+        V = np.asarray(state["V"])[: ratings.n_items]
+        qusers = np.array([0, 1, 17, ratings.n_users - 1])
+        scores = U[qusers] @ V.T
+        k = 10
+        top = np.argpartition(-scores, k, axis=1)[:, :k]
+        assert top.shape == (len(qusers), k)
+        assert np.isfinite(np.take_along_axis(scores, top, axis=1)).all()
+        ckpt.close()
+        stages["deploy_smoke_from_checkpoint"] = round(time.time() - t0, 2)
+        rec["checkpoint_restored_step"] = int(latest)
+        log("deploy smoke from restored checkpoint: ok")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    rec["stages"] = stages
+    rec["value"] = round(
+        sum(s for n, s in stages.items() if n != "write_source_file"), 2
+    )
+    rec["peak_rss_gb"] = round(peak_rss_gb(), 2)
+    rec["total_wall_s"] = round(time.time() - t_run0, 2)
+    rec["recorded_at"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    args.out.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

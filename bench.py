@@ -77,6 +77,51 @@ def synth_ml20m(scale: float = 1.0, seed: int = 0):
     return u, i, v, n_users, n_items
 
 
+def als_train_flops(nnz: int, n_users: int, n_items: int, rank: int,
+                    iters: int = 1) -> float:
+    """Closed-form FLOP count of ``iters`` ALS iterations (both halves):
+    Gram accumulation 2·nnz·R² per half, rhs 2·nnz·R per half, one
+    (2/3)·R³ dense SPD solve per row per iteration.  Gathers/scatters
+    move bytes, not FLOPs — they show up in MFU as lost utilization,
+    which is exactly what the metric is for."""
+    gram = 2.0 * nnz * rank * rank
+    rhs = 2.0 * nnz * rank
+    solve = (2.0 / 3.0) * rank ** 3
+    per_iter = 2.0 * (gram + rhs) + (n_users + n_items) * solve
+    return iters * per_iter
+
+
+# per-jax-device dense matmul peaks (FLOP/s) by device_kind prefix, at
+# the dtype the Gram einsum actually runs on the MXU (bf16-class for
+# default/"high", f32 via passes for "highest" — we report against the
+# bf16 peak and carry the basis in the record so the number can't be
+# silently misread).  Public figures; device_kind strings as the TPU
+# runtime reports them.
+_PEAK_FLOPS_BF16 = (
+    ("TPU v6", 918e12),      # Trillium chip
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12), # v5e
+    ("TPU v5e", 197e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 61.5e12),     # per jax device (core)
+    ("TPU v2", 22.5e12),
+)
+
+
+def device_peak_flops(jax) -> tuple:
+    """(peak FLOP/s or None, device_kind).  None for CPU/unknown kinds:
+    an unknown peak yields mfu=null rather than a made-up number."""
+    try:
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", dev.platform)
+    except Exception:  # noqa: BLE001 — bench must always print a line
+        return None, "unknown"
+    for prefix, peak in _PEAK_FLOPS_BF16:
+        if str(kind).startswith(prefix):
+            return peak, str(kind)
+    return None, str(kind)
+
+
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
@@ -141,6 +186,15 @@ def _parse_args(argv=None):
         "NumPy oracle that encodes the MLlib ALS conventions "
         "(tests/test_als.py) and print its JSON line; the quality half "
         "of the north star, as a recordable artifact",
+    )
+    ap.add_argument(
+        "--parity-northstar",
+        action="store_true",
+        help="the parity check AT the north-star config — rank 64, "
+        "20 iterations, ML-20M scale (scaled by --scale), low-rank "
+        "ground-truth ratings so holdout RMSE is meaningful — vs the "
+        "same shared oracle, untimed, CPU-friendly; writes "
+        "BENCH_PARITY_R64.json (VERDICT r4 #3)",
     )
     ap.add_argument(
         "--pipeline",
@@ -278,12 +332,24 @@ def run_breakdown(args) -> None:
     per_iter = (span - rtt) / n_steady
     emit("steady_iteration", per_iter, n=n_steady, total=round(span, 4))
     nnz = len(v)
-    flops_iter = 2 * (2 * nnz * args.rank ** 2) + (
-        (n_users + n_items) * 2 * args.rank ** 3 // 3
-    )
+    flops_iter = als_train_flops(nnz, n_users, n_items, args.rank)
+    achieved = flops_iter / per_iter
+    peak, kind = device_peak_flops(jax)
+    # aggregate mesh peak, not one device's: the trainer shards the
+    # work, so per-device peak would overstate MFU by the device count
+    n_dev = mesh.size if mesh is not None else 1
+    if peak:
+        peak *= n_dev
     print(json.dumps({
         "metric": "als_derived_tflops_per_s",
-        "value": round(flops_iter / per_iter / 1e12, 3),
+        "value": round(achieved / 1e12, 3),
+        # MFU vs the mesh's bf16 matmul peak: the roofline context that
+        # turns a phase split into "we are at X% of this silicon"
+        # without a human decoding it (VERDICT r4 #4)
+        "mfu": round(achieved / peak, 5) if peak else None,
+        "peak_tflops_bf16": round(peak / 1e12, 1) if peak else None,
+        "device_kind": kind,
+        "n_devices": n_dev,
         "platform": str(jax.devices()[0].platform),
     }), flush=True)
 
@@ -395,6 +461,30 @@ def run_inner(args) -> None:
     # RMSE" is a vestigial field)
     train_rmse = rmse(factors, u, i, v)
     rmse_holdout = rmse(factors, uh, ih, vh) if len(vh) else None
+    # explain-or-gate (VERDICT r4 weak #2): this bench's synthetic
+    # ratings are STRUCTURELESS (uniform half-stars, synth_ml20m), so
+    # holdout RMSE cannot beat the predict-the-train-mean baseline and
+    # rank-64/λ=0.01 overfits noise past it — the number certifies the
+    # holdout plumbing, not model quality.  Quality parity lives in
+    # BENCH_PARITY.json (low-rank ground truth).  Carrying the baseline
+    # in the same line makes that readable without a human decoding it.
+    holdout_mean_baseline = (
+        float(np.sqrt(np.mean((vh - float(np.mean(v))) ** 2)))
+        if len(vh) else None
+    )
+    # roofline context (VERDICT r4 #4): achieved FLOP/s over the WHOLE
+    # timed span (staging + init + train — the span the 60 s target
+    # covers) and MFU vs the chip's bf16 peak; null mfu on CPU/unknown
+    total_flops = als_train_flops(len(v), n_users, n_items, cfg.rank,
+                                  cfg.num_iterations)
+    achieved_flops = total_flops / dt
+    peak_flops, device_kind = device_peak_flops(jax)
+    # the train shards across the whole mesh, so the roofline is the
+    # MESH's aggregate peak — a per-device peak would overstate MFU by
+    # the device count on any multi-chip run
+    n_dev = mesh.size if mesh is not None else 1
+    if peak_flops:
+        peak_flops *= n_dev
     if args.verbose:
         print(f"# train RMSE {train_rmse:.4f}, wall {dt:.2f}s",
               file=sys.stderr)
@@ -432,12 +522,32 @@ def run_inner(args) -> None:
                 # no prior record is silently re-scaled)
                 "holdout": hold_frac,
                 "n_ratings_trained": int(len(v)),
+                "achieved_tflops_per_s": round(achieved_flops / 1e12, 4),
+                "mfu": (
+                    round(achieved_flops / peak_flops, 5)
+                    if peak_flops else None
+                ),
+                "device_kind": device_kind,
+                "n_devices": n_dev,
                 **(
                     {"train_rmse": round(train_rmse, 4)}
                     if train_rmse is not None else {}
                 ),
                 **(
-                    {"rmse_holdout": round(rmse_holdout, 4)}
+                    {
+                        "rmse_holdout": round(rmse_holdout, 4),
+                        "rmse_holdout_mean_baseline": round(
+                            holdout_mean_baseline, 4
+                        ),
+                        "holdout_note": (
+                            "synthetic ratings are structureless; "
+                            "holdout rmse has a noise floor at the "
+                            "mean baseline and small-lambda rank-64 "
+                            "overfits past it — quality parity is "
+                            "certified by BENCH_PARITY.json, not "
+                            "this field"
+                        ),
+                    }
                     if rmse_holdout is not None else {}
                 ),
             }
@@ -483,31 +593,13 @@ def run_parity(args) -> None:
     cfg = ALSConfig(rank=16, num_iterations=10, lam=0.01, seed=3)
     ours = train_als((ut, it_, vt), n_users, n_items, cfg)
 
-    # dense oracle: identical init and conventions
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, ki = jax.random.split(key)
-    U = np.asarray(
-        jax.random.normal(ku, (n_users, cfg.rank), "float32")
-    ) / np.sqrt(cfg.rank)
-    V = np.asarray(
-        jax.random.normal(ki, (n_items, cfg.rank), "float32")
-    ) / np.sqrt(cfg.rank)
+    # THE shared oracle (tools/mllib_oracle.py — also what
+    # tests/test_als.py compares against, and itself pinned by the
+    # closed-form rank-2 self-check there): identical init, identical
+    # ALS-WR conventions, independent per-row dense implementation
+    from tools.mllib_oracle import reference_als
 
-    def solve_side(X, Y, rows, cols, vals, n_rows):
-        for r in range(n_rows):
-            sel = rows == r
-            n = int(sel.sum())
-            if n == 0:
-                continue
-            Yr = Y[cols[sel]]
-            A = Yr.T @ Yr + cfg.lam * n * np.eye(cfg.rank)
-            b = Yr.T @ vals[sel]
-            X[r] = np.linalg.solve(A, b)
-        return X
-
-    for _ in range(cfg.num_iterations):
-        U = solve_side(U, V, ut, it_, vt, n_users)
-        V = solve_side(V, U, it_, ut, vt, n_items)
+    U, V = reference_als(ut, it_, vt, n_users, n_items, cfg)
     oracle = ALSFactors(user_factors=U, item_factors=V)
 
     ho_tpu = rmse(ours, uh, ih, vh)
@@ -527,6 +619,84 @@ def run_parity(args) -> None:
     # driver-readable artifact next to the BENCH output (round-3
     # verdict: the parity evidence lived only in ARCHITECTURE.md prose)
     PARITY_PATH.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec))
+
+
+def run_parity_northstar(args) -> None:
+    """RMSE parity vs the shared oracle AT the north-star config:
+    rank 64, 20 iterations, λ=0.01, ML-20M-scale sparsity pattern
+    (power-law users/items like ``synth_ml20m``), but rating VALUES
+    from a noisy low-rank ground truth — unlike the wall-clock bench's
+    structureless ratings, holdout RMSE here measures real
+    generalization, so "holdout_delta ≈ 0 at rank 64 full scale" is
+    the quality half of BASELINE.md's north star as one artifact
+    (VERDICT r4 #3: the round-4 parity evidence was rank 16 / 27k
+    ratings).  Untimed: the oracle is a single-core python row loop —
+    correctness evidence, not a benchmark."""
+    if args.platform:
+        from predictionio_tpu.parallel.mesh import force_platform
+
+        force_platform(args.platform)
+    import jax
+
+    from predictionio_tpu.models.als import ALSConfig, ALSFactors, rmse, train_als
+    from tools.mllib_oracle import reference_als
+
+    # sparsity pattern at bench scale; values from low-rank truth
+    u, i, _, n_users, n_items = synth_ml20m(args.scale)
+    rng = np.random.default_rng(7)
+    rank_true = 16
+    Ut = rng.normal(size=(n_users, rank_true)).astype(np.float32)
+    Vt = rng.normal(size=(n_items, rank_true)).astype(np.float32)
+    v = (
+        np.einsum("nr,nr->n", Ut[u], Vt[i]) / np.sqrt(rank_true)
+        + 0.1 * rng.normal(size=len(u)).astype(np.float32)
+    ).astype(np.float32)
+
+    hold = rng.random(len(v)) < 0.05
+    ut, it_, vt = u[~hold], i[~hold], v[~hold]
+    uh, ih, vh = u[hold], i[hold], v[hold]
+
+    cfg = ALSConfig(rank=args.rank, num_iterations=args.iters,
+                    lam=0.01, seed=3)
+    t0 = time.time()
+    ours = train_als((ut, it_, vt), n_users, n_items, cfg)
+    t_ours = time.time() - t0
+    print(f"# trainer done in {t_ours:.1f}s", file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    U, V = reference_als(
+        ut, it_, vt, n_users, n_items, cfg,
+        progress=lambda it: print(
+            f"# oracle iteration {it + 1}/{cfg.num_iterations} "
+            f"({time.time() - t0:.0f}s)", file=sys.stderr, flush=True
+        ),
+    )
+    oracle = ALSFactors(user_factors=U, item_factors=V)
+
+    ho_tpu = rmse(ours, uh, ih, vh)
+    ho_orc = rmse(oracle, uh, ih, vh)
+    delta = abs(ho_tpu - ho_orc)
+    rec = {
+        "metric": "als_rmse_parity_vs_mllib_oracle_northstar",
+        "rank": cfg.rank, "iters": cfg.num_iterations, "lam": cfg.lam,
+        "scale": args.scale, "rank_true": rank_true,
+        "n_train": int(len(vt)), "n_holdout": int(len(vh)),
+        "n_users": int(n_users), "n_items": int(n_items),
+        "rmse_train_tpu": round(rmse(ours, ut, it_, vt), 5),
+        "rmse_train_oracle": round(rmse(oracle, ut, it_, vt), 5),
+        "rmse_holdout_tpu": round(ho_tpu, 5),
+        "rmse_holdout_oracle": round(ho_orc, 5),
+        "holdout_delta": round(delta, 5),
+        "parity": bool(delta < 0.02),
+        "trainer_seconds_untimed_context": round(t_ours, 1),
+        "platform": jax.default_backend(),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    # always its own artifact: BENCH_PARITY.json stays the small
+    # verifiable-config record; a smoke invocation of this mode must
+    # not clobber it
+    PARITY_R64_PATH.write_text(json.dumps(rec, indent=1) + "\n")
     print(json.dumps(rec))
 
 
@@ -679,6 +849,7 @@ def _run_inner_subprocess(extra_args, timeout, cpu_only=False):
 
 HISTORY_PATH = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
 PARITY_PATH = Path(__file__).resolve().parent / "BENCH_PARITY.json"
+PARITY_R64_PATH = Path(__file__).resolve().parent / "BENCH_PARITY_R64.json"
 
 
 def _record_history(line: str) -> None:
@@ -731,6 +902,9 @@ def main() -> None:
         reexec_without_plugin()
     if args.parity:
         run_parity(args)
+        return
+    if args.parity_northstar:
+        run_parity_northstar(args)
         return
     if args.pipeline:
         run_pipeline(args)

@@ -32,36 +32,77 @@ def _toy(n_users=30, n_items=20, rank_true=3, density=0.4, seed=0):
 
 
 def _reference_als_explicit(u, i, v, n_users, n_items, cfg: ALSConfig):
-    """Dense NumPy ALS with identical init (uses jax PRNG to match)."""
-    import jax
+    """Dense NumPy ALS with identical init — THE shared oracle
+    (tools/mllib_oracle.py, also used by ``bench.py --parity``)."""
+    from tools.mllib_oracle import reference_als
 
-    key = jax.random.PRNGKey(cfg.seed)
-    ku, ki = jax.random.split(key)
-    U = np.asarray(
-        jax.random.normal(ku, (n_users, cfg.rank), "float32")
-    ) / np.sqrt(cfg.rank)
-    V = np.asarray(
-        jax.random.normal(ki, (n_items, cfg.rank), "float32")
-    ) / np.sqrt(cfg.rank)
-
-    def solve_side(X, Y, rows, cols, vals, n_rows):
-        for r in range(n_rows):
-            sel = rows == r
-            n = sel.sum()
-            if n == 0:
-                continue
-            Yr = Y[cols[sel]]
-            A = Yr.T @ Yr + cfg.lam * (n if cfg.weighted_lambda else 1.0) * np.eye(
-                cfg.rank
-            )
-            b = Yr.T @ vals[sel]
-            X[r] = np.linalg.solve(A, b)
-        return X
-
-    for _ in range(cfg.num_iterations):
-        U = solve_side(U, V, u, i, v, n_users)
-        V = solve_side(V, U, i, u, v, n_items)
+    U, V = reference_als(u, i, v, n_users, n_items, cfg)
     return ALSFactors(user_factors=U, item_factors=V)
+
+
+def test_oracle_closed_form_rank2():
+    """The oracle ITSELF against hand-expanded algebra (VERDICT r4
+    weak #4: an oracle bug propagates to both sides of every parity
+    artifact; this pins it to something that shares no solver code).
+
+    solve_row must satisfy the ALS-WR normal equations
+    ``(YᵀY + λ·n·I) x = Yᵀ r``; for rank 2 the inverse is the explicit
+    adjugate ``[[a,b],[c,d]]⁻¹ = [[d,-b],[-c,a]]/(ad-bc)``, written out
+    here by hand — no np.linalg involved on the checking side."""
+    from tools.mllib_oracle import solve_row
+
+    Y = np.array([[1.0, 2.0], [3.0, -1.0], [0.5, 4.0]])
+    r = np.array([2.0, -1.0, 3.5])
+    lam = 0.3
+    n = 3.0
+
+    got = solve_row(Y, r, lam, weighted=True)
+
+    G = Y.T @ Y
+    a, b = G[0, 0] + lam * n, G[0, 1]
+    c, d = G[1, 0], G[1, 1] + lam * n
+    rhs = Y.T @ r
+    det = a * d - b * c
+    expect = np.array(
+        [(d * rhs[0] - b * rhs[1]) / det,
+         (-c * rhs[0] + a * rhs[1]) / det]
+    )
+    np.testing.assert_allclose(got, expect, rtol=1e-12)
+
+    # unweighted convention: λ·I, not λ·n·I
+    got_uw = solve_row(Y, r, lam, weighted=False)
+    a, d = G[0, 0] + lam, G[1, 1] + lam
+    det = a * d - b * c
+    expect_uw = np.array(
+        [(d * rhs[0] - b * rhs[1]) / det,
+         (-c * rhs[0] + a * rhs[1]) / det]
+    )
+    np.testing.assert_allclose(got_uw, expect_uw, rtol=1e-12)
+    assert not np.allclose(got, got_uw)  # the conventions differ
+
+
+def test_oracle_exact_recovery_halfstep():
+    """For R = U₀V₀ᵀ fully observed with λ=0, the user half-sweep from
+    V=V₀ must return exactly U₀ (normal equations become
+    V₀ᵀV₀ x = V₀ᵀ V₀ U₀ᵀ-row): an independent functional check of the
+    oracle's sweep/bucketing, complementary to the algebraic one."""
+    from tools.mllib_oracle import _side_order, _solve_side
+
+    rng = np.random.default_rng(3)
+    n_users, n_items, rank = 11, 7, 3
+    U0 = rng.normal(size=(n_users, rank))
+    V0 = rng.normal(size=(n_items, rank))
+    R = U0 @ V0.T
+    u, i = np.meshgrid(np.arange(n_users), np.arange(n_items),
+                       indexing="ij")
+    u, i = u.ravel().astype(np.int32), i.ravel().astype(np.int32)
+    v = R[u, i]
+
+    order, bounds = _side_order(u, n_users)
+    X = np.zeros((n_users, rank))
+    out = _solve_side(X, V0, i[order], v[order], bounds,
+                      lam=0.0, weighted=True)
+    np.testing.assert_allclose(out, U0, rtol=1e-9, atol=1e-9)
 
 
 def test_bucket_layout_covers_all_ratings():

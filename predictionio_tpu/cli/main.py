@@ -483,12 +483,24 @@ def cmd_export(args, storage: Storage) -> int:
 
 def cmd_template(args, storage: Storage) -> int:
     """Offline gallery (`console/Template.scala:130-427` analogue)."""
+    import urllib.error
+
     from ..tools.template_gallery import (
-        TemplateVersionError, list_templates, scaffold,
-        scaffold_from_archive,
+        TemplateVersionError, fetch_index, list_templates, scaffold,
+        scaffold_from_archive, scaffold_from_index, scaffold_from_url,
     )
 
     if args.template_command == "list":
+        if args.index_url:
+            # remote gallery browse (Template.scala:130-170 analogue)
+            try:
+                entries = fetch_index(args.index_url)
+            except (ValueError, urllib.error.URLError, OSError) as e:
+                _out(f"Error: {e}")
+                return 1
+            for e in entries:
+                _out(f"{e['name']:<26} {e.get('description', '')}")
+            return 0
         for t in list_templates():
             _out(f"{t.name:<26} {t.description}")
         return 0
@@ -498,10 +510,18 @@ def cmd_template(args, storage: Storage) -> int:
                 target = scaffold_from_archive(
                     args.from_archive, args.directory or args.name
                 )
+            elif args.from_url:
+                target = scaffold_from_url(
+                    args.from_url, args.directory or args.name
+                )
+            elif args.index_url:
+                target = scaffold_from_index(
+                    args.name, args.directory or args.name, args.index_url
+                )
             else:
                 target = scaffold(args.name, args.directory or args.name)
         except (KeyError, FileExistsError, FileNotFoundError, ValueError,
-                TemplateVersionError) as e:
+                TemplateVersionError, urllib.error.URLError, OSError) as e:
             _out(f"Error: {e}")
             return 1
         _out(f"Engine template '{args.name}' created at {target}/")
@@ -799,14 +819,25 @@ def build_parser() -> argparse.ArgumentParser:
 
     tp = sub.add_parser("template", help="engine template gallery")
     tps = tp.add_subparsers(dest="template_command", required=True)
-    tps.add_parser("list")
+    tl = tps.add_parser("list")
+    tl.add_argument("--index-url", metavar="URL",
+                    help="browse a REMOTE JSON template index instead "
+                    "of the built-in gallery (Template.scala:130-170 "
+                    "analogue)")
     x = tps.add_parser("get")
     x.add_argument("name")
     x.add_argument("directory", nargs="?")
     x.add_argument("--from-archive", metavar="PATH",
                    help="scaffold from a local zip/tar engine archive "
-                   "instead of the built-in gallery (the egress-free "
-                   "half of the reference's template download)")
+                   "instead of the built-in gallery")
+    x.add_argument("--from-url", metavar="URL",
+                   help="download a zip/tar engine archive over "
+                   "http(s) and scaffold from it (the remote half of "
+                   "the reference's template download, "
+                   "Template.scala:171-300)")
+    x.add_argument("--index-url", metavar="URL",
+                   help="look NAME up in a remote JSON template index "
+                   "and download its archive")
 
     b = sub.add_parser("build", help="validate + register an engine")
     b.add_argument("--engine-json", default="engine.json")

@@ -24,12 +24,22 @@ from .. import __version__
 __all__ = [
     "GALLERY",
     "TemplateMeta",
+    "fetch_index",
     "list_templates",
     "scaffold",
     "scaffold_from_archive",
+    "scaffold_from_index",
+    "scaffold_from_url",
     "verify_template_min_version",
     "TemplateVersionError",
 ]
+
+# remote-fetch guardrails: templates are untrusted input arriving over
+# the operator-supplied URL, so the transport is capped before the
+# archive hardening in _extract_archive even starts
+_MAX_INDEX_BYTES = 4 << 20     # a template INDEX beyond 4 MB is wrong
+_MAX_ARCHIVE_BYTES = 256 << 20
+_ARCHIVE_SUFFIXES = (".zip", ".tar", ".tar.gz", ".tgz")
 
 
 @dataclass(frozen=True)
@@ -210,6 +220,137 @@ def scaffold(template_name: str, target_dir: str | Path) -> Path:
         )
     )
     return target
+
+
+def _http_get(url: str, max_bytes: int, timeout: float,
+              sink=None) -> Optional[bytes]:
+    """Streamed GET with a scheme check and a hard size cap (a
+    mis-pointed URL must fail fast, not fill the disk).  With ``sink``
+    (a writable binary file object) chunks stream straight to it and
+    None is returned — archives up to the 256 MB cap never sit in
+    memory; without it the body is returned as bytes (small indexes)."""
+    import urllib.request
+    from urllib.parse import urlparse
+
+    scheme = urlparse(url).scheme
+    if scheme not in ("http", "https"):
+        raise ValueError(
+            f"unsupported URL scheme {scheme!r} for {url!r} "
+            "(http/https only)"
+        )
+    req = urllib.request.Request(
+        url, headers={"User-Agent": f"pio-tpu/{__version__}"}
+    )
+    chunks, size = [], 0
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        while True:
+            chunk = resp.read(1 << 20)
+            if not chunk:
+                break
+            size += len(chunk)
+            if size > max_bytes:
+                raise ValueError(
+                    f"download of {url!r} exceeded the {max_bytes} byte "
+                    "cap; refusing"
+                )
+            if sink is not None:
+                sink.write(chunk)
+            else:
+                chunks.append(chunk)
+    if sink is not None:
+        sink.flush()
+        return None
+    return b"".join(chunks)
+
+
+def fetch_index(index_url: str, timeout: float = 20.0) -> list[dict]:
+    """Browse a remote template index — the HTTP half of the
+    reference's gallery browse (`tools/console/Template.scala:130-170`,
+    which lists a GitHub repository; here the index is framework-
+    neutral JSON so any static file server can host a gallery).
+
+    Accepts either a bare JSON list or ``{"templates": [...]}``; each
+    entry is a dict with at least ``name`` and ``url`` (archive
+    location, absolute or relative to the index URL) and optionally
+    ``description``.
+    """
+    raw = _http_get(index_url, _MAX_INDEX_BYTES, timeout)
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ValueError(f"template index at {index_url!r} is not JSON: {e}")
+    entries = doc.get("templates") if isinstance(doc, dict) else doc
+    if not isinstance(entries, list):
+        raise ValueError(
+            f"template index at {index_url!r} must be a JSON list or "
+            "{'templates': [...]}"
+        )
+    out = []
+    for e in entries:
+        if (
+            not isinstance(e, dict)
+            or not isinstance(e.get("name"), str)
+            or not isinstance(e.get("url"), str)
+            or not isinstance(e.get("description", ""), str)
+        ):
+            # untrusted input: a non-string url/name would otherwise
+            # surface later as a raw TypeError from urljoin/formatting
+            raise ValueError(
+                f"template index entry {e!r} needs string 'name' and "
+                "'url' (and a string 'description' if present)"
+            )
+        out.append(e)
+    return out
+
+
+def scaffold_from_url(url: str, target_dir: str | Path,
+                      timeout: float = 60.0) -> Path:
+    """Download an engine archive over HTTP(S), then run the SAME
+    hardened extract-and-validate flow as a local archive — the
+    download half of `tools/console/Template.scala:171-300` (fetch
+    release archive -> extract -> record metadata).  The transport adds
+    nothing to trust: size-capped fetch into a temp file, then every
+    local-archive check (member paths, links, engine.json presence,
+    min-version gate) applies unchanged."""
+    import tempfile
+    from urllib.parse import urlparse
+
+    path = urlparse(url).path.lower()
+    suffix = next(
+        (s for s in _ARCHIVE_SUFFIXES if path.endswith(s)), None
+    )
+    if suffix is None:
+        raise ValueError(
+            f"cannot tell the archive type of {url!r} "
+            f"(expected a path ending in one of {_ARCHIVE_SUFFIXES})"
+        )
+    # a doomed scaffold must not pull the archive first
+    target = Path(target_dir)
+    if target.exists() and any(target.iterdir()):
+        raise FileExistsError(f"target directory {target} is not empty")
+    with tempfile.NamedTemporaryFile(suffix=suffix) as tmp:
+        _http_get(url, _MAX_ARCHIVE_BYTES, timeout, sink=tmp)
+        return scaffold_from_archive(tmp.name, target_dir)
+
+
+def scaffold_from_index(name: str, target_dir: str | Path,
+                        index_url: str, timeout: float = 60.0) -> Path:
+    """``template get NAME --index-url``: look the name up in the
+    remote index, resolve its (possibly relative) archive URL, fetch,
+    extract."""
+    from urllib.parse import urljoin
+
+    entries = fetch_index(index_url, timeout=timeout)
+    by_name = {e["name"]: e for e in entries}
+    if name not in by_name:
+        raise KeyError(
+            f"template {name!r} not in index {index_url!r}; "
+            f"available: {', '.join(sorted(by_name)) or '(none)'}"
+        )
+    return scaffold_from_url(
+        urljoin(index_url, by_name[name]["url"]), target_dir,
+        timeout=timeout,
+    )
 
 
 def scaffold_from_archive(archive: str | Path, target_dir: str | Path) -> Path:

@@ -165,3 +165,25 @@ class ParamsKeyFactory:
         if key not in presets:
             raise KeyError(key)
         return presets[key]
+
+
+class ToyEventStore:
+    """Third-party event-store backend for the pluggable-registry test:
+    loaded purely from a dotted PIO_STORAGE_SOURCES_<N>_TYPE env value
+    (registry._load_custom), never imported by framework code.  Wraps
+    the in-memory store and records the config it was constructed with
+    — the ``Backend(conf)`` constructor contract."""
+
+    def __init__(self, conf):
+        from predictionio_tpu.storage.levents import MemoryEventStore
+
+        self.conf = dict(conf)
+        self._inner = MemoryEventStore()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class ExplodingStore:
+    def __init__(self, conf):
+        raise ValueError("boom from backend constructor")

@@ -466,3 +466,78 @@ def test_fast_json_export_matches_portable_export(tmp_path):
 
     # same ORDER (no sorting here): both exports are time-sorted
     assert canon(fast) == canon(portable)
+
+
+def test_schema_forward_migration_from_v0(tmp_path):
+    """Opening a pre-versioning (v0) event DB migrates it forward in
+    place: header stamped, missing indexes/aux table created, legacy
+    rows readable, new writes work (the `hbase/upgrade/Upgrade.scala`
+    capability — a schema change must not strand existing DBs)."""
+    import json as _json
+    import sqlite3 as _sq
+
+    from predictionio_tpu.storage.sqlite_events import (
+        SCHEMA_VERSION, SQLiteEventStore,
+    )
+
+    db = tmp_path / "legacy.db"
+    conn = _sq.connect(db)
+    # v0 layout: same 11 columns, but NO name index and NO
+    # _scan_versions table (the pre-versioning variance), one real row
+    conn.execute(
+        "CREATE TABLE events_1 (event_id TEXT PRIMARY KEY, event TEXT "
+        "NOT NULL, entity_type TEXT NOT NULL, entity_id TEXT NOT NULL, "
+        "target_entity_type TEXT, target_entity_id TEXT, properties "
+        "TEXT NOT NULL, event_time INTEGER NOT NULL, tags TEXT NOT "
+        "NULL, pr_id TEXT, creation_time INTEGER NOT NULL)"
+    )
+    conn.execute("CREATE INDEX events_1_time ON events_1 (event_time)")
+    conn.execute(
+        "INSERT INTO events_1 VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+        ("legacy-id", "rate", "user", "u1", "item", "i1",
+         _json.dumps({"rating": 4.0}), 1577836800000, "[]", None,
+         1577836800000),
+    )
+    conn.commit()
+    assert conn.execute("PRAGMA user_version").fetchone()[0] == 0
+    conn.close()
+
+    es = SQLiteEventStore(db)
+    assert es.schema_version() == SCHEMA_VERSION
+    # the legacy row is served through the normal read path
+    evs = list(es.find(app_id=1))
+    assert len(evs) == 1 and evs[0].event_id == "legacy-id"
+    assert evs[0].properties.get_float("rating") == 4.0
+    # migration added what was missing
+    names = {
+        r[0] for r in es._conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='index'"
+        ).fetchall()
+    }
+    assert {"events_1_entity", "events_1_name"} <= names
+    # and new writes (which bump _scan_versions) work
+    e = Event(event="rate", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i2",
+              properties=DataMap({"rating": 2.0}))
+    es.insert(e, app_id=1)
+    assert len(list(es.find(app_id=1))) == 2
+    es.close()
+
+    # re-open: already stamped, no re-migration needed, still v1
+    es2 = SQLiteEventStore(db)
+    assert es2.schema_version() == SCHEMA_VERSION
+    es2.close()
+
+
+def test_schema_newer_than_framework_refused(tmp_path):
+    import sqlite3 as _sq
+
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    db = tmp_path / "future.db"
+    conn = _sq.connect(db)
+    conn.execute("PRAGMA user_version = 99")
+    conn.commit()
+    conn.close()
+    with pytest.raises(RuntimeError, match="newer"):
+        SQLiteEventStore(db)

@@ -99,3 +99,58 @@ def test_shipped_env_template_parses_and_boots(tmp_path):
     assert (tmp_path / "pio" / "eventdata.db").exists()
     assert (tmp_path / "pio" / "models").is_dir()
     s.close()
+
+
+def test_pluggable_backend_via_dotted_type(tmp_path):
+    """A third-party EventStore registers via env config ONLY — a
+    dotted import path in the TYPE var, no framework edit (the
+    `Storage.scala:183-224` reflective extension point; VERDICT r4 #6).
+    The backend receives the source's full config dict and serves the
+    startup self-check end to end."""
+    from fixtures import ToyEventStore
+
+    s = Storage(env={
+        "PIO_TPU_HOME": str(tmp_path),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "TOY",
+        "PIO_STORAGE_SOURCES_TOY_TYPE": "fixtures.ToyEventStore",
+        "PIO_STORAGE_SOURCES_TOY_FLAVOR": "banana",
+    })
+    es = s.get_event_store()
+    assert isinstance(es, ToyEventStore)
+    # full source config arrives, custom keys included
+    assert es.conf["flavor"] == "banana"
+    assert es.conf["type"] == "fixtures.ToyEventStore"
+    # and it actually serves storage traffic (metadata stays builtin)
+    s.verify_all_data_objects()
+    s.close()
+
+
+def test_pluggable_backend_errors_are_loud():
+    # unimportable module
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "X",
+        "PIO_STORAGE_SOURCES_X_TYPE": "no.such.module.Cls",
+    })
+    with pytest.raises(StorageError, match="cannot load"):
+        s.get_event_store()
+    # importable module, missing attribute
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "X",
+        "PIO_STORAGE_SOURCES_X_TYPE": "fixtures.NoSuchStore",
+    })
+    with pytest.raises(StorageError, match="cannot load"):
+        s.get_event_store()
+    # constructor failure surfaces the config keys
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "X",
+        "PIO_STORAGE_SOURCES_X_TYPE": "fixtures.ExplodingStore",
+    })
+    with pytest.raises(StorageError, match="failed to initialize"):
+        s.get_event_store()
+    # dotless unknown names still get the old loud error
+    s = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "X",
+        "PIO_STORAGE_SOURCES_X_TYPE": "hbase",
+    })
+    with pytest.raises(StorageError, match="unknown event store"):
+        s.get_event_store()

@@ -35,6 +35,11 @@ def main() -> None:
     ap.add_argument("--n", type=int, default=2000)
     ap.add_argument("--threads", type=int, default=0,
                     help="also measure N concurrent single-event writers")
+    ap.add_argument("--shards", default="",
+                    help="comma list of shard counts (e.g. '1,2,4'): "
+                    "measure store-level concurrent bulk-write "
+                    "throughput per count (the region-parallel write "
+                    "analogue; VERDICT r4 #9)")
     args = ap.parse_args()
 
     from predictionio_tpu.server.event_server import (
@@ -137,6 +142,73 @@ def main() -> None:
         "metric": "import_bulk_events_per_s",
         "value": round(n / dt, 1), "unit": "events/s",
     }), flush=True)
+
+    if args.shards:
+        _bench_shard_scaling(args, tmp)
+
+
+def _bench_shard_scaling(args, tmp: str) -> None:
+    """Store-level concurrent write throughput vs shard count.
+
+    Measures what sharding actually changes — the WRITER LOCK: N
+    threads hammer ``insert_raw_rows`` (pre-built rows, minimal python
+    per batch, so the per-shard lock + WAL commit is the visible cost)
+    against 1..K shard files.  The REST path is deliberately excluded:
+    round 4 measured per-request HTTP+JSON under the GIL as its wall
+    (SERVING_BENCH.md), and sharding the store cannot amortize that
+    from below.  On a single-core host thread-scaling is GIL-bound —
+    the ``nproc`` field rides every line so a flat curve reads as the
+    environment, not the design."""
+    import concurrent.futures
+    import os as _os
+    import time as _time
+
+    from predictionio_tpu.storage import (
+        ShardedSQLiteEventStore, SQLiteEventStore,
+    )
+    from predictionio_tpu.storage.event import new_event_ids
+
+    writers = max(args.threads, 4)
+    n_batches = 40
+    rows_per = 1000
+    now = int(_time.time() * 1000)
+
+    def rows_for(tid, b):
+        base = (tid * n_batches + b) * rows_per
+        ids = new_event_ids(rows_per)
+        return [
+            (ids[j], "rate", "user", f"u{(base + j) % 9973}",
+             "item", f"i{(base + j) % 313}", '{"rating":4.0}',
+             now + base + j, "[]", None, now)
+            for j in range(rows_per)
+        ]
+
+    for k in [int(x) for x in args.shards.split(",")]:
+        if k == 1:
+            store = SQLiteEventStore(Path(tmp) / "scale-1.db")
+        else:
+            store = ShardedSQLiteEventStore(
+                Path(tmp) / f"scale-{k}", n_shards=k
+            )
+        store.init_channel(1)
+
+        def writer(tid):
+            for b in range(n_batches):
+                store.insert_raw_rows(rows_for(tid, b), app_id=1)
+
+        with concurrent.futures.ThreadPoolExecutor(writers) as ex:
+            list(ex.map(writer, [99]))  # warm: tables + first WAL
+            t0 = time.perf_counter()
+            list(ex.map(writer, range(writers)))
+            dt = time.perf_counter() - t0
+        total = writers * n_batches * rows_per
+        print(json.dumps({
+            "metric": "ingest_sharded_store_events_per_s",
+            "value": round(total / dt, 1), "unit": "events/s",
+            "shards": k, "writers": writers,
+            "nproc": _os.cpu_count(),
+        }), flush=True)
+        store.close()
 
 
 if __name__ == "__main__":

@@ -483,6 +483,7 @@ def cmd_export(args, storage: Storage) -> int:
 
 def cmd_template(args, storage: Storage) -> int:
     """Offline gallery (`console/Template.scala:130-427` analogue)."""
+    import http.client
     import urllib.error
 
     from ..tools.template_gallery import (
@@ -495,7 +496,8 @@ def cmd_template(args, storage: Storage) -> int:
             # remote gallery browse (Template.scala:130-170 analogue)
             try:
                 entries = fetch_index(args.index_url)
-            except (ValueError, urllib.error.URLError, OSError) as e:
+            except (ValueError, urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
                 _out(f"Error: {e}")
                 return 1
             for e in entries:
@@ -521,7 +523,10 @@ def cmd_template(args, storage: Storage) -> int:
             else:
                 target = scaffold(args.name, args.directory or args.name)
         except (KeyError, FileExistsError, FileNotFoundError, ValueError,
-                TemplateVersionError, urllib.error.URLError, OSError) as e:
+                TemplateVersionError, urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
+            # HTTPException covers truncated/garbage responses
+            # (IncompleteRead, BadStatusLine) that are not OSErrors
             _out(f"Error: {e}")
             return 1
         _out(f"Engine template '{args.name}' created at {target}/")

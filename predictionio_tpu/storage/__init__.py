@@ -32,6 +32,7 @@ from .metadata import (
     Model,
 )
 from .registry import Storage, StorageError, get_storage, reset_storage
+from .sharded_events import ShardedSQLiteEventStore
 from .sqlite_events import SQLiteEventStore
 from .store import LEventStore, PEventStore, app_name_to_id
 
@@ -59,6 +60,7 @@ __all__ = [
     "NO_TARGET",
     "EventStore",
     "MemoryEventStore",
+    "ShardedSQLiteEventStore",
     "SQLiteEventStore",
     "AccessKey",
     "App",

@@ -124,6 +124,23 @@ class Storage:
                     if path != ":memory:":
                         Path(path).parent.mkdir(parents=True, exist_ok=True)
                     self._event_store = SQLiteEventStore(path)
+                elif stype == "sqlite-sharded":
+                    # entity-hash sharded writes (region-parallel HBase
+                    # analogue); PATH is a directory, SHARDS the count
+                    from .sharded_events import ShardedSQLiteEventStore
+
+                    try:
+                        n_shards = int(conf.get("shards", "4"))
+                    except ValueError:
+                        raise StorageError(
+                            "sqlite-sharded source: SHARDS must be an "
+                            f"integer, got {conf.get('shards')!r}"
+                        )
+                    self._event_store = ShardedSQLiteEventStore(
+                        conf.get("path")
+                        or str(_home(self.env) / "eventdata-shards"),
+                        n_shards=n_shards,
+                    )
                 elif "." in stype:
                     self._event_store = self._load_custom(stype, conf)
                 else:

@@ -130,17 +130,19 @@ class Storage:
                     from .sharded_events import ShardedSQLiteEventStore
 
                     try:
-                        n_shards = int(conf.get("shards", "4"))
-                    except ValueError:
-                        raise StorageError(
-                            "sqlite-sharded source: SHARDS must be an "
-                            f"integer, got {conf.get('shards')!r}"
+                        self._event_store = ShardedSQLiteEventStore(
+                            conf.get("path")
+                            or str(_home(self.env) / "eventdata-shards"),
+                            n_shards=int(conf.get("shards", "4")),
                         )
-                    self._event_store = ShardedSQLiteEventStore(
-                        conf.get("path")
-                        or str(_home(self.env) / "eventdata-shards"),
-                        n_shards=n_shards,
-                    )
+                    except ValueError as e:
+                        # bad SHARDS value, count < 1, or a marker
+                        # mismatch — all config-class errors; surface
+                        # them the way every other registry misconfig
+                        # surfaces
+                        raise StorageError(
+                            f"sqlite-sharded source: {e}"
+                        ) from e
                 elif "." in stype:
                     self._event_store = self._load_custom(stype, conf)
                 else:

@@ -24,6 +24,14 @@ process), mirroring the md5-prefix distribution of the reference's
 rowkeys.  The shard count is fixed at creation and stamped in a
 marker file; opening with a different count refuses loudly instead of
 silently mis-routing entities.
+
+Known semantic drift from the single-file store: re-inserting an
+EXPLICIT ``event_id`` under a different entity lands in a different
+shard, so the cross-file OR-REPLACE upsert cannot collapse the two rows
+— both remain until deleted (``delete`` removes every copy).
+Auto-generated ids are unique, so only clients that reuse ids across
+entities can observe this; the reference's HBase rowkeys (entity-hash
+prefixed) cannot express that operation at all.
 """
 
 from __future__ import annotations
@@ -32,6 +40,8 @@ import contextlib
 import datetime as _dt
 import heapq
 import json
+import os
+import time
 import zlib
 from pathlib import Path
 from typing import Iterable, Iterator, Optional, Sequence
@@ -77,8 +87,24 @@ class ShardedSQLiteEventStore(EventStore):
             # marker, the loser falls through to the compare
             with open(marker, "x") as f:
                 f.write(json.dumps({"n_shards": n_shards}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
         except FileExistsError:
-            stamped = json.loads(marker.read_text()).get("n_shards")
+            # the winner may still be between create and write; wait
+            # for content rather than crash on an empty read
+            txt = ""
+            for _ in range(200):
+                txt = marker.read_text()
+                if txt.strip():
+                    break
+                time.sleep(0.01)
+            else:
+                raise ValueError(
+                    f"shard marker {marker} exists but never gained "
+                    "content (crashed concurrent creator?); remove it "
+                    "to re-initialize"
+                )
+            stamped = json.loads(txt).get("n_shards")
             if stamped != n_shards:
                 raise ValueError(
                     f"event store at {self._dir} was created with "
@@ -123,20 +149,31 @@ class ShardedSQLiteEventStore(EventStore):
         self, events, app_id: int, channel_id: int = 0,
         validate: bool = True,
     ) -> list[str]:
+        from .event import validate_event
+
         events = list(events)
+        if validate:
+            # validate EVERYTHING before any shard writes: the single
+            # store's all-or-nothing semantics must survive sharding
+            for e in events:
+                validate_event(e)
         groups: dict[int, list[int]] = {}
         for pos, e in enumerate(events):
             groups.setdefault(
                 _shard_ix(e.entity_type, e.entity_id, self.n_shards), []
             ).append(pos)
         ids: list[Optional[str]] = [None] * len(events)
-        for six, positions in groups.items():
-            got = self.shards[six].insert_batch(
-                [events[p] for p in positions], app_id, channel_id,
-                validate=validate,
-            )
-            for p, eid in zip(positions, got):
-                ids[p] = eid
+        # one bulk scope spanning every touched shard: a sqlite error
+        # on a later group rolls back the earlier groups too (each
+        # shard's scope rolls back on the propagating exception)
+        with self.bulk():
+            for six, positions in groups.items():
+                got = self.shards[six].insert_batch(
+                    [events[p] for p in positions], app_id, channel_id,
+                    validate=False,
+                )
+                for p, eid in zip(positions, got):
+                    ids[p] = eid
         return ids  # aligned with the input order
 
     def insert_raw_rows(self, rows, app_id: int,
@@ -148,8 +185,9 @@ class ShardedSQLiteEventStore(EventStore):
             groups.setdefault(
                 _shard_ix(row[2], row[3], self.n_shards), []
             ).append(row)
-        for six, grp in groups.items():
-            self.shards[six].insert_raw_rows(grp, app_id, channel_id)
+        with self.bulk():  # cross-shard atomicity, as in insert_batch
+            for six, grp in groups.items():
+                self.shards[six].insert_raw_rows(grp, app_id, channel_id)
 
     @contextlib.contextmanager
     def bulk(self):
@@ -169,9 +207,14 @@ class ShardedSQLiteEventStore(EventStore):
 
     def delete(self, event_id: str, app_id: int,
                channel_id: int = 0) -> bool:
-        return any(
+        # NO short-circuit: a client that re-posted an explicit eventId
+        # under a DIFFERENT entity left copies in two shards (routing is
+        # by entity, so cross-shard OR-REPLACE cannot dedup them — a
+        # documented semantic drift from the single store); delete must
+        # remove every copy, not the first one found
+        return any([
             s.delete(event_id, app_id, channel_id) for s in self.shards
-        )
+        ])
 
     def delete_batch(
         self, event_ids: Iterable[str], app_id: int, channel_id: int = 0

@@ -61,6 +61,17 @@ __all__ = ["SQLiteEventStore", "SCHEMA_VERSION"]
 SCHEMA_VERSION = 1
 
 
+# the per-table secondary indexes, ONE definition: table schema,
+# 0->1 migration, and the bulk-import defer/rebuild all derive from it
+_INDEX_SQL = (
+    "CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time)",
+    "CREATE INDEX IF NOT EXISTS {t}_entity "
+    "ON {t} (entity_type, entity_id, event_time)",
+    "CREATE INDEX IF NOT EXISTS {t}_name ON {t} (event, event_time)",
+)
+_INDEX_NAMES = ("{t}_time", "{t}_entity", "{t}_name")
+
+
 def _migrate_0_to_1(conn: sqlite3.Connection) -> None:
     """Bring a pre-versioning DB to v1: ensure the aux table and every
     per-table index exists for each events table already in the file.
@@ -76,16 +87,8 @@ def _migrate_0_to_1(conn: sqlite3.Connection) -> None:
         "(tbl TEXT PRIMARY KEY, v INTEGER NOT NULL)"
     )
     for t in tables:
-        conn.execute(
-            f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time)"
-        )
-        conn.execute(
-            f"CREATE INDEX IF NOT EXISTS {t}_entity "
-            f"ON {t} (entity_type, entity_id, event_time)"
-        )
-        conn.execute(
-            f"CREATE INDEX IF NOT EXISTS {t}_name ON {t} (event, event_time)"
-        )
+        for stmt in _INDEX_SQL:
+            conn.execute(stmt.format(t=t))
 
 
 # version -> migration to version+1; future schema changes append here
@@ -105,15 +108,15 @@ CREATE TABLE IF NOT EXISTS {table} (
   pr_id TEXT,
   creation_time INTEGER NOT NULL
 );
-CREATE INDEX IF NOT EXISTS {table}_time ON {table} (event_time);
-CREATE INDEX IF NOT EXISTS {table}_entity
-  ON {table} (entity_type, entity_id, event_time);
-CREATE INDEX IF NOT EXISTS {table}_name ON {table} (event, event_time);
 CREATE TABLE IF NOT EXISTS _scan_versions (
   tbl TEXT PRIMARY KEY,
   v INTEGER NOT NULL
 );
-"""
+""" + "".join(
+    # index DDL derived from _INDEX_SQL so fresh tables, the 0->1
+    # migration, and the bulk defer/rebuild can never disagree
+    s.replace("{t}", "{table}") + ";\n" for s in _INDEX_SQL
+)
 
 
 def _table_name(app_id: int, channel_id: int) -> str:
@@ -333,6 +336,8 @@ class SQLiteEventStore(EventStore):
             ids.append(eid)
             rows.append(self._row(e, eid))
         with self._lock:
+            if self._bulk_depth:
+                self._maybe_defer_indexes(t)
             self._conn.executemany(
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)", rows
             )
@@ -352,6 +357,8 @@ class SQLiteEventStore(EventStore):
         """
         t = self._ensure_table(app_id, channel_id)
         with self._lock:
+            if self._bulk_depth:
+                self._maybe_defer_indexes(t)
             self._conn.executemany(
                 f"INSERT OR REPLACE INTO {t} VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 rows,
@@ -382,6 +389,36 @@ class SQLiteEventStore(EventStore):
     def _bulk_depth(self) -> int:
         return getattr(self._local, "bulk_depth", 0)
 
+    # bulk writes into a table at or below this row count drop the
+    # secondary indexes and rebuild once at commit; above it, the table
+    # is big enough that a full rebuild would cost more than the
+    # incremental maintenance of a (presumed small) append
+    _DEFER_MAX_EXISTING_ROWS = 100_000
+
+    def _maybe_defer_indexes(self, t: str) -> None:
+        """Called under the lock from bulk-scope write paths: drop the
+        table's secondary indexes for the duration of the scope when
+        the table is small (fresh imports — the certified 20M path —
+        have zero existing rows).  Big tables keep their indexes: a
+        10k-event append to a 20M-row table must not trigger a full
+        three-index rebuild at commit."""
+        if t in self._local.bulk_dropped or t in self._local.bulk_kept:
+            return
+        n = self._conn.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]
+        if n > self._DEFER_MAX_EXISTING_ROWS:
+            self._local.bulk_kept.add(t)
+            return
+        # python sqlite3 implicitly BEGINs only for DML, not DDL — the
+        # drops must join the scope's transaction or a rollback would
+        # restore the rows but leave the indexes gone
+        conn = self._conn
+        raw = getattr(conn, "_conn", conn)  # SerializedConnection proxy
+        if not raw.in_transaction:
+            conn.execute("BEGIN")
+        for name in _INDEX_NAMES:
+            conn.execute(f"DROP INDEX IF EXISTS {name.format(t=t)}")
+        self._local.bulk_dropped.add(t)
+
     @contextlib.contextmanager
     def bulk(self):
         """Defer commits to the end of the scope: bulk imports pay one
@@ -403,8 +440,20 @@ class SQLiteEventStore(EventStore):
         importer does); and the shared-connection ``:memory:`` mode can
         have another thread's commit absorb pending rows (test-only
         backend, single-writer assumption).
+
+        Index deferral: the first bulk write to a SMALL table (see
+        ``_maybe_defer_indexes``) drops its secondary indexes inside
+        the open transaction and rebuilds them wholesale just before
+        the commit — incremental B-tree maintenance on random entity
+        keys was 62% of import wall time at ML-20M scale (profiled;
+        BENCH_FULLSCALE_CPU.json import stage), while a post-load
+        rebuild is one sort per index.  A rollback restores the
+        indexes with everything else (sqlite DDL is transactional).
         """
         self._local.bulk_depth = self._bulk_depth + 1
+        if self._local.bulk_depth == 1:
+            self._local.bulk_dropped = set()
+            self._local.bulk_kept = set()
         try:
             yield self
         except BaseException:
@@ -412,12 +461,38 @@ class SQLiteEventStore(EventStore):
             if self._local.bulk_depth == 0:
                 with self._lock:
                     self._conn.rollback()
+                    # normally the rollback restores the dropped
+                    # indexes, but interleaved DDL (_ensure_table for a
+                    # NEW app/channel) implicitly COMMITs mid-scope,
+                    # making the drop durable — rebuild idempotently
+                    # (IF NOT EXISTS: a no-op when rollback sufficed)
+                    # so a failed import can't strand an index-less
+                    # table across restarts
+                    self._rebuild_dropped_indexes()
+                    self._conn.commit()
             raise
         else:
             self._local.bulk_depth -= 1
             if self._local.bulk_depth == 0:
                 with self._lock:
+                    self._rebuild_dropped_indexes()
                     self._conn.commit()
+
+    def _rebuild_dropped_indexes(self) -> None:
+        """Recreate (IF NOT EXISTS) the secondary indexes of every
+        table this thread's bulk scope dropped; called under the
+        lock."""
+        for t in self._local.bulk_dropped:
+            # a remove_channel inside the scope may have dropped the
+            # table out from under its indexes
+            if not self._conn.execute(
+                "SELECT 1 FROM sqlite_master "
+                "WHERE type='table' AND name=?", (t,)
+            ).fetchone():
+                continue
+            for stmt in _INDEX_SQL:
+                self._conn.execute(stmt.format(t=t))
+        self._local.bulk_dropped = set()
 
     # -- point reads ------------------------------------------------------
     @staticmethod
@@ -462,10 +537,15 @@ class SQLiteEventStore(EventStore):
             cur = self._conn.executemany(
                 f"DELETE FROM {t} WHERE event_id=?", ids
             )
-            self._bump_version(t)
+            removed = cur.rowcount if cur.rowcount >= 0 else len(ids)
+            # a no-op delete must not invalidate cached scans (sharded
+            # stores fan every id to every shard; only the shard that
+            # actually held rows has a changed table)
+            if removed:
+                self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
-            return cur.rowcount if cur.rowcount >= 0 else len(ids)
+            return removed
 
     # -- scans ------------------------------------------------------------
     def _query(

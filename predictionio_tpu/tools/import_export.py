@@ -507,7 +507,10 @@ def import_ratings_csv(
 
     Stores exposing the low-level row sink take a raw-rows fast path —
     at ML-20M scale the Event-object route costs minutes of pure
-    overhead.  The schema is framework-shaped, but the entity ids come
+    overhead.  (A pandas-vectorized parse of this loop was built and
+    measured NO faster once the store defers index maintenance during
+    bulk scopes — the wall is sqlite executemany + row assembly, which
+    both share — so the simple loop stays; see sqlite_events.bulk.)  The schema is framework-shaped, but the entity ids come
     straight from the file and the event name from the caller, so the
     same checks `validate_event` would apply are kept: the event name is
     validated once up front (it is constant) and per-row empty ids raise

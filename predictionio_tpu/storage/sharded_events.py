@@ -165,8 +165,13 @@ class ShardedSQLiteEventStore(EventStore):
         ids: list[Optional[str]] = [None] * len(events)
         # one bulk scope spanning every touched shard: a sqlite error
         # on a later group rolls back the earlier groups too (each
-        # shard's scope rolls back on the propagating exception)
-        with self.bulk():
+        # shard's scope rolls back on the propagating exception).
+        # defer_indexes=False — this scope exists for per-REQUEST
+        # atomicity; whole-table index rebuilds per 50-event POST would
+        # be quadratic steady-state ingest.  An importer's own
+        # surrounding bulk() still defers (the outermost scope's flag
+        # wins).
+        with self.bulk(defer_indexes=False):
             for six, positions in groups.items():
                 got = self.shards[six].insert_batch(
                     [events[p] for p in positions], app_id, channel_id,
@@ -185,15 +190,17 @@ class ShardedSQLiteEventStore(EventStore):
             groups.setdefault(
                 _shard_ix(row[2], row[3], self.n_shards), []
             ).append(row)
-        with self.bulk():  # cross-shard atomicity, as in insert_batch
+        # cross-shard atomicity as in insert_batch (and same reasoning
+        # for defer_indexes=False: the importer's outer scope defers)
+        with self.bulk(defer_indexes=False):
             for six, grp in groups.items():
                 self.shards[six].insert_raw_rows(grp, app_id, channel_id)
 
     @contextlib.contextmanager
-    def bulk(self):
+    def bulk(self, defer_indexes: bool = True):
         with contextlib.ExitStack() as stack:
             for s in self.shards:
-                stack.enter_context(s.bulk())
+                stack.enter_context(s.bulk(defer_indexes=defer_indexes))
             yield self
 
     # -- point reads ------------------------------------------------------

@@ -61,15 +61,20 @@ __all__ = ["SQLiteEventStore", "SCHEMA_VERSION"]
 SCHEMA_VERSION = 1
 
 
-# the per-table secondary indexes, ONE definition: table schema,
-# 0->1 migration, and the bulk-import defer/rebuild all derive from it
-_INDEX_SQL = (
-    "CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time)",
-    "CREATE INDEX IF NOT EXISTS {t}_entity "
-    "ON {t} (entity_type, entity_id, event_time)",
-    "CREATE INDEX IF NOT EXISTS {t}_name ON {t} (event, event_time)",
+# the per-table secondary indexes, ONE definition: table schema, the
+# 0->1 migration, and the bulk-import defer/rebuild (names AND create
+# statements) all derive from this — adding a 4th index here updates
+# every consumer at once
+_INDEXES = (
+    ("time", "event_time"),
+    ("entity", "entity_type, entity_id, event_time"),
+    ("name", "event, event_time"),
 )
-_INDEX_NAMES = ("{t}_time", "{t}_entity", "{t}_name")
+_INDEX_SQL = tuple(
+    f"CREATE INDEX IF NOT EXISTS {{t}}_{sfx} ON {{t}} ({cols})"
+    for sfx, cols in _INDEXES
+)
+_INDEX_NAMES = tuple(f"{{t}}_{sfx}" for sfx, _ in _INDEXES)
 
 
 def _migrate_0_to_1(conn: sqlite3.Connection) -> None:
@@ -402,10 +407,17 @@ class SQLiteEventStore(EventStore):
         have zero existing rows).  Big tables keep their indexes: a
         10k-event append to a 20M-row table must not trigger a full
         three-index rebuild at commit."""
+        if not getattr(self._local, "bulk_defer", True):
+            return
         if t in self._local.bulk_dropped or t in self._local.bulk_kept:
             return
-        n = self._conn.execute(f"SELECT COUNT(*) FROM {t}").fetchone()[0]
-        if n > self._DEFER_MAX_EXISTING_ROWS:
+        # existence probe at O(threshold), NOT COUNT(*): a full count
+        # scans the whole table — worst exactly on the big tables this
+        # check protects
+        big = self._conn.execute(
+            f"SELECT 1 FROM {t} LIMIT 1 OFFSET {self._DEFER_MAX_EXISTING_ROWS}"
+        ).fetchone()
+        if big:
             self._local.bulk_kept.add(t)
             return
         # python sqlite3 implicitly BEGINs only for DML, not DDL — the
@@ -420,7 +432,7 @@ class SQLiteEventStore(EventStore):
         self._local.bulk_dropped.add(t)
 
     @contextlib.contextmanager
-    def bulk(self):
+    def bulk(self, defer_indexes: bool = True):
         """Defer commits to the end of the scope: bulk imports pay one
         fsync instead of one per 5k-event batch.
 
@@ -441,7 +453,8 @@ class SQLiteEventStore(EventStore):
         have another thread's commit absorb pending rows (test-only
         backend, single-writer assumption).
 
-        Index deferral: the first bulk write to a SMALL table (see
+        Index deferral (``defer_indexes=True``, the importer default):
+        the first bulk write to a SMALL table (see
         ``_maybe_defer_indexes``) drops its secondary indexes inside
         the open transaction and rebuilds them wholesale just before
         the commit — incremental B-tree maintenance on random entity
@@ -449,11 +462,17 @@ class SQLiteEventStore(EventStore):
         BENCH_FULLSCALE_CPU.json import stage), while a post-load
         rebuild is one sort per index.  A rollback restores the
         indexes with everything else (sqlite DDL is transactional).
+        Pass ``defer_indexes=False`` for SHORT atomicity scopes (e.g.
+        the sharded store wrapping one request's groups): rebuilding
+        whole-table indexes per 50-event request would be quadratic
+        steady-state ingest.  The flag is consulted only when THIS
+        call opens the outermost scope; nested scopes inherit it.
         """
         self._local.bulk_depth = self._bulk_depth + 1
         if self._local.bulk_depth == 1:
             self._local.bulk_dropped = set()
             self._local.bulk_kept = set()
+            self._local.bulk_defer = defer_indexes
         try:
             yield self
         except BaseException:

@@ -691,3 +691,44 @@ def test_bulk_index_deferral_lifecycle(tmp_path):
         assert index_names(es) == {"events_1_time", "events_1_entity",
                                    "events_1_name"}
     es.close()
+
+
+def test_sharded_request_writes_do_not_defer_indexes(tmp_path):
+    """The sharded store's internal atomicity scope must NOT trigger
+    index deferral: a 50-event /batch POST dropping + rebuilding
+    whole-table indexes per request would be quadratic steady-state
+    ingest.  An importer's OWN surrounding bulk() still defers (the
+    outermost scope's flag wins)."""
+    from predictionio_tpu.storage import ShardedSQLiteEventStore
+
+    s = ShardedSQLiteEventStore(tmp_path / "sh", n_shards=2)
+    s.init_channel(1)
+
+    def shard_index_counts():
+        return [
+            len(sh._conn.execute(
+                "SELECT name FROM sqlite_master WHERE type='index' "
+                "AND name LIKE 'events~_1~_%' ESCAPE '~'"
+            ).fetchall())
+            for sh in s.shards
+        ]
+
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{k}",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 1.0}), event_time=_t(k))
+        for k in range(50)
+    ]
+    # request-style write (no caller bulk): indexes never dropped —
+    # observable via each shard's dropped-bookkeeping staying empty
+    s.insert_batch(evs, app_id=1)
+    assert shard_index_counts() == [3, 3]
+    for sh in s.shards:
+        assert getattr(sh._local, "bulk_dropped", set()) == set()
+
+    # importer-style write (caller bulk): deferral engages mid-scope
+    with s.bulk():
+        s.insert_batch(evs, app_id=1)
+        assert shard_index_counts() == [0, 0]
+    assert shard_index_counts() == [3, 3]  # rebuilt at commit
+    s.close()

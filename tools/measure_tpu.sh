@@ -86,4 +86,30 @@ run serving_threads4    python bench_serving.py --verbose --n 800 --threads 4
 run serving_threads16   python bench_serving.py --verbose --n 1600 --threads 16
 run serving_threads32   python bench_serving.py --verbose --n 3200 --threads 32
 run ingest              python bench_ingest.py
+# the serving path over real HTTP: separates tunnel RTT from device
+# time (the single-query p99 question, VERDICT r4 weak #5)
+run serving_http        python bench_serving.py --verbose --n 800 --threads 16 --http
+# ring top-k on the real device queue (single chip = 1-stage ring:
+# validates the shard_map ring lowers and runs on TPU silicon — the
+# multi-stage ICI behavior stays CPU-mesh-tested)
+run ring_topk_smoke     python -c "
+import time, numpy as np, jax, jax.numpy as jnp
+from predictionio_tpu.ops.distributed_topk import ring_topk_scores
+from predictionio_tpu.parallel.mesh import fence, make_mesh
+mesh = make_mesh()
+rng = np.random.default_rng(0)
+B, M, R, K = 64, 26744 // len(jax.devices()) * len(jax.devices()), 64, 16
+q = jnp.asarray(rng.normal(size=(B, R)).astype(np.float32))
+tbl = jnp.asarray(rng.normal(size=(M, R)).astype(np.float32))
+v, ix = ring_topk_scores(q, tbl, K, mesh); fence(v, ix)
+ref = np.asarray(q) @ np.asarray(tbl).T
+ok = bool(np.allclose(np.sort(np.asarray(v), axis=1)[:, -1],
+                      np.sort(ref, axis=1)[:, -1], atol=1e-3))
+t0 = time.time()
+for _ in range(10):
+    v, ix = ring_topk_scores(q, tbl, K, mesh)
+fence(v, ix)
+print({'metric': 'ring_topk_device_seconds', 'value': (time.time()-t0)/10,
+       'devices': len(jax.devices()), 'top1_matches_dense': ok})
+"
 echo "done; review $OUT/*.json and update docs"

@@ -28,10 +28,30 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["sort_coo_by_row", "scan_events_jsonl", "native_available"]
+__all__ = ["sort_coo_by_row", "scan_events_jsonl", "scan_ratings_sqlite",
+           "native_available"]
+
+
+class _PioRatingsScan(ctypes.Structure):
+    # mirrors PioRatingsScan in native/sqlite_scan.cpp
+    _fields_ = [
+        ("n", ctypes.c_int64),
+        ("u_codes", ctypes.POINTER(ctypes.c_int32)),
+        ("i_codes", ctypes.POINTER(ctypes.c_int32)),
+        ("values", ctypes.POINTER(ctypes.c_double)),
+        ("times", ctypes.POINTER(ctypes.c_int64)),
+        ("n_users", ctypes.c_int64),
+        ("n_items", ctypes.c_int64),
+        ("user_arena", ctypes.POINTER(ctypes.c_char)),
+        ("user_offs", ctypes.POINTER(ctypes.c_int64)),
+        ("item_arena", ctypes.POINTER(ctypes.c_char)),
+        ("item_offs", ctypes.POINTER(ctypes.c_int64)),
+        ("err", ctypes.c_char * 256),
+    ]
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
-_SRCS = [_NATIVE_DIR / "bucketize.cpp", _NATIVE_DIR / "jsonl_scan.cpp"]
+_SRCS = [_NATIVE_DIR / "bucketize.cpp", _NATIVE_DIR / "jsonl_scan.cpp",
+         _NATIVE_DIR / "sqlite_scan.cpp"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -66,11 +86,41 @@ def _load() -> Optional[ctypes.CDLL]:
                 # concurrent processes never dlopen a half-written file
                 tmp = so.with_suffix(f".{os.getpid()}.tmp")
                 try:
-                    subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC"]
-                        + [str(p) for p in srcs] + ["-o", str(tmp)],
-                        check=True, capture_output=True, timeout=120,
-                    )
+                    # -l:libsqlite3.so.0 — the image ships the runtime
+                    # library but no dev symlink/header; the colon form
+                    # links the exact soname (sqlite_scan.cpp declares
+                    # the ABI-stable prototypes itself).  If THAT link
+                    # fails (no libsqlite3 in the linker path, or a
+                    # toolchain without -l: support), retry without the
+                    # sqlite kernel so the other native kernels keep
+                    # their acceleration instead of all regressing to
+                    # NumPy.
+                    base = ["g++", "-O3", "-shared", "-fPIC"]
+                    try:
+                        subprocess.run(
+                            base + [str(p) for p in srcs]
+                            + ["-o", str(tmp), "-l:libsqlite3.so.0"],
+                            check=True, capture_output=True, timeout=120,
+                        )
+                    except subprocess.CalledProcessError as ce:
+                        # keep the compiler's own words: a syntax error
+                        # in any source would otherwise masquerade as a
+                        # libsqlite3 linking problem
+                        logger.warning(
+                            "sqlite-linked native build failed "
+                            "(stderr tail: %s); rebuilding without the "
+                            "sqlite scan kernel",
+                            (ce.stderr or b"")[-500:].decode(
+                                "utf-8", "replace"
+                            ),
+                        )
+                        subprocess.run(
+                            base + [
+                                str(p) for p in srcs
+                                if p.name != "sqlite_scan.cpp"
+                            ] + ["-o", str(tmp)],
+                            check=True, capture_output=True, timeout=120,
+                        )
                     os.replace(tmp, so)
                 finally:
                     tmp.unlink(missing_ok=True)
@@ -88,6 +138,18 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, i64p, i32p, f32p,
         ]
         lib.pio_sort_coo.restype = None
+        if hasattr(lib, "pio_scan_ratings"):
+            lib.pio_scan_ratings.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_char_p,
+            ]
+            lib.pio_scan_ratings.restype = ctypes.POINTER(
+                _PioRatingsScan
+            )
+            lib.pio_scan_ratings_free.argtypes = [
+                ctypes.POINTER(_PioRatingsScan)
+            ]
+            lib.pio_scan_ratings_free.restype = None
         if hasattr(lib, "pio_scan_events_jsonl"):
             lib.pio_scan_events_jsonl.argtypes = [
                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
@@ -193,3 +255,65 @@ def scan_events_jsonl(data: bytes):
         event_ms[:n], creation_ms[:n], line_off[:n], line_len[:n],
         status[:n],
     )
+
+
+def scan_ratings_sqlite(
+    db_path: str, table: str, event_name: str, float_prop: str,
+):
+    """Fused scan + id-dictionary encode over one events table.
+
+    Returns ``(u_codes i32[n], i_codes i32[n], values f64[n],
+    times i64[n], user_ids object[n_users], item_ids object[n_items])``
+    with codes in FIRST-SEEN dictionary order (callers remap to their
+    preferred determinism), or None when the native lib is absent.
+    Raises RuntimeError with sqlite's message on scan errors (e.g.
+    json_extract hitting a NaN/Infinity token) so callers can fall
+    back to the python peek path.
+
+    Caller contract (enforced in sqlite_events.find_ratings): ``table``
+    matches the events_<app>[_<ch>] shape and ``float_prop`` is a
+    simple ``[A-Za-z0-9_]+`` name — both are spliced into SQL;
+    ``event_name`` is bound, never spliced.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "pio_scan_ratings"):
+        return None
+    res = lib.pio_scan_ratings(
+        db_path.encode(), table.encode(), event_name.encode(),
+        float_prop.encode(),
+    )
+    if not res:
+        raise MemoryError("pio_scan_ratings allocation failed")
+    try:
+        rec = res.contents
+        err = bytes(rec.err).split(b"\0", 1)[0]
+        if err:
+            raise RuntimeError(
+                f"native ratings scan failed: {err.decode()}"
+            )
+        n = int(rec.n)
+        u = np.ctypeslib.as_array(rec.u_codes, shape=(n,)).copy() \
+            if n else np.empty(0, np.int32)
+        i = np.ctypeslib.as_array(rec.i_codes, shape=(n,)).copy() \
+            if n else np.empty(0, np.int32)
+        v = np.ctypeslib.as_array(rec.values, shape=(n,)).copy() \
+            if n else np.empty(0, np.float64)
+        t = np.ctypeslib.as_array(rec.times, shape=(n,)).copy() \
+            if n else np.empty(0, np.int64)
+
+        def ids(arena_ptr, offs_ptr, count):
+            count = int(count)
+            if count == 0:
+                return np.empty(0, dtype=object)
+            offs = np.ctypeslib.as_array(offs_ptr, shape=(count + 1,))
+            blob = ctypes.string_at(arena_ptr, int(offs[count]))
+            out = np.empty(count, dtype=object)
+            for k in range(count):
+                out[k] = blob[offs[k]:offs[k + 1]].decode()
+            return out
+
+        user_ids = ids(rec.user_arena, rec.user_offs, rec.n_users)
+        item_ids = ids(rec.item_arena, rec.item_offs, rec.n_items)
+    finally:
+        lib.pio_scan_ratings_free(res)
+    return u, i, v, t, user_ids, item_ids

@@ -18,7 +18,40 @@ import numpy as np
 from .bimap import StringIndex
 from .event import Event, time_millis
 
-__all__ = ["EventFrame", "events_to_frame", "Ratings"]
+__all__ = ["EventFrame", "dedup_coo", "events_to_frame", "Ratings"]
+
+
+def dedup_coo(u, it, v, t, n_items: int, dedup: str):
+    """Shared (user, item) pair dedup over an encoded COO — ONE
+    definition used by ``EventFrame.to_ratings`` and the native
+    fused-scan path (`sqlite_events.find_ratings`), so the two read
+    paths cannot drift.
+
+    ``dedup``: 'last' keeps the latest EVENT TIME per pair, with
+    EQUAL-time duplicates tie-broken by the larger value — a pure
+    function of the row multiset, so scan order (python cursor vs
+    native rowid walk vs shard interleave) can never pick different
+    survivors.  'sum' accumulates, 'none' keeps all.  Returns
+    ``(u, it, v)``.
+    """
+    if dedup == "none" or not len(u):
+        return u, it, v
+    pair = u.astype(np.int64) * n_items + it
+    if dedup == "last":
+        order = np.lexsort((v, t, pair))
+        pair_s = pair[order]
+        keep = np.r_[pair_s[1:] != pair_s[:-1], True]
+        sel = order[keep]
+        return u[sel], it[sel], v[sel]
+    if dedup == "sum":
+        uniq, inv = np.unique(pair, return_inverse=True)
+        v = np.bincount(inv, weights=v, minlength=len(uniq))
+        return (
+            (uniq // n_items).astype(np.int32),
+            (uniq % n_items).astype(np.int32),
+            v,
+        )
+    raise ValueError(f"unknown dedup mode: {dedup}")
 
 
 @dataclass
@@ -117,21 +150,7 @@ class EventFrame:
             v = np.full(len(self), implicit_value, dtype=np.float64)
         ok = (u >= 0) & (it >= 0) & ~np.isnan(v)
         u, it, v, t = u[ok], it[ok], v[ok], self.event_time_ms[ok]
-        if dedup != "none" and len(u):
-            pair = u.astype(np.int64) * len(items) + it
-            if dedup == "last":
-                order = np.lexsort((t, pair))
-                pair_s = pair[order]
-                keep = np.r_[pair_s[1:] != pair_s[:-1], True]
-                sel = order[keep]
-                u, it, v = u[sel], it[sel], v[sel]
-            elif dedup == "sum":
-                uniq, inv = np.unique(pair, return_inverse=True)
-                v = np.bincount(inv, weights=v, minlength=len(uniq))
-                u = (uniq // len(items)).astype(np.int32)
-                it = (uniq % len(items)).astype(np.int32)
-            else:
-                raise ValueError(f"unknown dedup mode: {dedup}")
+        u, it, v = dedup_coo(u, it, v, t, len(items), dedup)
         return Ratings(
             user_ix=u.astype(np.int32),
             item_ix=it.astype(np.int32),

@@ -274,6 +274,76 @@ class ShardedSQLiteEventStore(EventStore):
 
         yield from itertools.islice(merged, limit)
 
+    def find_ratings(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        event_name: str = "rate",
+        rating_property: str = "rating",
+        dedup: str = "last",
+    ):
+        """Fused training read across shards: each shard runs its
+        native scan+encode (`sqlite_events.find_ratings`), then the
+        shard dictionaries merge into one global id space.
+
+        Per-shard dedup is GLOBALLY exact here: routing is by entity,
+        so every event of a (user, item) pair lives in the user's one
+        shard — cross-shard duplicates of a pair cannot exist."""
+        from .bimap import StringIndex
+        from .columnar import Ratings
+
+        # shards are independent files and the native scan is a
+        # GIL-releasing C call: scan them CONCURRENTLY so the fused
+        # read costs ~max(per-shard) on a multi-core host, not the sum
+        # (the region-parallel behavior this store exists for)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(len(self.shards)) as ex:
+            parts = list(ex.map(
+                lambda s: s.find_ratings(
+                    app_id, channel_id, event_name=event_name,
+                    rating_property=rating_property, dedup=dedup,
+                ),
+                self.shards,
+            ))
+        paths = {
+            getattr(s, "last_ratings_scan_path", "python")
+            for s in self.shards
+        }
+        self.last_ratings_scan_path = (
+            paths.pop() if len(paths) == 1 else "mixed"
+        )
+        # dictionaries merge from EVERY part — a shard whose rows all
+        # filtered out (e.g. propless ratings) still contributes its
+        # ids, exactly like the single store's global factorize would
+        users = StringIndex(sorted(set().union(
+            *(p.users.ids.tolist() for p in parts)
+        )))
+        items = StringIndex(sorted(set().union(
+            *(p.items.ids.tolist() for p in parts)
+        )))
+        u_out, i_out, v_out = [], [], []
+        for p in parts:
+            if not len(p):
+                continue
+            # shard-local code -> global code, one gather per side
+            umap = users.encode(p.users.ids)
+            imap = items.encode(p.items.ids)
+            u_out.append(umap[p.user_ix])
+            i_out.append(imap[p.item_ix])
+            v_out.append(p.rating)
+        if not u_out:
+            u_out = [np.empty(0, np.int32)]
+            i_out = [np.empty(0, np.int32)]
+            v_out = [np.empty(0, np.float32)]
+        return Ratings(
+            user_ix=np.concatenate(u_out).astype(np.int32),
+            item_ix=np.concatenate(i_out).astype(np.int32),
+            rating=np.concatenate(v_out).astype(np.float32),
+            users=users,
+            items=items,
+        )
+
     def find_columnar(
         self,
         app_id: int,

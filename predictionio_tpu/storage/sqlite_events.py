@@ -640,6 +640,82 @@ class SQLiteEventStore(EventStore):
         cur = self._conn.execute(sql, params)
         return (self._event_from_row(r) for r in iter(cur.fetchone, None))
 
+    # -- fused training read (scan + encode in C) -------------------------
+    def find_ratings(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        event_name: str = "rate",
+        rating_property: str = "rating",
+        dedup: str = "last",
+    ):
+        """COO :class:`~predictionio_tpu.storage.columnar.Ratings`
+        straight from the events table in ONE native pass — the
+        training-read hot path fused (scan + string-id dictionary
+        build), replacing find_columnar + to_ratings' ~145 s + ~19 s at
+        ML-20M scale with a single C loop over the sqlite B-tree
+        (`native/sqlite_scan.cpp`).  Falls back to exactly
+        ``find_columnar(minimal=True) -> to_ratings`` when the native
+        lib is absent, the db is in-memory, or the scan errors
+        (non-strict JSON in properties makes json_extract raise).
+
+        Encoding matches ``to_ratings``' sorted-unique determinism:
+        the native first-seen codes are remapped through one argsort of
+        the (small) unique-id table.  Dedup shares ``dedup_coo`` with
+        the python path.
+        """
+        from .columnar import Ratings, dedup_coo
+        from ..storage.bimap import StringIndex
+
+        simple = bool(re.fullmatch(r"[A-Za-z0-9_]+", rating_property))
+        native = None
+        if simple and self._path != ":memory:" and self._bulk_depth == 0:
+            from ..native import scan_ratings_sqlite
+
+            t = self._ensure_table(app_id, channel_id)
+            try:
+                native = scan_ratings_sqlite(
+                    self._path, t, event_name, rating_property
+                )
+            except RuntimeError as e:
+                logger.warning(
+                    "native ratings scan fell back to python: %s", e
+                )
+        if native is None:
+            # recorded so benchmarks can label which path actually ran
+            # (a "fused" stage that silently fell back would compare a
+            # mislabeled slow path against the fused claims)
+            self.last_ratings_scan_path = "python"
+            frame = self.find_columnar(
+                app_id, channel_id, event_names=[event_name],
+                float_property=rating_property, minimal=True,
+            )
+            return frame.to_ratings(
+                rating_property=rating_property, dedup=dedup
+            )
+        self.last_ratings_scan_path = "native"
+
+        u, i, v, t_ms, user_ids, item_ids = native
+        # first-seen -> sorted-unique codes (to_ratings determinism)
+        uo = np.argsort(user_ids)
+        io = np.argsort(item_ids)
+        urank = np.empty(len(uo), np.int32)
+        urank[uo] = np.arange(len(uo), dtype=np.int32)
+        irank = np.empty(len(io), np.int32)
+        irank[io] = np.arange(len(io), dtype=np.int32)
+        u = urank[u] if len(u) else u
+        i = irank[i] if len(i) else i
+        ok = ~np.isnan(v)
+        u, i, v, t_ms = u[ok], i[ok], v[ok], t_ms[ok]
+        u, i, v = dedup_coo(u, i, v, t_ms, len(item_ids), dedup)
+        return Ratings(
+            user_ix=u.astype(np.int32),
+            item_ix=i.astype(np.int32),
+            rating=v.astype(np.float32),
+            users=StringIndex(user_ids[uo]),
+            items=StringIndex(item_ids[io]),
+        )
+
     # -- columnar batch read (PEvents analogue) ---------------------------
     def find_columnar(
         self,

@@ -219,9 +219,11 @@ def test_pipeline_mode_emits_stage_breakdown(capsys):
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(line)
     assert rec["metric"] == "ml20m_pipeline_file_to_model_seconds"
-    for stage in ("import", "scan_columnar", "encode_ids", "train"):
+    for stage in ("import", "scan_and_encode_fused", "train"):
         assert rec["stages"][stage] >= 0
     assert rec["n_events"] > 0
+    # which read path actually ran must be visible in the artifact
+    assert rec["scan_path"] in ("native", "python")
     assert rec["value"] > 0 and "train_rmse" in rec
 
 

@@ -732,3 +732,67 @@ def test_sharded_request_writes_do_not_defer_indexes(tmp_path):
         assert shard_index_counts() == [0, 0]
     assert shard_index_counts() == [3, 3]  # rebuilt at commit
     s.close()
+
+
+@pytest.mark.parametrize("backend", ["sqlite_file", "sharded"])
+def test_find_ratings_matches_python_path(tmp_path, backend, monkeypatch):
+    """The fused native scan+encode (`native/sqlite_scan.cpp` via
+    find_ratings) must produce EXACTLY the Ratings of
+    find_columnar(minimal) -> to_ratings — same sorted-unique id
+    dictionaries, same dedup — on both the single-file and sharded
+    stores, and the python fallback must engage when the native lib is
+    absent."""
+    import numpy as np
+
+    from predictionio_tpu.storage import ShardedSQLiteEventStore
+
+    if backend == "sharded":
+        s = ShardedSQLiteEventStore(tmp_path / "sh", n_shards=3)
+    else:
+        s = SQLiteEventStore(tmp_path / "ev.db")
+    s.init_channel(1)
+    rng = np.random.default_rng(5)
+    evs = [
+        Event(event="rate", entity_type="user",
+              entity_id=f"u{rng.integers(0, 40)}",
+              target_entity_type="item",
+              target_entity_id=f"i{rng.integers(0, 15)}",
+              properties=DataMap({"rating": float(rng.integers(1, 6))}),
+              event_time=_t(int(rng.integers(0, 59))))
+        for _ in range(600)
+    ] + [
+        # noise the scan must exclude: other event name, missing prop
+        Event(event="buy", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1"),
+        Event(event="rate", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i2"),
+    ]
+    s.insert_batch(evs, app_id=1)
+
+    def assert_same(a, b):
+        assert list(a.users.ids) == list(b.users.ids)
+        assert list(a.items.ids) == list(b.items.ids)
+        ka = np.lexsort((a.item_ix, a.user_ix))
+        kb = np.lexsort((b.item_ix, b.user_ix))
+        assert np.array_equal(a.user_ix[ka], b.user_ix[kb])
+        assert np.array_equal(a.item_ix[ka], b.item_ix[kb])
+        assert np.allclose(a.rating[ka], b.rating[kb])
+
+    frame = s.find_columnar(app_id=1, event_names=["rate"],
+                            float_property="rating", minimal=True)
+    for dd in ("last", "sum", "none"):
+        assert_same(
+            s.find_ratings(app_id=1, dedup=dd),
+            frame.to_ratings(rating_property="rating", dedup=dd),
+        )
+
+    # forced python fallback takes the identical-result path
+    import predictionio_tpu.native as native_mod
+
+    monkeypatch.setattr(native_mod, "_lib", None)
+    monkeypatch.setattr(native_mod, "_tried", True)
+    assert_same(
+        s.find_ratings(app_id=1),
+        frame.to_ratings(rating_property="rating", dedup="last"),
+    )
+    s.close()

@@ -117,10 +117,19 @@ struct PioRatingsScan {
 
 // db_path/table/float_prop are validated by the python caller (table
 // matches events_<app>[_<ch>], prop matches [A-Za-z0-9_]+); event_name
-// is bound, never spliced.
-PioRatingsScan *pio_scan_ratings(const char *db_path, const char *table,
-                                 const char *event_name,
-                                 const char *float_prop) {
+// and entity_type are bound, never spliced.  has_entity_type=0 means
+// "no entity-type filter" — an explicit flag, NOT an empty-string
+// sentinel, because entity_type='' is a legal (never-matching) filter
+// in the python path and the two must behave identically.  The _v2
+// suffix is the ABI guard: a stale cached _native.so lacks the symbol,
+// so the loader's hasattr check routes to the python fallback instead
+// of silently calling a 4-arg function with 6 args.
+PioRatingsScan *pio_scan_ratings_v2(const char *db_path,
+                                    const char *table,
+                                    const char *event_name,
+                                    const char *float_prop,
+                                    const char *entity_type,
+                                    int has_entity_type) {
   PioRatingsScan *r = (PioRatingsScan *)calloc(1, sizeof(PioRatingsScan));
   if (!r) return nullptr;
   sqlite3 *db = nullptr;
@@ -131,11 +140,13 @@ PioRatingsScan *pio_scan_ratings(const char *db_path, const char *table,
     if (db) sqlite3_close(db);
     return r;
   }
+  bool with_etype = has_entity_type != 0;
   char sql[512];
   snprintf(sql, sizeof(sql),
            "SELECT entity_id, target_entity_id, event_time, "
-           "json_extract(properties, '$.%s') FROM %s WHERE event = ?1",
-           float_prop, table);
+           "json_extract(properties, '$.%s') FROM %s WHERE event = ?1%s",
+           float_prop, table,
+           with_etype ? " AND entity_type = ?2" : "");
   sqlite3_stmt *st = nullptr;
   if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) {
     snprintf(r->err, sizeof(r->err), "prepare failed: %s",
@@ -144,6 +155,8 @@ PioRatingsScan *pio_scan_ratings(const char *db_path, const char *table,
     return r;
   }
   sqlite3_bind_text(st, 1, event_name, -1, SQLITE_TRANSIENT);
+  if (with_etype)
+    sqlite3_bind_text(st, 2, entity_type, -1, SQLITE_TRANSIENT);
 
   Interner users, items;
   std::vector<int32_t> uc, ic;

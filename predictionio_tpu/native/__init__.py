@@ -138,12 +138,12 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, i64p, i32p, f32p,
         ]
         lib.pio_sort_coo.restype = None
-        if hasattr(lib, "pio_scan_ratings"):
-            lib.pio_scan_ratings.argtypes = [
+        if hasattr(lib, "pio_scan_ratings_v2"):
+            lib.pio_scan_ratings_v2.argtypes = [
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_char_p,
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
             ]
-            lib.pio_scan_ratings.restype = ctypes.POINTER(
+            lib.pio_scan_ratings_v2.restype = ctypes.POINTER(
                 _PioRatingsScan
             )
             lib.pio_scan_ratings_free.argtypes = [
@@ -259,6 +259,7 @@ def scan_events_jsonl(data: bytes):
 
 def scan_ratings_sqlite(
     db_path: str, table: str, event_name: str, float_prop: str,
+    entity_type: Optional[str] = None,
 ):
     """Fused scan + id-dictionary encode over one events table.
 
@@ -270,17 +271,23 @@ def scan_ratings_sqlite(
     json_extract hitting a NaN/Infinity token) so callers can fall
     back to the python peek path.
 
+    ``entity_type`` filters rows to one entity type; None disables the
+    filter (an EMPTY STRING is a real, never-matching filter — the
+    same semantics the python path's ``is not None`` check gives).
+
     Caller contract (enforced in sqlite_events.find_ratings): ``table``
     matches the events_<app>[_<ch>] shape and ``float_prop`` is a
     simple ``[A-Za-z0-9_]+`` name — both are spliced into SQL;
     ``event_name`` is bound, never spliced.
     """
     lib = _load()
-    if lib is None or not hasattr(lib, "pio_scan_ratings"):
+    if lib is None or not hasattr(lib, "pio_scan_ratings_v2"):
         return None
-    res = lib.pio_scan_ratings(
+    res = lib.pio_scan_ratings_v2(
         db_path.encode(), table.encode(), event_name.encode(),
         float_prop.encode(),
+        (entity_type or "").encode(),
+        0 if entity_type is None else 1,
     )
     if not res:
         raise MemoryError("pio_scan_ratings allocation failed")

@@ -281,6 +281,7 @@ class ShardedSQLiteEventStore(EventStore):
         event_name: str = "rate",
         rating_property: str = "rating",
         dedup: str = "last",
+        entity_type=None,
     ):
         """Fused training read across shards: each shard runs its
         native scan+encode (`sqlite_events.find_ratings`), then the
@@ -303,6 +304,7 @@ class ShardedSQLiteEventStore(EventStore):
                 lambda s: s.find_ratings(
                     app_id, channel_id, event_name=event_name,
                     rating_property=rating_property, dedup=dedup,
+                    entity_type=entity_type,
                 ),
                 self.shards,
             ))

@@ -648,6 +648,7 @@ class SQLiteEventStore(EventStore):
         event_name: str = "rate",
         rating_property: str = "rating",
         dedup: str = "last",
+        entity_type: Optional[str] = None,
     ):
         """COO :class:`~predictionio_tpu.storage.columnar.Ratings`
         straight from the events table in ONE native pass — the
@@ -675,7 +676,8 @@ class SQLiteEventStore(EventStore):
             t = self._ensure_table(app_id, channel_id)
             try:
                 native = scan_ratings_sqlite(
-                    self._path, t, event_name, rating_property
+                    self._path, t, event_name, rating_property,
+                    entity_type,
                 )
             except RuntimeError as e:
                 logger.warning(
@@ -689,6 +691,7 @@ class SQLiteEventStore(EventStore):
             frame = self.find_columnar(
                 app_id, channel_id, event_names=[event_name],
                 float_property=rating_property, minimal=True,
+                entity_type=entity_type,
             )
             return frame.to_ratings(
                 rating_property=rating_property, dedup=dedup

@@ -200,10 +200,11 @@ class RecommendationDataSource(DataSource):
             ).items()
         }
 
-    def _read_frame(self, ctx: WorkflowContext):
+    def _read_frame(self, ctx: WorkflowContext, es=None, app_id=None):
         p: DataSourceParams = self.params
-        app_id = _resolve_app_id(ctx, p)
-        es: EventStore = ctx.storage.get_event_store()
+        if es is None:
+            app_id = _resolve_app_id(ctx, p)
+            es = ctx.storage.get_event_store()
         frame = es.find_columnar(
             app_id=app_id,
             entity_type=p.entity_type,
@@ -215,14 +216,16 @@ class RecommendationDataSource(DataSource):
 
     def read_training(self, ctx: WorkflowContext) -> TrainingData:
         p: DataSourceParams = self.params
+        # one resolution for every branch below (metadata lookup +
+        # store handle; the branches used to each re-resolve)
+        app_id = _resolve_app_id(ctx, p)
+        es: EventStore = ctx.storage.get_event_store()
         if jax.process_count() > 1:
             # multi-host run: each process scans only its entity-hash shard
             # (the region-parallel HBase analogue, `HBPEvents.scala:99-105`),
             # then id dictionaries + COO are exchanged/gathered
             from ..parallel.ingest import read_ratings_distributed
 
-            app_id = _resolve_app_id(ctx, p)
-            es: EventStore = ctx.storage.get_event_store()
             ratings = read_ratings_distributed(
                 es,
                 exchange_dir=ctx.storage.model_data_dir() / "_ingest",
@@ -239,7 +242,27 @@ class RecommendationDataSource(DataSource):
                 items=self._read_items(es, app_id),
                 coo_local=(p.coo == "local"),
             )
-        frame, items = self._read_frame(ctx)
+        if (
+            hasattr(es, "find_ratings")
+            and len(p.event_names) == 1
+            and p.rating_property
+        ):
+            # fused native scan+encode (one C pass over the events
+            # table, `native/sqlite_scan.cpp`) when the configured
+            # filter set is expressible there; any other configuration
+            # — multiple event names, implicit ratings — takes the
+            # general columnar path below
+            ratings = es.find_ratings(
+                app_id=app_id,
+                event_name=p.event_names[0],
+                rating_property=p.rating_property,
+                dedup="last",
+                entity_type=p.entity_type,
+            )
+            return TrainingData(
+                ratings=ratings, items=self._read_items(es, app_id)
+            )
+        frame, items = self._read_frame(ctx, es=es, app_id=app_id)
         ratings = frame.to_ratings(
             rating_property=p.rating_property,
             dedup="last" if p.rating_property else "sum",

@@ -387,3 +387,74 @@ def test_coo_local_placement_mismatch_rejected_at_config_time():
             "rank": 4, "factorPlacement": "sharded"}}],
     })
     assert ep.algorithms[0][1].factor_placement == "sharded"
+
+
+def test_read_training_fused_path_matches_general(tmp_path):
+    """The DataSource's fused native read (sqlite find_ratings) must
+    produce the SAME TrainingData as the general columnar path (memory
+    store): identical id dictionaries, identical deduped COO.  This is
+    the user-facing `pio-tpu train` read, so the two storage backends
+    must be indistinguishable above the store layer."""
+    from predictionio_tpu.storage import Storage, reset_storage
+    from predictionio_tpu.templates.recommendation import (
+        RecommendationDataSource,
+    )
+
+    rng = np.random.default_rng(9)
+    events = []
+    for _ in range(500):
+        events.append(Event(
+            event="rate", entity_type="user",
+            entity_id=f"u{rng.integers(0, 30)}",
+            target_entity_type="item",
+            target_entity_id=f"i{rng.integers(0, 12)}",
+            properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            event_time=dt.datetime(2020, 1, 1,
+                                   minute=int(rng.integers(0, 59)),
+                                   tzinfo=UTC),
+        ))
+    # a buy event the rate-only read must ignore
+    events.append(Event(event="buy", entity_type="user", entity_id="u0",
+                        target_entity_type="item", target_entity_id="i0"))
+
+    results = []
+    for kind in ("memory", "sqlite"):
+        env = {"PIO_TPU_HOME": str(tmp_path / kind)}
+        if kind == "memory":
+            env.update({
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+                "PIO_STORAGE_SOURCES_M_TYPE": "memory",
+            })
+        s = Storage(env=env)
+        md = s.get_metadata()
+        app = md.app_insert("fusedapp")
+        es = s.get_event_store()
+        es.init_channel(app.id)
+        es.insert_batch(events, app_id=app.id)
+        from predictionio_tpu.controller.base import instantiate
+
+        ds = instantiate(
+            RecommendationDataSource,
+            DataSourceParams(app_name="fusedapp"),
+        )
+        td = ds.read_training(WorkflowContext(storage=s, mode="Training"))
+        results.append(td)
+        if kind == "sqlite":
+            from predictionio_tpu.native import native_available
+
+            # the fused path must have engaged where the lib exists;
+            # hosts without a toolchain legitimately take the fallback
+            expected = "native" if native_available() else "python"
+            assert es.last_ratings_scan_path == expected
+        s.close()
+        reset_storage(None)
+
+    a, b = results
+    assert list(a.ratings.users.ids) == list(b.ratings.users.ids)
+    assert list(a.ratings.items.ids) == list(b.ratings.items.ids)
+    ka = np.lexsort((a.ratings.item_ix, a.ratings.user_ix))
+    kb = np.lexsort((b.ratings.item_ix, b.ratings.user_ix))
+    assert np.array_equal(a.ratings.user_ix[ka], b.ratings.user_ix[kb])
+    assert np.array_equal(a.ratings.item_ix[ka], b.ratings.item_ix[kb])
+    assert np.allclose(a.ratings.rating[ka], b.ratings.rating[kb])
+    assert a.items == b.items

@@ -143,3 +143,58 @@ def _prune(d: Path) -> None:
             p.unlink()
         except OSError:
             pass
+
+
+def load_ratings(k: str):
+    """Cached Ratings snapshot (the fused find_ratings result), or
+    None.  Same correctness story as frames: the key embeds the table's
+    write-version + db identity, so a stale snapshot is never LOOKED UP,
+    only orphaned."""
+    path = cache_dir() / f"{k}.ratings.npz"
+    if not path.exists():
+        return None
+    try:
+        from .bimap import StringIndex
+        from .columnar import Ratings
+
+        with np.load(path, allow_pickle=False) as z:
+            r = Ratings(
+                user_ix=z["user_ix"],
+                item_ix=z["item_ix"],
+                rating=z["rating"],
+                users=StringIndex(z["user_ids"].astype(object)),
+                items=StringIndex(z["item_ids"].astype(object)),
+            )
+        os.utime(path, None)
+        return r
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        logger.debug("ratings cache read failed (%s); rescanning", e)
+        return None
+
+
+def store_ratings(k: str, ratings) -> None:
+    """Snapshot a Ratings; best-effort, atomic publish."""
+    try:
+        d = cache_dir()
+        tmp = tempfile.NamedTemporaryFile(
+            dir=d, suffix=".tmp", delete=False
+        )
+        try:
+            np.savez(
+                tmp,
+                user_ix=ratings.user_ix,
+                item_ix=ratings.item_ix,
+                rating=ratings.rating,
+                user_ids=ratings.users.ids.astype(str),
+                item_ids=ratings.items.ids.astype(str),
+            )
+            tmp.close()
+            os.replace(tmp.name, d / f"{k}.ratings.npz")
+        finally:
+            try:
+                os.unlink(tmp.name)
+            except OSError:
+                pass
+        _prune(d)
+    except Exception as e:  # noqa: BLE001
+        logger.debug("ratings cache write failed (%s)", e)

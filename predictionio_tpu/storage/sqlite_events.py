@@ -21,6 +21,7 @@ from __future__ import annotations
 import contextlib
 import datetime as _dt
 import json
+import os
 import re
 import sqlite3
 import threading
@@ -649,6 +650,7 @@ class SQLiteEventStore(EventStore):
         rating_property: str = "rating",
         dedup: str = "last",
         entity_type: Optional[str] = None,
+        cache: Optional[bool] = None,
     ):
         """COO :class:`~predictionio_tpu.storage.columnar.Ratings`
         straight from the events table in ONE native pass — the
@@ -665,8 +667,34 @@ class SQLiteEventStore(EventStore):
         the (small) unique-id table.  Dedup shares ``dedup_coo`` with
         the python path.
         """
+        from . import scan_cache
         from .columnar import Ratings, dedup_coo
         from ..storage.bimap import StringIndex
+
+        # same snapshot cache as find_columnar (same correctness story:
+        # key embeds the table write-version + db identity), but at the
+        # RATINGS level — repeat trains/sweeps skip the whole scan AND
+        # the encode, not just the cursor walk
+        cache_key = None
+        v_before = None
+        if (
+            scan_cache.enabled(cache)
+            and self._path != ":memory:"
+            and self._bulk_depth == 0
+        ):
+            t0 = self._ensure_table(app_id, channel_id)
+            st = os.stat(self._path)
+            v_before = self._version(t0)
+            cache_key = scan_cache.key(
+                self._path, t0,
+                (v_before, st.st_ino, st.st_ctime_ns),
+                ["find_ratings", event_name, rating_property, dedup,
+                 entity_type],
+            )
+            cached = scan_cache.load_ratings(cache_key)
+            if cached is not None:
+                self.last_ratings_scan_path = "cache"
+                return cached
 
         simple = bool(re.fullmatch(r"[A-Za-z0-9_]+", rating_property))
         native = None
@@ -688,13 +716,19 @@ class SQLiteEventStore(EventStore):
             # (a "fused" stage that silently fell back would compare a
             # mislabeled slow path against the fused claims)
             self.last_ratings_scan_path = "python"
+            # cache=False: the result is cached at the RATINGS level
+            # below; a frame snapshot would never be read back and
+            # would only crowd the shared LRU
             frame = self.find_columnar(
                 app_id, channel_id, event_names=[event_name],
                 float_property=rating_property, minimal=True,
-                entity_type=entity_type,
+                entity_type=entity_type, cache=False,
             )
-            return frame.to_ratings(
+            out = frame.to_ratings(
                 rating_property=rating_property, dedup=dedup
+            )
+            return self._maybe_store_ratings(
+                out, cache_key, v_before, app_id, channel_id
             )
         self.last_ratings_scan_path = "native"
 
@@ -711,13 +745,31 @@ class SQLiteEventStore(EventStore):
         ok = ~np.isnan(v)
         u, i, v, t_ms = u[ok], i[ok], v[ok], t_ms[ok]
         u, i, v = dedup_coo(u, i, v, t_ms, len(item_ids), dedup)
-        return Ratings(
+        out = Ratings(
             user_ix=u.astype(np.int32),
             item_ix=i.astype(np.int32),
             rating=v.astype(np.float32),
             users=StringIndex(user_ids[uo]),
             items=StringIndex(item_ids[io]),
         )
+        return self._maybe_store_ratings(
+            out, cache_key, v_before, app_id, channel_id
+        )
+
+    def _maybe_store_ratings(self, out, cache_key, v_before, app_id,
+                             channel_id):
+        """ONE store gate for both find_ratings branches: snapshot only
+        when the table is provably unchanged across the scan (same rule
+        as find_columnar's frame snapshots)."""
+        from . import scan_cache
+
+        if (
+            cache_key is not None
+            and self._version(self._ensure_table(app_id, channel_id))
+            == v_before
+        ):
+            scan_cache.store_ratings(cache_key, out)
+        return out
 
     # -- columnar batch read (PEvents analogue) ---------------------------
     def find_columnar(
@@ -766,7 +818,7 @@ class SQLiteEventStore(EventStore):
             and self._path != ":memory:"
             and self._bulk_depth == 0
         ):
-            st = __import__("os").stat(self._path)
+            st = os.stat(self._path)
             v_before = self._version(t)
             cache_key = scan_cache.key(
                 self._path, t,

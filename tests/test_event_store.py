@@ -796,3 +796,45 @@ def test_find_ratings_matches_python_path(tmp_path, backend, monkeypatch):
         frame.to_ratings(rating_property="rating", dedup="last"),
     )
     s.close()
+
+
+def test_find_ratings_cache_roundtrip_and_invalidation(tmp_path,
+                                                       monkeypatch):
+    """The fused read caches at the RATINGS level (scan + encode both
+    skipped on repeat trains), serves the snapshot only while the
+    table's write-version is unchanged, and labels the path 'cache'."""
+    import numpy as np
+
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path / "home"))
+    monkeypatch.setenv("PIO_TPU_SCAN_CACHE", "1")
+    s = SQLiteEventStore(str(tmp_path / "rc.db"))
+    s.init_channel(1)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{k}",
+              target_entity_type="item", target_entity_id=f"i{k % 5}",
+              properties=DataMap({"rating": float(k % 5 + 1)}),
+              event_time=_t(k % 59))
+        for k in range(60)
+    ]
+    s.insert_batch(evs, app_id=1)
+
+    r1 = s.find_ratings(app_id=1)
+    assert s.last_ratings_scan_path in ("native", "python")
+    r2 = s.find_ratings(app_id=1)
+    assert s.last_ratings_scan_path == "cache"
+    assert list(r2.users.ids) == list(r1.users.ids)
+    assert np.array_equal(
+        np.sort(r2.rating), np.sort(r1.rating)
+    )
+    # different params -> different key, not the same snapshot
+    s.find_ratings(app_id=1, dedup="none")
+    assert s.last_ratings_scan_path != "cache"
+
+    # any write invalidates (version bump changes the key)
+    s.insert(Event(event="rate", entity_type="user", entity_id="u99",
+                   target_entity_type="item", target_entity_id="i0",
+                   properties=DataMap({"rating": 2.0})), app_id=1)
+    r3 = s.find_ratings(app_id=1)
+    assert s.last_ratings_scan_path != "cache"
+    assert "u99" in set(r3.users.ids.tolist())
+    s.close()

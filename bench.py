@@ -751,7 +751,7 @@ def run_pipeline(args) -> None:
         # native/sqlite_scan.cpp); recorded as one stage
         scan_path = None
         if hasattr(store, "find_ratings"):
-            ratings = store.find_ratings(app_id=1, event_name="rate",
+            ratings = store.find_ratings(app_id=1, event_names=("rate",),
                                          rating_property="rating",
                                          dedup="last")
             stages["scan_and_encode_fused"] = round(time.time() - t0, 3)

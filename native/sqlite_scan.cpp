@@ -115,21 +115,21 @@ struct PioRatingsScan {
   char err[256];        // empty on success
 };
 
-// db_path/table/float_prop are validated by the python caller (table
-// matches events_<app>[_<ch>], prop matches [A-Za-z0-9_]+); event_name
-// and entity_type are bound, never spliced.  has_entity_type=0 means
-// "no entity-type filter" — an explicit flag, NOT an empty-string
-// sentinel, because entity_type='' is a legal (never-matching) filter
-// in the python path and the two must behave identically.  The _v2
-// suffix is the ABI guard: a stale cached _native.so lacks the symbol,
-// so the loader's hasattr check routes to the python fallback instead
-// of silently calling a 4-arg function with 6 args.
-PioRatingsScan *pio_scan_ratings_v2(const char *db_path,
-                                    const char *table,
-                                    const char *event_name,
-                                    const char *float_prop,
-                                    const char *entity_type,
-                                    int has_entity_type) {
+// The python caller builds the SELECT itself (with the exact WHERE
+// semantics of its fallback path — identifiers validated, every
+// VALUE bound via ?N placeholders, never spliced) and passes the bind
+// strings; the C side just walks it.  Column contract: 0=entity_id,
+// 1=target_entity_id, 2=event_time, and — iff has_value_col — 3=the
+// numeric rating expression; has_value_col=0 is the implicit-feedback
+// mode (every row counts 1.0, the ``to_ratings(implicit_value=1.0)``
+// analogue).  The _sql suffix is the ABI guard: a stale cached
+// _native.so lacks the symbol, so the loader's hasattr check routes
+// to the python fallback instead of silently mis-calling.
+PioRatingsScan *pio_scan_ratings_sql(const char *db_path,
+                                     const char *sql,
+                                     const char *const *binds,
+                                     int n_binds,
+                                     int has_value_col) {
   PioRatingsScan *r = (PioRatingsScan *)calloc(1, sizeof(PioRatingsScan));
   if (!r) return nullptr;
   sqlite3 *db = nullptr;
@@ -140,13 +140,6 @@ PioRatingsScan *pio_scan_ratings_v2(const char *db_path,
     if (db) sqlite3_close(db);
     return r;
   }
-  bool with_etype = has_entity_type != 0;
-  char sql[512];
-  snprintf(sql, sizeof(sql),
-           "SELECT entity_id, target_entity_id, event_time, "
-           "json_extract(properties, '$.%s') FROM %s WHERE event = ?1%s",
-           float_prop, table,
-           with_etype ? " AND entity_type = ?2" : "");
   sqlite3_stmt *st = nullptr;
   if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) {
     snprintf(r->err, sizeof(r->err), "prepare failed: %s",
@@ -154,9 +147,8 @@ PioRatingsScan *pio_scan_ratings_v2(const char *db_path,
     sqlite3_close(db);
     return r;
   }
-  sqlite3_bind_text(st, 1, event_name, -1, SQLITE_TRANSIENT);
-  if (with_etype)
-    sqlite3_bind_text(st, 2, entity_type, -1, SQLITE_TRANSIENT);
+  for (int b = 0; b < n_binds; b++)
+    sqlite3_bind_text(st, b + 1, binds[b], -1, SQLITE_TRANSIENT);
 
   Interner users, items;
   std::vector<int32_t> uc, ic;
@@ -177,7 +169,7 @@ PioRatingsScan *pio_scan_ratings_v2(const char *db_path,
         // caller to that same loud path — native availability must
         // never flip behavior between crash and silent drop
         snprintf(r->err, sizeof(r->err),
-                 "null entity/target id in a %s row", event_name);
+                 "null entity/target id in an event row");
         sqlite3_finalize(st);
         sqlite3_close(db);
         return r;
@@ -186,22 +178,23 @@ PioRatingsScan *pio_scan_ratings_v2(const char *db_path,
       int ulen = sqlite3_column_bytes(st, 0);
       const char *i = (const char *)sqlite3_column_text(st, 1);
       int ilen = sqlite3_column_bytes(st, 1);
-      int vt = sqlite3_column_type(st, 3);
-      double v;
-      if (vt == SQLITE_NULL) {
-        v = NAN;  // property absent: dropped by the caller's ok-mask
-      } else if (vt == SQLITE_INTEGER || vt == SQLITE_FLOAT) {
-        v = sqlite3_column_double(st, 3);
-      } else {
-        // TEXT/BLOB rating: column_double would coerce to 0.0 and
-        // fabricate a rating the python path rejects with ValueError
-        // — error out so the caller falls back to that loud path
-        snprintf(r->err, sizeof(r->err),
-                 "non-numeric %s value in a %s row", float_prop,
-                 event_name);
-        sqlite3_finalize(st);
-        sqlite3_close(db);
-        return r;
+      double v = 1.0;  // implicit mode: every event counts once
+      if (has_value_col) {
+        int vt = sqlite3_column_type(st, 3);
+        if (vt == SQLITE_NULL) {
+          v = NAN;  // property absent: dropped by the caller's ok-mask
+        } else if (vt == SQLITE_INTEGER || vt == SQLITE_FLOAT) {
+          v = sqlite3_column_double(st, 3);
+        } else {
+          // TEXT/BLOB rating: column_double would coerce to 0.0 and
+          // fabricate a rating the python path rejects with ValueError
+          // — error out so the caller falls back to that loud path
+          snprintf(r->err, sizeof(r->err),
+                   "non-numeric rating value in an event row");
+          sqlite3_finalize(st);
+          sqlite3_close(db);
+          return r;
+        }
       }
       uc.push_back(users.intern(u, ulen));
       ic.push_back(items.intern(i, ilen));

@@ -138,12 +138,13 @@ def _load() -> Optional[ctypes.CDLL]:
             i64p, i64p, i32p, f32p,
         ]
         lib.pio_sort_coo.restype = None
-        if hasattr(lib, "pio_scan_ratings_v2"):
-            lib.pio_scan_ratings_v2.argtypes = [
-                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        if hasattr(lib, "pio_scan_ratings_sql"):
+            lib.pio_scan_ratings_sql.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                ctypes.c_int,
             ]
-            lib.pio_scan_ratings_v2.restype = ctypes.POINTER(
+            lib.pio_scan_ratings_sql.restype = ctypes.POINTER(
                 _PioRatingsScan
             )
             lib.pio_scan_ratings_free.argtypes = [
@@ -258,36 +259,30 @@ def scan_events_jsonl(data: bytes):
 
 
 def scan_ratings_sqlite(
-    db_path: str, table: str, event_name: str, float_prop: str,
-    entity_type: Optional[str] = None,
+    db_path: str, sql: str, binds, has_value_col: bool,
 ):
-    """Fused scan + id-dictionary encode over one events table.
+    """Fused scan + id-dictionary encode over one ratings SELECT.
 
-    Returns ``(u_codes i32[n], i_codes i32[n], values f64[n],
+    The caller builds ``sql`` (identifiers validated, every VALUE a
+    ``?N`` placeholder filled from ``binds``) with the column contract
+    ``entity_id, target_entity_id, event_time[, value]``;
+    ``has_value_col=False`` is implicit-feedback mode (each row counts
+    1.0).  Returns ``(u_codes i32[n], i_codes i32[n], values f64[n],
     times i64[n], user_ids object[n_users], item_ids object[n_items])``
     with codes in FIRST-SEEN dictionary order (callers remap to their
     preferred determinism), or None when the native lib is absent.
     Raises RuntimeError with sqlite's message on scan errors (e.g.
     json_extract hitting a NaN/Infinity token) so callers can fall
-    back to the python peek path.
-
-    ``entity_type`` filters rows to one entity type; None disables the
-    filter (an EMPTY STRING is a real, never-matching filter — the
-    same semantics the python path's ``is not None`` check gives).
-
-    Caller contract (enforced in sqlite_events.find_ratings): ``table``
-    matches the events_<app>[_<ch>] shape and ``float_prop`` is a
-    simple ``[A-Za-z0-9_]+`` name — both are spliced into SQL;
-    ``event_name`` is bound, never spliced.
+    back to the python path.
     """
     lib = _load()
-    if lib is None or not hasattr(lib, "pio_scan_ratings_v2"):
+    if lib is None or not hasattr(lib, "pio_scan_ratings_sql"):
         return None
-    res = lib.pio_scan_ratings_v2(
-        db_path.encode(), table.encode(), event_name.encode(),
-        float_prop.encode(),
-        (entity_type or "").encode(),
-        0 if entity_type is None else 1,
+    binds = [b.encode() for b in binds]
+    arr = (ctypes.c_char_p * len(binds))(*binds) if binds else None
+    res = lib.pio_scan_ratings_sql(
+        db_path.encode(), sql.encode(), arr, len(binds),
+        1 if has_value_col else 0,
     )
     if not res:
         raise MemoryError("pio_scan_ratings allocation failed")

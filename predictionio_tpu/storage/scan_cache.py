@@ -100,6 +100,26 @@ def load(k: str):
         return None
 
 
+def _publish(filename: str, arrays: dict) -> None:
+    """Atomic snapshot publish shared by the frame and ratings caches:
+    write to a temp file in the cache dir, os.replace into place,
+    prune.  Best-effort by contract — callers wrap in try/except."""
+    d = cache_dir()
+    tmp = tempfile.NamedTemporaryFile(
+        dir=d, suffix=".tmp", delete=False
+    )
+    try:
+        np.savez(tmp, **arrays)
+        tmp.close()
+        os.replace(tmp.name, d / filename)
+    finally:
+        try:
+            os.unlink(tmp.name)
+        except OSError:
+            pass
+    _prune(d)
+
+
 def store(k: str, frame) -> None:
     """Snapshot a property-free frame; best-effort, atomic publish."""
     if frame.properties is not None:
@@ -118,20 +138,7 @@ def store(k: str, frame) -> None:
                     return
                 a = a.astype(str)
             arrays[name] = a
-        d = cache_dir()
-        tmp = tempfile.NamedTemporaryFile(
-            dir=d, suffix=".tmp", delete=False
-        )
-        try:
-            np.savez(tmp, **arrays)
-            tmp.close()
-            os.replace(tmp.name, d / f"{k}.npz")
-        finally:
-            try:
-                os.unlink(tmp.name)
-            except OSError:
-                pass
-        _prune(d)
+        _publish(f"{k}.npz", arrays)
     except Exception as e:
         logger.debug("scan cache write failed (%s)", e)
 
@@ -175,26 +182,12 @@ def load_ratings(k: str):
 def store_ratings(k: str, ratings) -> None:
     """Snapshot a Ratings; best-effort, atomic publish."""
     try:
-        d = cache_dir()
-        tmp = tempfile.NamedTemporaryFile(
-            dir=d, suffix=".tmp", delete=False
-        )
-        try:
-            np.savez(
-                tmp,
-                user_ix=ratings.user_ix,
-                item_ix=ratings.item_ix,
-                rating=ratings.rating,
-                user_ids=ratings.users.ids.astype(str),
-                item_ids=ratings.items.ids.astype(str),
-            )
-            tmp.close()
-            os.replace(tmp.name, d / f"{k}.ratings.npz")
-        finally:
-            try:
-                os.unlink(tmp.name)
-            except OSError:
-                pass
-        _prune(d)
+        _publish(f"{k}.ratings.npz", dict(
+            user_ix=ratings.user_ix,
+            item_ix=ratings.item_ix,
+            rating=ratings.rating,
+            user_ids=ratings.users.ids.astype(str),
+            item_ids=ratings.items.ids.astype(str),
+        ))
     except Exception as e:  # noqa: BLE001
         logger.debug("ratings cache write failed (%s)", e)

@@ -278,10 +278,11 @@ class ShardedSQLiteEventStore(EventStore):
         self,
         app_id: int,
         channel_id: int = 0,
-        event_name: str = "rate",
-        rating_property: str = "rating",
+        event_names=("rate",),
+        rating_property="rating",
         dedup: str = "last",
         entity_type=None,
+        cache=None,
     ):
         """Fused training read across shards: each shard runs its
         native scan+encode (`sqlite_events.find_ratings`), then the
@@ -302,9 +303,9 @@ class ShardedSQLiteEventStore(EventStore):
         with ThreadPoolExecutor(len(self.shards)) as ex:
             parts = list(ex.map(
                 lambda s: s.find_ratings(
-                    app_id, channel_id, event_name=event_name,
+                    app_id, channel_id, event_names=event_names,
                     rating_property=rating_property, dedup=dedup,
-                    entity_type=entity_type,
+                    entity_type=entity_type, cache=cache,
                 ),
                 self.shards,
             ))

@@ -646,8 +646,8 @@ class SQLiteEventStore(EventStore):
         self,
         app_id: int,
         channel_id: int = 0,
-        event_name: str = "rate",
-        rating_property: str = "rating",
+        event_names: Sequence[str] = ("rate",),
+        rating_property: Optional[str] = "rating",
         dedup: str = "last",
         entity_type: Optional[str] = None,
         cache: Optional[bool] = None,
@@ -657,9 +657,11 @@ class SQLiteEventStore(EventStore):
         training-read hot path fused (scan + string-id dictionary
         build), replacing find_columnar + to_ratings' ~145 s + ~19 s at
         ML-20M scale with a single C loop over the sqlite B-tree
-        (`native/sqlite_scan.cpp`).  Falls back to exactly
-        ``find_columnar(minimal=True) -> to_ratings`` when the native
-        lib is absent, the db is in-memory, or the scan errors
+        (`native/sqlite_scan.cpp`).  ``rating_property=None`` is the
+        implicit-feedback read (every event counts 1.0 — the
+        similarproduct/ecommerce view-events path).  Falls back to
+        exactly ``find_columnar(minimal=True) -> to_ratings`` when the
+        native lib is absent, the db is in-memory, or the scan errors
         (non-strict JSON in properties makes json_extract raise).
 
         Encoding matches ``to_ratings``' sorted-unique determinism:
@@ -671,6 +673,7 @@ class SQLiteEventStore(EventStore):
         from .columnar import Ratings, dedup_coo
         from ..storage.bimap import StringIndex
 
+        event_names = list(event_names)
         # same snapshot cache as find_columnar (same correctness story:
         # key embeds the table write-version + db identity), but at the
         # RATINGS level — repeat trains/sweeps skip the whole scan AND
@@ -688,7 +691,7 @@ class SQLiteEventStore(EventStore):
             cache_key = scan_cache.key(
                 self._path, t0,
                 (v_before, st.st_ino, st.st_ctime_ns),
-                ["find_ratings", event_name, rating_property, dedup,
+                ["find_ratings", event_names, rating_property, dedup,
                  entity_type],
             )
             cached = scan_cache.load_ratings(cache_key)
@@ -696,16 +699,37 @@ class SQLiteEventStore(EventStore):
                 self.last_ratings_scan_path = "cache"
                 return cached
 
-        simple = bool(re.fullmatch(r"[A-Za-z0-9_]+", rating_property))
+        simple = rating_property is None or bool(
+            re.fullmatch(r"[A-Za-z0-9_]+", rating_property)
+        )
         native = None
-        if simple and self._path != ":memory:" and self._bulk_depth == 0:
+        if (
+            simple and event_names
+            and self._path != ":memory:" and self._bulk_depth == 0
+        ):
             from ..native import scan_ratings_sqlite
 
             t = self._ensure_table(app_id, channel_id)
+            # same WHERE semantics as the fallback's _query: event
+            # names and entity_type are VALUES (bound); the table name
+            # and the validated property name are identifiers
+            value_sql = (
+                f", json_extract(properties, '$.{rating_property}')"
+                if rating_property is not None else ""
+            )
+            qs = ",".join("?" * len(event_names))
+            sql = (
+                f"SELECT entity_id, target_entity_id, event_time"
+                f"{value_sql} FROM {t} WHERE event IN ({qs})"
+            )
+            binds = list(event_names)
+            if entity_type is not None:
+                sql += f" AND entity_type = ?{len(binds) + 1}"
+                binds.append(entity_type)
             try:
                 native = scan_ratings_sqlite(
-                    self._path, t, event_name, rating_property,
-                    entity_type,
+                    self._path, sql, binds,
+                    has_value_col=rating_property is not None,
                 )
             except RuntimeError as e:
                 logger.warning(
@@ -720,7 +744,7 @@ class SQLiteEventStore(EventStore):
             # below; a frame snapshot would never be read back and
             # would only crowd the shared LRU
             frame = self.find_columnar(
-                app_id, channel_id, event_names=[event_name],
+                app_id, channel_id, event_names=event_names,
                 float_property=rating_property, minimal=True,
                 entity_type=entity_type, cache=False,
             )

@@ -74,16 +74,26 @@ class ECommDataSource(DataSource):
         p = self.params
         app_id = _resolve_app_id(ctx, p)
         es = ctx.storage.get_event_store()
-        frame = es.find_columnar(
-            app_id=app_id, entity_type="user",
-            event_names=list(p.view_events),
-            float_property=p.rating_property,
-            minimal=True,   # only to_ratings fields are consumed
-        )
-        ratings = frame.to_ratings(
-            rating_property=p.rating_property,
-            dedup="last" if p.rating_property else "sum",
-        )
+        if hasattr(es, "find_ratings"):
+            # fused native read (explicit or implicit-count mode,
+            # native/sqlite_scan.cpp)
+            ratings = es.find_ratings(
+                app_id=app_id, event_names=p.view_events,
+                rating_property=p.rating_property,
+                dedup="last" if p.rating_property else "sum",
+                entity_type="user",
+            )
+        else:
+            frame = es.find_columnar(
+                app_id=app_id, entity_type="user",
+                event_names=list(p.view_events),
+                float_property=p.rating_property,
+                minimal=True,   # only to_ratings fields are consumed
+            )
+            ratings = frame.to_ratings(
+                rating_property=p.rating_property,
+                dedup="last" if p.rating_property else "sum",
+            )
         items = {
             k: dict(v.fields)
             for k, v in es.aggregate_properties_of(

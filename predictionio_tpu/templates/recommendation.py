@@ -242,21 +242,17 @@ class RecommendationDataSource(DataSource):
                 items=self._read_items(es, app_id),
                 coo_local=(p.coo == "local"),
             )
-        if (
-            hasattr(es, "find_ratings")
-            and len(p.event_names) == 1
-            and p.rating_property
-        ):
+        if hasattr(es, "find_ratings"):
             # fused native scan+encode (one C pass over the events
-            # table, `native/sqlite_scan.cpp`) when the configured
-            # filter set is expressible there; any other configuration
-            # — multiple event names, implicit ratings — takes the
-            # general columnar path below
+            # table, `native/sqlite_scan.cpp`); rating_property=None is
+            # the implicit-count mode, so every configuration routes
+            # through it — stores without the method take the general
+            # columnar path below
             ratings = es.find_ratings(
                 app_id=app_id,
-                event_name=p.event_names[0],
+                event_names=p.event_names,
                 rating_property=p.rating_property,
-                dedup="last",
+                dedup="last" if p.rating_property else "sum",
                 entity_type=p.entity_type,
             )
             return TrainingData(

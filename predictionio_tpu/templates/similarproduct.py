@@ -85,12 +85,20 @@ class SimilarProductDataSource(DataSource):
         p = self.params
         app_id = _resolve_app_id(ctx, p)
         es = ctx.storage.get_event_store()
-        frame = es.find_columnar(
-            app_id=app_id, entity_type="user",
-            event_names=list(p.view_events),
-            minimal=True,   # only to_ratings fields are consumed
-        )
-        ratings = frame.to_ratings(dedup="sum")  # implicit view counts
+        if hasattr(es, "find_ratings"):
+            # fused native implicit read: one C pass counting view
+            # events per (user, item) pair (native/sqlite_scan.cpp)
+            ratings = es.find_ratings(
+                app_id=app_id, event_names=p.view_events,
+                rating_property=None, dedup="sum", entity_type="user",
+            )
+        else:
+            frame = es.find_columnar(
+                app_id=app_id, entity_type="user",
+                event_names=list(p.view_events),
+                minimal=True,   # only to_ratings fields are consumed
+            )
+            ratings = frame.to_ratings(dedup="sum")  # implicit counts
         items = {
             k: dict(v.fields)
             for k, v in es.aggregate_properties_of(

@@ -786,6 +786,16 @@ def test_find_ratings_matches_python_path(tmp_path, backend, monkeypatch):
             frame.to_ratings(rating_property="rating", dedup=dd),
         )
 
+    # implicit-count mode over MULTIPLE event names (the
+    # similarproduct/ecommerce view-events read)
+    fr2 = s.find_columnar(app_id=1, event_names=["rate", "buy"],
+                          minimal=True)
+    assert_same(
+        s.find_ratings(app_id=1, event_names=("rate", "buy"),
+                       rating_property=None, dedup="sum"),
+        fr2.to_ratings(dedup="sum"),
+    )
+
     # forced python fallback takes the identical-result path
     import predictionio_tpu.native as native_mod
 

@@ -128,7 +128,7 @@ def main() -> None:
         # columnar scan + to_ratings internally if the lib is absent) --
         t0 = time.time()
         ratings = store.find_ratings(
-            app_id=1, event_name="rate", rating_property="rating",
+            app_id=1, event_names=("rate",), rating_property="rating",
             dedup="last",
         )
         stages["scan_and_encode_fused"] = round(time.time() - t0, 2)

@@ -180,4 +180,4 @@ if __name__ == "__main__":
     from predictionio_tpu.workflow import run_evaluation
 
     _, result = run_evaluation(evaluation_factory(), engine_params_list())
-    print(result.to_oneliner())
+    print(result.to_one_liner())

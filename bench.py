@@ -41,8 +41,6 @@ PROBE_TIMEOUT = 120   # s per attempt: accelerator backend init + tiny matmul
 PROBE_ATTEMPTS = 3    # retry ladder: transient tunnel flakes (r02/r03 both
                       # died on a single expired probe) get more shots
                       # within TOTAL_BUDGET before the CPU fallback
-TPU_RUN_TIMEOUT = 700   # s cap per attempt: full-scale staged train incl.
-                        # first compile
 CPU_RUN_TIMEOUT = 480   # s cap: small-scale fallback
 # hard wall-clock budget for the WHOLE orchestrated invocation: every
 # stage's timeout is clamped to the time remaining (less a reserve for
@@ -237,12 +235,14 @@ def _prepare(args):
 
     enable_compilation_cache()
     u, i, v, n_users, n_items = synth_ml20m(args.scale)
-    if args.verbose:
-        print(
-            f"# {len(v):,} ratings, {n_users:,} users x {n_items:,} items, "
-            f"devices={jax.devices()}",
-            file=sys.stderr,
-        )
+    # always a marker, not verbose-gated: the supervised orchestrator
+    # reads "# " stderr lines as proof of progress (a slow-but-healthy
+    # tunnel init must not be killed as a stall)
+    print(
+        f"# {len(v):,} ratings, {n_users:,} users x {n_items:,} items, "
+        f"devices={jax.devices()}",
+        file=sys.stderr, flush=True,
+    )
     mesh = make_mesh()
     mesh = mesh if mesh.size > 1 else None
     extra = {}
@@ -419,6 +419,13 @@ def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
 
 def run_inner(args) -> None:
     """The actual timed train: stages, warms up, trains, prints the JSON."""
+    # markers may declare how long the NEXT silent stretch is allowed to
+    # take (next-phase-budget=N); the supervisor widens its stall window
+    # accordingly.  Backend init through a sick tunnel either completes
+    # in ~40 s or errors out after ~15 min (round-5 log) — 420 s is the
+    # point past which waiting has never paid off.
+    print("# bench inner start next-phase-budget=420 (backend init + "
+          "synth)", file=sys.stderr, flush=True)
     jax, (u, i, v, n_users, n_items), mesh, cfg = _prepare(args)
     from predictionio_tpu.models.als import ALSFactors, ALSTrainer, rmse
 
@@ -435,12 +442,22 @@ def run_inner(args) -> None:
         uh = ih = vh = np.empty(0, np.int32)
 
     # warmup: compile both half-iteration executables (one per direction)
+    print("# next-phase-budget=420 (staging + first compiles)",
+          file=sys.stderr, flush=True)
     warm = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
                       staging=args.staging)
+    print(f"# warm trainer staged (staging={warm.staging}) "
+          "next-phase-budget=420 (first compiles)",
+          file=sys.stderr, flush=True)
     wU, wV = warm.init_factors()
     warm.run(wU, wV, 1)
     solver_used = warm.solver   # after the pallas compile-probe
     del warm, wU, wV
+    # the timed train is fence-free by design (per-step host round trips
+    # would pollute the measurement), so it is one long silent stretch:
+    # declare its budget instead of emitting heartbeats
+    print("# warm iteration done (compiles cached); timed train starts "
+          "next-phase-budget=600", file=sys.stderr, flush=True)
 
     # timed: full train — staging + 20 iterations (compiles now cached).
     # trainer.run() ends with a fence (tiny d2h), so dt includes the full
@@ -834,6 +851,13 @@ def _probe_accelerator(timeout: int = PROBE_TIMEOUT):
     return None, (proc.stderr.strip().splitlines() or ["backend init failed"])[-1]
 
 
+def _inner_cmd(extra_args):
+    """The ``bench.py --inner`` command line (tests substitute a stub)."""
+    return [
+        sys.executable, str(Path(__file__).resolve()), "--inner"
+    ] + extra_args
+
+
 def _run_inner_subprocess(extra_args, timeout, cpu_only=False):
     """Run ``bench.py --inner`` under a timeout; returns (json_line, err).
 
@@ -841,7 +865,7 @@ def _run_inner_subprocess(extra_args, timeout, cpu_only=False):
     plugin_env module docstring) so a down TPU tunnel can't hang it."""
     from plugin_env import scrub_plugin_env
 
-    cmd = [sys.executable, str(Path(__file__).resolve()), "--inner"] + extra_args
+    cmd = _inner_cmd(extra_args)
     env = dict(os.environ)
     if cpu_only:
         scrub_plugin_env(env)
@@ -853,10 +877,95 @@ def _run_inner_subprocess(extra_args, timeout, cpu_only=False):
         return None, f"timed out after {timeout}s"
     if proc.stderr:
         sys.stderr.write(proc.stderr[-4000:])
-    for line in proc.stdout.splitlines():
+    return _extract_result(proc.stdout, proc.stderr.splitlines())
+
+
+def _extract_result(stdout_text, stderr_lines):
+    """(json_line, err) from a finished child's captured output — the
+    one place both runners' result contract lives."""
+    for line in (stdout_text or "").splitlines():
         if line.startswith("{"):
             return line, None
-    return None, (proc.stderr.strip().splitlines() or ["no output"])[-1]
+    tail = [ln.strip() for ln in stderr_lines if ln.strip()]
+    return None, (tail or ["no output"])[-1]
+
+
+# kill an accelerator attempt only when it stops PROGRESSING for this
+# long — a degraded tunnel can take minutes per stage and still finish,
+# and a killed attempt wastes its whole backend init (measured 30 s
+# healthy, 12+ min when the tunnel control plane is sick, round-5 log)
+STALL_TIMEOUT = int(os.environ.get("PIO_TPU_BENCH_STALL_S", "330"))
+
+
+def _run_inner_supervised(extra_args, hard_cap, stall_timeout=None):
+    """Run ``bench.py --inner`` with progress-aware supervision.
+
+    Unlike the fixed-timeout ``_run_inner_subprocess``, the child is
+    killed only when (a) no ``# `` progress marker has appeared on its
+    stderr for the current stall window, or (b) ``hard_cap`` expires.
+    Stage markers are printed by ``run_inner`` at every phase boundary
+    (inner start → backend init/synth → warm staged → compiles done →
+    timed train), so a slow-but-advancing attempt through a degraded
+    tunnel survives, while a hung backend init dies in one stall window
+    instead of eating the whole budget.  A marker may carry
+    ``next-phase-budget=N`` to widen the window for a known-long silent
+    phase (backend init, the fence-free timed train) — still clamped by
+    ``hard_cap``.  Returns (json_line, err)."""
+    import re
+    import threading
+
+    stall = STALL_TIMEOUT if stall_timeout is None else stall_timeout
+    cmd = _inner_cmd(extra_args)
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    state = {"last_progress": time.time(), "stderr": [], "allow": stall}
+
+    def _drain():
+        for ln in proc.stderr:
+            state["stderr"].append(ln)
+            if ln.startswith("# "):
+                state["last_progress"] = time.time()
+                m = re.search(r"next-phase-budget=(\d+)", ln)
+                # each declared budget covers ONE phase: reset to the
+                # default at the next marker unless it declares its own
+                state["allow"] = int(m.group(1)) if m else stall
+            sys.stderr.write(ln)
+            sys.stderr.flush()
+
+    t = threading.Thread(target=_drain, daemon=True)
+    t.start()
+    start = time.time()
+    why = None
+    while proc.poll() is None:
+        now = time.time()
+        if now - start > hard_cap:
+            why = f"hard cap {hard_cap}s"
+            break
+        if now - state["last_progress"] > max(state["allow"], stall):
+            why = (
+                f"no progress for {state['allow']}s "
+                f"(ran {int(now - start)}s total)"
+            )
+            break
+        time.sleep(1.0)
+    if why is not None:
+        proc.kill()
+        proc.wait()
+        # the child may have PRINTED its JSON line and hung in teardown
+        # (TPU runtime atexit through a sick tunnel): a completed
+        # measurement must survive the kill
+        try:
+            out = proc.stdout.read() if proc.stdout else ""
+        except Exception:  # noqa: BLE001
+            out = ""
+        line, _ = _extract_result(out, [])
+        if line is not None:
+            return line, None
+        return None, f"killed: {why}"
+    out = proc.stdout.read() if proc.stdout else ""
+    t.join(timeout=5)
+    return _extract_result(out, state["stderr"])
 
 
 HISTORY_PATH = Path(__file__).resolve().parent / "BENCH_HISTORY.jsonl"
@@ -960,15 +1069,17 @@ def main() -> None:
         if platform is not None:
             break
     if platform is not None:
-        # attempt the best configurations first — the fused
-        # gather+Gram+solve kernel (the cost model's answer to the
-        # measured gather wall), then Gauss-Jordan Pallas solves +
-        # bf16x3 Gram, then the conservative all-XLA/f32 config: a
-        # kernel that fails to lower on this backend must cost one
-        # bounded retry, never the whole number.  (The in-trainer
-        # compile probes make kernel failures cheap: a failed probe
-        # degrades to xla within the same attempt.)  Explicit
-        # --solver/--precision flags pin a single attempt.
+        # attempt the best configuration first — Gauss-Jordan Pallas
+        # solves + bf16 gather + bf16x3 Gram (the GJ kernel is
+        # silicon-validated; the fused kernel is NOT — its jnp.take does
+        # not satisfy Mosaic's take_along_axis-only gather rule,
+        # round-5 fused_smoke — and requesting it would only degrade to
+        # xla after wasting one full backend init), then the
+        # conservative all-XLA/f32 config.  A kernel that fails its
+        # in-trainer compile probe degrades to xla within the same
+        # attempt, so kernel failures never cost a retry.  Explicit
+        # --solver/--precision/--gather-dtype flags pin a single
+        # attempt.
         attempts = [common]
         if (
             args.solver is None
@@ -976,27 +1087,21 @@ def main() -> None:
             and args.gather_dtype is None  # explicit dtype pins attempts
         ):
             attempts.insert(
-                0, common + ["--solver", "pallas", "--precision", "high"]
-            )
-            attempts.insert(
-                0, common + ["--solver", "fused", "--precision", "high",
+                0, common + ["--solver", "pallas", "--precision", "high",
                              "--gather-dtype", "bfloat16"]
             )
         errs = []
-        # weighted split of what's left over the attempts still to run:
-        # the FIRST (best) config gets the biggest share — an even split
-        # left it ~260 s, tight against a legitimate full-scale run
-        # (staging + compile + 20 iters measured ~235 s through the
-        # tunnel), so a slow-but-healthy best attempt could time out.
-        # A HANGING attempt still can't starve the rest: later attempts
-        # keep their weighted share of whatever actually remains.
-        weights = [9, 6, 5][: len(attempts)] or [1]
+        # progress-aware supervision (round-5): a slow-but-advancing
+        # attempt keeps its slot until the budget genuinely runs out —
+        # fixed per-attempt caps killed a full-scale run 11 s after its
+        # compiles landed (round-5 log) — while a stalled attempt dies
+        # after one STALL_TIMEOUT window.  The first (best) config gets
+        # the larger share of what remains.
+        weights = [3, 2][: len(attempts)] or [1]
         for k, extra in enumerate(attempts):
             share = weights[k] / sum(weights[k:])
-            cap = min(
-                TPU_RUN_TIMEOUT, int(remaining(CPU_RESERVE) * share)
-            )
-            line, err = _run_inner_subprocess(extra, max(cap, 60))
+            cap = int(remaining(CPU_RESERVE) * share)
+            line, err = _run_inner_supervised(extra, max(cap, 60))
             if line is not None:
                 _record_history(line)
                 print(line)

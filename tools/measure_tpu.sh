@@ -13,6 +13,13 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 OUT="${OUT:-tpu_measurements}"
 mkdir -p "$OUT"
+# one battery at a time: the watchdog may fire while a manual run is
+# in flight, and two processes cannot share the tunnel device queue
+exec 9> "$OUT/.battery.lock"
+if ! flock -n 9; then
+  echo "another battery holds $OUT/.battery.lock; exiting" >&2
+  exit 1
+fi
 run() {
   name=$1; shift
   echo "=== $name: $*" | tee -a "$OUT/log.txt"
@@ -20,7 +27,14 @@ run() {
   echo "--- rc=$? -> $OUT/$name.json" | tee -a "$OUT/log.txt"
 }
 
-# first: does the Gauss-Jordan kernel LOWER on this chip at all?
+# headline FIRST: round-5 showed tunnel windows can close in minutes —
+# the fenced north-star line (auto-appended to BENCH_HISTORY.jsonl) is
+# the single most valuable artifact, so it gets the freshest window.
+# bench.py's orchestrator supervises its own attempts (progress-aware
+# stalls, pallas-first ladder) within its ~17 min budget.
+run north_star          python bench.py --verbose
+
+# does the Gauss-Jordan kernel LOWER on this chip at all?
 # (decides the solver A/Bs' interpretation; ~30 s)
 run solver_smoke        python -c "
 import numpy as np, jax.numpy as jnp
@@ -66,16 +80,19 @@ for M, name in ((26744, 'item_table_resident'), (138493, 'user_table_streamed'))
            'plan': fused_tile_plan(M, R, K, 2), 'value': (time.time()-t0)/5})
 "
 
-# headline: device staging (the default at full scale), then the A/Bs
-run north_star          python bench.py --verbose
+# which Mosaic-supported gather form can replace the fused kernel's
+# unsupported jnp.take (round-5: lowering.py:2484 rejects it)?  Times
+# take_along_axis sublane/lane gathers, DMA row-copy loops, and the
+# XLA take baseline — the data that decides the fused-kernel rewrite.
+run probe_gather        python tools/probe_gather.py
+
+# the A/Bs (device staging is the default at full scale)
 run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"
 run breakdown_host_stage python bench.py --breakdown --staging host
 run breakdown_pallas    python bench.py --breakdown --solver pallas
-run breakdown_fused     python bench.py --breakdown --solver fused --gather-dtype bfloat16 --precision high
 run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16
 run breakdown_prec_high python bench.py --breakdown --precision high
-run north_star_best     python bench.py --inner --solver fused --gather-dtype bfloat16 --precision high --verbose
-run north_star_pallas   python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
+run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
 run parity              python bench.py --parity
 run pipeline            python bench.py --pipeline
 run solver_grid         python bench_solver.py

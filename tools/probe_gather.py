@@ -1,0 +1,266 @@
+"""On-chip probes for Mosaic-lowerable dynamic-gather forms.
+
+Round-5 finding: the fused ALS kernel's ``jnp.take(table, flat_idx)``
+does NOT lower on TPU — Mosaic's ``lax.gather`` rule
+(jax/_src/pallas/mosaic/lowering.py:2481-2484, jax 0.9.0) requires
+``input.shape == indices.shape[:-1] == output.shape`` (i.e.
+``take_along_axis`` semantics along axis 0 or 1), while the kernel
+needs ``[TB*KC, R]`` rows out of an ``[MC, R]`` table.
+
+This script measures, on the real chip, every candidate replacement:
+
+  A. same-shape ``take_along_axis(axis=0)`` sub-gathers — indices
+     broadcast across lanes, ``ceil(TB*KC/MC)`` gathers per chunk;
+  B. the transposed lane-dim variant (``axis=1`` on ``[R, M]``);
+  C. an in-kernel rolling-window ``pltpu.make_async_copy`` row loop
+     (indices scalar-prefetched to SMEM);
+  D. the XLA ``jnp.take`` baseline on identical shapes (what the
+     unfused path pays today), f32 and bf16.
+
+Each probe prints one JSON line; lowering failures print
+``{"ok": false, "error": ...}`` instead of raising, so the battery can
+run this unattended.  Decision rule: a Pallas form wins if its
+per-element gather time beats D's; otherwise the fused kernel stays
+retired and docs/PERF_PLAN.md records why.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    # off-TPU the probes run in interpret mode: validates shapes/logic
+    # (a CPU smoke), answers nothing about Mosaic lowering
+    return jax.default_backend() != "tpu"
+
+
+def _bench(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps, out
+
+
+def _emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+# ---------------------------------------------------------------- A --
+
+def _taa0_kernel(table_ref, idx_ref, out_ref):
+    # idx_ref [N, R] (row id broadcast across lanes); supported form:
+    # out[i, j] = table[idx[i, j], j]
+    out_ref[:] = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _taa0(table, idx):
+    n, r = table.shape
+    return pl.pallas_call(
+        _taa0_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, r), table.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(table, idx)
+
+
+def probe_taa0(n, r, dtype):
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(n, r)).astype(np.float32)
+    ).astype(dtype)
+    rows = rng.integers(0, n, size=(n,)).astype(np.int32)
+    idx = jnp.asarray(np.broadcast_to(rows[:, None], (n, r)).copy())
+    try:
+        dt, out = _bench(_taa0, table, idx)
+        good = bool(
+            np.allclose(
+                np.asarray(out, np.float32),
+                np.asarray(table, np.float32)[rows],
+                atol=1e-2,
+            )
+        )
+        _emit(metric="taa_axis0", n=n, r=r, dtype=str(dtype.dtype.name
+              if hasattr(dtype, "dtype") else dtype), ok=good,
+              seconds=dt, ns_per_row=dt / n * 1e9)
+    except Exception as e:  # noqa: BLE001
+        _emit(metric="taa_axis0", n=n, r=r, ok=False,
+              error=repr(e)[:300])
+
+
+# ---------------------------------------------------------------- B --
+
+def _taa1_kernel(table_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _taa1(table, idx):
+    r, m = table.shape
+    return pl.pallas_call(
+        _taa1_kernel,
+        out_shape=jax.ShapeDtypeStruct((r, m), table.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(table, idx)
+
+
+def probe_taa1(m, r, dtype):
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(r, m)).astype(np.float32)
+    ).astype(dtype)
+    cols = rng.integers(0, m, size=(m,)).astype(np.int32)
+    idx = jnp.asarray(np.broadcast_to(cols[None, :], (r, m)).copy())
+    try:
+        dt, out = _bench(_taa1, table, idx)
+        good = bool(
+            np.allclose(
+                np.asarray(out, np.float32),
+                np.asarray(table, np.float32)[:, cols],
+                atol=1e-2,
+            )
+        )
+        _emit(metric="taa_axis1", m=m, r=r, ok=good, seconds=dt,
+              ns_per_col=dt / m * 1e9)
+    except Exception as e:  # noqa: BLE001
+        _emit(metric="taa_axis1", m=m, r=r, ok=False,
+              error=repr(e)[:300])
+
+
+# ---------------------------------------------------------------- C --
+
+def _dma_kernel(idx_ref, table_ref, out_ref, sem):
+    # idx_ref is scalar-prefetched (SMEM); issue one row DMA per output
+    # row with a rolling window of WINDOW outstanding copies.
+    nout = out_ref.shape[0]
+    window = 16
+
+    def issue(k):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(idx_ref[k], 1)],
+            out_ref.at[pl.ds(k, 1)],
+            sem.at[k % window],
+        )
+
+    def body(k, _):
+        @pl.when(k >= window)
+        def _wait():
+            issue(k - window).wait()  # same (src, dst, sem) triple
+
+        issue(k).start()
+        return 0
+
+    jax.lax.fori_loop(0, nout, body, 0)
+
+    def drain(k, _):
+        issue(nout - window + k).wait()
+        return 0
+
+    jax.lax.fori_loop(0, window, drain, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("nout",))
+def _dma_gather(table, idx, *, nout):
+    _, r = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((16,))],
+    )
+    return pl.pallas_call(
+        _dma_kernel,
+        out_shape=jax.ShapeDtypeStruct((nout, r), table.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )(idx, table)
+
+
+def probe_dma(m, nout, r, dtype):
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(m, r)).astype(np.float32)
+    ).astype(dtype)
+    rows = rng.integers(0, m, size=(nout,)).astype(np.int32)
+    idx = jnp.asarray(rows)
+    try:
+        dt, out = _bench(
+            functools.partial(_dma_gather, nout=nout), table, idx
+        )
+        good = bool(
+            np.allclose(
+                np.asarray(out, np.float32),
+                np.asarray(table, np.float32)[rows],
+                atol=1e-2,
+            )
+        )
+        _emit(metric="dma_row_gather", m=m, nout=nout, r=r, ok=good,
+              seconds=dt, ns_per_row=dt / nout * 1e9)
+    except Exception as e:  # noqa: BLE001
+        _emit(metric="dma_row_gather", m=m, nout=nout, r=r, ok=False,
+              error=repr(e)[:300])
+
+
+# ---------------------------------------------------------------- D --
+
+def probe_xla_take(m, nout, r, dtype):
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(m, r)).astype(np.float32)
+    ).astype(dtype)
+    idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
+    take = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
+    dt, _ = _bench(take, table, idx)
+    bytes_moved = nout * r * table.dtype.itemsize
+    _emit(metric="xla_take", m=m, nout=nout, r=r,
+          dtype=table.dtype.name, seconds=dt,
+          ns_per_row=dt / nout * 1e9,
+          effective_gbps=bytes_moved / dt / 1e9)
+
+
+def main():
+    _emit(metric="probe_env", backend=jax.default_backend(),
+          device=str(jax.devices()[0]))
+    r = 64
+    for dtype in (jnp.float32, jnp.bfloat16):
+        name = jnp.dtype(dtype).name
+        _emit(metric="section", form="taa_axis0", dtype=name)
+        for n in (8, 256, 2048, 8192, 26744):
+            probe_taa0(n, r, dtype)
+    _emit(metric="section", form="taa_axis1")
+    probe_taa1(4096, r, jnp.float32)
+    probe_taa1(26744, r, jnp.float32)
+    _emit(metric="section", form="dma_row_gather")
+    for nout in (4096, 32768):
+        probe_dma(26744, nout, r, jnp.float32)
+    _emit(metric="section", form="xla_take_baseline")
+    for dtype in (jnp.float32, jnp.bfloat16):
+        probe_xla_take(26744, 32768, r, dtype)
+        probe_xla_take(138493, 32768, r, dtype)
+
+
+if __name__ == "__main__":
+    main()

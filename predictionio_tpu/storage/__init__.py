@@ -31,6 +31,7 @@ from .metadata import (
     MetadataStore,
     Model,
 )
+from .file_metadata import FileMetadataStore
 from .registry import Storage, StorageError, get_storage, reset_storage
 from .sharded_events import ShardedSQLiteEventStore
 from .sqlite_events import SQLiteEventStore
@@ -69,6 +70,7 @@ __all__ = [
     "EngineManifest",
     "EvaluationInstance",
     "MetadataStore",
+    "FileMetadataStore",
     "Model",
     "Storage",
     "StorageError",

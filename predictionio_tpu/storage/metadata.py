@@ -41,6 +41,14 @@ __all__ = [
 CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")  # Channels.scala:27-65
 
 
+def generate_access_key() -> str:
+    """A fresh CLI-argument-safe access key (no leading ``-``/``_``)."""
+    k = secrets.token_urlsafe(48).lstrip("-_")
+    while len(k) < 24:  # extremely unlikely
+        k = secrets.token_urlsafe(48).lstrip("-_")
+    return k
+
+
 @dataclass
 class App:
     id: int
@@ -256,12 +264,7 @@ class MetadataStore:
 
     # ---------------- access keys (AccessKeys.scala) ----------------
     def access_key_insert(self, key: AccessKey) -> str:
-        k = key.key
-        if not k:
-            # strip leading -/_ so generated keys are always CLI-argument-safe
-            k = secrets.token_urlsafe(48).lstrip("-_")
-            while len(k) < 24:  # extremely unlikely
-                k = secrets.token_urlsafe(48).lstrip("-_")
+        k = key.key or generate_access_key()
         with self._lock:
             self._conn.execute(
                 "INSERT INTO access_keys (key, appid, events) VALUES (?,?,?)",

@@ -160,6 +160,16 @@ class Storage:
                     if path != ":memory:":
                         Path(path).parent.mkdir(parents=True, exist_ok=True)
                     self._metadata = MetadataStore(path)
+                elif stype == "jsonfs":
+                    # JSON-document file tree (the reference's alternate
+                    # mongodb metadata backend, re-designed for the
+                    # shared-filesystem multi-host shape — file_metadata.py)
+                    from .file_metadata import FileMetadataStore
+
+                    path = conf.get("path") or str(
+                        _home(self.env) / "metadata-json"
+                    )
+                    self._metadata = FileMetadataStore(path)
                 elif "." in stype:
                     self._metadata = self._load_custom(stype, conf)
                 else:

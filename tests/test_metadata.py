@@ -1,4 +1,8 @@
-"""MetadataStore DAO tests (reference ES DAOs + record specs)."""
+"""Metadata DAO contract tests (reference ES DAOs + record specs).
+
+Parametrized over BOTH backends — the SQLite store and the jsonfs
+file-tree store (the reference's alternate mongodb metadata backend
+analogue) — so they stay behaviorally interchangeable."""
 
 import pytest
 
@@ -7,14 +11,18 @@ from predictionio_tpu.storage import (
     EngineInstance,
     EngineManifest,
     EvaluationInstance,
+    FileMetadataStore,
     MetadataStore,
     Model,
 )
 
 
-@pytest.fixture()
-def md(tmp_path):
-    m = MetadataStore(tmp_path / "meta.db")
+@pytest.fixture(params=["sqlite", "jsonfs"])
+def md(tmp_path, request):
+    if request.param == "sqlite":
+        m = MetadataStore(tmp_path / "meta.db")
+    else:
+        m = FileMetadataStore(tmp_path / "meta-json")
     yield m
     m.close()
 
@@ -117,3 +125,127 @@ def test_models_blob(md):
     assert md.model_get("i1").models == b"\x00\x01bytes"
     md.model_delete("i1")
     assert md.model_get("i1") is None
+
+
+def test_duplicate_access_key_rejected(md):
+    """An existing key must never be silently reassigned to another
+    app (PRIMARY KEY on sqlite; explicit check on jsonfs)."""
+    a = md.app_insert("appa")
+    b = md.app_insert("appb")
+    md.access_key_insert(AccessKey(key="K", appid=a.id))
+    with pytest.raises(Exception):
+        md.access_key_insert(AccessKey(key="K", appid=b.id))
+    assert md.access_key_get("K").appid == a.id
+
+
+def test_app_rename_to_existing_name_rejected(md):
+    """UNIQUE(name) holds through update on both backends; renaming an
+    app to itself stays legal."""
+    one = md.app_insert("one")
+    two = md.app_insert("two")
+    two.name = "one"
+    with pytest.raises(Exception):
+        md.app_update(two)
+    assert md.app_get(two.id).name == "two"
+    one.description = "self-rename ok"
+    md.app_update(one)
+    assert md.app_get(one.id).description == "self-rename ok"
+
+
+def test_hostile_keys_roundtrip(md):
+    """Keys with path separators / traversal shapes must round-trip as
+    DATA, never as filesystem structure (jsonfs escapes them; sqlite is
+    naturally immune — the contract holds for both)."""
+    m = EngineManifest(id="../evil/../id", version="v/1@x",
+                      name="n", engine_factory="f")
+    md.manifest_upsert(m)
+    got = md.manifest_get("../evil/../id", "v/1@x")
+    assert got is not None and got.name == "n"
+    assert md.manifest_get("../evil/../id", "v") is None
+    md.manifest_delete("../evil/../id", "v/1@x")
+    assert md.manifest_get("../evil/../id", "v/1@x") is None
+
+
+# ---------------- jsonfs-specific behavior ------------------------------
+
+
+def test_jsonfs_persists_across_reopen(tmp_path):
+    root = tmp_path / "meta-json"
+    a = FileMetadataStore(root)
+    app = a.app_insert("survivor", "desc")
+    a.model_insert(Model(id="m", models=b"blob"))
+    a.close()
+    b = FileMetadataStore(root)
+    assert b.app_get(app.id).name == "survivor"
+    assert b.model_get("m").models == b"blob"
+    # ids stay monotonic across delete + reopen (AUTOINCREMENT parity)
+    b.app_delete(app.id)
+    c = FileMetadataStore(root)
+    assert c.app_insert("next").id == app.id + 1
+
+
+def test_jsonfs_documents_stay_inside_root(tmp_path):
+    root = tmp_path / "meta-json"
+    m = FileMetadataStore(root)
+    m.manifest_upsert(EngineManifest(id="../../escape", version="v",
+                                     name="n", engine_factory="f"))
+    m.engine_instance_insert(EngineInstance(
+        id="../outside", status="INIT", start_time="t", end_time="t",
+        engine_id="e", engine_version="1", engine_variant="v",
+        engine_factory="f"))
+    inside = {p.resolve() for p in root.rglob("*") if p.is_file()}
+    outside = [p for p in inside if root.resolve() not in p.parents]
+    assert not outside
+    assert not (tmp_path / "escape@v.json").exists()
+
+
+def test_jsonfs_registry_wiring(tmp_path):
+    """TYPE=jsonfs resolves through the env registry; the same tree
+    also loads as a dotted-path custom backend with the conf dict."""
+    from predictionio_tpu.storage import Storage
+
+    env = {
+        "PIO_TPU_HOME": str(tmp_path / "home"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "FSM",
+        "PIO_STORAGE_SOURCES_FSM_TYPE": "jsonfs",
+        "PIO_STORAGE_SOURCES_FSM_PATH": str(tmp_path / "tree"),
+    }
+    s = Storage(env)
+    md = s.get_metadata()
+    assert isinstance(md, FileMetadataStore)
+    app = md.app_insert("via-env")
+    s.close()
+
+    env2 = dict(env)
+    env2["PIO_STORAGE_SOURCES_FSM_TYPE"] = (
+        "predictionio_tpu.storage.file_metadata.FileMetadataStore"
+    )
+    s2 = Storage(env2)
+    md2 = s2.get_metadata()
+    assert isinstance(md2, FileMetadataStore)
+    assert md2.app_get_by_name("via-env").id == app.id  # same tree
+    s2.close()
+
+
+def test_jsonfs_concurrent_inserts_unique_ids(tmp_path):
+    """The flock + sequence-file path must hand out unique monotonic
+    ids under thread concurrency (the chief/peer multi-writer shape)."""
+    import threading
+
+    m = FileMetadataStore(tmp_path / "meta-json")
+    ids = []
+    errs = []
+
+    def work(k):
+        try:
+            for j in range(5):
+                ids.append(m.app_insert(f"app-{k}-{j}").id)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=work, args=(k,)) for k in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    assert len(ids) == 20 and len(set(ids)) == 20
+    assert len(m.app_get_all()) == 20

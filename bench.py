@@ -138,6 +138,11 @@ def _parse_args(argv=None):
                     "bandwidth optimization.  Unset = float32, except "
                     "the orchestrated attempt chain may try bfloat16 "
                     "first; an EXPLICIT value pins every attempt")
+    ap.add_argument("--gather-mode", default=None,
+                    choices=("row", "grouped"),
+                    help="ALS gather form: plain row take vs tile-"
+                    "aligned slab gather + in-slab select (A/B the "
+                    "tile-waste hypothesis on-chip)")
     ap.add_argument("--staging", default="auto",
                     choices=("auto", "host", "device"),
                     help="COO staging path: host counting-sort vs compact "
@@ -253,6 +258,7 @@ def _prepare(args):
     cfg = ALSConfig(
         rank=args.rank, num_iterations=args.iters, lam=0.01,
         seed=args.seed, gather_dtype=args.gather_dtype or "float32",
+        gather_mode=args.gather_mode or "row",
         **extra,
     )
     return jax, (u, i, v, n_users, n_items), mesh, cfg
@@ -387,7 +393,8 @@ def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
             ks=ks, implicit=cfg.implicit,
             weighted_lambda=cfg.weighted_lambda,
             precision=cfg.matmul_precision, solver=cfg.solver,
-            gather_dtype=cfg.gather_dtype, stop_after=stop_after,
+            gather_dtype=cfg.gather_dtype, gather_mode=cfg.gather_mode,
+            stop_after=stop_after,
         )
 
     lam = jnp.asarray(cfg.lam, jnp.float32)
@@ -533,6 +540,7 @@ def run_inner(args) -> None:
                 ),
                 "precision": cfg.matmul_precision,
                 "gather_dtype": cfg.gather_dtype,
+                "gather_mode": cfg.gather_mode,
                 # the timed train covers the (1-holdout) split; recorded
                 # so the workload identity is explicit in every artifact
                 # (no fenced full-scale history predates this field, so
@@ -1045,6 +1053,8 @@ def main() -> None:
         "--staging", args.staging, "--holdout", str(args.holdout),
     ] + (["--gather-dtype", args.gather_dtype]
          if args.gather_dtype else []) \
+      + (["--gather-mode", args.gather_mode]
+         if args.gather_mode else []) \
       + (["--solver", args.solver] if args.solver else []) \
       + (["--precision", args.precision] if args.precision else []) \
       + (["--verbose"] if args.verbose else [])

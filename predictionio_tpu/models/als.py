@@ -52,6 +52,12 @@ from ..storage.columnar import Ratings
 
 logger = logging.getLogger(__name__)
 
+# cap on the grouped-gather slab intermediate ([chunk, K, G*R]): the
+# slab is G (8-16) times the row gather's output, so it's produced in
+# row-chunks of at most this many bytes and shrunk back to [*, K, R] by
+# the in-slab select before the next chunk materializes
+_GROUPED_SLAB_BYTES = 256 * 1024 * 1024
+
 __all__ = [
     "ALSConfig",
     "ALSFactors",
@@ -101,6 +107,15 @@ class ALSConfig:
     # bytes the hot gather moves (and the ICI all-gather in sharded mode)
     # at a small accuracy cost; solves and accumulation stay f32
     gather_dtype: str = "float32"
+    # how the opposite rows are fetched: "row" (plain jnp.take) or
+    # "grouped" — gather TILE-ALIGNED groups of 8 (f32) / 16 (bf16)
+    # consecutive rows as one [G*R]-lane slab, then take_along_axis the
+    # wanted row.  A rank-64 row is a fraction of one (8,128) memory
+    # tile, so the plain row gather can move up to 16x (f32) / 32x
+    # (bf16) more bytes than it delivers; grouped reads move whole
+    # tiles usefully.  Exact (same rows, same math) — the A/B is pure
+    # gather bandwidth, measured on-chip by bench.py --gather-mode.
+    gather_mode: str = "row"
 
     def __post_init__(self) -> None:
         # checked here, not at use sites: the use sites test exact
@@ -111,6 +126,11 @@ class ALSConfig:
             raise ValueError(
                 f"gather_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.gather_dtype!r}"
+            )
+        if self.gather_mode not in ("row", "grouped"):
+            raise ValueError(
+                f"gather_mode must be 'row' or 'grouped', "
+                f"got {self.gather_mode!r}"
             )
         if self.solver not in ("xla", "pallas", "fused"):
             raise ValueError(
@@ -399,6 +419,7 @@ def _half_iteration_impl(
     precision: str,
     solver: str,
     gather_dtype: str = "float32",
+    gather_mode: str = "row",
 ) -> jax.Array:
     def write(acc, rows, x):
         acc = upd if acc is None else acc
@@ -411,6 +432,7 @@ def _half_iteration_impl(
         write, opp, c_sorted, v_sorted, bucket_args, lam, alpha,
         ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
         precision=precision, solver=solver, gather_dtype=gather_dtype,
+        gather_mode=gather_mode,
     )
     return upd if out is None else out
 
@@ -421,7 +443,7 @@ _half_iteration = functools.partial(
     jax.jit,
     static_argnames=(
         "ks", "implicit", "weighted_lambda", "precision", "solver",
-        "gather_dtype",
+        "gather_dtype", "gather_mode",
     ),
     donate_argnums=(0,),
 )(_half_iteration_impl)
@@ -442,6 +464,7 @@ def _solve_buckets(
     precision: str,
     solver: str,
     gather_dtype: str = "float32",
+    gather_mode: str = "row",
     gram: Optional[jax.Array] = None,
     stop_after: Optional[str] = None,
 ):
@@ -484,6 +507,16 @@ def _solve_buckets(
         else opp
     )
     f32 = jnp.float32
+    opp_grp = grp = None
+    if gather_mode == "grouped":
+        # tile-aligned slab gather (ALSConfig.gather_mode): group height
+        # = the dtype's memory-tile sublane count, so one slab read is
+        # whole (8,128)/(16,128) tiles with no wasted sublanes
+        grp = 8 * (4 // opp_g.dtype.itemsize)
+        mg = -(-opp_g.shape[0] // grp) * grp
+        opp_grp = jnp.pad(
+            opp_g, ((0, mg - opp_g.shape[0]), (0, 0))
+        ).reshape(mg // grp, grp * r)
     fused_side = False
     if solver == "fused" and stop_after is None and ks:
         from ..ops.fused_als import fused_side_fits
@@ -521,7 +554,40 @@ def _solve_buckets(
             )
             out = upd_write(out, rows, x)
             continue
-        Vm = opp_g[idx] * valid[..., None].astype(opp_g.dtype)  # [B,K,R]
+        if opp_grp is not None:
+            # slab gather + in-slab select: exact same rows as the row
+            # gather, but every HBM read is a full memory tile.  The
+            # [*, K, G*R] slab is G times the row gather's output, so
+            # it's produced in row-chunks bounded by _GROUPED_SLAB_BYTES
+            # — the select shrinks each chunk back to [*, K, R] before
+            # the next one materializes.
+            bsz, k_ = idx.shape
+            per_row = k_ * grp * r * opp_grp.dtype.itemsize
+            bc = max(1, min(bsz, _GROUPED_SLAB_BYTES // max(per_row, 1)))
+
+            def _slab_rows(ix):
+                rows_n = ix.shape[0]
+                slab = jnp.take(opp_grp, ix // grp, axis=0)
+                sel = jnp.broadcast_to(
+                    (ix % grp)[..., None, None], (rows_n, k_, 1, r)
+                )
+                return jnp.take_along_axis(
+                    slab.reshape(rows_n, k_, grp, r), sel, axis=2
+                )[..., 0, :]
+
+            if bc >= bsz:
+                Vm = _slab_rows(idx)
+            else:
+                Vm = jnp.concatenate(
+                    [
+                        _slab_rows(idx[lo : lo + bc])
+                        for lo in range(0, bsz, bc)
+                    ],
+                    axis=0,
+                )
+            Vm = Vm * valid[..., None].astype(Vm.dtype)
+        else:
+            Vm = opp_g[idx] * valid[..., None].astype(opp_g.dtype)  # [B,K,R]
         if stop_after == "gather":
             out = (0.0 if out is None else out) + Vm.astype(f32).sum()
             continue
@@ -582,6 +648,7 @@ def build_sharded_half(
     precision: str,
     solver: str,
     gather_dtype: str = "float32",
+    gather_mode: str = "row",
 ):
     """ALX-style half-iteration over block-sharded factor tables.
 
@@ -663,7 +730,7 @@ def build_sharded_half(
             write, opp_full, c_sorted, v_sorted, bucket_args, lam, alpha,
             ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
             precision=precision, solver=solver,
-            gather_dtype=gather_dtype, gram=gram,
+            gather_dtype=gather_dtype, gather_mode=gather_mode, gram=gram,
         )
         return upd if out is None else out
 
@@ -821,6 +888,7 @@ class ALSTrainer:
             precision=cfg.matmul_precision,
             solver=self.solver,
             gather_dtype=cfg.gather_dtype,
+            gather_mode=cfg.gather_mode,
         )
         self._sharded_user_half = build_sharded_half(
             self.mesh, ks=self._user_side["ks"], **common
@@ -1243,6 +1311,7 @@ class ALSTrainer:
             precision=cfg.matmul_precision,
             solver=self.solver,
             gather_dtype=cfg.gather_dtype,
+            gather_mode=cfg.gather_mode,
         )
 
     def run(
@@ -1395,7 +1464,7 @@ def sweep_train_als(
     common = dict(
         implicit=cfg.implicit, weighted_lambda=cfg.weighted_lambda,
         precision=cfg.matmul_precision, solver=cfg.solver,
-        gather_dtype=cfg.gather_dtype,
+        gather_dtype=cfg.gather_dtype, gather_mode=cfg.gather_mode,
     )
 
     def make_half(side):

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import fcntl
 import json
+import logging
 import os
 import urllib.parse
 from dataclasses import asdict
@@ -53,6 +54,16 @@ from .metadata import (
 )
 
 __all__ = ["FileMetadataStore"]
+
+logger = logging.getLogger(__name__)
+
+
+def _log_corrupt(path) -> None:
+    logger.warning(
+        "jsonfs metadata: skipping undecodable document %s (torn write "
+        "from a crash on a non-fsyncing mount?) — delete or restore it "
+        "to silence this", path,
+    )
 
 _KINDS = (
     "apps",
@@ -113,17 +124,42 @@ class FileMetadataStore:
     def _doc_path(self, kind: str, key: str, suffix: str = ".json") -> Path:
         return self.root / kind / (_esc(key) + suffix)
 
+    @staticmethod
+    def _replace_durable(tmp: Path, dst: Path, data: bytes) -> None:
+        """tmp-write + fsync + atomic rename + directory fsync: the
+        document is on disk BEFORE it becomes visible, and the rename
+        itself is durable — a crash leaves old-or-new, never a torn
+        file, and a persisted record can never outrun its sequence
+        bump's dirent (which would let ids be reused)."""
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dst)
+        dfd = os.open(dst.parent, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
     def _write(self, kind: str, key: str, doc: dict[str, Any]) -> None:
         p = self._doc_path(kind, key)
-        tmp = p.with_name(p.name + ".tmp")
-        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True))
-        os.replace(tmp, p)  # readers see old-or-new, never partial
+        self._replace_durable(
+            p.with_name(p.name + ".tmp"), p,
+            json.dumps(doc, indent=1, sort_keys=True).encode(),
+        )
 
     def _read(self, kind: str, key: str) -> Optional[dict[str, Any]]:
         p = self._doc_path(kind, key)
         try:
             return json.loads(p.read_text())
         except FileNotFoundError:
+            return None
+        except ValueError:
+            # a torn document (crash mid-write on a non-fsyncing mount)
+            # was never logically committed: treat as absent, loudly —
+            # one bad file must not brick every lookup
+            _log_corrupt(p)
             return None
 
     def _delete(self, kind: str, key: str, suffix: str = ".json") -> None:
@@ -136,6 +172,9 @@ class FileMetadataStore:
                 yield json.loads(p.read_text())
             except FileNotFoundError:  # deleted mid-scan
                 continue
+            except ValueError:
+                _log_corrupt(p)
+                continue
 
     def _next_id(self, seq: str) -> int:
         """Monotonic integer sequence (never reused after deletes),
@@ -146,9 +185,8 @@ class FileMetadataStore:
         except (FileNotFoundError, ValueError):
             n = 0
         n += 1
-        tmp = p.with_name(p.name + ".tmp")
-        tmp.write_text(str(n))
-        os.replace(tmp, p)
+        self._replace_durable(p.with_name(p.name + ".tmp"), p,
+                              str(n).encode())
         return n
 
     # ---------------- apps ------------------------------------------------
@@ -178,6 +216,10 @@ class FileMetadataStore:
 
     def app_update(self, app: App) -> None:
         with self._mutate():
+            if self._read("apps", str(app.id)) is None:
+                # sqlite parity: UPDATE on a missing id is a no-op — a
+                # stale App object must never resurrect a deleted app
+                return
             if any(
                 d["name"] == app.name and d["id"] != app.id
                 for d in self._scan("apps")
@@ -350,9 +392,8 @@ class FileMetadataStore:
     def model_insert(self, m: Model) -> None:
         with self._mutate():
             p = self._doc_path("models", m.id, ".bin")
-            tmp = p.with_name(p.name + ".tmp")
-            tmp.write_bytes(m.models)
-            os.replace(tmp, p)
+            self._replace_durable(p.with_name(p.name + ".tmp"), p,
+                                  m.models)
 
     def model_get(self, id: str) -> Optional[Model]:
         p = self._doc_path("models", id, ".bin")

@@ -118,6 +118,7 @@ class ECommAlgorithmParams(Params):
     solver: str = "xla"
     factor_placement: str = "replicated"
     gather_dtype: str = "float32"
+    gather_mode: str = "row"
     unseen_only: bool = False
     seen_events: tuple[str, ...] = ("view", "buy")
 
@@ -146,6 +147,7 @@ class ECommAlgorithm(Algorithm):
                 implicit=implicit, alpha=p.alpha, seed=p.seed,
                 solver=p.solver, factor_placement=p.factor_placement,
                 gather_dtype=p.gather_dtype,
+                gather_mode=p.gather_mode,
             ),
             mesh=ctx.mesh,
         )

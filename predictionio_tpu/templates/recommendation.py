@@ -338,6 +338,9 @@ class ALSAlgorithmParams(Params):
     # train-time gather dtype for the opposite factor table ("bfloat16"
     # halves the hot gather's HBM bytes; solves stay f32 — models/als.py)
     gather_dtype: str = "float32"
+    # gather access pattern: "row" | "grouped" (tile-aligned slab
+    # gather — models/als.py ALSConfig.gather_mode)
+    gather_mode: str = "row"
     # batched SPD solver: "xla" | "pallas" | "fused" (compile-probed;
     # degrades to xla if the kernel doesn't lower on this backend)
     solver: str = "xla"
@@ -383,6 +386,7 @@ class ALSAlgorithm(Algorithm):
             alpha=p.alpha,
             weighted_lambda=p.weighted_lambda,
             gather_dtype=p.gather_dtype,
+            gather_mode=p.gather_mode,
             solver=p.solver,
             factor_placement=p.factor_placement,
         )

@@ -123,6 +123,7 @@ class SimilarALSParams(Params):
     solver: str = "xla"
     factor_placement: str = "replicated"
     gather_dtype: str = "float32"
+    gather_mode: str = "row"
 
 
 @dataclass
@@ -148,6 +149,7 @@ class SimilarProductAlgorithm(Algorithm):
                 implicit=True, alpha=p.alpha, seed=p.seed,
                 solver=p.solver, factor_placement=p.factor_placement,
                 gather_dtype=p.gather_dtype,
+                gather_mode=p.gather_mode,
             ),
             mesh=ctx.mesh,
         )

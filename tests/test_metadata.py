@@ -152,6 +152,34 @@ def test_app_rename_to_existing_name_rejected(md):
     assert md.app_get(one.id).description == "self-rename ok"
 
 
+def test_app_update_missing_id_is_noop(md):
+    """UPDATE on a deleted/unknown id must not resurrect the app (sqlite
+    UPDATE matches zero rows; jsonfs must not recreate the document)."""
+    app = md.app_insert("ghost")
+    md.app_delete(app.id)
+    app.description = "stale handle"
+    md.app_update(app)
+    assert md.app_get(app.id) is None
+    assert md.app_get_by_name("ghost") is None
+
+
+def test_jsonfs_tolerates_torn_documents(tmp_path, caplog):
+    """One undecodable document (torn write) must not brick scans or
+    lookups: it reads as absent, loudly, and other records survive."""
+    import logging
+
+    m = FileMetadataStore(tmp_path / "meta-json")
+    good = m.app_insert("good")
+    (tmp_path / "meta-json" / "apps" / "999.json").write_text("{trunc")
+    with caplog.at_level(logging.WARNING):
+        assert m.app_get(999) is None
+        assert [a.name for a in m.app_get_all()] == ["good"]
+        assert m.app_get_by_name("good").id == good.id
+        # inserts scan for name uniqueness — must also survive
+        m.app_insert("another")
+    assert any("undecodable" in r.message for r in caplog.records)
+
+
 def test_hostile_keys_roundtrip(md):
     """Keys with path separators / traversal shapes must round-trip as
     DATA, never as filesystem structure (jsonfs escapes them; sqlite is

@@ -352,13 +352,14 @@ def test_engine_json_exposes_scaling_knobs(ctx):
             "params": {
                 "rank": 4, "numIterations": 2, "lambda": 0.1,
                 "solver": "fused", "factorPlacement": "sharded",
-                "gatherDtype": "float32",
+                "gatherDtype": "float32", "gatherMode": "grouped",
             },
         }],
     })
     algo_params = params.algorithms[0][1]
     assert algo_params.solver == "fused"
     assert algo_params.factor_placement == "sharded"
+    assert algo_params.gather_mode == "grouped"
     algos, models = engine.train_components(ctx, params)
     model = models[0]
     assert np.isfinite(model.user_factors).all()

@@ -91,6 +91,8 @@ run breakdown           python bench.py --breakdown --phase-probe --profile "$OU
 run breakdown_host_stage python bench.py --breakdown --staging host
 run breakdown_pallas    python bench.py --breakdown --solver pallas
 run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16
+run breakdown_grouped   python bench.py --breakdown --gather-mode grouped
+run breakdown_grouped_bf16 python bench.py --breakdown --gather-mode grouped --gather-dtype bfloat16
 run breakdown_prec_high python bench.py --breakdown --precision high
 run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
 run parity              python bench.py --parity

@@ -224,6 +224,49 @@ def probe_dma(m, nout, r, dtype):
               error=repr(e)[:300])
 
 
+# ---------------------------------------------------------------- E --
+
+def probe_xla_grouped_take(m, nout, r, dtype, group=8):
+    """Tile-aligned gather: read GROUPS of `group` consecutive rows
+    (one [1, group*R] slab = full (8,128) tiles at f32 r=128-lane
+    packing), then select the wanted row with take_along_axis.
+
+    Hypothesis for the measured ~17 GB/s of the plain row gather: each
+    rank-64 row is 256 B but the memory system moves (8,128) tiles
+    (4 KB f32), a 16x waste; grouped reads move the same tiles usefully.
+    If this wins on-chip, the ALS gather swaps in the grouped form at
+    the XLA level — no Pallas needed."""
+    mg = -(-m // group) * group
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(mg, r)).astype(np.float32)
+    ).astype(dtype)
+    idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
+
+    def grouped(t, i):
+        g = jnp.take(t.reshape(mg // group, group * r), i // group, axis=0)
+        sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
+        return jnp.take_along_axis(
+            g.reshape(nout, group, r), sel, axis=1
+        )[:, 0, :]
+
+    fn = jax.jit(grouped)
+    ref = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
+    dt, out = _bench(fn, table, idx)
+    good = bool(
+        np.allclose(
+            np.asarray(out, np.float32),
+            np.asarray(ref(table, idx), np.float32),
+            atol=1e-2,
+        )
+    )
+    bytes_useful = nout * r * table.dtype.itemsize
+    _emit(metric="xla_grouped_take", m=m, nout=nout, r=r, group=group,
+          dtype=table.dtype.name, ok=good, seconds=dt,
+          ns_per_row=dt / nout * 1e9,
+          useful_gbps=bytes_useful / dt / 1e9)
+
+
 # ---------------------------------------------------------------- D --
 
 def probe_xla_take(m, nout, r, dtype):
@@ -260,6 +303,13 @@ def main():
     for dtype in (jnp.float32, jnp.bfloat16):
         probe_xla_take(26744, 32768, r, dtype)
         probe_xla_take(138493, 32768, r, dtype)
+    # r=128: are lane-padded (full-vreg) rows gathered faster per byte?
+    probe_xla_take(26744, 32768, 128, jnp.float32)
+    _emit(metric="section", form="xla_grouped_take")
+    for dtype in (jnp.float32, jnp.bfloat16):
+        probe_xla_grouped_take(26744, 32768, r, dtype)
+        probe_xla_grouped_take(138493, 32768, r, dtype)
+        probe_xla_grouped_take(138493, 32768, r, dtype, group=16)
 
 
 if __name__ == "__main__":

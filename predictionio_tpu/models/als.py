@@ -132,6 +132,15 @@ class ALSConfig:
                 f"gather_mode must be 'row' or 'grouped', "
                 f"got {self.gather_mode!r}"
             )
+        if self.gather_mode == "grouped" and self.solver == "fused":
+            # the fused kernel does its own in-kernel access pattern —
+            # accepting the combination would record gather_mode=grouped
+            # in bench artifacts while actually measuring the fused path
+            raise ValueError(
+                "gather_mode='grouped' does not compose with "
+                "solver='fused' (the fused kernel gathers in-kernel); "
+                "pick one"
+            )
         if self.solver not in ("xla", "pallas", "fused"):
             raise ValueError(
                 f"solver must be 'xla', 'pallas' or 'fused', "

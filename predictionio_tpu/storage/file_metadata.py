@@ -216,9 +216,14 @@ class FileMetadataStore:
 
     def app_update(self, app: App) -> None:
         with self._mutate():
-            if self._read("apps", str(app.id)) is None:
+            if (
+                self._read("apps", str(app.id)) is None
+                and not self._doc_path("apps", str(app.id)).exists()
+            ):
                 # sqlite parity: UPDATE on a missing id is a no-op — a
-                # stale App object must never resurrect a deleted app
+                # stale App object must never resurrect a deleted app.
+                # A present-but-torn document is different: overwriting
+                # it is the API's repair path (_log_corrupt's advice).
                 return
             if any(
                 d["name"] == app.name and d["id"] != app.id

@@ -526,9 +526,14 @@ def test_grouped_gather_chunked_matches_unchunked(monkeypatch):
     monkeypatch.setattr(als_mod, "_GROUPED_SLAB_BYTES", 4096)
     # the slab budget is read at TRACE time; identical shapes + static
     # args would hit the jit cache and silently re-run the unchunked
-    # executable — drop the caches so the chunked branch really traces
+    # executable — drop the caches so the chunked branch really traces,
+    # and again afterwards so no later test inherits the tiny-chunk
+    # executable under the production cache key
     jax.clear_caches()
-    chunked = train_als((u, i, v), nu, ni, ALSConfig(**base))
+    try:
+        chunked = train_als((u, i, v), nu, ni, ALSConfig(**base))
+    finally:
+        jax.clear_caches()
     np.testing.assert_allclose(
         chunked.user_factors, whole.user_factors, rtol=1e-6, atol=1e-6
     )
@@ -820,6 +825,10 @@ def test_config_rejects_typo_knob_values():
         ALSConfig(gather_dtype="fp32")
     with pytest.raises(ValueError, match="gather_mode"):
         ALSConfig(gather_mode="tiled")
+    # grouped + fused would record gather_mode=grouped in artifacts
+    # while measuring the fused kernel's own access pattern
+    with pytest.raises(ValueError, match="does not compose"):
+        ALSConfig(gather_mode="grouped", solver="fused")
 
 
 def test_device_expand_sides_reconstruction():

@@ -279,8 +279,8 @@ def test_supervised_returns_json_and_streams_progress(monkeypatch):
         "    time.sleep(0.05)\n"
         "print('{\"value\": 7}')\n"
     ))
-    line, err = bench._run_inner_supervised([], hard_cap=30,
-                                            stall_timeout=5)
+    line, err = bench._run_inner_supervised([], hard_cap=60,
+                                            stall_timeout=15)
     assert err is None and json.loads(line)["value"] == 7
 
 
@@ -314,8 +314,8 @@ def test_supervised_spares_slow_but_advancing_child(monkeypatch):
         "    time.sleep(0.8)\n"
         "print('{\"value\": 9}')\n"
     ))
-    line, err = bench._run_inner_supervised([], hard_cap=30,
-                                            stall_timeout=2)
+    line, err = bench._run_inner_supervised([], hard_cap=60,
+                                            stall_timeout=10)
     assert err is None and json.loads(line)["value"] == 9
 
 
@@ -325,13 +325,13 @@ def test_supervised_honors_declared_phase_budget(monkeypatch):
     widens for that one phase, then snaps back at the next marker."""
     monkeypatch.setattr(bench, "_inner_cmd", _stub_cmd(
         "import sys, time\n"
-        "print('# start next-phase-budget=10 (long quiet phase)',\n"
+        "print('# start next-phase-budget=30 (long quiet phase)',\n"
         "      file=sys.stderr, flush=True)\n"
-        "time.sleep(5)\n"   # > the 2s stall default, < the budget
+        "time.sleep(5)\n"   # > the 3s stall default, < the budget
         "print('{\"value\": 11}')\n"
     ))
-    line, err = bench._run_inner_supervised([], hard_cap=30,
-                                            stall_timeout=2)
+    line, err = bench._run_inner_supervised([], hard_cap=60,
+                                            stall_timeout=3)
     assert err is None and json.loads(line)["value"] == 11
 
 
@@ -345,8 +345,10 @@ def test_supervised_recovers_json_from_killed_child(monkeypatch):
         "print('{\"value\": 13}', flush=True)\n"
         "time.sleep(60)\n"   # hung teardown, no more markers
     ))
-    line, err = bench._run_inner_supervised([], hard_cap=45,
-                                            stall_timeout=2)
+    # duration == stall_timeout by construction (the child never prints
+    # again): 6 s is boot margin on a loaded box without 15 s dead wait
+    line, err = bench._run_inner_supervised([], hard_cap=60,
+                                            stall_timeout=6)
     assert err is None and json.loads(line)["value"] == 13
 
 

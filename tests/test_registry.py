@@ -53,6 +53,36 @@ def test_storage_fixture(storage_memory):
     storage_memory.verify_all_data_objects()
 
 
+def test_all_shell_scripts_parse():
+    """Every shipped shell script must at least pass `bash -n` — the
+    battery/watchdog scripts only execute when the TPU tunnel answers,
+    so a syntax error would silently burn the measurement window."""
+    import subprocess
+    from pathlib import Path
+
+    root = Path(__file__).parent.parent
+    candidates = (
+        list((root / "bin").iterdir())
+        + list((root / "tools").iterdir())
+        + list((root / "conf").glob("*.sh*"))
+    )
+    scripts = sorted(
+        p for p in candidates
+        if p.is_file()
+        and p.read_bytes()[:32].startswith(b"#!")
+        and b"bash" in p.read_bytes()[:32]
+    )
+    # the gate scripts MUST be covered: a syntax error there would
+    # skip/fail every commit, not just one battery step
+    names = {p.name for p in scripts}
+    assert {"pre-commit", "measure_tpu.sh", "tpu_watchdog.sh"} <= names
+    for sc in scripts:
+        proc = subprocess.run(
+            ["bash", "-n", str(sc)], capture_output=True, text=True
+        )
+        assert proc.returncode == 0, f"{sc.name}: {proc.stderr}"
+
+
 def test_shipped_env_template_parses_and_boots(tmp_path):
     """`conf/pio-env-tpu.template` is the ops on-ramp (reference
     `conf/pio-env.sh.template:36-60`): every exported variable must be

@@ -1,0 +1,123 @@
+"""Every ALS config A/B in ONE process: one backend init, one synth.
+
+The round-5 tunnel window showed per-step backend init (~36 s healthy,
+minutes when degraded) dominates short windows; the per-config
+``bench.py --breakdown`` steps pay it once per config.  This driver
+pays it once TOTAL: init + synth + holdout split happen once, then each
+config stages, warms (compiles), and times ``--steady`` iterations,
+emitting one JSON line per config.  A 15-minute window yields the full
+matrix that decides the ALSConfig defaults (docs/PERF_PLAN.md §2).
+
+Configs run in value order — the baseline first (everything is a delta
+against it), then the single-knob A/Bs, then the best-combo candidates
+— so a dying tunnel still leaves interpretable prefixes.
+
+Usage (the battery runs it right after north_star):
+    python tools/breakdown_matrix.py [--scale 1.0] [--steady 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+CONFIGS = [
+    # (label, ALSConfig overrides, staging)
+    ("baseline_xla_f32_highest", {}, "auto"),
+    ("solver_pallas", {"solver": "pallas"}, "auto"),
+    ("gather_bf16", {"gather_dtype": "bfloat16"}, "auto"),
+    ("gather_grouped", {"gather_mode": "grouped"}, "auto"),
+    ("gather_grouped_bf16",
+     {"gather_mode": "grouped", "gather_dtype": "bfloat16"}, "auto"),
+    ("precision_high", {"matmul_precision": "high"}, "auto"),
+    ("best_pallas_bf16_high",
+     {"solver": "pallas", "gather_dtype": "bfloat16",
+      "matmul_precision": "high"}, "auto"),
+    ("best_plus_grouped",
+     {"solver": "pallas", "gather_dtype": "bfloat16",
+      "matmul_precision": "high", "gather_mode": "grouped"}, "auto"),
+    ("staging_host", {}, "host"),
+]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--steady", type=int, default=3,
+                    help="timed steady-state iterations per config")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated config labels to run")
+    args = ap.parse_args()
+
+    from bench import synth_ml20m, als_train_flops, device_peak_flops
+    from predictionio_tpu.models.als import ALSConfig, ALSTrainer
+    from predictionio_tpu.parallel.mesh import (
+        enable_compilation_cache, fence, make_mesh,
+    )
+
+    enable_compilation_cache()
+    t0 = time.time()
+    u, i, v, n_users, n_items = synth_ml20m(args.scale)
+    import jax
+
+    print(json.dumps({
+        "metric": "matrix_env", "scale": args.scale,
+        "n_ratings": len(v), "devices": str(jax.devices()),
+        "setup_seconds": round(time.time() - t0, 2),
+    }), flush=True)
+    mesh = make_mesh()
+    mesh = mesh if mesh.size > 1 else None
+    peak, kind = device_peak_flops(jax)
+
+    labels = set(args.only.split(",")) if args.only else None
+    for label, overrides, staging in CONFIGS:
+        if labels is not None and label not in labels:
+            continue
+        t0 = time.time()
+        try:
+            cfg = ALSConfig(rank=args.rank, num_iterations=20, lam=0.01,
+                            seed=args.seed, **overrides)
+            trainer = ALSTrainer((u, i, v), n_users, n_items, cfg,
+                                 mesh=mesh, staging=staging)
+            U, V = trainer.init_factors()
+            U, V = trainer.run(U, V, 1)   # staging wait + compiles
+            warm = time.time() - t0
+            t1 = time.time()
+            U, V = trainer.run(U, V, args.steady)  # run() fences
+            span = time.time() - t1
+            per_iter = span / args.steady
+            flops = als_train_flops(len(v), n_users, n_items, args.rank)
+            rec = {
+                "metric": "als_config_per_iteration_seconds",
+                "config": label,
+                "value": round(per_iter, 4),
+                "warm_seconds": round(warm, 2),
+                "solver": trainer.solver,
+                **({"degraded": True}
+                   if trainer.solver != cfg.solver else {}),
+                "staging": trainer.staging,
+                "achieved_tflops_per_s": round(flops / per_iter / 1e12, 3),
+                "mfu": (round(flops / per_iter / peak, 5)
+                        if peak else None),
+                "device_kind": kind,
+            }
+            del trainer, U, V
+        except Exception as e:  # noqa: BLE001 — later configs must run
+            rec = {
+                "metric": "als_config_per_iteration_seconds",
+                "config": label, "value": None,
+                "error": repr(e)[:300],
+            }
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,13 @@ for M, name in ((26744, 'item_table_resident'), (138493, 'user_table_streamed'))
            'plan': fused_tile_plan(M, R, K, 2), 'value': (time.time()-t0)/5})
 "
 
+# the full config A/B matrix in ONE process (one backend init, one
+# synth): every ALSConfig-default decision in docs/PERF_PLAN.md §2
+# from a single step, ordered so a dying tunnel still leaves
+# interpretable prefixes.  Supersedes the old per-config breakdown_*
+# steps (each paid its own backend init; VERDICT-r5-era cleanup).
+STEP_TIMEOUT=2400 run config_matrix python tools/breakdown_matrix.py
+
 # which Mosaic-supported gather form can replace the fused kernel's
 # unsupported jnp.take (round-5: lowering.py:2484 rejects it)?  Times
 # take_along_axis sublane/lane gathers, DMA row-copy loops, and the
@@ -88,12 +95,6 @@ run probe_gather        python tools/probe_gather.py
 
 # the A/Bs (device staging is the default at full scale)
 run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"
-run breakdown_host_stage python bench.py --breakdown --staging host
-run breakdown_pallas    python bench.py --breakdown --solver pallas
-run breakdown_bf16      python bench.py --breakdown --gather-dtype bfloat16
-run breakdown_grouped   python bench.py --breakdown --gather-mode grouped
-run breakdown_grouped_bf16 python bench.py --breakdown --gather-mode grouped --gather-dtype bfloat16
-run breakdown_prec_high python bench.py --breakdown --precision high
 run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
 run parity              python bench.py --parity
 run pipeline            python bench.py --pipeline

@@ -1,0 +1,175 @@
+"""Summarize a battery run into the PERF_PLAN decision table.
+
+Reads ``tpu_measurements/*.json`` (or ``--dir``) and prints a compact
+markdown report: the north-star verdict, the config-matrix ranking with
+speedups vs the baseline config, kernel smoke answers, gather-probe
+winners, and serving/ingest headlines.  The battery appends it to
+``$OUT/ANALYSIS.md`` so an unattended overnight window leaves
+conclusions, not just artifacts.
+
+Every section degrades to "absent" when its artifact is missing or
+malformed — a dying tunnel leaves partial batteries, and the report
+must describe whatever survived.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+
+def _lines(path: Path):
+    """Best-effort parse: one JSON object per line (python-repr lines
+    from the smoke probes are tolerated via eval-free coercion)."""
+    out = []
+    if not path.exists():
+        return out
+    for ln in path.read_text().splitlines():
+        ln = ln.strip()
+        if not ln or ln[0] not in "{[":
+            continue
+        try:
+            out.append(json.loads(ln))
+        except ValueError:
+            try:  # smoke probes print python dicts (single quotes)
+                out.append(json.loads(
+                    ln.replace("'", '"')
+                    .replace("True", "true").replace("False", "false")
+                    .replace("None", "null")
+                ))
+            except ValueError:
+                continue
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="tpu_measurements")
+    args = ap.parse_args()
+    d = Path(args.dir)
+    say = []
+
+    # ---- north star ----
+    ns = _lines(d / "north_star.json")
+    say.append("# Battery analysis\n")
+    if ns:
+        rec = ns[-1]
+        val, plat = rec.get("value"), rec.get("platform")
+        if plat and plat != "cpu" and rec.get("scale", 0) >= 1.0:
+            verdict = ("**MET**" if val is not None and val < 60
+                       else "not met")
+            say.append(
+                f"## North star: {val} s on {plat} "
+                f"(target < 60 s) — {verdict}\n"
+                f"- solver={rec.get('solver')} "
+                f"gather={rec.get('gather_dtype')}/"
+                f"{rec.get('gather_mode', 'row')} "
+                f"precision={rec.get('precision')} "
+                f"staging={rec.get('staging')} "
+                f"mfu={rec.get('mfu')}\n"
+                f"- train_rmse={rec.get('train_rmse')} "
+                f"holdout={rec.get('rmse_holdout')}\n"
+            )
+        else:
+            say.append(
+                f"## North star: NO on-chip number "
+                f"(platform={plat}, scale={rec.get('scale')}; "
+                f"error={rec.get('error', 'none')!r})\n"
+            )
+    else:
+        say.append("## North star: artifact absent\n")
+
+    # ---- kernel smokes ----
+    gj = _lines(d / "solver_smoke.json")
+    lowered = any(r.get("lowered") for r in gj)
+    say.append(f"## GJ solver lowers: {lowered if gj else 'absent'}\n")
+    fs = _lines(d / "fused_smoke.json")
+    if fs:
+        oks = {r["metric"]: r.get("ok") for r in fs if "ok" in r}
+        say.append(f"## Fused kernel probes: {oks or 'no ok fields'}\n")
+    else:
+        say.append("## Fused kernel probes: absent\n")
+
+    # ---- config matrix ----
+    mx = [r for r in _lines(d / "config_matrix.json")
+          if r.get("metric") == "als_config_per_iteration_seconds"]
+    if mx:
+        base = next((r for r in mx
+                     if r["config"] == "baseline_xla_f32_highest"
+                     and r.get("value")), None)
+        say.append("## Config matrix (s/iteration; speedup vs baseline)\n")
+        say.append("| config | s/iter | vs baseline | mfu | note |")
+        say.append("|---|---|---|---|---|")
+        for r in sorted(mx, key=lambda r: (r.get("value") is None,
+                                           r.get("value") or 0)):
+            v = r.get("value")
+            sp = (f"{base['value'] / v:.2f}x"
+                  if base and v else "—")
+            note = ("DEGRADED" if r.get("degraded")
+                    else r.get("error", "")[:60])
+            say.append(
+                f"| {r['config']} | {v if v is not None else '—'} "
+                f"| {sp} | {r.get('mfu', '—')} | {note} |"
+            )
+        if base:
+            best = min((r for r in mx if r.get("value")),
+                       key=lambda r: r["value"], default=None)
+            if best and best["config"] != "baseline_xla_f32_highest":
+                say.append(
+                    f"\n**Default-flip candidate**: `{best['config']}` "
+                    f"at {base['value'] / best['value']:.2f}x the "
+                    "baseline (flip ALSConfig defaults per "
+                    "docs/PERF_PLAN.md §2 if RMSE held).\n"
+                )
+    else:
+        say.append("## Config matrix: absent\n")
+
+    # ---- gather probe ----
+    pg = _lines(d / "probe_gather.json")
+    if pg:
+        takes = [r for r in pg if r.get("metric") == "xla_take"]
+        say.append("## Gather probe\n")
+        for r in pg:
+            m = r.get("metric")
+            if m in ("taa_axis0", "taa_axis1", "dma_row_gather"):
+                per = r.get("ns_per_row", r.get("ns_per_col"))
+                status = ("ok %.0f ns/elt" % per
+                          if r.get("ok") and per is not None
+                          else ("ok" if r.get("ok")
+                                else f"FAILED {r.get('error', '')[:80]}"))
+                size = r.get("n", r.get("nout", r.get("m")))
+                say.append(f"- {m} (n={size}): {status}")
+            elif m == "xla_grouped_take":
+                base_t = next(
+                    (t for t in takes
+                     if t["m"] == r["m"] and t["dtype"] == r["dtype"]),
+                    None)
+                sp = (f"{base_t['seconds'] / r['seconds']:.2f}x vs take"
+                      if base_t and r.get("seconds") else "")
+                say.append(
+                    f"- grouped take m={r['m']} {r['dtype']} g={r['group']}: "
+                    f"{r.get('ns_per_row', 0):.0f} ns/row "
+                    f"useful {r.get('useful_gbps', 0):.1f} GB/s {sp}"
+                )
+            elif m == "xla_take":
+                say.append(
+                    f"- xla take m={r['m']} {r['dtype']}: "
+                    f"{r.get('ns_per_row', 0):.0f} ns/row "
+                    f"effective {r.get('effective_gbps', 0):.1f} GB/s"
+                )
+        say.append("")
+    else:
+        say.append("## Gather probe: absent\n")
+
+    # ---- serving / ingest headlines ----
+    for name in ("serving", "serving_http", "ingest", "ring_topk_smoke"):
+        recs = _lines(d / f"{name}.json")
+        if recs:
+            say.append(f"## {name}: {json.dumps(recs[-1])[:240]}\n")
+
+    print("\n".join(say))
+
+
+if __name__ == "__main__":
+    main()

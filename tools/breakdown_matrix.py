@@ -58,14 +58,23 @@ def main() -> None:
     args = ap.parse_args()
 
     from bench import synth_ml20m, als_train_flops, device_peak_flops
-    from predictionio_tpu.models.als import ALSConfig, ALSTrainer
+    from predictionio_tpu.models.als import (
+        ALSConfig, ALSFactors, ALSTrainer, rmse,
+    )
     from predictionio_tpu.parallel.mesh import (
         enable_compilation_cache, fence, make_mesh,
     )
+    import numpy as np
 
     enable_compilation_cache()
     t0 = time.time()
     u, i, v, n_users, n_items = synth_ml20m(args.scale)
+    # same holdout convention as bench --inner: the quality fields ride
+    # every config line so the RMSE-conditioned default flips
+    # (PERF_PLAN §2) are decidable from this one artifact
+    hmask = np.random.default_rng(917).random(len(v)) < 0.02
+    uh, ih, vh = u[hmask], i[hmask], v[hmask]
+    u, i, v = u[~hmask], i[~hmask], v[~hmask]
     import jax
 
     print(json.dumps({
@@ -76,12 +85,15 @@ def main() -> None:
     mesh = make_mesh()
     mesh = mesh if mesh.size > 1 else None
     peak, kind = device_peak_flops(jax)
+    if peak:  # mesh-aggregate roofline, same basis as bench.py
+        peak *= mesh.size if mesh is not None else 1
 
     labels = set(args.only.split(",")) if args.only else None
     for label, overrides, staging in CONFIGS:
         if labels is not None and label not in labels:
             continue
         t0 = time.time()
+        trainer = U = V = None
         try:
             cfg = ALSConfig(rank=args.rank, num_iterations=20, lam=0.01,
                             seed=args.seed, **overrides)
@@ -94,6 +106,8 @@ def main() -> None:
             U, V = trainer.run(U, V, args.steady)  # run() fences
             span = time.time() - t1
             per_iter = span / args.steady
+            factors = ALSFactors(user_factors=np.asarray(U),
+                                 item_factors=np.asarray(V))
             flops = als_train_flops(len(v), n_users, n_items, args.rank)
             rec = {
                 "metric": "als_config_per_iteration_seconds",
@@ -108,14 +122,23 @@ def main() -> None:
                 "mfu": (round(flops / per_iter / peak, 5)
                         if peak else None),
                 "device_kind": kind,
+                # quality after 1 + steady iterations — NOT a converged
+                # 20-iter rmse, but config-comparable: a precision/dtype
+                # knob that hurts shows up as a delta vs the baseline row
+                "train_rmse": round(rmse(factors, u, i, v), 4),
+                "rmse_holdout": (round(rmse(factors, uh, ih, vh), 4)
+                                 if len(vh) else None),
             }
-            del trainer, U, V
         except Exception as e:  # noqa: BLE001 — later configs must run
             rec = {
                 "metric": "als_config_per_iteration_seconds",
                 "config": label, "value": None,
                 "error": repr(e)[:300],
             }
+        finally:
+            # drop staged device tables even on failure: a dead
+            # trainer's HBM must not cascade later configs into OOM
+            del trainer, U, V
         print(json.dumps(rec), flush=True)
 
 

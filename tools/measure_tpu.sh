@@ -132,4 +132,11 @@ fence(v, ix)
 print({'metric': 'ring_topk_device_seconds', 'value': (time.time()-t0)/10,
        'devices': len(jax.devices()), 'top1_matches_dense': ok})
 "
-echo "done; review $OUT/*.json and update docs"
+# self-summarize: an unattended overnight window must leave
+# conclusions (the PERF_PLAN decision table), not just artifacts
+{
+  echo
+  echo "---- $(date -u +%FT%TZ) ----"
+  python tools/analyze_battery.py --dir "$OUT"
+} >> "$OUT/ANALYSIS.md" 2>&1
+echo "done; review $OUT/ANALYSIS.md and update docs"

@@ -1,0 +1,97 @@
+"""tools/analyze_battery.py renders conclusions from battery artifacts.
+
+The analyzer runs unattended at the end of every battery
+(`tools/measure_tpu.sh` appends its output to ANALYSIS.md), so its
+parsing must survive the real artifact zoo: JSON lines, python-repr
+dict lines from the smoke probes, error rows, and missing files."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+
+
+def _run(d: Path) -> str:
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "analyze_battery.py"),
+         "--dir", str(d)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def test_empty_dir_reports_absence(tmp_path):
+    out = _run(tmp_path)
+    assert "North star: artifact absent" in out
+    assert "Config matrix: absent" in out
+    assert "Gather probe: absent" in out
+
+
+def test_full_battery_renders_decisions(tmp_path):
+    (tmp_path / "north_star.json").write_text(json.dumps({
+        "metric": "ml20m_als_rank64_20iter_train_seconds",
+        "value": 42.5, "platform": "tpu", "scale": 1.0,
+        "solver": "pallas", "gather_dtype": "bfloat16",
+        "precision": "high", "staging": "device", "mfu": 0.03,
+        "train_rmse": 1.13, "rmse_holdout": 1.42,
+    }) + "\n")
+    # smoke probes print python dicts (single quotes, True/False)
+    (tmp_path / "solver_smoke.json").write_text(
+        "{'metric': 'gj_kernel_smoke', 'rank': 64, 'max_resid': 0.05}\n"
+        "{'metric': 'gj_kernel_smoke', 'lowered': True}\n"
+    )
+    (tmp_path / "fused_smoke.json").write_text(
+        "{'metric': 'fused_probe_f32_r64', 'ok': False}\n"
+    )
+    (tmp_path / "config_matrix.json").write_text(
+        json.dumps({"metric": "als_config_per_iteration_seconds",
+                    "config": "baseline_xla_f32_highest", "value": 3.6,
+                    "mfu": 0.001, "train_rmse": 1.13}) + "\n"
+        + json.dumps({"metric": "als_config_per_iteration_seconds",
+                      "config": "best_pallas_bf16_high", "value": 0.9,
+                      "mfu": 0.004, "train_rmse": 1.13}) + "\n"
+        + json.dumps({"metric": "als_config_per_iteration_seconds",
+                      "config": "staging_host", "value": None,
+                      "error": "RuntimeError('tunnel died')"}) + "\n"
+    )
+    (tmp_path / "probe_gather.json").write_text(
+        json.dumps({"metric": "taa_axis0", "n": 26744, "r": 64,
+                    "ok": False, "error": "NotImplementedError('x')"})
+        + "\n"
+        + json.dumps({"metric": "taa_axis1", "m": 4096, "r": 64,
+                      "ok": True, "seconds": 1e-3, "ns_per_col": 244.0})
+        + "\n"
+        + json.dumps({"metric": "xla_take", "m": 26744, "nout": 32768,
+                      "r": 64, "dtype": "float32", "seconds": 5e-4,
+                      "ns_per_row": 15.2, "effective_gbps": 16.8})
+        + "\n"
+        + json.dumps({"metric": "xla_grouped_take", "m": 26744,
+                      "nout": 32768, "r": 64, "group": 8,
+                      "dtype": "float32", "ok": True, "seconds": 1e-4,
+                      "ns_per_row": 3.1, "useful_gbps": 84.0}) + "\n"
+    )
+    out = _run(tmp_path)
+    assert "42.5 s on tpu" in out and "**MET**" in out
+    assert "GJ solver lowers: True" in out
+    assert "'fused_probe_f32_r64': False" in out.replace('"', "'")
+    # matrix: ranking, speedup vs baseline, error row, flip candidate
+    assert "| best_pallas_bf16_high | 0.9 | 4.00x" in out
+    assert "RuntimeError" in out
+    assert "Default-flip candidate" in out
+    # gather probe: failure, axis1 size label, grouped speedup
+    assert "taa_axis0 (n=26744): FAILED" in out
+    assert "taa_axis1 (n=4096): ok" in out
+    assert "5.00x vs take" in out
+
+
+def test_cpu_fallback_north_star_is_not_met(tmp_path):
+    (tmp_path / "north_star.json").write_text(json.dumps({
+        "value": 9.2, "platform": "cpu", "scale": 0.02,
+        "error": "accelerator unavailable",
+    }) + "\n")
+    out = _run(tmp_path)
+    assert "NO on-chip number" in out
+    assert "MET" not in out

@@ -52,7 +52,7 @@ from ..storage.columnar import Ratings
 
 logger = logging.getLogger(__name__)
 
-# cap on the grouped-gather slab intermediate ([chunk, K, G*R]): the
+# cap on the grouped-gather slab intermediate ([chunk, K, G, R]): the
 # slab is G (8-16) times the row gather's output, so it's produced in
 # row-chunks of at most this many bytes and shrunk back to [*, K, R] by
 # the in-slab select before the next chunk materializes
@@ -519,13 +519,18 @@ def _solve_buckets(
     opp_grp = grp = None
     if gather_mode == "grouped":
         # tile-aligned slab gather (ALSConfig.gather_mode): group height
-        # = the dtype's memory-tile sublane count, so one slab read is
-        # whole (8,128)/(16,128) tiles with no wasted sublanes
+        # = the dtype's memory-tile sublane count (8 f32 / 16 bf16).
+        # The slab table is the 3D view [M/G, G, R] — the SAME row-major
+        # bytes, but XLA tiles the trailing (G, R) dims, so one gathered
+        # [G, R] slice is whole (8,128)/(16,128) tiles.  (The 2D
+        # [M/G, G*R] form would lay the G rows along LANES: a slab row
+        # is then 1 sublane tall and every gather still pays the full
+        # tile-height waste it was meant to eliminate.)
         grp = 8 * (4 // opp_g.dtype.itemsize)
         mg = -(-opp_g.shape[0] // grp) * grp
         opp_grp = jnp.pad(
             opp_g, ((0, mg - opp_g.shape[0]), (0, 0))
-        ).reshape(mg // grp, grp * r)
+        ).reshape(mg // grp, grp, r)
     fused_side = False
     if solver == "fused" and stop_after is None and ks:
         from ..ops.fused_als import fused_side_fits
@@ -566,7 +571,7 @@ def _solve_buckets(
         if opp_grp is not None:
             # slab gather + in-slab select: exact same rows as the row
             # gather, but every HBM read is a full memory tile.  The
-            # [*, K, G*R] slab is G times the row gather's output, so
+            # [*, K, G, R] slab is G times the row gather's output, so
             # it's produced in row-chunks bounded by _GROUPED_SLAB_BYTES
             # — the select shrinks each chunk back to [*, K, R] before
             # the next one materializes.
@@ -576,13 +581,11 @@ def _solve_buckets(
 
             def _slab_rows(ix):
                 rows_n = ix.shape[0]
-                slab = jnp.take(opp_grp, ix // grp, axis=0)
+                slab = jnp.take(opp_grp, ix // grp, axis=0)  # [n,K,G,R]
                 sel = jnp.broadcast_to(
                     (ix % grp)[..., None, None], (rows_n, k_, 1, r)
                 )
-                return jnp.take_along_axis(
-                    slab.reshape(rows_n, k_, grp, r), sel, axis=2
-                )[..., 0, :]
+                return jnp.take_along_axis(slab, sel, axis=2)[..., 0, :]
 
             if bc >= bsz:
                 Vm = _slab_rows(idx)

@@ -140,7 +140,7 @@ def main() -> None:
                                 else f"FAILED {r.get('error', '')[:80]}"))
                 size = r.get("n", r.get("nout", r.get("m")))
                 say.append(f"- {m} (n={size}): {status}")
-            elif m == "xla_grouped_take":
+            elif m in ("xla_grouped_take", "xla_grouped3d_take"):
                 base_t = next(
                     (t for t in takes
                      if t["m"] == r["m"] and t["dtype"] == r["dtype"]),
@@ -148,7 +148,7 @@ def main() -> None:
                 sp = (f"{base_t['seconds'] / r['seconds']:.2f}x vs take"
                       if base_t and r.get("seconds") else "")
                 say.append(
-                    f"- grouped take m={r['m']} {r['dtype']} g={r['group']}: "
+                    f"- {m} m={r['m']} {r['dtype']} g={r['group']}: "
                     f"{r.get('ns_per_row', 0):.0f} ns/row "
                     f"useful {r.get('useful_gbps', 0):.1f} GB/s {sp}"
                 )

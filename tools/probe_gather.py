@@ -226,16 +226,25 @@ def probe_dma(m, nout, r, dtype):
 
 # ---------------------------------------------------------------- E --
 
-def probe_xla_grouped_take(m, nout, r, dtype, group=8):
-    """Tile-aligned gather: read GROUPS of `group` consecutive rows
-    (one [1, group*R] slab = full (8,128) tiles at f32 r=128-lane
-    packing), then select the wanted row with take_along_axis.
+def probe_xla_grouped_take(m, nout, r, dtype, group=None):
+    """Grouped slab gather, BOTH layouts, vs the plain row take.
 
     Hypothesis for the measured ~17 GB/s of the plain row gather: each
-    rank-64 row is 256 B but the memory system moves (8,128) tiles
-    (4 KB f32), a 16x waste; grouped reads move the same tiles usefully.
-    If this wins on-chip, the ALS gather swaps in the grouped form at
-    the XLA level — no Pallas needed."""
+    rank-64 row is 256 B but the memory system moves (8,128)/(16,128)
+    tiles, a 16-32x waste.  Emits TWO metrics per call:
+
+    - ``xla_grouped3d_take`` — the PRODUCTION form
+      (`ALSConfig(gather_mode="grouped")`): gather [G, R] slices of the
+      3D view [M/G, G, R], whose trailing dims are the tiled ones, so
+      one gathered slice is whole tiles.
+    - ``xla_grouped_take`` — the 2D lane-slab [M/G, G*R] CONTROL arm:
+      its slab rows are 1 sublane tall, so the tile-height waste
+      remains; it should NOT beat the baseline.
+
+    ``group`` defaults to the dtype's tile sublane count (8 f32 /
+    16 bf16), matching production's ``grp`` exactly."""
+    if group is None:
+        group = 8 * (4 // jnp.dtype(dtype).itemsize)
     mg = -(-m // group) * group
     rng = np.random.default_rng(0)
     table = jnp.asarray(
@@ -243,28 +252,37 @@ def probe_xla_grouped_take(m, nout, r, dtype, group=8):
     ).astype(dtype)
     idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
 
-    def grouped(t, i):
+    def grouped_lanes(t, i):
+        # 2D lane-slab form [M/G, G*R]: the G rows lie along LANES, so
+        # one slab row is 1 sublane tall — kept as the control arm that
+        # should NOT beat the tile-height waste
         g = jnp.take(t.reshape(mg // group, group * r), i // group, axis=0)
         sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
         return jnp.take_along_axis(
             g.reshape(nout, group, r), sel, axis=1
         )[:, 0, :]
 
-    fn = jax.jit(grouped)
+    def grouped_tiles(t, i):
+        # 3D tile-slab form [M/G, G, R] (same bytes): trailing (G, R)
+        # dims are the tiled ones, so a gathered [G, R] slice is whole
+        # tiles — the production ALSConfig(gather_mode="grouped") form
+        g = jnp.take(t.reshape(mg // group, group, r), i // group, axis=0)
+        sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
+        return jnp.take_along_axis(g, sel, axis=1)[:, 0, :]
+
     ref = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
-    dt, out = _bench(fn, table, idx)
-    good = bool(
-        np.allclose(
-            np.asarray(out, np.float32),
-            np.asarray(ref(table, idx), np.float32),
-            atol=1e-2,
-        )
-    )
+    want = np.asarray(ref(table, idx), np.float32)
     bytes_useful = nout * r * table.dtype.itemsize
-    _emit(metric="xla_grouped_take", m=m, nout=nout, r=r, group=group,
-          dtype=table.dtype.name, ok=good, seconds=dt,
-          ns_per_row=dt / nout * 1e9,
-          useful_gbps=bytes_useful / dt / 1e9)
+    for name, fn in (("xla_grouped_take", grouped_lanes),
+                     ("xla_grouped3d_take", grouped_tiles)):
+        dt, out = _bench(jax.jit(fn), table, idx)
+        good = bool(
+            np.allclose(np.asarray(out, np.float32), want, atol=1e-2)
+        )
+        _emit(metric=name, m=m, nout=nout, r=r, group=group,
+              dtype=table.dtype.name, ok=good, seconds=dt,
+              ns_per_row=dt / nout * 1e9,
+              useful_gbps=bytes_useful / dt / 1e9)
 
 
 # ---------------------------------------------------------------- D --
@@ -307,9 +325,9 @@ def main():
     probe_xla_take(26744, 32768, 128, jnp.float32)
     _emit(metric="section", form="xla_grouped_take")
     for dtype in (jnp.float32, jnp.bfloat16):
+        # group defaults to the dtype's tile height (8 f32 / 16 bf16)
         probe_xla_grouped_take(26744, 32768, r, dtype)
         probe_xla_grouped_take(138493, 32768, r, dtype)
-        probe_xla_grouped_take(138493, 32768, r, dtype, group=16)
 
 
 if __name__ == "__main__":

@@ -351,13 +351,15 @@ def test_engine_json_exposes_scaling_knobs(ctx):
             "name": "als",
             "params": {
                 "rank": 4, "numIterations": 2, "lambda": 0.1,
-                "solver": "fused", "factorPlacement": "sharded",
+                # pallas, not fused: grouped+fused is REJECTED at
+                # config time (the fused kernel gathers in-kernel)
+                "solver": "pallas", "factorPlacement": "sharded",
                 "gatherDtype": "float32", "gatherMode": "grouped",
             },
         }],
     })
     algo_params = params.algorithms[0][1]
-    assert algo_params.solver == "fused"
+    assert algo_params.solver == "pallas"
     assert algo_params.factor_placement == "sharded"
     assert algo_params.gather_mode == "grouped"
     algos, models = engine.train_components(ctx, params)

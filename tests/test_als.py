@@ -554,6 +554,68 @@ def test_grouped_gather_sharded_matches_replicated():
     )
 
 
+def test_knob_lattice_consistency():
+    """Every valid combination of the perf knobs must train to the same
+    PREDICTIONS as the plain baseline (f32/row/xla/replicated).
+
+    Single-knob A/B tests miss interaction bugs (e.g. grouped x sharded
+    x bf16); an interaction bug produces garbage, not epsilon drift, so
+    the bounds are deliberately looser than the dedicated single-knob
+    tests' (and hold on REAL TPU kernels, not just the near-exact
+    interpret mode CPU runs them in — kernel f32 needs ~5e-3 at factor
+    level, fused+bf16 ~0.1: tests/test_als.py pallas bound,
+    tests/test_fused_als.py).  Implicit mode adds only two extreme
+    corners: the knob plumbing is implicit-agnostic."""
+    import itertools
+
+    from predictionio_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    combos = [
+        (False, s, d, m, p)
+        for s, d, m, p in itertools.product(
+            ("xla", "pallas", "fused"),
+            ("float32", "bfloat16"),
+            ("row", "grouped"),
+            ("replicated", "sharded"),
+        )
+    ] + [
+        (True, "pallas", "bfloat16", "grouped", "sharded"),
+        (True, "fused", "bfloat16", "row", "replicated"),
+    ]
+    refs = {}
+    data = {}
+    for implicit, solver, dtype, mode, placement in combos:
+        if solver == "fused" and mode == "grouped":
+            continue  # rejected combination
+        if implicit not in data:
+            u, i, v, nu, ni = _toy(density=0.5, seed=11)
+            vals = np.abs(v) + 1.0 if implicit else v
+            data[implicit] = (u, i, vals, nu, ni)
+            base_kw = dict(rank=4, num_iterations=2, lam=0.1, seed=5,
+                           implicit=implicit,
+                           **({"alpha": 2.0} if implicit else {}))
+            ref = train_als((u, i, vals), nu, ni, ALSConfig(**base_kw))
+            refs[implicit] = (
+                base_kw, ref.user_factors @ ref.item_factors.T
+            )
+        u, i, vals, nu, ni = data[implicit]
+        base_kw, pred_ref = refs[implicit]
+        cfg_kw = dict(base_kw, solver=solver, gather_dtype=dtype,
+                      gather_mode=mode, factor_placement=placement)
+        got = train_als(
+            (u, i, vals), nu, ni, ALSConfig(**cfg_kw),
+            mesh=mesh if placement == "sharded" else None,
+        )
+        label = f"{solver}/{dtype}/{mode}/{placement}/imp={implicit}"
+        assert np.isfinite(got.user_factors).all(), label
+        assert np.isfinite(got.item_factors).all(), label
+        pred = got.user_factors @ got.item_factors.T
+        atol = 0.2 if dtype == "bfloat16" else 2e-2
+        np.testing.assert_allclose(pred, pred_ref, atol=atol,
+                                   err_msg=label)
+
+
 def test_bf16_gather_implicit_and_sharded():
     from predictionio_tpu.parallel import make_mesh
 

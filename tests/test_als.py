@@ -696,13 +696,17 @@ def test_device_staging_sharded_placement():
                                rtol=1e-3, atol=1e-4)
 
 
-def test_sweep_train_matches_independent_trains():
-    """vmapped lambda sweep == K independent trains, staging paid once."""
+@pytest.mark.parametrize("gather_mode", ["row", "grouped"])
+def test_sweep_train_matches_independent_trains(gather_mode):
+    """vmapped lambda sweep == K independent trains, staging paid once
+    — including under the grouped slab gather (the vmap must batch the
+    3D tile-slab take correctly)."""
     from predictionio_tpu.models.als import sweep_train_als
 
     u, i, v, nu, ni = _toy(n_users=25, n_items=15, density=0.5)
     lams = [0.01, 0.1, 1.0]
-    cfg = ALSConfig(rank=4, num_iterations=4, lam=-1.0)  # lam overridden
+    cfg = ALSConfig(rank=4, num_iterations=4, lam=-1.0,  # lam overridden
+                    gather_mode=gather_mode)
     swept = sweep_train_als((u, i, v), nu, ni, cfg, lams=lams)
     assert len(swept) == 3
     for lam, got in zip(lams, swept):

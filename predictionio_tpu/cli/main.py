@@ -204,6 +204,13 @@ def cmd_app(args, storage: Storage) -> int:
             _out(f"Error: {e}")
             return 1
         _out(f"Trimmed {n} events from app '{args.name}'.")
+        if args.compact:
+            es.compact()
+            _out("Compacted the event store (space reclaimed).")
+        return 0
+    if args.app_command == "compact":
+        es.compact()
+        _out("Compacted the event store (space reclaimed).")
         return 0
     if args.app_command == "channel-new":
         app = md.app_get_by_name(args.name)
@@ -721,6 +728,10 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--channel")
     x.add_argument("--all", action="store_true",
                    help="also delete $set/$unset/$delete property events")
+    x.add_argument("--compact", action="store_true",
+                   help="reclaim freed space afterwards (sqlite VACUUM)")
+    aps.add_parser("compact",
+                   help="reclaim space freed by trims/deletes")
     x = aps.add_parser("channel-new")
     x.add_argument("name")
     x.add_argument("channel")

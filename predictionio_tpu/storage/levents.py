@@ -63,6 +63,16 @@ class EventStore(abc.ABC):
     def close(self) -> None:  # noqa: B027 — optional hook
         pass
 
+    def compact(self) -> None:  # noqa: B027 — optional hook
+        """Reclaim storage space freed by deletes (`app trim`).
+
+        The reference's trim flow rewrote the event table (a Spark job
+        writing a fresh copy minus the window —
+        `examples/experimental/scala-parallel-trim-app`), which
+        implicitly compacted; embedded stores must offer the same
+        reclamation explicitly (sqlite: VACUUM).  Default no-op for
+        stores without free-space bookkeeping."""
+
     # -- writes -----------------------------------------------------------
     @abc.abstractmethod
     def insert(self, event: Event, app_id: int, channel_id: int = 0,

@@ -138,6 +138,10 @@ class ShardedSQLiteEventStore(EventStore):
         for s in self.shards:
             s.close()
 
+    def compact(self) -> None:
+        for s in self.shards:
+            s.compact()
+
     # -- writes -----------------------------------------------------------
     def insert(self, event: Event, app_id: int, channel_id: int = 0,
                validate: bool = True) -> str:

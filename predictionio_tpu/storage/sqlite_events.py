@@ -295,6 +295,21 @@ class SQLiteEventStore(EventStore):
                     conn.close()
                     self._local.conn = None
 
+    def compact(self) -> None:
+        """VACUUM + WAL truncate: rebuild the DB without the pages
+        deletes freed (`app trim` leaves them allocated) and fold the
+        rewrite back into the main file — in WAL mode VACUUM's result
+        lives in the -wal until a checkpoint, so without TRUNCATE the
+        on-disk footprint would not shrink at all.  Must run outside
+        any transaction and takes the writer lock for its duration —
+        an offline-maintenance operation, not a serving-path one."""
+        with self._lock:
+            conn = self._conn
+            conn.commit()  # VACUUM refuses inside a transaction
+            conn.execute("VACUUM")
+            conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+            conn.commit()
+
     # -- writes -----------------------------------------------------------
     def _row(self, event: Event, eid: str) -> tuple:
         return (

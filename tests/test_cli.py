@@ -476,6 +476,58 @@ def test_app_trim(cli):
     assert len(list(es.find(app_id=app.id))) == 1
 
 
+def test_app_trim_compact_reclaims_space(tmp_path):
+    """trim --compact (and `app compact`) shrink the DB file: deletes
+    alone leave sqlite's freed pages allocated — the reference's
+    trim-app flow rewrote the event table, reclaiming space, and the
+    embedded store must offer the same."""
+    import datetime as dt
+    import os
+
+    from predictionio_tpu.storage.event import UTC
+
+    cli_main = main
+    env = dict(os.environ)
+    env["PIO_TPU_HOME"] = str(tmp_path)
+    s = Storage(env)
+    reset_storage(s)
+    try:
+        md = s.get_metadata()
+        app = md.app_insert("compactapp")
+        es = s.get_event_store()
+        es.init_channel(app.id)
+        old = dt.datetime(2020, 1, 1, tzinfo=UTC)
+        es.insert_batch(
+            [
+                Event(event="view", entity_type="user",
+                      entity_id=f"u{k}", target_entity_type="item",
+                      target_entity_id=f"i{k % 7}",
+                      properties=DataMap({"pad": "x" * 512}),
+                      event_time=old)
+                for k in range(4000)
+            ],
+            app.id,
+        )
+        db = tmp_path / "eventdata.db"
+        s.close()  # flush WAL so the size on disk is the real one
+        reset_storage(None)
+        s = Storage(env)
+        reset_storage(s)
+        es = s.get_event_store()
+        size_full = db.stat().st_size
+        code = cli_main(["app", "trim", "compactapp", "--before",
+                         "2022-01-01T00:00:00.000Z", "--all",
+                         "--compact"])
+        assert code == 0
+        size_after = db.stat().st_size
+        assert size_after < size_full / 2, (size_full, size_after)
+        assert list(es.find(app_id=app.id)) == []
+        # standalone compact runs too (idempotent)
+        assert cli_main(["app", "compact"]) == 0
+    finally:
+        reset_storage(None)
+
+
 def test_app_trim_requires_filter(cli):
     run, s, _ = cli
     run("app", "new", "trimguard")

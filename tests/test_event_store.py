@@ -848,3 +848,51 @@ def test_find_ratings_cache_roundtrip_and_invalidation(tmp_path,
     assert s.last_ratings_scan_path != "cache"
     assert "u99" in set(r3.users.ids.tolist())
     s.close()
+
+
+def test_compact_reclaims_space_both_stores(tmp_path):
+    """compact() shrinks the on-disk footprint after mass deletes —
+    VACUUM alone is not enough in WAL mode (the rewrite lives in the
+    -wal until a checkpoint); the sharded store compacts every shard."""
+    import datetime as dt
+
+    from predictionio_tpu.storage import (
+        Event, DataMap, ShardedSQLiteEventStore, SQLiteEventStore,
+    )
+    from predictionio_tpu.storage.event import UTC
+
+    def fill_and_trim(store):
+        store.init_channel(1)
+        old = dt.datetime(2020, 1, 1, tzinfo=UTC)
+        store.insert_batch(
+            [Event(event="view", entity_type="u", entity_id=f"u{k}",
+                   target_entity_type="i", target_entity_id="i1",
+                   properties=DataMap({"pad": "x" * 512}),
+                   event_time=old) for k in range(3000)],
+            1,
+        )
+        ids = [e.event_id for e in store.find(app_id=1)]
+        store.delete_batch(ids, 1)
+
+    def tree_bytes(p):
+        if p.is_file():
+            return p.stat().st_size
+        return sum(f.stat().st_size for f in p.rglob("*") if f.is_file())
+
+    flat = SQLiteEventStore(tmp_path / "flat.db")
+    fill_and_trim(flat)
+    before = tree_bytes(tmp_path / "flat.db")
+    flat.compact()
+    after = tree_bytes(tmp_path / "flat.db")
+    assert after < before / 4, (before, after)
+    assert list(flat.find(app_id=1)) == []
+    flat.close()
+
+    sh = ShardedSQLiteEventStore(tmp_path / "shards", n_shards=3)
+    fill_and_trim(sh)
+    before = tree_bytes(tmp_path / "shards")
+    sh.compact()
+    after = tree_bytes(tmp_path / "shards")
+    assert after < before / 4, (before, after)
+    assert list(sh.find(app_id=1)) == []
+    sh.close()

@@ -62,7 +62,7 @@ def main() -> None:
         ALSConfig, ALSFactors, ALSTrainer, rmse,
     )
     from predictionio_tpu.parallel.mesh import (
-        enable_compilation_cache, fence, make_mesh,
+        enable_compilation_cache, make_mesh,
     )
     import numpy as np
 

@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import functools
 import json
-import sys
 import time
 
 import jax

@@ -96,6 +96,7 @@ run probe_gather        python tools/probe_gather.py
 # the A/Bs (device staging is the default at full scale)
 run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"
 run north_star_best     python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --verbose
+run north_star_best_grouped python bench.py --inner --solver pallas --gather-dtype bfloat16 --precision high --gather-mode grouped --verbose
 run parity              python bench.py --parity
 run pipeline            python bench.py --pipeline
 run solver_grid         python bench_solver.py

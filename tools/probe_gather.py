@@ -305,17 +305,9 @@ def main():
     _emit(metric="probe_env", backend=jax.default_backend(),
           device=str(jax.devices()[0]))
     r = 64
-    for dtype in (jnp.float32, jnp.bfloat16):
-        name = jnp.dtype(dtype).name
-        _emit(metric="section", form="taa_axis0", dtype=name)
-        for n in (8, 256, 2048, 8192, 26744):
-            probe_taa0(n, r, dtype)
-    _emit(metric="section", form="taa_axis1")
-    probe_taa1(4096, r, jnp.float32)
-    probe_taa1(26744, r, jnp.float32)
-    _emit(metric="section", form="dma_row_gather")
-    for nout in (4096, 32768):
-        probe_dma(26744, nout, r, jnp.float32)
+    # guaranteed-lowerable XLA rows FIRST: the speculative Pallas forms
+    # below can hit pathological Mosaic compiles, and a dying step must
+    # still leave the rows the grouped-gather decision needs
     _emit(metric="section", form="xla_take_baseline")
     for dtype in (jnp.float32, jnp.bfloat16):
         probe_xla_take(26744, 32768, r, dtype)
@@ -327,6 +319,18 @@ def main():
         # group defaults to the dtype's tile height (8 f32 / 16 bf16)
         probe_xla_grouped_take(26744, 32768, r, dtype)
         probe_xla_grouped_take(138493, 32768, r, dtype)
+    # speculative Pallas forms (fused-kernel rewrite candidates)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        name = jnp.dtype(dtype).name
+        _emit(metric="section", form="taa_axis0", dtype=name)
+        for n in (8, 256, 2048, 8192, 26744):
+            probe_taa0(n, r, dtype)
+    _emit(metric="section", form="taa_axis1")
+    probe_taa1(4096, r, jnp.float32)
+    probe_taa1(26744, r, jnp.float32)
+    _emit(metric="section", form="dma_row_gather")
+    for nout in (4096, 32768):
+        probe_dma(26744, nout, r, jnp.float32)
 
 
 if __name__ == "__main__":

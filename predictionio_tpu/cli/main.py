@@ -373,6 +373,10 @@ def cmd_deploy(args, storage: Storage) -> int:
             log_url=args.log_url,
             log_prefix=args.log_prefix,
             microbatch=args.microbatch,
+            query_timeout_s=args.query_timeout,
+            feedback_capacity=args.feedback_capacity,
+            breaker_failures=args.breaker_failures,
+            breaker_reset_s=args.breaker_reset,
         ),
         engine_id=engine_id,
         engine_variant=str(args.engine_json),
@@ -436,7 +440,9 @@ def cmd_eventserver(args, storage: Storage) -> int:
 
     server = EventServer(
         storage, EventServerConfig(host=args.ip, port=args.port,
-                                   stats=args.stats)
+                                   stats=args.stats,
+                                   write_retries=args.write_retries,
+                                   write_backoff_s=args.write_backoff)
     )
     _out(f"Event server running on {args.ip}:{args.port}")
     server.serve_forever()
@@ -791,6 +797,24 @@ def build_parser() -> argparse.ArgumentParser:
                    "device call (auto: when the algorithm batch-"
                    "predicts; off restores bitwise per-request "
                    "determinism)")
+    d.add_argument("--query-timeout", type=float, default=None,
+                   metavar="SEC",
+                   help="per-request time budget: expiry answers a "
+                   "structured 503 + Retry-After instead of queueing "
+                   "device work behind a client that gave up "
+                   "(per-request override: /queries.json?timeout=SEC)")
+    d.add_argument("--feedback-capacity", type=int, default=1024,
+                   help="bounded feedback/remote-log delivery queue "
+                   "size; overflow drops the OLDEST entry and counts "
+                   "it in the status JSON")
+    d.add_argument("--breaker-failures", type=int, default=5,
+                   help="consecutive delivery failures that open the "
+                   "circuit breaker for a dead event server / log "
+                   "collector")
+    d.add_argument("--breaker-reset", type=float, default=10.0,
+                   metavar="SEC",
+                   help="seconds an open breaker waits before letting "
+                   "one probe through")
 
     e = sub.add_parser("eval", help="run an evaluation sweep")
     e.add_argument("evaluation",
@@ -809,6 +833,14 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--ip", default="0.0.0.0")
     ev.add_argument("--port", type=int, default=7070)
     ev.add_argument("--stats", action="store_true", default=True)
+    ev.add_argument("--write-retries", type=int, default=3,
+                    help="attempts (first try included) for a transient "
+                    "storage failure before the route answers 503 + "
+                    "Retry-After")
+    ev.add_argument("--write-backoff", type=float, default=0.05,
+                    metavar="SEC",
+                    help="base backoff between storage retries "
+                    "(decorrelated jitter grows it toward a 10x cap)")
 
     ad = sub.add_parser("adminserver", help="run the admin API server")
     ad.add_argument("--ip", default="127.0.0.1")

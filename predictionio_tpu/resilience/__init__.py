@@ -1,0 +1,51 @@
+"""Resilience layer: deadlines, retry/backoff, circuit breakers, a
+bounded background delivery queue, and a deterministic fault-injection
+registry.
+
+The reference deploys long-running serving + event-server processes
+whose failure handling is implicit (a dead log collector must not stall
+serving — `CreateServer.scala` fires feedback/remoteLog asynchronously
+for exactly that reason).  At "millions of users" scale failures are the
+steady state, so the machinery is explicit here:
+
+* :mod:`.policy` — :class:`RetryPolicy` (exponential backoff with
+  decorrelated jitter, seeded so tests are deterministic),
+  :class:`Deadline` (a propagated time budget checked at storage and
+  device-dispatch boundaries), :class:`CircuitBreaker`
+  (closed/open/half-open per dependency).
+* :mod:`.faults` — named injection points instrumented in the real
+  code paths, armed programmatically or via ``PIO_FAULT_PLAN``;
+  zero overhead when no plan is armed.
+* :mod:`.delivery` — a bounded single-drain-thread delivery queue
+  (retry with backoff, drop-oldest when full) replacing
+  thread-per-request fire-and-forget HTTP delivery.
+"""
+
+from .policy import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+)
+from .faults import FaultPlan, InjectedFault, arm, armed, check, disarm
+from .delivery import DeliveryQueue
+
+__all__ = [
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "DeliveryQueue",
+    "FaultPlan",
+    "InjectedFault",
+    "RetryPolicy",
+    "arm",
+    "armed",
+    "check",
+    "check_deadline",
+    "current_deadline",
+    "deadline_scope",
+    "disarm",
+]

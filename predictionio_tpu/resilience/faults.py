@@ -1,0 +1,224 @@
+"""Deterministic fault injection: named points in the real code paths.
+
+Production code calls :func:`check` at each named boundary; with no
+plan armed that is one module-global load and a ``None`` compare — the
+happy path costs nothing.  Tests (or an operator reproducing an
+incident) arm a plan programmatically or through the ``PIO_FAULT_PLAN``
+environment variable and the *real* serving/ingestion/restore code
+executes its degradation paths.
+
+Injection points instrumented in this codebase::
+
+    storage.write      event-server storage inserts
+    storage.read       event-server storage scans
+    device.dispatch    serving predict just before the device call
+    http.feedback      feedback-event delivery (delivery queue send)
+    http.remote_log    remote error-log delivery (delivery queue send)
+    reload.load_model  engine (re)load of trained components
+
+Plan grammar (``;``-separated rules, ``,``-separated options)::
+
+    PIO_FAULT_PLAN="storage.write:nth=1,times=2,exc=operational"
+    PIO_FAULT_PLAN="seed=7;http.feedback:prob=0.5;device.dispatch:delay=0.05"
+
+Options per rule:
+
+* ``nth=N``   — first firing call (1-based, default 1)
+* ``times=T`` — stop after T firings (default: unlimited)
+* ``prob=P``  — fire each eligible call with probability P from a
+  seeded per-point RNG (same plan + seed => same firing sequence)
+* ``delay=S`` — sleep S seconds when firing (without ``exc``: a pure
+  slowdown, the way to exercise deadlines)
+* ``exc=NAME`` — exception to raise: ``fault`` (default,
+  :class:`InjectedFault`), ``operational`` (sqlite3.OperationalError),
+  ``oserror``, ``timeout``, ``urlerror``
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import sqlite3
+import threading
+import time
+import urllib.error
+from typing import Optional
+
+__all__ = ["InjectedFault", "FaultRule", "FaultPlan", "POINTS",
+           "arm", "disarm", "armed", "check"]
+
+POINTS = (
+    "storage.write",
+    "storage.read",
+    "device.dispatch",
+    "http.feedback",
+    "http.remote_log",
+    "reload.load_model",
+)
+
+
+class InjectedFault(RuntimeError):
+    """The default exception a firing injection point raises."""
+
+
+def _make_exc(name: str, msg: str) -> BaseException:
+    if name == "fault":
+        return InjectedFault(msg)
+    if name == "operational":
+        return sqlite3.OperationalError(msg)
+    if name == "oserror":
+        return OSError(msg)
+    if name == "timeout":
+        return TimeoutError(msg)
+    if name == "urlerror":
+        return urllib.error.URLError(msg)
+    raise ValueError(f"unknown fault exception kind {name!r}")
+
+
+class FaultRule:
+    def __init__(self, point: str, nth: int = 1,
+                 times: Optional[int] = None, prob: Optional[float] = None,
+                 delay: Optional[float] = None, exc: Optional[str] = None,
+                 seed: Optional[int] = None):
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown injection point {point!r}; known: {POINTS}"
+            )
+        if exc is not None:
+            _make_exc(exc, "probe")  # validate the name at parse time
+        self.point = point
+        self.nth = nth
+        self.times = times
+        self.prob = prob
+        self.delay = delay
+        # a pure-delay rule raises nothing; otherwise default InjectedFault
+        self.exc = exc if exc is not None else (
+            None if delay is not None else "fault"
+        )
+        # per-point RNG stream: a rule's firing sequence depends only on
+        # its own call order, not on when OTHER points were checked
+        self._rng = random.Random(f"{seed}:{point}")
+        self.calls = 0
+        self.fires = 0
+
+    def hit(self) -> tuple[bool, Optional[BaseException]]:
+        """Count one call; decide whether this call fires and what (if
+        anything) to raise.  Caller holds the plan lock."""
+        self.calls += 1
+        if self.calls < self.nth:
+            return False, None
+        if self.times is not None and self.fires >= self.times:
+            return False, None
+        if self.prob is not None and self._rng.random() >= self.prob:
+            return False, None
+        self.fires += 1
+        exc = None if self.exc is None else _make_exc(
+            self.exc,
+            f"injected fault at {self.point} (call {self.calls})",
+        )
+        return True, exc
+
+
+class FaultPlan:
+    """A set of rules, at most one per point, plus the firing log."""
+
+    def __init__(self, rules: list[FaultRule]):
+        self._rules = {r.point: r for r in rules}
+        self._lock = threading.Lock()
+        # (point, call_index) per firing — the observable sequence a
+        # determinism test compares across identically-seeded runs
+        self.log: list[tuple[str, int]] = []
+
+    @classmethod
+    def parse(cls, spec: str, seed: Optional[int] = None) -> "FaultPlan":
+        rules = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part:
+                k, _, v = part.partition("=")
+                if k.strip() != "seed":
+                    raise ValueError(f"bad fault rule {part!r}")
+                seed = int(v)
+                continue
+            point, _, opts = part.partition(":")
+            kw: dict = {}
+            for opt in opts.split(","):
+                if not opt.strip():
+                    continue
+                k, _, v = opt.partition("=")
+                k = k.strip()
+                if k in ("nth", "times"):
+                    kw[k] = int(v)
+                elif k in ("prob", "delay"):
+                    kw[k] = float(v)
+                elif k == "exc":
+                    kw[k] = v.strip()
+                elif k == "seed":
+                    kw[k] = int(v)
+                else:
+                    raise ValueError(f"unknown fault option {k!r}")
+            kw.setdefault("seed", seed)
+            rules.append(FaultRule(point.strip(), **kw))
+        return cls(rules)
+
+    def hit(self, point: str) -> None:
+        rule = self._rules.get(point)
+        if rule is None:
+            return
+        with self._lock:
+            fired, exc = rule.hit()
+            if fired:
+                self.log.append((point, rule.calls))
+        if not fired:
+            return
+        if rule.delay:
+            time.sleep(rule.delay)  # outside the lock: other points flow
+        if exc is not None:
+            raise exc
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                p: {"calls": r.calls, "fires": r.fires}
+                for p, r in self._rules.items()
+            }
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def arm(plan_or_spec, seed: Optional[int] = None) -> FaultPlan:
+    """Activate a plan (replacing any armed one) and return it."""
+    global _plan
+    plan = (plan_or_spec if isinstance(plan_or_spec, FaultPlan)
+            else FaultPlan.parse(plan_or_spec, seed=seed))
+    _plan = plan
+    return plan
+
+
+def disarm() -> None:
+    global _plan
+    _plan = None
+
+
+def armed() -> Optional[FaultPlan]:
+    return _plan
+
+
+def check(point: str) -> None:
+    """The instrumented boundary.  No plan armed => one global load."""
+    plan = _plan
+    if plan is None:
+        return
+    plan.hit(point)
+
+
+# operator workflow: arm from the environment at import, so any entry
+# point (CLI deploy/eventserver, a test subprocess) picks the plan up
+# without code changes
+_env_spec = os.environ.get("PIO_FAULT_PLAN")
+if _env_spec:
+    arm(_env_spec)
+del _env_spec

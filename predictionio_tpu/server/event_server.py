@@ -23,10 +23,13 @@ from __future__ import annotations
 
 import json
 import logging
+import sqlite3
 import threading
 import urllib.parse
 from typing import Any, Optional
 
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy
 from ..storage.event import Event, EventValidationError, parse_time
 from ..storage.levents import NO_TARGET
 from ..storage.registry import Storage, get_storage
@@ -46,14 +49,28 @@ __all__ = ["EventServer", "EventServerConfig"]
 
 class EventServerConfig:
     def __init__(self, host: str = "127.0.0.1", port: int = 7070,
-                 stats: bool = True):
+                 stats: bool = True, write_retries: int = 3,
+                 write_backoff_s: float = 0.05,
+                 retry_seed: Optional[int] = None):
         self.host = host
         self.port = port
         self.stats = stats
+        # transient-storage-failure policy: a busy WAL / locked sqlite
+        # write is retried with backoff before the route answers
+        # 503 + Retry-After (write_retries counts the first try)
+        self.write_retries = write_retries
+        self.write_backoff_s = write_backoff_s
+        self.retry_seed = retry_seed
 
 
 class AuthError(Exception):
     pass
+
+
+# storage exceptions worth retrying: cross-connection sqlite contention
+# (SQLITE_BUSY past the busy_timeout, WAL checkpoint races) is transient
+# by construction; schema/constraint errors are not OperationalError
+TRANSIENT_STORAGE_ERRORS = (sqlite3.OperationalError,)
 
 
 class EventServer(HTTPServerBase):
@@ -62,6 +79,19 @@ class EventServer(HTTPServerBase):
         self.storage = storage or get_storage()
         self.config = config or EventServerConfig()
         self.stats = StatsCollector() if self.config.stats else None
+        self.write_retry = RetryPolicy(
+            max_attempts=self.config.write_retries,
+            base_s=self.config.write_backoff_s,
+            cap_s=max(1.0, self.config.write_backoff_s * 10),
+            seed=self.config.retry_seed,
+        )
+
+    def _note_retry(self, kind: str):
+        def on_retry(attempt: int, exc: BaseException) -> None:
+            logger.warning("%s retry %d after %s", kind, attempt, exc)
+            if self.stats is not None:
+                self.stats.note(f"{kind}.retry")
+        return on_retry
 
     @property
     def host(self) -> str:
@@ -110,7 +140,15 @@ class EventServer(HTTPServerBase):
         self.check_allowed(event, allowed)
         es = self.storage.get_event_store()
         es.init_channel(app_id, channel_id)
-        return es.insert(event, app_id, channel_id)
+
+        def put():
+            faults.check("storage.write")
+            return es.insert(event, app_id, channel_id)
+
+        return self.write_retry.call(
+            put, retry_on=TRANSIENT_STORAGE_ERRORS,
+            on_retry=self._note_retry("storage.write"),
+        )
 
     @staticmethod
     def _find_kwargs(params: dict[str, list[str]]) -> dict[str, Any]:
@@ -159,6 +197,15 @@ class EventServer(HTTPServerBase):
                 if server.stats is not None:
                     server.stats.bookkeeping(app_id, status, event)
 
+            def _reply_503(self, e: BaseException):
+                """Storage still unavailable after retries: tell the
+                client when to come back instead of failing opaquely."""
+                self.extra_headers = [("Retry-After", "1")]
+                self._reply(503, {
+                    "message": f"event store unavailable: {e}",
+                    "error": "StorageUnavailable",
+                })
+
             # ---- POST ----
             def do_POST(self):
                 path = self._route()
@@ -176,6 +223,8 @@ class EventServer(HTTPServerBase):
                 except (EventValidationError, ConnectorError,
                         json.JSONDecodeError, ValueError) as e:
                     self._reply(400, {"message": str(e)})
+                except TRANSIENT_STORAGE_ERRORS as e:
+                    self._reply_503(e)
                 except Exception as e:
                     logger.exception("event server error")
                     self._reply(500, {"message": str(e)})
@@ -195,6 +244,10 @@ class EventServer(HTTPServerBase):
                     self._book(app_id, 401)
                     self._reply(401, {"message": str(e)})
                     return
+                except TRANSIENT_STORAGE_ERRORS as e:
+                    self._book(app_id, 503)
+                    self._reply_503(e)
+                    return
                 self._book(app_id, 201, event)
                 self._reply(201, {"eventId": eid})
 
@@ -202,18 +255,25 @@ class EventServer(HTTPServerBase):
                 """Batch insert: per-event status
                 (reference EventAPI batch route)."""
                 app_id, channel_id, allowed = self._auth()
-                items = json.loads(self._body().decode())
-                if not isinstance(items, list):
-                    raise ValueError("batch body must be a JSON array")
-                if len(items) > 50:
-                    # the reference's limit (EventAPI.scala batch route);
-                    # the REST path is for live trickle ingest — bulk
-                    # loads belong on `pio-tpu import` (native scanner,
-                    # one transaction, 55-95k events/s)
-                    raise ValueError(
-                        "batch limited to 50 events; use `pio-tpu import` "
-                        "for bulk loads"
-                    )
+                # whole-body rejections are still this app's traffic:
+                # book the 400 or /stats.json under-counts rejections
+                try:
+                    items = json.loads(self._body().decode())
+                    if not isinstance(items, list):
+                        raise ValueError("batch body must be a JSON array")
+                    if len(items) > 50:
+                        # the reference's limit (EventAPI.scala batch
+                        # route); the REST path is for live trickle
+                        # ingest — bulk loads belong on `pio-tpu import`
+                        # (native scanner, one transaction, 55-95k
+                        # events/s)
+                        raise ValueError(
+                            "batch limited to 50 events; use `pio-tpu "
+                            "import` for bulk loads"
+                        )
+                except (json.JSONDecodeError, ValueError):
+                    self._book(app_id, 400)
+                    raise
                 es = server.storage.get_event_store()
                 es.init_channel(app_id, channel_id)
                 # Parse/validate first, then insert every valid event in
@@ -238,10 +298,31 @@ class EventServer(HTTPServerBase):
                     except (EventValidationError, ValueError) as e:
                         self._book(app_id, 400)
                         results[k] = {"status": 400, "message": str(e)}
-                ids = es.insert_batch(
-                    [e for _, e in valid], app_id, channel_id,
-                    validate=False,
-                ) if valid else []
+                def put_batch():
+                    faults.check("storage.write")
+                    return es.insert_batch(
+                        [e for _, e in valid], app_id, channel_id,
+                        validate=False,
+                    )
+
+                try:
+                    ids = server.write_retry.call(
+                        put_batch, retry_on=TRANSIENT_STORAGE_ERRORS,
+                        on_retry=server._note_retry("storage.write"),
+                    ) if valid else []
+                except TRANSIENT_STORAGE_ERRORS as e:
+                    # the batch contract is per-event statuses even when
+                    # the store is down: valid events answer 503 (come
+                    # back), invalid siblings keep their 400/401
+                    for k, _ in valid:
+                        self._book(app_id, 503)
+                        results[k] = {
+                            "status": 503,
+                            "message": f"event store unavailable: {e}",
+                        }
+                    self.extra_headers = [("Retry-After", "1")]
+                    self._reply(200, results)
+                    return
                 for (k, event), eid in zip(valid, ids):
                     self._book(app_id, 201, event)
                     results[k] = {"status": 201, "eventId": eid}
@@ -269,7 +350,13 @@ class EventServer(HTTPServerBase):
                     self._reply(404, {"message": "unknown webhook format"})
                     return
                 event = to_event(connector, data)
-                eid = server.insert_event(event, app_id, channel_id, allowed)
+                try:
+                    eid = server.insert_event(
+                        event, app_id, channel_id, allowed
+                    )
+                except TRANSIENT_STORAGE_ERRORS:
+                    self._book(app_id, 503)
+                    raise  # central handler answers 503 + Retry-After
                 self._book(app_id, 201, event)
                 self._reply(201, {"eventId": eid})
 
@@ -302,16 +389,36 @@ class EventServer(HTTPServerBase):
                     self._reply(401, {"message": str(e)})
                 except ValueError as e:
                     self._reply(400, {"message": str(e)})
+                except TRANSIENT_STORAGE_ERRORS as e:
+                    self._reply_503(e)
                 except Exception as e:
                     logger.exception("event server error")
                     self._reply(500, {"message": str(e)})
+
+            def _scan(self, app_id, fn):
+                """Run a storage read through the injection point and
+                the transient-error retry policy."""
+                def read():
+                    faults.check("storage.read")
+                    return fn()
+
+                try:
+                    return server.write_retry.call(
+                        read, retry_on=TRANSIENT_STORAGE_ERRORS,
+                        on_retry=server._note_retry("storage.read"),
+                    )
+                except TRANSIENT_STORAGE_ERRORS:
+                    self._book(app_id, 503)
+                    raise
 
             def _get_events(self):
                 app_id, channel_id, _ = self._auth()
                 kw = server._find_kwargs(self._params())
                 es = server.storage.get_event_store()
                 es.init_channel(app_id, channel_id)
-                events = list(es.find(app_id=app_id, channel_id=channel_id, **kw))
+                events = self._scan(app_id, lambda: list(
+                    es.find(app_id=app_id, channel_id=channel_id, **kw)
+                ))
                 self._book(app_id, 200)
                 if not events:
                     self._reply(404, {"message": "Not Found"})
@@ -322,7 +429,9 @@ class EventServer(HTTPServerBase):
                 app_id, channel_id, _ = self._auth()
                 es = server.storage.get_event_store()
                 es.init_channel(app_id, channel_id)
-                e = es.get(event_id, app_id, channel_id)
+                e = self._scan(
+                    app_id, lambda: es.get(event_id, app_id, channel_id)
+                )
                 if e is None:
                     self._reply(404, {"message": "Not Found"})
                 else:

@@ -25,6 +25,7 @@ import json
 import logging
 import threading
 import time
+import urllib.parse
 import uuid
 from dataclasses import is_dataclass, asdict
 from http.server import ThreadingHTTPServer
@@ -33,6 +34,15 @@ from typing import Any, Callable, Optional
 from ..controller.base import WorkflowContext
 from .http_base import HTTPServerBase, JsonRequestHandler
 from ..controller.engine import Engine, EngineParams
+from ..resilience import faults
+from ..resilience.delivery import DeliveryQueue
+from ..resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    deadline_scope,
+)
 from ..workflow.train import prepare_deploy_components
 
 logger = logging.getLogger(__name__)
@@ -45,7 +55,16 @@ class ServerConfig:
                  feedback: bool = False, event_server_url: Optional[str] = None,
                  access_key: Optional[str] = None,
                  log_url: Optional[str] = None, log_prefix: str = "",
-                 microbatch: str = "auto", microbatch_max: int = 64):
+                 microbatch: str = "auto", microbatch_max: int = 64,
+                 query_timeout_s: Optional[float] = None,
+                 feedback_capacity: int = 1024,
+                 delivery_attempts: int = 50,
+                 delivery_base_s: float = 0.1,
+                 delivery_cap_s: float = 5.0,
+                 delivery_timeout_s: float = 2.0,
+                 breaker_failures: int = 5,
+                 breaker_reset_s: float = 10.0,
+                 retry_seed: Optional[int] = None):
         self.host = host
         self.port = port
         self.feedback = feedback
@@ -60,6 +79,19 @@ class ServerConfig:
         # "on" forces it, "off" keeps per-request device dispatch
         self.microbatch = microbatch
         self.microbatch_max = microbatch_max
+        # per-request time budget (None = unbounded, the pre-resilience
+        # behavior); expiry answers a structured 503 instead of queueing
+        # device work for a client that already gave up
+        self.query_timeout_s = query_timeout_s
+        # feedback/remote-log delivery queue + breaker knobs
+        self.feedback_capacity = feedback_capacity
+        self.delivery_attempts = delivery_attempts
+        self.delivery_base_s = delivery_base_s
+        self.delivery_cap_s = delivery_cap_s
+        self.delivery_timeout_s = delivery_timeout_s
+        self.breaker_failures = breaker_failures
+        self.breaker_reset_s = breaker_reset_s
+        self.retry_seed = retry_seed
 
 
 def _takes_max_batch(fn: Callable) -> bool:
@@ -145,6 +177,31 @@ class EngineServer(HTTPServerBase):
             engine, engine_params
         )
         self._lock = threading.RLock()
+        self.last_reload_error: Optional[str] = None
+        # bounded background delivery (resilience/delivery.py) replaces
+        # the old thread-per-request fire-and-forget POSTs; built even
+        # when feedback/log_url are off (the drain thread only starts on
+        # first submit) so post-init config changes keep working
+        def _queue(name, point):
+            return DeliveryQueue(
+                name,
+                capacity=self.config.feedback_capacity,
+                retry=RetryPolicy(
+                    max_attempts=self.config.delivery_attempts,
+                    base_s=self.config.delivery_base_s,
+                    cap_s=self.config.delivery_cap_s,
+                    seed=self.config.retry_seed,
+                ),
+                breaker=CircuitBreaker(
+                    failure_threshold=self.config.breaker_failures,
+                    reset_timeout_s=self.config.breaker_reset_s,
+                ),
+                timeout_s=self.config.delivery_timeout_s,
+                fault_point=point,
+            )
+
+        self._feedback_queue = _queue("feedback", "http.feedback")
+        self._log_queue = _queue("remote-log", "http.remote_log")
         self._load(instance_id)
         # serving stats (CreateServer.scala:396-398)
         self.request_count = 0
@@ -155,6 +212,10 @@ class EngineServer(HTTPServerBase):
 
     # -- lifecycle --------------------------------------------------------
     def _load(self, instance_id: str) -> None:
+        # a failed (re)load must leave the previous components serving —
+        # nothing below mutates server state until the atomic swap at
+        # the end, and the injection point lets chaos tests prove it
+        faults.check("reload.load_model")
         # serve with the params the instance was trained with; the current
         # engine.json may have drifted (engineInstanceToEngineParams parity)
         engine_params = self.engine_params
@@ -162,12 +223,12 @@ class EngineServer(HTTPServerBase):
         if rec is not None and rec.algorithms_params:
             try:
                 engine_params = self.engine.params_from_instance(rec)
-                self.engine_params = engine_params
             except Exception:
                 logger.exception(
                     "could not reconstruct params from instance %s; "
                     "using variant params", instance_id,
                 )
+                engine_params = self.engine_params
         algorithms, models, serving = prepare_deploy_components(
             self.engine, engine_params, instance_id, ctx=self.ctx
         )
@@ -208,6 +269,7 @@ class EngineServer(HTTPServerBase):
                     logger.info("%s warmed up in %.2fs",
                                 type(algo).__name__, dt)
         with self._lock:
+            self.engine_params = engine_params
             self.models = models
             self.algorithms = algorithms
             self.serving = serving
@@ -259,34 +321,60 @@ class EngineServer(HTTPServerBase):
         )
 
     def reload(self) -> str:
-        """Swap in the latest COMPLETED instance (GET /reload)."""
+        """Swap in the latest COMPLETED instance (GET /reload).
+
+        A failed load is recorded (``lastReloadError`` in the status
+        JSON) and re-raised; the previously-loaded components keep
+        serving untouched — stale answers beat no answers."""
         md = self.ctx.storage.get_metadata()
         latest = md.engine_instance_get_latest_completed(
             self.engine_id, self.engine_version, self.engine_variant
         )
         if latest is None:
             raise LookupError("no completed engine instance found")
-        self._load(latest.id)
+        try:
+            self._load(latest.id)
+        except Exception as e:
+            with self._lock:
+                self.last_reload_error = f"{type(e).__name__}: {e}"
+            raise
+        with self._lock:
+            self.last_reload_error = None
         return latest.id
 
     # -- query path -------------------------------------------------------
-    def predict_json(self, query_json: dict) -> Any:
+    def predict_json(self, query_json: dict,
+                     timeout_s: Optional[float] = None) -> Any:
         t0 = time.time()
+        # the request's time budget: per-request override, else the
+        # configured default, else unbounded (None costs nothing)
+        budget = timeout_s if timeout_s is not None \
+            else self.config.query_timeout_s
+        deadline = Deadline.after(budget) if budget is not None else None
         query = self.query_decoder(query_json)
         with self._lock:
             algorithms, models, serving, batcher = (
                 self.algorithms, self.models, self.serving, self.batcher,
             )
-        if batcher is not None:
-            # concurrent requests coalesce into one batched device call
-            # (serve() stays per-request on the caller's thread)
-            predictions = batcher.submit(query)
-        else:
-            predictions = [
-                algo.predict(model, query)
-                for algo, model in zip(algorithms, models)
-            ]
-        result = serving.serve(query, predictions)
+        faults.check("device.dispatch")
+        with deadline_scope(deadline):
+            if deadline is not None:
+                # checked at the device boundary: dispatching a batched
+                # XLA call for a request whose client gave up wastes the
+                # one resource concurrency shares — the device queue
+                deadline.check("query device dispatch")
+            if batcher is not None:
+                # concurrent requests coalesce into one batched device
+                # call (serve() stays per-request on the caller's thread)
+                predictions = batcher.submit(query)
+            else:
+                predictions = [
+                    algo.predict(model, query)
+                    for algo, model in zip(algorithms, models)
+                ]
+            if deadline is not None:
+                deadline.check("query serving")
+            result = serving.serve(query, predictions)
         dt = time.time() - t0
         with self._lock:
             self.request_count += 1
@@ -298,8 +386,12 @@ class EngineServer(HTTPServerBase):
         return out
 
     def _send_feedback(self, query_json: dict, result_json: Any) -> Any:
-        """POST a pio_pr feedback event with prId injection, off the hot
-        path (reference `CreateServer.scala:480-550` does this async too)."""
+        """Enqueue a pio_pr feedback event with prId injection, off the
+        hot path (reference `CreateServer.scala:480-550` does this async
+        too).  The bounded delivery queue retries with backoff behind a
+        circuit breaker, so a down event server neither stalls serving
+        nor loses events below queue capacity — they deliver when it
+        returns."""
         pr_id = (
             result_json.get("prId") if isinstance(result_json, dict) else None
         ) or uuid.uuid4().hex
@@ -313,22 +405,7 @@ class EngineServer(HTTPServerBase):
             f"{self.config.event_server_url}/events.json"
             f"?accessKey={self.config.access_key or ''}"
         )
-
-        def post():
-            import urllib.request
-
-            try:
-                req = urllib.request.Request(
-                    url,
-                    data=json.dumps(event).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-                urllib.request.urlopen(req, timeout=2)
-            except Exception as e:  # fire-and-forget
-                logger.warning("feedback event POST failed: %s", e)
-
-        threading.Thread(target=post, daemon=True).start()
+        self._feedback_queue.submit(url, event)
         if isinstance(result_json, dict):
             result_json = {**result_json, "prId": pr_id}
         return result_json
@@ -337,7 +414,8 @@ class EngineServer(HTTPServerBase):
         """Ship a serving error to the configured remote log endpoint
         (reference `CreateServer.scala:413-424` ``remoteLog``): POST
         ``log_prefix + json({engineInstance, message})`` off the hot
-        path; delivery failures are logged locally, never raised."""
+        path via the delivery queue; delivery failures are retried then
+        counted, never raised."""
         if not self.config.log_url:
             return
         payload = self.config.log_prefix + json.dumps({
@@ -349,22 +427,7 @@ class EngineServer(HTTPServerBase):
             },
             "message": message,
         })
-
-        def post():
-            import urllib.request
-
-            try:
-                req = urllib.request.Request(
-                    self.config.log_url,
-                    data=payload.encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST",
-                )
-                urllib.request.urlopen(req, timeout=2)
-            except Exception as e:
-                logger.error("Unable to send remote log: %s", e)
-
-        threading.Thread(target=post, daemon=True).start()
+        self._log_queue.submit(self.config.log_url, payload.encode())
 
     def status_json(self) -> dict:
         out = {
@@ -384,6 +447,14 @@ class EngineServer(HTTPServerBase):
                 "requests": self.batcher.requests,
                 "maxBatchSeen": self.batcher.max_seen,
             }
+        # failure observability: queue depths/drops, breaker states, and
+        # the last reload error an operator should know about
+        out["resilience"] = {
+            "lastReloadError": self.last_reload_error,
+            "queryTimeoutSec": self.config.query_timeout_s,
+            "feedback": self._feedback_queue.stats(),
+            "remoteLog": self._log_queue.stats(),
+        }
         return out
 
     def status_html(self) -> str:
@@ -459,6 +530,13 @@ class EngineServer(HTTPServerBase):
             "</body></html>"
         )
 
+    def stop(self) -> None:
+        super().stop()
+        # release the delivery drain threads (pending entries are
+        # abandoned — the process is going away)
+        self._feedback_queue.close()
+        self._log_queue.close()
+
     # -- http --------------------------------------------------------------
     @property
     def host(self) -> str:
@@ -508,8 +586,30 @@ class EngineServer(HTTPServerBase):
                     except json.JSONDecodeError as e:
                         self._reply(400, {"message": f"invalid JSON: {e}"})
                         return
+                    # optional per-request budget: /queries.json?timeout=0.5
+                    timeout_s = None
+                    tv = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    ).get("timeout")
+                    if tv:
+                        try:
+                            timeout_s = float(tv[0])
+                        except ValueError:
+                            self._reply(
+                                400, {"message": f"bad timeout: {tv[0]!r}"}
+                            )
+                            return
                     try:
-                        self._reply(200, server.predict_json(query_json))
+                        self._reply(200, server.predict_json(
+                            query_json, timeout_s=timeout_s))
+                    except DeadlineExceeded as e:
+                        # structured overload answer, not a hang: the
+                        # client can back off and retry
+                        self.extra_headers = [("Retry-After", "1")]
+                        self._reply(503, {
+                            "message": str(e),
+                            "error": "DeadlineExceeded",
+                        })
                     except (KeyError, ValueError, TypeError) as e:
                         self._reply(400, {"message": f"bad query: {e}"})
                         server.remote_log(

@@ -74,6 +74,11 @@ class StatsCollector:
         self.current = Stats()
         self.previous: Optional[Stats] = None
         self._hour = self._hour_now()
+        # resilience counters (storage.write.retry, storage.read.retry,
+        # ...): lifetime-scoped, fed by the retry policies' on_retry
+        # hooks so operators can see recovered-from trouble, not just
+        # terminal failures
+        self.resilience: Counter = Counter()
 
     @staticmethod
     def _hour_now() -> int:
@@ -102,6 +107,11 @@ class StatsCollector:
             self.lifetime.update(app_id, status, kinded)
             self.current.update(app_id, status, kinded)
 
+    def note(self, counter: str, n: int = 1) -> None:
+        """Bump a named resilience counter (e.g. ``storage.write.retry``)."""
+        with self._lock:
+            self.resilience[counter] += n
+
     def to_json(self, app_id: Optional[int] = None) -> dict:
         with self._lock:
             self._roll()
@@ -111,4 +121,5 @@ class StatsCollector:
                 "previousHour": (
                     self.previous.to_json(app_id) if self.previous else None
                 ),
+                "resilience": dict(sorted(self.resilience.items())),
             }

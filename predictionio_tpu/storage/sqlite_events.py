@@ -30,6 +30,7 @@ from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
+from ..resilience.policy import check_deadline
 from ._sqlite_util import SerializedConnection
 from .columnar import EventFrame
 from .event import (
@@ -328,6 +329,9 @@ class SQLiteEventStore(EventStore):
 
     def insert(self, event: Event, app_id: int, channel_id: int = 0,
                validate: bool = True) -> str:
+        # the storage boundary honors a caller's propagated time budget
+        # (resilience/policy.Deadline): no-op unless a scope is active
+        check_deadline("event store write")
         if validate:
             validate_event(event)
         t = self._ensure_table(app_id, channel_id)
@@ -648,6 +652,7 @@ class SQLiteEventStore(EventStore):
         limit: Optional[int] = None,
         reversed: bool = False,
     ) -> Iterator[Event]:
+        check_deadline("event store scan")
         t = self._ensure_table(app_id, channel_id)
         sql, params = self._query(
             t, start_time, until_time, entity_type, entity_id, event_names,

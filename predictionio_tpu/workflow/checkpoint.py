@@ -38,6 +38,7 @@ class StepCheckpointer:
                 max_to_keep=keep, create=True
             ),
         )
+        self.last_restored_step: Optional[int] = None
 
     def save(self, step: int, tree: Any, wait: bool = True) -> None:
         self._mgr.save(step, args=self._ocp.args.StandardSave(tree))
@@ -51,11 +52,42 @@ class StepCheckpointer:
     def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
         """Restore ``step`` (default latest).  ``like`` — a pytree of
         arrays or ShapeDtypeStructs with target shardings — makes orbax
-        place the restored shards directly onto the current mesh."""
+        place the restored shards directly onto the current mesh.
+
+        When no explicit ``step`` was requested and the newest
+        checkpoint turns out torn (a crash mid-write, a truncated
+        object store upload), restore falls back through older steps
+        instead of failing the whole resume — losing K iterations of
+        progress beats losing the run.  An explicitly requested step
+        never falls back: the caller asked for *that* state.
+        The step actually restored is recorded as
+        ``last_restored_step``."""
+        explicit = step is not None
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        candidates = [step] if explicit else sorted(
+            (s for s in self._mgr.all_steps() if s <= step), reverse=True
+        ) or [step]
+        last_err: Optional[BaseException] = None
+        for i, s in enumerate(candidates):
+            try:
+                out = self._restore_step(s, like)
+            except Exception as e:
+                last_err = e
+                if i + 1 < len(candidates):
+                    logger.warning(
+                        "checkpoint step %d is unreadable (%s: %s); "
+                        "falling back to step %d",
+                        s, type(e).__name__, e, candidates[i + 1],
+                    )
+                continue
+            self.last_restored_step = s
+            return out
+        raise last_err
+
+    def _restore_step(self, step: int, like: Any = None) -> Any:
         if like is not None:
             import jax
 

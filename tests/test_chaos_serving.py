@@ -1,0 +1,490 @@
+"""Chaos suite: every named injection point driven end-to-end through
+real `EventServer` / `EngineServer` instances over HTTP.
+
+The invariants under test are the documented degradation semantics
+(docs/ARCHITECTURE.md "Failure semantics & resilience"):
+
+* ``storage.write``/``storage.read`` — transient storage failures are
+  retried, then answered 503 + Retry-After (batch keeps per-event
+  statuses); the server recovers when the store does.
+* ``http.feedback`` — feedback events survive a temporarily-down event
+  server: queued, breaker-paced, delivered on recovery; drops (only at
+  capacity) are visible in status JSON counters.
+* ``reload.load_model`` — a failed /reload keeps serving the OLD
+  components and surfaces ``lastReloadError``.
+* ``device.dispatch`` — deadline expiry answers a structured 503; a
+  mid-batch fault fails only its own request, never hangs followers.
+* fault plans are deterministic under a fixed seed.
+"""
+
+import datetime as dt
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import WorkflowContext
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.server import EngineServer, ServerConfig
+from predictionio_tpu.server.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from predictionio_tpu.storage import AccessKey, DataMap, Event
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.templates.recommendation import recommendation_engine
+from predictionio_tpu.workflow import run_train
+
+pytestmark = pytest.mark.chaos
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """No fault plan leaks across tests."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def world():
+    """One storage + trained engine instance for the whole module
+    (training is the expensive part; servers are cheap per-test)."""
+    storage = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEMDB",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_MEMDB_TYPE": "memory",
+    })
+    md = storage.get_metadata()
+    app = md.app_insert("chaosapp")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    es = storage.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(5)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{u}",
+              target_entity_type="item", target_entity_id=f"i{i}",
+              properties=DataMap({"rating": float(rng.integers(1, 6))}),
+              event_time=dt.datetime(2020, 1, 1, tzinfo=UTC))
+        for u in range(8) for i in rng.choice(12, size=6, replace=False)
+    ]
+    es.insert_batch(evs, app_id=app.id)
+    ctx = WorkflowContext(storage=storage)
+    engine = recommendation_engine()
+    ep = engine.params_from_variant({
+        "datasource": {"params": {"appName": "chaosapp"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 4, "numIterations": 2, "lambda": 0.1}}],
+    })
+    iid = run_train(engine, ep, ctx=ctx, engine_variant="chaos.json")
+    return {
+        "storage": storage, "app": app, "key": key,
+        "engine": engine, "ep": ep, "iid": iid, "ctx": ctx,
+    }
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode()), dict(r.headers)
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _status_of(fn):
+    """Run a request, mapping HTTPError to its status code."""
+    try:
+        return fn()[0]
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+RATE = {
+    "event": "rate", "entityType": "user", "entityId": "u1",
+    "targetEntityType": "item", "targetEntityId": "i1",
+    "properties": {"rating": 4.0},
+}
+
+
+@pytest.fixture()
+def event_server(world):
+    server = EventServer(world["storage"], EventServerConfig(
+        port=0, write_retries=2, write_backoff_s=0.01, retry_seed=11,
+    ))
+    server.start_background()
+    yield server, f"http://127.0.0.1:{server.config.port}", world["key"]
+    server.stop()
+
+
+def _engine_server(world, **cfg_kw):
+    cfg_kw.setdefault("port", 0)
+    cfg_kw.setdefault("microbatch", "off")
+    server = EngineServer(
+        world["engine"], world["ep"], world["iid"], ctx=world["ctx"],
+        config=ServerConfig(**cfg_kw), engine_variant="chaos.json",
+    )
+    server.start_background()
+    return server
+
+
+# -- storage.write ---------------------------------------------------------
+
+
+def test_storage_write_fault_retry_then_503_then_recovery(event_server):
+    server, base, key = event_server
+    url = f"{base}/events.json?accessKey={key}"
+    # 3 injected failures, write_retries=2: POST #1 burns 2 attempts ->
+    # 503; POST #2 burns the last fire then succeeds on its retry -> 201
+    faults.arm("storage.write:nth=1,times=3,exc=operational")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, RATE)
+    assert e.value.code == 503
+    assert e.value.headers["Retry-After"] == "1"
+    assert json.loads(e.value.read().decode())["error"] == \
+        "StorageUnavailable"
+    status, body, _ = _post(url, RATE)
+    assert status == 201 and body["eventId"]
+    # observability: the 503 and the retries are in /stats.json
+    _, stats = _get(f"{base}/stats.json?accessKey={key}")
+    assert any(c["status"] == 503 and c["count"] == 1
+               for c in stats["lifetime"]["statusCount"])
+    assert stats["resilience"]["storage.write.retry"] >= 2
+
+
+def test_batch_route_keeps_per_event_statuses_when_store_down(event_server):
+    server, base, key = event_server
+    url = f"{base}/batch/events.json?accessKey={key}"
+    batch = [RATE, {**RATE, "event": ""}, {**RATE, "entityId": "u2"}]
+    # storage down for good (more fires than the route will attempt)
+    faults.arm("storage.write:nth=1,times=1000,exc=operational")
+    status, results, headers = _post(url, batch)
+    assert status == 200  # the batch envelope still answers
+    assert [r["status"] for r in results] == [503, 400, 503]
+    assert headers["Retry-After"] == "1"
+    faults.disarm()
+    status, results, _ = _post(url, batch)
+    assert [r["status"] for r in results] == [201, 400, 201]
+
+
+def test_storage_read_fault_503_then_recovery(event_server):
+    server, base, key = event_server
+    _post(f"{base}/events.json?accessKey={key}", RATE)
+    faults.arm("storage.read:nth=1,times=1000,exc=operational")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(f"{base}/events.json?accessKey={key}")
+    assert e.value.code == 503
+    assert e.value.headers["Retry-After"] == "1"
+    faults.disarm()
+    status, evs = _get(f"{base}/events.json?accessKey={key}")
+    assert status == 200 and len(evs) >= 1
+
+
+def test_fault_plan_deterministic_observable_sequence(event_server):
+    """Same seeded probabilistic plan => the same HTTP status sequence
+    and the same firing log, run twice."""
+    server, base, key = event_server
+    url = f"{base}/events.json?accessKey={key}"
+    runs = []
+    for _ in range(2):
+        plan = faults.arm(
+            "storage.write:prob=0.5,exc=operational", seed=123
+        )
+        statuses = [
+            _status_of(lambda: _post(url, RATE)) for _ in range(12)
+        ]
+        runs.append((statuses, list(plan.log)))
+        faults.disarm()
+    assert runs[0] == runs[1]
+    statuses = runs[0][0]
+    assert 503 in statuses and 201 in statuses  # both paths exercised
+
+
+# -- http.feedback ---------------------------------------------------------
+
+
+def test_feedback_survives_event_server_outage(world):
+    """Kill the event store endpoint mid-traffic, restore it, and every
+    feedback event below queue capacity is eventually delivered — with
+    queue depth/breaker state visible in status JSON meanwhile."""
+    ev = EventServer(world["storage"], EventServerConfig(port=0))
+    ev.start_background()
+    ev_port = ev.config.port
+    es_url = f"http://127.0.0.1:{ev_port}"
+
+    srv = _engine_server(
+        world, feedback=True, event_server_url=es_url,
+        access_key=world["key"],
+        feedback_capacity=64, delivery_attempts=100000,
+        delivery_base_s=0.02, delivery_cap_s=0.05,
+        delivery_timeout_s=2.0, breaker_failures=2, breaker_reset_s=0.05,
+        retry_seed=3,
+    )
+    base = f"http://127.0.0.1:{srv.config.port}"
+    store = world["storage"].get_event_store()
+    app_id = world["app"].id
+
+    def feedback_count():
+        return sum(1 for _ in store.find(
+            app_id=app_id, entity_type="pio_pr"))
+
+    try:
+        n0 = feedback_count()
+        status, body, _ = _post(f"{base}/queries.json",
+                                {"user": "u1", "num": 2})
+        assert status == 200 and body["prId"]
+        assert srv._feedback_queue.flush(10.0)
+        assert feedback_count() == n0 + 1
+
+        # outage: the collector dies
+        ev.stop()
+        for k in range(5):
+            status, body, _ = _post(f"{base}/queries.json",
+                                    {"user": f"u{k % 8}", "num": 2})
+            assert status == 200  # serving is NOT stalled by the outage
+        # the queue holds the events; the breaker gives up hammering
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = srv.status_json()["resilience"]["feedback"]
+            if st["depth"] > 0 and st["breaker"]["state"] != "closed":
+                break
+            time.sleep(0.05)
+        assert st["depth"] > 0, st
+        assert st["breaker"]["state"] in ("open", "half-open"), st
+
+        # recovery: a new event server on the SAME port
+        ev2 = EventServer(world["storage"],
+                          EventServerConfig(port=ev_port))
+        ev2.start_background()
+        try:
+            assert srv._feedback_queue.flush(20.0), \
+                srv._feedback_queue.stats()
+            assert feedback_count() == n0 + 6  # nothing lost
+            st = srv.status_json()["resilience"]["feedback"]
+            assert st["dropped"] == 0 and st["delivered"] == 6
+            assert st["retries"] > 0  # the outage was real
+        finally:
+            ev2.stop()
+    finally:
+        srv.stop()
+
+
+def test_feedback_drops_at_capacity_are_counted(world):
+    """Above queue capacity the oldest entries drop — visibly."""
+    srv = _engine_server(
+        world, feedback=True,
+        event_server_url="http://127.0.0.1:1",  # nothing listens
+        access_key=world["key"], feedback_capacity=3,
+        delivery_attempts=100000, delivery_base_s=0.02,
+        delivery_cap_s=0.05, breaker_failures=1, breaker_reset_s=30.0,
+    )
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        for k in range(8):
+            _post(f"{base}/queries.json", {"user": f"u{k % 8}", "num": 2})
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            st = srv.status_json()["resilience"]["feedback"]
+            if st["dropped"] >= 4:
+                break
+            time.sleep(0.05)
+        assert st["submitted"] == 8
+        assert st["dropped"] >= 4 and st["depth"] <= 3, st
+    finally:
+        srv.stop()
+
+
+def test_http_feedback_fault_retried_until_delivered(world):
+    """Injected send failures at the http.feedback point: the delivery
+    queue retries through them; nothing is lost, retries are counted."""
+    ev = EventServer(world["storage"], EventServerConfig(port=0))
+    ev.start_background()
+    srv = _engine_server(
+        world, feedback=True,
+        event_server_url=f"http://127.0.0.1:{ev.config.port}",
+        access_key=world["key"], delivery_attempts=100000,
+        delivery_base_s=0.01, delivery_cap_s=0.03,
+        breaker_failures=50, breaker_reset_s=0.05, retry_seed=9,
+    )
+    base = f"http://127.0.0.1:{srv.config.port}"
+    store = world["storage"].get_event_store()
+    n0 = sum(1 for _ in store.find(app_id=world["app"].id,
+                                   entity_type="pio_pr"))
+    try:
+        faults.arm("http.feedback:nth=1,times=3")
+        for k in range(3):
+            status, _, _ = _post(f"{base}/queries.json",
+                                 {"user": f"u{k}", "num": 2})
+            assert status == 200
+        assert srv._feedback_queue.flush(15.0), srv._feedback_queue.stats()
+        n1 = sum(1 for _ in store.find(app_id=world["app"].id,
+                                       entity_type="pio_pr"))
+        assert n1 == n0 + 3  # every event survived the injected faults
+        st = srv.status_json()["resilience"]["feedback"]
+        assert st["delivered"] == 3 and st["dropped"] == 0
+        assert st["sendFailures"] == 3 and st["retries"] == 3
+    finally:
+        srv.stop()
+        ev.stop()
+
+
+def test_http_remote_log_fault_does_not_break_serving(world):
+    """http.remote_log faults: error-log shipping degrades (retried,
+    counted), queries keep answering."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    received = []
+    arrived = threading.Event()
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            received.append(
+                self.rfile.read(int(self.headers["Content-Length"])))
+            arrived.set()
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    sink = HTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+    srv = _engine_server(
+        world, log_url=f"http://127.0.0.1:{sink.server_port}/log",
+        log_prefix="pio-err: ", delivery_attempts=100000,
+        delivery_base_s=0.01, delivery_cap_s=0.03,
+        breaker_failures=50, breaker_reset_s=0.05, retry_seed=9,
+    )
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        faults.arm("http.remote_log:nth=1,times=2")
+        # a bad query ships a remote log AND still answers 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/queries.json", {"num": 3})
+        assert e.value.code == 400
+        assert arrived.wait(10.0), srv._log_queue.stats()
+        assert srv._log_queue.flush(10.0)
+        assert received[0].decode().startswith("pio-err: ")
+        st = srv.status_json()["resilience"]["remoteLog"]
+        assert st["delivered"] == 1 and st["retries"] == 2
+        # serving itself never noticed
+        status, _, _ = _post(f"{base}/queries.json",
+                             {"user": "u1", "num": 2})
+        assert status == 200
+    finally:
+        srv.stop()
+        sink.shutdown()
+        sink.server_close()
+
+
+# -- reload.load_model -----------------------------------------------------
+
+
+def test_failed_reload_keeps_serving_stale_model(world):
+    srv = _engine_server(world)
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        old_iid = srv.instance_id
+        faults.arm("reload.load_model:nth=1,times=1")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/reload")
+        assert e.value.code == 500
+        # stale-model serving: queries still answer from the old load
+        status, body, _ = _post(f"{base}/queries.json",
+                                {"user": "u1", "num": 3})
+        assert status == 200 and len(body["itemScores"]) == 3
+        assert srv.instance_id == old_iid
+        _, st = _get(f"{base}/")
+        assert "injected fault at reload.load_model" in \
+            st["resilience"]["lastReloadError"]
+        # the fault plan is exhausted: the next reload heals the record
+        status, body = _get(f"{base}/reload")
+        assert status == 200 and body["reloaded"] == old_iid
+        _, st = _get(f"{base}/")
+        assert st["resilience"]["lastReloadError"] is None
+    finally:
+        srv.stop()
+
+
+# -- device.dispatch + deadlines ------------------------------------------
+
+
+def test_query_deadline_returns_structured_503(world):
+    srv = _engine_server(world)
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        # a pure slowdown at the device boundary + a tight per-request
+        # budget => structured 503, not a hang
+        faults.arm("device.dispatch:delay=0.2,times=1")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/queries.json?timeout=0.05",
+                  {"user": "u1", "num": 2})
+        assert e.value.code == 503
+        assert e.value.headers["Retry-After"] == "1"
+        body = json.loads(e.value.read().decode())
+        assert body["error"] == "DeadlineExceeded"
+        # no fault, same budget: plenty of time -> 200
+        status, out, _ = _post(f"{base}/queries.json?timeout=5",
+                               {"user": "u1", "num": 2})
+        assert status == 200 and len(out["itemScores"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_server_default_query_timeout_applies(world):
+    srv = _engine_server(world, query_timeout_s=0.05)
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        faults.arm("device.dispatch:delay=0.2,times=1")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/queries.json", {"user": "u1", "num": 2})
+        assert e.value.code == 503
+        status, _, _ = _post(f"{base}/queries.json",
+                             {"user": "u1", "num": 2})
+        assert status == 200
+        assert srv.status_json()["resilience"]["queryTimeoutSec"] == 0.05
+    finally:
+        srv.stop()
+
+
+def test_device_fault_fails_one_request_not_the_batcher(world):
+    """A device-boundary fault under concurrency: exactly the injected
+    requests fail; every other in-flight request completes (no hung
+    MicroBatcher followers, no wedged server)."""
+    import concurrent.futures
+
+    srv = _engine_server(world, microbatch="on", microbatch_max=8)
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        faults.arm("device.dispatch:nth=3,times=2")
+
+        def one(k):
+            return _status_of(lambda: _post(
+                f"{base}/queries.json", {"user": f"u{k % 8}", "num": 2},
+                timeout=30,
+            ))
+
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            statuses = list(ex.map(one, range(24)))
+        assert statuses.count(500) == 2, statuses
+        assert statuses.count(200) == 22, statuses
+        # the server still serves after the chaos
+        faults.disarm()
+        status, body, _ = _post(f"{base}/queries.json",
+                                {"user": "u1", "num": 2})
+        assert status == 200 and len(body["itemScores"]) == 2
+    finally:
+        srv.stop()

@@ -206,6 +206,27 @@ def test_stats_json(srv):
     assert any(e["event"] == "rate" for e in life["eventCount"])
 
 
+def test_batch_whole_body_rejections_booked_in_stats(srv):
+    """A non-list or >50-event batch body is rejected BEFORE any
+    per-event loop; the 400 must still land in /stats.json (it used to
+    raise out of _post_batch without booking)."""
+    base, key, *_ = srv
+
+    def count_400():
+        _, body = _get(f"{base}/stats.json?accessKey={key}")
+        return sum(c["count"] for c in body["lifetime"]["statusCount"]
+                   if c["status"] == 400)
+
+    before = count_400()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/batch/events.json?accessKey={key}", {"not": "a list"})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"{base}/batch/events.json?accessKey={key}", [RATE] * 51)
+    assert e.value.code == 400
+    assert count_400() == before + 2
+
+
 def test_webhook_segmentio(srv):
     base, key, *_ = srv
     payload = {

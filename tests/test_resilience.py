@@ -1,0 +1,475 @@
+"""Unit tests for the resilience layer (`predictionio_tpu/resilience/`):
+retry policy, deadlines, circuit breaker, fault-injection registry, and
+the bounded delivery queue.  End-to-end chaos drills live in
+`tests/test_chaos_serving.py`."""
+
+import sqlite3
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.resilience.delivery import DeliveryQueue
+from predictionio_tpu.resilience.policy import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    check_deadline,
+    current_deadline,
+    deadline_scope,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+# -- RetryPolicy -----------------------------------------------------------
+
+
+def test_retry_delays_deterministic_under_seed():
+    a = list(RetryPolicy(max_attempts=6, seed=42).delays())
+    b = list(RetryPolicy(max_attempts=6, seed=42).delays())
+    c = list(RetryPolicy(max_attempts=6, seed=43).delays())
+    assert a == b and len(a) == 5
+    assert a != c  # the seed is actually consulted
+    assert all(d >= 0.05 for d in a)  # base floor
+
+
+def test_retry_call_retries_then_raises():
+    calls = []
+    slept = []
+
+    def flaky():
+        calls.append(1)
+        raise sqlite3.OperationalError("database is locked")
+
+    p = RetryPolicy(max_attempts=3, base_s=0.001, seed=0)
+    with pytest.raises(sqlite3.OperationalError):
+        p.call(flaky, retry_on=(sqlite3.OperationalError,),
+               sleep=slept.append)
+    assert len(calls) == 3 and len(slept) == 2
+
+
+def test_retry_call_succeeds_midway_and_reports():
+    seen = []
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, base_s=0.001, seed=0)
+    out = p.call(flaky, retry_on=(OSError,), sleep=lambda d: None,
+                 on_retry=lambda attempt, exc: seen.append(attempt))
+    assert out == "ok" and seen == [1, 2]
+
+
+def test_retry_does_not_sleep_past_deadline():
+    """Once the budget cannot cover the next backoff, the error
+    surfaces immediately instead of burning the client's remaining
+    patience."""
+    def always():
+        raise OSError("down")
+
+    p = RetryPolicy(max_attempts=10, base_s=0.2, seed=0)
+    with deadline_scope(Deadline.after(0.05)):
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            p.call(always, retry_on=(OSError,))
+        assert time.monotonic() - t0 < 0.2  # no 0.2s+ sleeps happened
+
+
+def test_non_matching_exception_not_retried():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=5, base_s=0.001).call(
+            bad, retry_on=(OSError,))
+    assert len(calls) == 1
+
+
+# -- Deadline --------------------------------------------------------------
+
+
+def test_deadline_check_and_expiry():
+    dl = Deadline.after(60.0)
+    dl.check("warm")  # plenty of budget: no raise
+    assert 0 < dl.remaining() <= 60.0
+    expired = Deadline.after(-0.001)
+    assert expired.expired
+    with pytest.raises(DeadlineExceeded):
+        expired.check("cold")
+
+
+def test_deadline_scope_propagates_and_restores():
+    assert current_deadline() is None
+    check_deadline("no scope")  # no-op without a scope
+    outer = Deadline.after(60.0)
+    with deadline_scope(outer):
+        assert current_deadline() is outer
+        inner = Deadline.after(30.0)
+        with deadline_scope(inner):
+            assert current_deadline() is inner
+        assert current_deadline() is outer
+        # a None scope inherits the surrounding deadline
+        with deadline_scope(None):
+            assert current_deadline() is outer
+    assert current_deadline() is None
+
+
+def test_deadline_scope_is_thread_local():
+    seen = {}
+
+    def probe():
+        seen["other"] = current_deadline()
+
+    with deadline_scope(Deadline.after(60.0)):
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+    assert seen["other"] is None
+
+
+def test_sqlite_store_honors_deadline():
+    """The storage boundary checks the propagated budget (the tentpole's
+    'checked at storage boundaries' contract)."""
+    from predictionio_tpu.storage.event import DataMap, Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    es = SQLiteEventStore(":memory:")
+    es.init_channel(1)
+    ev = Event(event="rate", entity_type="user", entity_id="u1",
+               properties=DataMap({}))
+    with deadline_scope(Deadline.after(-0.001)):
+        with pytest.raises(DeadlineExceeded):
+            es.insert(ev, app_id=1)
+        with pytest.raises(DeadlineExceeded):
+            list(es.find(app_id=1))
+    # outside the scope the same store works
+    es.insert(ev, app_id=1)
+    assert len(list(es.find(app_id=1))) == 1
+
+
+# -- CircuitBreaker --------------------------------------------------------
+
+
+def test_breaker_opens_probes_and_recovers():
+    t = {"now": 0.0}
+    cb = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                        clock=lambda: t["now"])
+    assert cb.state == "closed" and cb.allow()
+    cb.record_failure()
+    assert cb.state == "closed"  # below threshold
+    cb.record_failure()
+    assert cb.state == "open" and not cb.allow()
+    t["now"] = 5.0
+    assert cb.allow()            # the single half-open probe
+    assert not cb.allow()        # concurrent caller blocked while probing
+    cb.record_failure()          # probe failed: re-open for another window
+    assert cb.state == "open" and not cb.allow()
+    t["now"] = 10.0
+    assert cb.allow()
+    cb.record_success()
+    assert cb.state == "closed" and cb.allow()
+    snap = cb.snapshot()
+    assert snap["state"] == "closed" and snap["openCount"] == 2
+
+
+# -- fault registry --------------------------------------------------------
+
+
+def test_fault_plan_nth_times_exc():
+    plan = faults.arm("storage.write:nth=2,times=2,exc=operational")
+    faults.check("storage.write")  # call 1: below nth
+    for expected_call in (2, 3):
+        with pytest.raises(sqlite3.OperationalError):
+            faults.check("storage.write")
+    faults.check("storage.write")  # times exhausted
+    assert plan.log == [("storage.write", 2), ("storage.write", 3)]
+    assert plan.counters()["storage.write"] == {"calls": 4, "fires": 2}
+
+
+def test_fault_plan_probabilistic_deterministic():
+    """Same plan + same seed => the same observable firing sequence
+    (the acceptance-criteria determinism contract)."""
+    logs = []
+    for _ in range(2):
+        plan = faults.arm("device.dispatch:prob=0.4", seed=7)
+        for _ in range(50):
+            try:
+                faults.check("device.dispatch")
+            except faults.InjectedFault:
+                pass
+        logs.append(list(plan.log))
+        faults.disarm()
+    assert logs[0] == logs[1]
+    assert 0 < len(logs[0]) < 50  # actually probabilistic
+    other = faults.arm("device.dispatch:prob=0.4", seed=8)
+    for _ in range(50):
+        try:
+            faults.check("device.dispatch")
+        except faults.InjectedFault:
+            pass
+    assert list(other.log) != logs[0]
+
+
+def test_fault_plan_pure_delay_fires_without_raising():
+    faults.arm("device.dispatch:delay=0.03,times=1")
+    t0 = time.monotonic()
+    faults.check("device.dispatch")  # sleeps, no exception
+    assert time.monotonic() - t0 >= 0.025
+    t1 = time.monotonic()
+    faults.check("device.dispatch")  # times exhausted: instant
+    assert time.monotonic() - t1 < 0.02
+
+
+def test_fault_plan_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("not.a.point:nth=1")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("storage.write:wat=1")
+    with pytest.raises(ValueError):
+        faults.FaultPlan.parse("storage.write:exc=nope")
+
+
+def test_no_plan_armed_is_noop():
+    faults.disarm()
+    for p in faults.POINTS:
+        faults.check(p)  # must not raise, count, or allocate
+
+
+def test_env_var_arms_plan_in_fresh_process():
+    """PIO_FAULT_PLAN is the operator interface: a fresh interpreter
+    picks the plan up at import with no code changes."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "from predictionio_tpu.resilience import faults\n"
+        "assert faults.armed() is not None\n"
+        "try:\n"
+        "    faults.check('storage.write')\n"
+        "    raise SystemExit('fault did not fire')\n"
+        "except faults.InjectedFault:\n"
+        "    print('FIRED')\n"
+    )
+    env = dict(os.environ)
+    env["PIO_FAULT_PLAN"] = "storage.write:nth=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "FIRED" in proc.stdout
+
+
+# -- delivery queue --------------------------------------------------------
+
+
+class _Sink:
+    """Local HTTP endpoint that can be told to fail the next N posts."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        sink = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if sink.fail_next > 0:
+                    sink.fail_next -= 1
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                sink.received.append(body)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.received = []
+        self.fail_next = 0
+        self._httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_port}/sink"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture()
+def sink():
+    s = _Sink()
+    yield s
+    s.stop()
+
+
+def _queue(retries=10, capacity=8, breaker_failures=3, reset=0.05):
+    return DeliveryQueue(
+        "test", capacity=capacity,
+        retry=RetryPolicy(max_attempts=retries, base_s=0.01, cap_s=0.05,
+                          seed=0),
+        breaker=CircuitBreaker(failure_threshold=breaker_failures,
+                               reset_timeout_s=reset),
+        timeout_s=2.0,
+    )
+
+
+def test_delivery_queue_delivers(sink):
+    q = _queue()
+    try:
+        assert q.submit(sink.url, {"k": 1})
+        assert q.flush(5.0)
+        assert len(sink.received) == 1
+        st = q.stats()
+        assert st["delivered"] == 1 and st["dropped"] == 0
+        assert st["breaker"]["state"] == "closed"
+    finally:
+        q.close()
+
+
+def test_delivery_queue_retries_through_transient_failure(sink):
+    sink.fail_next = 2
+    q = _queue()
+    try:
+        q.submit(sink.url, {"k": 2})
+        assert q.flush(10.0)
+        assert len(sink.received) == 1
+        st = q.stats()
+        assert st["delivered"] == 1 and st["retries"] >= 2
+        assert st["sendFailures"] >= 2 and st["dropped"] == 0
+    finally:
+        q.close()
+
+
+def test_delivery_queue_drop_oldest_at_capacity():
+    # no server listening: nothing drains fast; point at a dead port
+    q = _queue(capacity=4, retries=1000)
+    try:
+        url = "http://127.0.0.1:1/never"
+        for i in range(10):
+            q.submit(url, {"i": i})
+        st = q.stats()
+        assert st["depth"] <= 4
+        assert st["dropped"] >= 6  # oldest displaced, counted
+        assert st["submitted"] == 10
+    finally:
+        q.close()
+
+
+def test_delivery_queue_breaker_opens_on_dead_endpoint():
+    q = _queue(retries=1000, breaker_failures=2, reset=30.0)
+    try:
+        q.submit("http://127.0.0.1:1/never", {"x": 1})
+        for _ in range(200):
+            if q.stats()["breaker"]["state"] == "open":
+                break
+            time.sleep(0.02)
+        st = q.stats()
+        assert st["breaker"]["state"] == "open"
+        fails_when_open = st["sendFailures"]
+        # with the breaker open the entry WAITS: no attempt burn-down
+        time.sleep(0.2)
+        assert q.stats()["sendFailures"] == fails_when_open
+        assert q.stats()["depth"] == 1  # still queued, not dropped
+    finally:
+        q.close()
+
+
+def test_delivery_queue_redelivers_after_endpoint_returns(sink):
+    """The headline invariant: entries queued while the endpoint was
+    dead deliver once it comes back (breaker half-open probe)."""
+    port = sink._httpd.server_port
+    sink.stop()
+    q = _queue(retries=1000, breaker_failures=2, reset=0.05)
+    try:
+        dead_url = f"http://127.0.0.1:{port}/sink"
+        for i in range(5):
+            q.submit(dead_url, {"i": i})
+        for _ in range(100):
+            if q.stats()["breaker"]["state"] != "closed":
+                break
+            time.sleep(0.01)
+        # resurrect the endpoint on the SAME port
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        received = []
+
+        class Ok(BaseHTTPRequestHandler):
+            def do_POST(self):
+                received.append(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", port), Ok)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            assert q.flush(15.0), q.stats()
+            assert len(received) == 5
+            st = q.stats()
+            assert st["delivered"] == 5 and st["dropped"] == 0
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+    finally:
+        q.close()
+
+
+# -- checkpoint torn-restore fallback --------------------------------------
+
+
+def test_checkpoint_restore_falls_back_past_torn_step(tmp_path):
+    import numpy as np
+
+    from predictionio_tpu.workflow.checkpoint import StepCheckpointer
+
+    import jax.numpy as jnp
+
+    ck = StepCheckpointer(tmp_path / "ck", keep=5)
+    tree1 = {"U": jnp.ones((3, 2)) * 1.0}
+    tree2 = {"U": jnp.ones((3, 2)) * 2.0}
+    ck.save(1, tree1)
+    ck.save(2, tree2)
+    assert ck.latest_step() == 2
+    # tear the newest checkpoint the way a crash mid-write does:
+    # truncate every regular file under the step directory
+    step_dir = next(p for p in (tmp_path / "ck").iterdir()
+                    if p.name in ("2", "2.orbax-checkpoint"))
+    torn = 0
+    for f in step_dir.rglob("*"):
+        if f.is_file():
+            f.write_bytes(b"torn")
+            torn += 1
+    assert torn > 0
+    out = ck.restore()
+    assert ck.last_restored_step == 1
+    np.testing.assert_array_equal(np.asarray(out["U"]),
+                                  np.ones((3, 2)))
+    # an explicitly requested torn step must NOT silently fall back
+    with pytest.raises(Exception):
+        ck.restore(step=2)
+    ck.close()

@@ -117,6 +117,24 @@ def test_status_page_latency_bookkeeping(deployed):
     assert body["engineInstanceId"] == server.instance_id
 
 
+def test_status_json_exposes_resilience_observability(deployed):
+    """Failure observability contract: queue depth/drops, breaker
+    states, retry counts, and lastReloadError all ride the status
+    JSON."""
+    server, *_ = deployed
+    base = f"http://127.0.0.1:{server.config.port}"
+    _, body = _get(f"{base}/")
+    res = body["resilience"]
+    assert res["lastReloadError"] is None
+    assert res["queryTimeoutSec"] is None  # default: unbounded
+    for queue in (res["feedback"], res["remoteLog"]):
+        for k in ("depth", "capacity", "submitted", "delivered",
+                  "dropped", "retries", "sendFailures"):
+            assert isinstance(queue[k], int), k
+        assert queue["breaker"]["state"] == "closed"
+        assert queue["breaker"]["consecutiveFailures"] == 0
+
+
 def test_reload_swaps_to_latest(deployed):
     server, ctx, engine, ep = deployed
     old_iid = server.instance_id

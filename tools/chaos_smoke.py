@@ -1,0 +1,234 @@
+"""Tiny-scale chaos smoke: seeded fault plans through real servers.
+
+The chaos analogue of `tools/fullscale_cert.py`: drives the documented
+failure-semantics invariants end-to-end through real `EventServer` +
+`EngineServer` instances at a scale that finishes in seconds on CPU,
+and emits a judge-readable JSON artifact.  CI runs it inside tier-1
+(`tests/test_chaos_smoke.py`) so a regression in any degradation path
+fails fast instead of surfacing during an actual outage.
+
+Stages (each timed, each asserting its invariant):
+
+1. ``storage_write_retry`` — seeded storage.write faults: retried,
+   503 + Retry-After on exhaustion, recovery afterwards, rejections
+   booked in /stats.json.
+2. ``feedback_redelivery`` — event server killed mid-traffic: serving
+   unaffected, feedback queued, redelivered in full on restart.
+3. ``stale_reload`` — reload.load_model fault: /reload answers 500,
+   the old model keeps serving, ``lastReloadError`` surfaces and heals.
+
+Usage::
+
+    python tools/chaos_smoke.py --out chaos_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as dt
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+UTC = dt.timezone.utc
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="chaos_smoke.json")
+    ap.add_argument("--seed", type=int, default=20260804)
+    args = ap.parse_args(argv)
+
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.resilience import faults
+    from predictionio_tpu.server import EngineServer, ServerConfig
+    from predictionio_tpu.server.event_server import (
+        EventServer, EventServerConfig,
+    )
+    from predictionio_tpu.storage import AccessKey, DataMap, Event
+    from predictionio_tpu.storage.registry import Storage
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import run_train
+
+    import numpy as np
+
+    stages: dict[str, float] = {}
+    invariants: dict[str, bool] = {}
+
+    def stage(name):
+        class _T:
+            def __enter__(self):
+                self.t0 = time.time()
+
+            def __exit__(self, *exc):
+                stages[name] = round(time.time() - self.t0, 3)
+
+        return _T()
+
+    storage = Storage(env={
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEMDB",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_MEMDB_TYPE": "memory",
+    })
+    md = storage.get_metadata()
+    app = md.app_insert("chaossmoke")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    es = storage.get_event_store()
+    es.init_channel(app.id)
+
+    # ---- stage 1: storage.write retry -> 503 -> recovery ----------------
+    with stage("storage_write_retry"):
+        ev = EventServer(storage, EventServerConfig(
+            port=0, write_retries=2, write_backoff_s=0.01,
+            retry_seed=args.seed,
+        ))
+        ev.start_background()
+        base = f"http://127.0.0.1:{ev.config.port}"
+        url = f"{base}/events.json?accessKey={key}"
+        rate = {
+            "event": "rate", "entityType": "user", "entityId": "u0",
+            "targetEntityType": "item", "targetEntityId": "i0",
+            "properties": {"rating": 3.0},
+        }
+        faults.arm("storage.write:nth=1,times=3,exc=operational",
+                   seed=args.seed)
+        codes = []
+        for _ in range(3):
+            try:
+                codes.append(_post(url, rate)[0])
+            except urllib.error.HTTPError as e:
+                e.read()
+                codes.append(e.code)
+        faults.disarm()
+        _, stats = _get(f"{base}/stats.json?accessKey={key}")
+        invariants["write_fault_503_then_recovery"] = (
+            codes == [503, 201, 201]
+        )
+        invariants["rejection_booked_in_stats"] = any(
+            c["status"] == 503 and c["count"] >= 1
+            for c in stats["lifetime"]["statusCount"]
+        )
+        invariants["retries_counted_in_stats"] = (
+            stats["resilience"].get("storage.write.retry", 0) >= 2
+        )
+        ev.stop()
+
+    # ---- train the tiny engine once for stages 2+3 ----------------------
+    with stage("train_tiny_engine"):
+        rng = np.random.default_rng(args.seed)
+        evs = [
+            Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                  target_entity_type="item", target_entity_id=f"i{i}",
+                  properties=DataMap(
+                      {"rating": float(rng.integers(1, 6))}),
+                  event_time=dt.datetime(2020, 1, 1, tzinfo=UTC))
+            for u in range(6) for i in rng.choice(8, size=4,
+                                                  replace=False)
+        ]
+        es.insert_batch(evs, app_id=app.id)
+        ctx = WorkflowContext(storage=storage)
+        engine = recommendation_engine()
+        ep = engine.params_from_variant({
+            "datasource": {"params": {"appName": "chaossmoke"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 4, "numIterations": 2, "lambda": 0.1}}],
+        })
+        iid = run_train(engine, ep, ctx=ctx, engine_variant="smoke.json")
+
+    # ---- stage 2: feedback redelivery across an outage ------------------
+    with stage("feedback_redelivery"):
+        ev = EventServer(storage, EventServerConfig(port=0))
+        ev.start_background()
+        ev_port = ev.config.port
+        srv = EngineServer(
+            engine, ep, iid, ctx=ctx,
+            config=ServerConfig(
+                port=0, microbatch="off", feedback=True,
+                event_server_url=f"http://127.0.0.1:{ev_port}",
+                access_key=key, feedback_capacity=64,
+                delivery_attempts=100000, delivery_base_s=0.02,
+                delivery_cap_s=0.05, breaker_failures=2,
+                breaker_reset_s=0.05, retry_seed=args.seed,
+            ),
+            engine_variant="smoke.json",
+        )
+        srv.start_background()
+        qbase = f"http://127.0.0.1:{srv.config.port}"
+        ev.stop()  # the collector dies before any feedback flows
+        served = all(
+            _post(f"{qbase}/queries.json",
+                  {"user": f"u{k % 6}", "num": 2})[0] == 200
+            for k in range(4)
+        )
+        invariants["serving_survives_collector_outage"] = served
+        st = srv.status_json()["resilience"]["feedback"]
+        invariants["feedback_queued_during_outage"] = st["depth"] > 0
+        ev2 = EventServer(storage, EventServerConfig(port=ev_port))
+        ev2.start_background()
+        drained = srv._feedback_queue.flush(20.0)
+        n_fb = sum(1 for _ in storage.get_event_store().find(
+            app_id=app.id, entity_type="pio_pr"))
+        st = srv.status_json()["resilience"]["feedback"]
+        invariants["feedback_redelivered_in_full"] = (
+            drained and n_fb == 4 and st["dropped"] == 0
+        )
+        ev2.stop()
+
+    # ---- stage 3: stale-model serving through a failed reload -----------
+    with stage("stale_reload"):
+        faults.arm("reload.load_model:nth=1,times=1", seed=args.seed)
+        try:
+            _get(f"{qbase}/reload")
+            reload_failed = False
+        except urllib.error.HTTPError as e:
+            e.read()
+            reload_failed = e.code == 500
+        ok, _ = _post(f"{qbase}/queries.json", {"user": "u1", "num": 2})
+        last_err = srv.status_json()["resilience"]["lastReloadError"]
+        invariants["failed_reload_answers_500"] = reload_failed
+        invariants["stale_model_keeps_serving"] = ok == 200
+        invariants["last_reload_error_surfaced"] = bool(last_err)
+        faults.disarm()
+        healed, _ = _get(f"{qbase}/reload")
+        invariants["reload_heals_after_fault"] = (
+            healed == 200
+            and srv.status_json()["resilience"]["lastReloadError"] is None
+        )
+        srv.stop()
+
+    rec = {
+        "metric": "chaos_smoke",
+        "seed": args.seed,
+        "stages": stages,
+        "invariants": invariants,
+        "ok": all(invariants.values()),
+    }
+    Path(args.out).write_text(json.dumps(rec, indent=2) + "\n")
+    print(json.dumps(rec, indent=2))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,6 +153,15 @@ def _parse_args(argv=None):
                     help="batched SPD solver override (default: "
                     "ALSConfig default); 'fused' = single-pass "
                     "gather+Gram+solve kernel on VMEM-fitting sides")
+    ap.add_argument("--solver-mode", default=None,
+                    choices=("full", "subspace"),
+                    help="rank-sweep strategy: 'full' = R×R solve per "
+                    "row, 'subspace' = iALS++ block sweep "
+                    "(ALSConfig.solver_mode)")
+    ap.add_argument("--subspace-block", type=int, default=None,
+                    metavar="B",
+                    help="block width of the subspace sweep "
+                    "(ALSConfig.subspace_size; default 16)")
     ap.add_argument("--precision", default=None,
                     choices=("highest", "high", "default"),
                     help="Gram-einsum MXU precision override "
@@ -255,6 +264,10 @@ def _prepare(args):
         extra["solver"] = args.solver
     if args.precision:
         extra["matmul_precision"] = args.precision
+    if args.solver_mode:
+        extra["solver_mode"] = args.solver_mode
+    if args.subspace_block is not None:
+        extra["subspace_size"] = args.subspace_block
     cfg = ALSConfig(
         rank=args.rank, num_iterations=args.iters, lam=0.01,
         seed=args.seed, gather_dtype=args.gather_dtype or "float32",
@@ -386,14 +399,20 @@ def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
     side = trainer._user_side
 
     @functools.partial(jax.jit, static_argnames=("ks", "stop_after"))
-    def probe(opp, c_sorted, v_sorted, buckets, lam, alpha, *, ks,
-              stop_after):
+    def probe(upd_tab, opp, c_sorted, v_sorted, buckets, lam, alpha, *,
+              ks, stop_after):
+        # upd_tab: the current factor table — subspace mode's "gram"
+        # probe warm-starts its block sweep from it, so the measured
+        # Gram phase includes the residual/prediction cache builds the
+        # real sweep pays
         return _solve_buckets(
             None, opp, c_sorted, v_sorted, buckets, lam, alpha,
             ks=ks, implicit=cfg.implicit,
             weighted_lambda=cfg.weighted_lambda,
             precision=cfg.matmul_precision, solver=cfg.solver,
             gather_dtype=cfg.gather_dtype, gather_mode=cfg.gather_mode,
+            solver_mode=cfg.solver_mode,
+            subspace_size=cfg.subspace_size, upd_table=upd_tab,
             stop_after=stop_after,
         )
 
@@ -412,9 +431,15 @@ def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
         emit(
             f"user_half_probe_{stop}",
             timed(lambda: probe(
-                V, side["c_sorted"], side["v_sorted"], side["buckets"],
-                lam, alpha, ks=side["ks"], stop_after=stop,
+                U, V, side["c_sorted"], side["v_sorted"],
+                side["buckets"], lam, alpha, ks=side["ks"],
+                stop_after=stop,
             )),
+            **(
+                {"solver_mode": cfg.solver_mode,
+                 "subspace_size": cfg.subspace_size}
+                if cfg.solver_mode == "subspace" else {}
+            ),
         )
     # the full half-iteration donates its first argument; feed copies
     emit(
@@ -537,6 +562,11 @@ def run_inner(args) -> None:
                 **(
                     {"degraded": True}
                     if solver_used != cfg.solver else {}
+                ),
+                "solver_mode": cfg.solver_mode,
+                **(
+                    {"subspace_size": cfg.subspace_size}
+                    if cfg.solver_mode == "subspace" else {}
                 ),
                 "precision": cfg.matmul_precision,
                 "gather_dtype": cfg.gather_dtype,
@@ -1056,6 +1086,9 @@ def main() -> None:
       + (["--gather-mode", args.gather_mode]
          if args.gather_mode else []) \
       + (["--solver", args.solver] if args.solver else []) \
+      + (["--solver-mode", args.solver_mode] if args.solver_mode else []) \
+      + (["--subspace-block", str(args.subspace_block)]
+         if args.subspace_block is not None else []) \
       + (["--precision", args.precision] if args.precision else []) \
       + (["--verbose"] if args.verbose else [])
 
@@ -1131,7 +1164,21 @@ def main() -> None:
     )
     if line is not None:
         rec = json.loads(line)
+        # LOUD fallback contract: a rc=0 line whose only hint was a
+        # buried "error" string let a CPU number masquerade as a TPU
+        # one in the bench trajectory.  `platform_fallback` is the
+        # explicit top-level field consumers must check, and the
+        # warning line makes it visible in raw logs too.
+        rec["platform_fallback"] = True
+        rec["platform_requested"] = "accelerator"
         rec["error"] = f"accelerator unavailable: {probe_err}"
+        print(
+            f"# WARNING: accelerator unavailable ({probe_err}); the "
+            f"JSON line below is a CPU fallback at scale={cpu_scale} "
+            "— NOT an accelerator measurement "
+            "(platform_fallback=true)",
+            file=sys.stderr, flush=True,
+        )
         last = _last_accelerator_measurement()
         if last is not None:
             rec["last_accelerator_run"] = last
@@ -1152,6 +1199,7 @@ def main() -> None:
         "unit": "s",
         "vs_baseline": None,
         "platform": None,
+        "platform_fallback": True,
         "error": f"accelerator: {probe_err}; cpu fallback: {err}",
     }
     last = _last_accelerator_measurement()

@@ -1,19 +1,28 @@
 """Batched-SPD-solver benchmark: XLA (cholesky + triangular_solve) vs the
-Pallas kernel (`ops/solve.py`), on the default accelerator.
+Pallas kernel (`ops/solve.py`) vs the iALS++ subspace sweep's solve
+phase, on the default accelerator.
 
 VERDICT r1 item 3: the crossover must be MEASURED on the real chip, not
 promised in a docstring.  Run with the TPU reachable:
 
     python bench_solver.py                 # full grid, prints a table
     python bench_solver.py --rank 64 --batch 32768   # one cell
+    python bench_solver.py --solver subspace --block 16   # sweep cells
 
 Prints one JSON line per (rank, batch) cell:
   {"metric": "spd_solve_batched_ms", "rank": R, "batch": B,
    "xla_ms": ..., "pallas_ms": ..., "speedup": ..., "max_err": ...}
-and a final summary line recommending the default solver per rank.
+plus, per --block B, a subspace line measuring the SOLVE PHASE of an
+iALS++ sweep — ceil(R/B) data-dependent chained batched B×B solves,
+the work `ALSConfig(solver_mode="subspace")` dispatches per
+half-iteration in place of one batched R×R solve:
+  {"metric": "spd_solve_subspace_ms", "rank": R, "batch": B,
+   "block": Bk, "n_blocks": ..., "sweep_xla_ms": ...,
+   "sweep_pallas_ms": ..., "solve_speedup_vs_full": ...}
+and a final summary line recommending full-solve vs subspace per rank.
 Results should be recorded in docs/ARCHITECTURE.md ("Measured
-performance") and, if Pallas wins at the north-star rank, the
-`ALSConfig.solver` default flipped.
+performance") and, if a mode wins at the north-star rank, the
+`ALSConfig` defaults flipped.
 """
 
 from __future__ import annotations
@@ -35,6 +44,14 @@ def main() -> None:
                     help="rank(s) to test (default: 10 64 128)")
     ap.add_argument("--batch", type=int, action="append",
                     help="batch size(s) (default: 4096 32768)")
+    ap.add_argument("--solver", action="append",
+                    choices=("xla", "pallas", "subspace"),
+                    help="solver(s) to grid (default: all three); "
+                    "'subspace' times the iALS++ sweep's solve phase "
+                    "(xla full-solve always runs as the baseline)")
+    ap.add_argument("--block", type=int, action="append",
+                    help="subspace block width(s) B (default: 16); "
+                    "only used with the subspace solver")
     ap.add_argument("--reps", type=int, default=10)
     ap.add_argument("--platform", help="force a jax platform (e.g. cpu)")
     args = ap.parse_args()
@@ -62,7 +79,17 @@ def main() -> None:
     rng = np.random.default_rng(0)
     ranks = args.rank or [10, 64, 128]
     batches = args.batch or [4096, 32768]
-    wins: dict[int, list[float]] = {}
+    solvers = tuple(args.solver or ("xla", "pallas", "subspace"))
+    blocks = args.block or [16]
+    # per rank: solver label -> list of per-batch ms (xla always runs —
+    # it is the baseline every speedup/recommendation is measured from)
+    times: dict[int, dict[str, list[float]]] = {}
+
+    def note(R, name, ms):
+        times.setdefault(R, {}).setdefault(name, []).append(ms)
+
+    from predictionio_tpu.parallel.mesh import fence
+
     for R in ranks:
         for B in batches:
             M = rng.normal(size=(B, R, R)).astype(np.float32)
@@ -71,13 +98,9 @@ def main() -> None:
                 + 10 * np.eye(R, dtype=np.float32)
             )
             b = jax.device_put(rng.normal(size=(B, R)).astype(np.float32))
-            from predictionio_tpu.parallel.mesh import fence
 
             x1 = xla_j(A, b)
             fence(x1)
-            x2 = cholesky_solve_batched(A, b)
-            fence(x2)
-            err = float(jnp.max(jnp.abs(x1 - x2)))
             # fence (tiny d2h) instead of block_until_ready — the latter is
             # a no-op on remote-tunnel backends.  Time all reps as one span
             # with a single closing fence so the per-solve figure excludes
@@ -86,28 +109,81 @@ def main() -> None:
             fence(x1)
             rtt = time.perf_counter() - t0
 
-            def timed(fn):
+            def timed(fn, *operands):
                 t0 = time.perf_counter()
                 for _ in range(args.reps):
-                    x = fn(A, b)
+                    x = fn(*operands)
                 fence(x)
                 return max(time.perf_counter() - t0 - rtt, 0.0) / args.reps
 
-            xm = timed(xla_j) * 1e3
-            pm = timed(cholesky_solve_batched) * 1e3
-            wins.setdefault(R, []).append(xm / pm)
-            print(json.dumps({
-                "metric": "spd_solve_batched_ms",
-                "platform": jax.default_backend(),
-                "rank": R, "batch": B,
-                "xla_ms": round(xm, 3), "pallas_ms": round(pm, 3),
-                "speedup": round(xm / pm, 3),
-                "max_err": float(f"{err:.3e}"),
-            }), flush=True)
-    rec = {
-        R: ("pallas" if float(np.mean(s)) > 1.0 else "xla")
-        for R, s in wins.items()
-    }
+            xm = timed(xla_j, A, b) * 1e3
+            note(R, "xla", xm)
+            if "pallas" in solvers:
+                x2 = cholesky_solve_batched(A, b)
+                fence(x2)
+                err = float(jnp.max(jnp.abs(x1 - x2)))
+                pm = timed(cholesky_solve_batched, A, b) * 1e3
+                note(R, "pallas", pm)
+                print(json.dumps({
+                    "metric": "spd_solve_batched_ms",
+                    "platform": jax.default_backend(),
+                    "rank": R, "batch": B,
+                    "xla_ms": round(xm, 3), "pallas_ms": round(pm, 3),
+                    "speedup": round(xm / pm, 3),
+                    "max_err": float(f"{err:.3e}"),
+                }), flush=True)
+            if "subspace" not in solvers:
+                continue
+            for blk in blocks:
+                if blk >= R:
+                    continue
+                nb = -(-R // blk)
+                # the sweep's solve phase: nb chained batched blk×blk
+                # solves (each block's rhs depends on the previous
+                # block's solution through the residual update, so the
+                # chain is data-dependent — XLA cannot overlap them,
+                # matching the real sweep's dispatch structure)
+                Ab = jax.device_put(np.ascontiguousarray(
+                    np.asarray(A)[:, :blk, :blk]))
+                bb = jax.device_put(np.asarray(b)[:, :blk])
+
+                def sweep(solve_fn):
+                    def f(Ab, bb):
+                        x = bb
+                        for _ in range(nb):
+                            x = solve_fn(Ab, x)
+                        return x
+                    return jax.jit(f)
+
+                sweep_x = sweep(xla_solve)
+                fence(sweep_x(Ab, bb))
+                sm_x = timed(sweep_x, Ab, bb) * 1e3
+                note(R, f"subspace:{blk}", sm_x)
+                rec = {
+                    "metric": "spd_solve_subspace_ms",
+                    "platform": jax.default_backend(),
+                    "rank": R, "batch": B, "block": blk, "n_blocks": nb,
+                    "full_xla_ms": round(xm, 3),
+                    "sweep_xla_ms": round(sm_x, 3),
+                }
+                if "pallas" in solvers:
+                    sweep_p = sweep(cholesky_solve_batched)
+                    fence(sweep_p(Ab, bb))
+                    sm_p = timed(sweep_p, Ab, bb) * 1e3
+                    note(R, f"subspace-pallas:{blk}", sm_p)
+                    rec["sweep_pallas_ms"] = round(sm_p, 3)
+                best_sweep = min(
+                    [sm_x] + ([sm_p] if "pallas" in solvers else [])
+                )
+                rec["solve_speedup_vs_full"] = round(xm / best_sweep, 3)
+                print(json.dumps(rec), flush=True)
+
+    # recommendation: the lowest mean solve-phase time per rank; names
+    # are "xla" | "pallas" | "subspace:B" | "subspace-pallas:B"
+    rec = {}
+    for R, per in times.items():
+        best = min(per, key=lambda name: float(np.mean(per[name])))
+        rec[R] = best
     print(json.dumps({"metric": "solver_recommendation",
                       "per_rank": rec}))
 

@@ -101,6 +101,19 @@ class ALSConfig:
     # (ops/fused_als.py single-pass gather+Gram+solve kernel on sides
     # whose opposite table fits VMEM; other sides fall back to xla)
     solver: str = "xla"
+    # rank-sweep strategy: "full" solves the complete R×R normal
+    # equations per row (today's behavior, the default); "subspace"
+    # (iALS++, arXiv 2110.14044) sweeps the rank dimension in blocks of
+    # ``subspace_size``, replacing each per-row O(R³) SPD solve with
+    # R/B solves of B×B subsystems and the full [K,R]→R² Gram
+    # contraction with rank-B updates against a cached residual —
+    # per sweep: Gram work drops R/B-fold, solve work (R/B)²-fold.
+    # ``subspace_size >= rank`` routes through the EXACT full-solve
+    # code path (bitwise-identical results).
+    solver_mode: str = "full"
+    # block width B of the subspace sweep (ALX-friendly: smaller B×B
+    # systems pack MORE rows per VMEM tile in the Pallas GJ kernel)
+    subspace_size: int = 16
     # dtype the opposite factor table is GATHERED in: "float32" (exact,
     # default) or "bfloat16" — the Gram einsums are gather-bandwidth-bound
     # (see docs/ARCHITECTURE.md cost model), so a bf16 table halves the
@@ -146,6 +159,27 @@ class ALSConfig:
                 f"solver must be 'xla', 'pallas' or 'fused', "
                 f"got {self.solver!r}"
             )
+        if self.solver_mode not in ("full", "subspace"):
+            raise ValueError(
+                f"solver_mode must be 'full' or 'subspace', "
+                f"got {self.solver_mode!r}"
+            )
+        if self.solver_mode == "subspace":
+            if self.subspace_size < 1:
+                raise ValueError(
+                    f"subspace_size must be >= 1, got {self.subspace_size}"
+                )
+            if self.solver == "fused":
+                # the fused kernel is a single-pass full-rank
+                # gather+Gram+solve — there is no block-sweep variant of
+                # it; accepting the combination would silently run the
+                # full solve while the config claims subspace
+                raise ValueError(
+                    "solver_mode='subspace' does not compose with "
+                    "solver='fused' (the fused kernel solves the full "
+                    "R×R system in-kernel); use solver='pallas' or "
+                    "'xla'"
+                )
         if self.factor_placement not in ("replicated", "sharded"):
             raise ValueError(
                 f"factor_placement must be 'replicated' or 'sharded', "
@@ -413,6 +447,30 @@ def _device_expand_sides(col_by_row, val_by_row, row_counts, val_scale):
 # --------------------------------------------------------------------------
 
 
+def _spd_solve(A: jax.Array, b: jax.Array, solver: str) -> jax.Array:
+    """Batched SPD solve ``A[i] x[i] = b[i]`` via the configured backend.
+
+    One routing point for BOTH the full R×R systems and the subspace
+    mode's B×B subsystems: ``"pallas"`` runs the Gauss-Jordan kernel
+    (`ops/solve.py` — smaller systems pack more rows per VMEM tile, so
+    the kernel gets FASTER per system as B shrinks), anything else the
+    XLA Cholesky + two triangular solves.
+    """
+    if solver == "pallas":
+        from ..ops.solve import cholesky_solve_batched
+
+        return cholesky_solve_batched(
+            A.astype(jnp.float32), b.astype(jnp.float32)
+        )
+    L = jax.lax.linalg.cholesky(A)
+    y = jax.lax.linalg.triangular_solve(
+        L, b[..., None], left_side=True, lower=True
+    )
+    return jax.lax.linalg.triangular_solve(
+        L, y, left_side=True, lower=True, transpose_a=True
+    )[..., 0]
+
+
 def _half_iteration_impl(
     upd: jax.Array,        # [N, R] factor table being solved (donated)
     opp: jax.Array,        # [M, R] opposite-side factor table
@@ -429,6 +487,8 @@ def _half_iteration_impl(
     solver: str,
     gather_dtype: str = "float32",
     gather_mode: str = "row",
+    solver_mode: str = "full",
+    subspace_size: int = 0,
 ) -> jax.Array:
     def write(acc, rows, x):
         acc = upd if acc is None else acc
@@ -441,7 +501,8 @@ def _half_iteration_impl(
         write, opp, c_sorted, v_sorted, bucket_args, lam, alpha,
         ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
         precision=precision, solver=solver, gather_dtype=gather_dtype,
-        gather_mode=gather_mode,
+        gather_mode=gather_mode, solver_mode=solver_mode,
+        subspace_size=subspace_size, upd_table=upd,
     )
     return upd if out is None else out
 
@@ -452,7 +513,7 @@ _half_iteration = functools.partial(
     jax.jit,
     static_argnames=(
         "ks", "implicit", "weighted_lambda", "precision", "solver",
-        "gather_dtype", "gather_mode",
+        "gather_dtype", "gather_mode", "solver_mode", "subspace_size",
     ),
     donate_argnums=(0,),
 )(_half_iteration_impl)
@@ -474,10 +535,31 @@ def _solve_buckets(
     solver: str,
     gather_dtype: str = "float32",
     gather_mode: str = "row",
+    solver_mode: str = "full",
+    subspace_size: int = 0,
+    upd_table: Optional[jax.Array] = None,
     gram: Optional[jax.Array] = None,
     stop_after: Optional[str] = None,
 ):
     """Shared bucket-solve math for the replicated and sharded paths.
+
+    ``solver_mode="subspace"`` (iALS++, arXiv 2110.14044) replaces the
+    per-row full R×R normal-equation solve with a sweep over rank
+    blocks of width ``subspace_size``: per block S, a Newton step on
+    the block coordinates — exact because the objective is quadratic —
+
+        H_S δ = -(g_S),  x_S ← x_S + δ
+
+    where ``H_S`` is the B×B principal Gram submatrix (+ reg) and
+    ``g_S`` the block gradient evaluated against an incrementally
+    maintained per-row residual (explicit) / prediction + YtY·x caches
+    (implicit).  Per sweep the Gram contraction drops from O(K·R²) to
+    O(K·B·R) and the solves from O(R³) to O(R·B²).  The sweep warm-
+    starts from the CURRENT factor row, read from ``upd_table`` (the
+    full — possibly all-gathered — table being updated; required for
+    subspace mode).  ``subspace_size >= R`` takes the full-solve branch
+    below verbatim, so the degenerate config is bitwise-identical to
+    ``solver_mode="full"``.
 
     ``stop_after`` ("gather" | "gram") truncates the per-bucket pipeline
     and returns a scalar reduction instead of writing factors — used by
@@ -505,6 +587,14 @@ def _solve_buckets(
     """
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]
+    # B >= R degenerates to the full-solve branch VERBATIM (bitwise-
+    # identical compiled program), per the ALSConfig contract
+    sub = solver_mode == "subspace" and 0 < subspace_size < r
+    if sub and upd_table is None:
+        raise ValueError(
+            "solver_mode='subspace' requires the current factor table "
+            "(upd_table) to warm-start the block sweep"
+        )
     prec = jax.lax.Precision(
         {"highest": "highest", "high": "high", "default": "default"}[precision]
     )
@@ -604,6 +694,28 @@ def _solve_buckets(
             out = (0.0 if out is None else out) + Vm.astype(f32).sum()
             continue
         n_row = counts.astype(f32)                       # [B]
+        lam_t = lam.astype(f32)
+        if weighted_lambda:
+            reg = lam_t * jnp.maximum(n_row, 1.0)        # ALS-WR: λ·n_row
+        else:
+            reg = jnp.broadcast_to(lam_t, n_row.shape)
+        if sub:
+            # iALS++ block sweep: warm-start from the current factor
+            # rows (batch-padding ids are OOB -> fill 0; their output
+            # is dropped by the scatter anyway)
+            x0 = upd_table.at[rows].get(
+                mode="fill", fill_value=0.0
+            ).astype(f32)
+            cw_b = (alpha.astype(f32) * val * maskf) if implicit else None
+            res = _subspace_sweep(
+                Vm, val, maskf, x0, reg, cw_b, gram, prec, solver,
+                subspace_size, gram_probe=stop_after == "gram",
+            )
+            if stop_after == "gram":
+                out = (0.0 if out is None else out) + res
+            else:
+                out = upd_write(out, rows, res)
+            continue
         # weight vectors are computed in f32 then cast to the gather dtype
         # right before the einsum, so a mixed-dtype contraction never
         # silently promotes (and re-materializes) the big Vm operand
@@ -624,31 +736,97 @@ def _solve_buckets(
                 "bk,bkr->br", (val * maskf).astype(Vm.dtype), Vm,
                 precision=prec, preferred_element_type=f32,
             )
-        lam_t = lam.astype(f32)
-        if weighted_lambda:
-            reg = lam_t * jnp.maximum(n_row, 1.0)        # ALS-WR: λ·n_row
-        else:
-            reg = jnp.broadcast_to(lam_t, n_row.shape)
         A = A + reg[:, None, None] * jnp.eye(r, dtype=A.dtype)
         if stop_after == "gram":
             out = (0.0 if out is None else out) + A.sum() + b.sum()
             continue
-        if solver == "pallas":
-            from ..ops.solve import cholesky_solve_batched
-
-            x = cholesky_solve_batched(
-                A.astype(jnp.float32), b.astype(jnp.float32)
-            )
-        else:
-            L = jax.lax.linalg.cholesky(A)
-            y = jax.lax.linalg.triangular_solve(
-                L, b[..., None], left_side=True, lower=True
-            )
-            x = jax.lax.linalg.triangular_solve(
-                L, y, left_side=True, lower=True, transpose_a=True
-            )[..., 0]
+        x = _spd_solve(A, b, solver)
         out = upd_write(out, rows, x)
     return out
+
+
+def _subspace_sweep(
+    Vm: jax.Array,          # [B, K, R] gathered+masked opposite rows
+    val: jax.Array,         # [B, K] masked ratings, f32
+    maskf: jax.Array,       # [B, K] validity mask, f32
+    x0: jax.Array,          # [B, R] current factor rows, f32
+    reg: jax.Array,         # [B] per-row regularization (λ or λ·n_row)
+    cw: Optional[jax.Array],  # [B, K] implicit (c-1) weights, or None
+    gram: Optional[jax.Array],  # [R, R] YtY (implicit mode), f32
+    prec,
+    solver: str,
+    block: int,
+    *,
+    gram_probe: bool = False,
+):
+    """One iALS++ rank-block sweep over a bucket's rows (arXiv
+    2110.14044 Alg. 2, batched over rows).
+
+    Each block update is an exact Newton step on the block coordinates
+    of the quadratic per-row objective, against caches maintained
+    incrementally with rank-B work:
+
+    * explicit — residual ``e = Vm·x - val`` ([B, K]); block gradient
+      ``g_S = VsᵀE + reg·x_S``, Hessian ``H_S = VsᵀVs + reg·I``.
+    * implicit — prediction ``p = Vm·x`` and ``q = x·YtY`` ([B, R]);
+      ``g_S = q_S + Vsᵀ((c-1)p - c) + reg·x_S``,
+      ``H_S = YtY[S,S] + Vsᵀdiag(c-1)Vs + reg·I``.
+
+    ``gram_probe=True`` computes every block's (H, g) without solving
+    or updating the caches and returns their scalar sum — the
+    ``stop_after="gram"`` hook that keeps the per-phase timing probe
+    honest for this mode (the Gram-contraction cost of a sweep, minus
+    the solve/update half).
+    """
+    f32 = jnp.float32
+    r = Vm.shape[-1]
+    pred = jnp.einsum(
+        "bkr,br->bk", Vm, x0.astype(Vm.dtype),
+        precision=prec, preferred_element_type=f32,
+    )
+    e = q = None
+    if cw is None:
+        e = pred - val
+    else:
+        q = jnp.einsum("bs,sr->br", x0, gram, precision=prec)
+    acc = jnp.zeros((), f32)
+    for s in range(0, r, block):
+        w = min(block, r - s)
+        Vs = jax.lax.slice_in_dim(Vm, s, s + w, axis=2)   # [B, K, w]
+        xs = jax.lax.slice_in_dim(x0, s, s + w, axis=1)   # [B, w]
+        if cw is None:
+            H = jnp.einsum("bks,bkt->bst", Vs, Vs, precision=prec,
+                           preferred_element_type=f32)
+            g = jnp.einsum("bk,bks->bs", e.astype(Vs.dtype), Vs,
+                           precision=prec, preferred_element_type=f32)
+        else:
+            H = gram[s:s + w, s:s + w] + jnp.einsum(
+                "bk,bks,bkt->bst", cw.astype(Vs.dtype), Vs, Vs,
+                precision=prec, preferred_element_type=f32,
+            )
+            # (c-1)·p - c on rated items: cw is masked, so c·mask is
+            # maskf + cw
+            coef = cw * pred - maskf - cw
+            g = q[:, s:s + w] + jnp.einsum(
+                "bk,bks->bs", coef.astype(Vs.dtype), Vs,
+                precision=prec, preferred_element_type=f32,
+            )
+        H = H + reg[:, None, None] * jnp.eye(w, dtype=H.dtype)
+        g = g + reg[:, None] * xs
+        if gram_probe:
+            acc = acc + H.sum() + g.sum()
+            continue
+        d = -_spd_solve(H, g, solver)                    # [B, w]
+        x0 = jax.lax.dynamic_update_slice_in_dim(x0, xs + d, s, axis=1)
+        dp = jnp.einsum("bks,bs->bk", Vs, d.astype(Vs.dtype),
+                        precision=prec, preferred_element_type=f32)
+        if cw is None:
+            e = e + dp
+        else:
+            pred = pred + dp
+            q = q + jnp.einsum("bs,sr->br", d, gram[s:s + w, :],
+                               precision=prec)
+    return acc if gram_probe else x0
 
 
 def build_sharded_half(
@@ -661,6 +839,8 @@ def build_sharded_half(
     solver: str,
     gather_dtype: str = "float32",
     gather_mode: str = "row",
+    solver_mode: str = "full",
+    subspace_size: int = 0,
 ):
     """ALX-style half-iteration over block-sharded factor tables.
 
@@ -726,6 +906,15 @@ def build_sharded_half(
             tuple(flat_buckets[i : i + 3])
             for i in range(0, len(flat_buckets), 3)
         )
+        # subspace mode warm-starts each row's block sweep from the
+        # CURRENT factor value, but this device solves rows owned by
+        # OTHER shards — gather the full updating table transiently
+        # too (f32: it is the iterate, not a bandwidth-discountable
+        # operand).  One extra [N, R] all-gather per half-iteration;
+        # stays zero-cost when the mode is off or degenerate.
+        upd_full = None
+        if solver_mode == "subspace" and 0 < subspace_size < upd.shape[-1]:
+            upd_full = jax.lax.all_gather(upd, axis, axis=0, tiled=True)
 
         def write(acc, rows, x):
             acc = upd if acc is None else acc
@@ -742,7 +931,9 @@ def build_sharded_half(
             write, opp_full, c_sorted, v_sorted, bucket_args, lam, alpha,
             ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
             precision=precision, solver=solver,
-            gather_dtype=gather_dtype, gather_mode=gather_mode, gram=gram,
+            gather_dtype=gather_dtype, gather_mode=gather_mode,
+            solver_mode=solver_mode, subspace_size=subspace_size,
+            upd_table=upd_full, gram=gram,
         )
         return upd if out is None else out
 
@@ -770,7 +961,13 @@ def _resolve_solver(cfg: ALSConfig) -> str:
     if cfg.solver == "pallas":
         from ..ops.solve import pallas_solver_ok
 
-        if not pallas_solver_ok(cfg.rank):
+        # probe the dimension the kernel will actually solve: subspace
+        # mode dispatches B×B subsystems, not R×R (tail blocks are
+        # narrower still — probing the widest block suffices)
+        dim = cfg.rank
+        if cfg.solver_mode == "subspace" and 0 < cfg.subspace_size < cfg.rank:
+            dim = cfg.subspace_size
+        if not pallas_solver_ok(dim):
             return "xla"
     elif cfg.solver == "fused":
         from ..ops.fused_als import fused_solver_ok
@@ -901,6 +1098,8 @@ class ALSTrainer:
             solver=self.solver,
             gather_dtype=cfg.gather_dtype,
             gather_mode=cfg.gather_mode,
+            solver_mode=cfg.solver_mode,
+            subspace_size=cfg.subspace_size,
         )
         self._sharded_user_half = build_sharded_half(
             self.mesh, ks=self._user_side["ks"], **common
@@ -1324,6 +1523,8 @@ class ALSTrainer:
             solver=self.solver,
             gather_dtype=cfg.gather_dtype,
             gather_mode=cfg.gather_mode,
+            solver_mode=cfg.solver_mode,
+            subspace_size=cfg.subspace_size,
         )
 
     def run(
@@ -1477,6 +1678,7 @@ def sweep_train_als(
         implicit=cfg.implicit, weighted_lambda=cfg.weighted_lambda,
         precision=cfg.matmul_precision, solver=cfg.solver,
         gather_dtype=cfg.gather_dtype, gather_mode=cfg.gather_mode,
+        solver_mode=cfg.solver_mode, subspace_size=cfg.subspace_size,
     )
 
     def make_half(side):

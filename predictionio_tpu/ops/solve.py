@@ -26,9 +26,14 @@ elimination does the same O(R^3) work per system but needs no
 back-substitution passes, which both halves the step count and removes
 the row-extraction traffic the substitutions paid.
 
-Used by ``ALSConfig(solver="pallas")``.  ``interpret=True`` (automatic
-off-TPU) runs the same kernel through the Pallas interpreter, which is
-what the CPU test suite exercises.
+Used by ``ALSConfig(solver="pallas")`` — for the full R×R normal
+equations in ``solver_mode="full"`` AND for the B×B subsystems of the
+iALS++ subspace sweep (``solver_mode="subspace"``, `models/als.py
+_subspace_sweep`): the tile sizing (`_tile_rows`) packs MORE systems
+per VMEM tile as R shrinks, so the kernel gets faster per system at
+block sizes, not bypassed.  ``interpret=True`` (automatic off-TPU)
+runs the same kernel through the Pallas interpreter, which is what the
+CPU test suite exercises.
 """
 
 from __future__ import annotations

@@ -344,6 +344,12 @@ class ALSAlgorithmParams(Params):
     # batched SPD solver: "xla" | "pallas" | "fused" (compile-probed;
     # degrades to xla if the kernel doesn't lower on this backend)
     solver: str = "xla"
+    # rank-sweep strategy: "full" (R×R solve per row) | "subspace"
+    # (iALS++ block sweep — engine.json keys solverMode/subspaceSize;
+    # models/als.py ALSConfig.solver_mode)
+    solver_mode: str = "full"
+    # block width B of the subspace sweep; B >= rank is exactly "full"
+    subspace_size: int = 16
     # "replicated" (both factor tables + COO on every device) or
     # "sharded" (tables AND rating COO block-sharded over the mesh —
     # model and data capacity scale with total HBM)
@@ -388,6 +394,8 @@ class ALSAlgorithm(Algorithm):
             gather_dtype=p.gather_dtype,
             gather_mode=p.gather_mode,
             solver=p.solver,
+            solver_mode=p.solver_mode,
+            subspace_size=p.subspace_size,
             factor_placement=p.factor_placement,
         )
 

@@ -121,6 +121,8 @@ class SimilarALSParams(Params):
     # compile-probe and degrade to "xla"; "sharded" placement
     # shards factor tables AND the rating COO over the mesh
     solver: str = "xla"
+    solver_mode: str = "full"    # "subspace" = iALS++ block sweep
+    subspace_size: int = 16
     factor_placement: str = "replicated"
     gather_dtype: str = "float32"
     gather_mode: str = "row"
@@ -148,6 +150,8 @@ class SimilarProductAlgorithm(Algorithm):
                 rank=p.rank, num_iterations=p.num_iterations, lam=p.lam,
                 implicit=True, alpha=p.alpha, seed=p.seed,
                 solver=p.solver, factor_placement=p.factor_placement,
+                solver_mode=p.solver_mode,
+                subspace_size=p.subspace_size,
                 gather_dtype=p.gather_dtype,
                 gather_mode=p.gather_mode,
             ),

@@ -11,6 +11,13 @@ solver, so the arithmetic is load-bearing).
 import datetime as dt
 import json
 
+import pytest
+
+# hypothesis is an optional dev dependency: without the guard this
+# module's import error aborts the whole tier-1 collection instead of
+# skipping just these property tests
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

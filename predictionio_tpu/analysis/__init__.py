@@ -1,0 +1,38 @@
+"""piolint — JAX-aware static analysis + lock-discipline checking.
+
+Two AST engines over the package's own source (no imports, no jax, no
+device): the **JAX engine** (PIO1xx, `jaxlint.py`) walks functions
+reachable from ``jax.jit``/``pjit``/``shard_map`` tracing and flags
+host-device syncs, recompile hazards, donated-buffer reuse, and
+unfenced benchmark timing spans; the **concurrency engine** (PIO2xx,
+`locklint.py`) infers per-class lock discipline — which ``self._*``
+attributes are ever written under ``self._lock`` — and flags accesses
+on paths that don't hold the lock.
+
+Driver: ``python -m predictionio_tpu.analysis`` (see `cli.py`).
+Findings are suppressed inline with ``# piolint: disable=PIO101`` or
+accepted wholesale in ``piolint.baseline.json`` (matched by
+path/rule/scope/snippet, so line drift doesn't churn the baseline).
+``tools/gate.sh`` and ``tools/pre-commit`` fail on any non-baseline
+finding.
+"""
+
+from .cli import analyze_file, analyze_paths, main
+from .core import (
+    RULES,
+    Baseline,
+    Finding,
+    SourceFile,
+    load_baseline,
+)
+
+__all__ = [
+    "RULES",
+    "Baseline",
+    "Finding",
+    "SourceFile",
+    "load_baseline",
+    "analyze_file",
+    "analyze_paths",
+    "main",
+]

@@ -1,0 +1,203 @@
+"""piolint driver: file discovery, engines, baseline, output, exit code.
+
+``python -m predictionio_tpu.analysis [paths...]`` with no paths scans
+the gate scope — ``predictionio_tpu/``, ``bench*.py``, ``tools/*.py``
+relative to the repo root.  Exit code is 1 iff any finding is neither
+inline-suppressed nor baselined (``--strict`` ignores the baseline, for
+periodic full-debt review).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+from .core import RULES, Baseline, Finding, SourceFile, load_baseline
+from .jaxlint import JaxEngine
+from .locklint import LockEngine
+
+__all__ = ["analyze_file", "analyze_paths", "repo_root", "main"]
+
+BASELINE_NAME = "piolint.baseline.json"
+
+# deliberately-violating analyzer test inputs: never scanned implicitly
+# (tests/test_piolint.py runs the engines on them directly); passing one
+# as an explicit single-file argument still works
+EXCLUDED_DIR_PARTS = ("piolint_fixtures",)
+
+
+def _excluded(path: Path) -> bool:
+    return any(part in EXCLUDED_DIR_PARTS for part in path.parts)
+
+
+def repo_root() -> Path:
+    """The directory holding the ``predictionio_tpu`` package."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def _is_bench_scope(path: Path, root: Path) -> bool:
+    """PIO108 (timing-span) scope: benchmark harnesses + tools."""
+    try:
+        rel = path.resolve().relative_to(root.resolve())
+    except ValueError:
+        rel = Path(path.name)
+    return rel.name.startswith("bench") or (
+        len(rel.parts) > 1 and rel.parts[0] == "tools"
+    )
+
+
+def default_paths(root: Optional[Path] = None) -> list[Path]:
+    root = root or repo_root()
+    paths: list[Path] = sorted((root / "predictionio_tpu").rglob("*.py"))
+    paths += sorted(root.glob("bench*.py"))
+    tools = root / "tools"
+    if tools.is_dir():
+        paths += sorted(tools.glob("*.py"))
+    return paths
+
+
+def changed_paths(root: Optional[Path] = None) -> list[Path]:
+    """Python files currently staged in the git index (pre-commit scope)."""
+    root = root or repo_root()
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--cached", "--name-only", "--diff-filter=ACMR"],
+            cwd=root, capture_output=True, text=True, check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return []
+    paths = []
+    for line in out.splitlines():
+        p = root / line.strip()
+        if p.suffix == ".py" and p.exists() and not _excluded(p):
+            paths.append(p)
+    return paths
+
+
+def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
+    """Run both engines over one file."""
+    root = root or repo_root()
+    try:
+        src = SourceFile.load(path, root)
+    except (SyntaxError, UnicodeDecodeError, ValueError, OSError) as e:
+        # a file the gate scans but can't read or parse IS a finding
+        return [Finding(
+            rule="PIO100", path=str(path), line=getattr(e, "lineno", 1) or 1,
+            col=0, message=f"file does not parse: {e}", scope="",
+            snippet="",
+        )]
+    findings = JaxEngine(
+        src, bench_scope=_is_bench_scope(path, root)
+    ).run()
+    findings += LockEngine(src).run()
+    return findings
+
+
+def analyze_paths(paths: list[Path],
+                  root: Optional[Path] = None) -> list[Finding]:
+    root = root or repo_root()
+    findings: list[Finding] = []
+    for p in paths:
+        findings += analyze_file(p, root)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _report_json(findings: list[Finding], strict: bool) -> dict:
+    active = [f for f in findings if strict or not f.baselined]
+    return {
+        "version": 1,
+        "strict": strict,
+        "rules": RULES,
+        "counts": {
+            "total": len(findings),
+            "baselined": sum(f.baselined for f in findings),
+            "active": len(active),
+        },
+        "findings": [f.to_json() for f in findings],
+    }
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m predictionio_tpu.analysis",
+        description="piolint: JAX-aware static analysis + lock-discipline "
+                    "checker (rules PIO1xx/PIO2xx)",
+    )
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files or directories to analyze (default: the "
+                         "gate scope — predictionio_tpu/, bench*.py, "
+                         "tools/*.py)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default: <repo>/{BASELINE_NAME})")
+    ap.add_argument("--strict", action="store_true",
+                    help="ignore the baseline: every finding fails "
+                         "(periodic full-debt review)")
+    ap.add_argument("--changed-files", action="store_true",
+                    help="analyze only .py files staged in the git index "
+                         "(pre-commit mode); overrides positional paths")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline "
+                         "file and exit 0")
+    ap.add_argument("--report", type=Path, default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            print(f"{code}  {RULES[code]}")
+        return 0
+
+    root = repo_root()
+    if args.changed_files:
+        paths = changed_paths(root)
+        if not paths:
+            print("piolint: no staged python files; nothing to do")
+            return 0
+    elif args.paths:
+        paths = []
+        for p in args.paths:
+            if p.is_dir():
+                paths += sorted(q for q in p.rglob("*.py")
+                                if not _excluded(q))
+            else:
+                paths.append(p)
+    else:
+        paths = default_paths(root)
+
+    findings = analyze_paths(paths, root)
+
+    baseline_path = args.baseline or (root / BASELINE_NAME)
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"piolint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    baseline.apply(findings)
+    active = [f for f in findings if args.strict or not f.baselined]
+
+    report = _report_json(findings, args.strict)
+    if args.report is not None:
+        args.report.parent.mkdir(parents=True, exist_ok=True)
+        args.report.write_text(json.dumps(report, indent=2) + "\n")
+
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        for f in findings:
+            if f.baselined and not args.strict:
+                continue
+            print(f.text())
+        n_base = report["counts"]["baselined"]
+        print(f"piolint: {len(paths)} file(s), {len(active)} active "
+              f"finding(s), {n_base} baselined"
+              + (" (strict: baseline ignored)" if args.strict else ""))
+    return 1 if active else 0

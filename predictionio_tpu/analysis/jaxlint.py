@@ -1,0 +1,903 @@
+"""piolint JAX engine (PIO1xx): traced-code hazards, found statically.
+
+Walks every function reachable from a ``jax.jit``/``pjit``/``shard_map``
+application (decorator form, ``g = jax.jit(f)`` call form, the
+``functools.partial(jax.jit, ...)(f)`` idiom, and functions handed to
+tracing higher-order ops like ``lax.scan``) and runs a forward taint
+analysis: non-static parameters are tracers, values derived from
+tracers are tracers, and ``.shape``/``.dtype``-style attribute reads
+strip the taint (shapes are static under tracing).  Host syncs,
+data-dependent Python control flow, string formatting of tracers,
+unhashable static args, and donated-buffer reuse all fall out as taint
+queries at specific syntax nodes.
+
+Everything is module-local and first-order: a callback passed into
+another function is not followed.  That bounds false negatives, and the
+baseline mechanism absorbs the (rare) false positive — this is a gate,
+not a verifier.
+
+PIO108 (unfenced timing spans) lives here too because it needs the same
+"which calls dispatch device work" knowledge; it only runs on files the
+driver marks as benchmark scope (``bench*.py``, ``tools/``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from .core import Finding, SourceFile
+
+__all__ = ["JaxEngine"]
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+# attribute reads that yield static (trace-time) metadata, not a tracer
+SHAPE_ATTRS = {
+    "shape", "dtype", "ndim", "size", "sharding", "device", "devices",
+    "weak_type", "aval", "itemsize", "nbytes",
+}
+
+# higher-order jax ops whose function arguments run under tracing
+TRACING_HOFS = {
+    "scan", "cond", "while_loop", "fori_loop", "switch", "associative_scan",
+    "vmap", "grad", "value_and_grad", "jacfwd", "jacrev", "pmap",
+    "remat", "checkpoint", "custom_jvp", "custom_vjp", "map",
+}
+
+JIT_ATTRS = {"jit", "pjit", "shard_map"}
+
+UNHASHABLE_LITERALS = (
+    ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+    ast.GeneratorExp,
+)
+
+TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time"}
+
+# call names that force device completion (or copy to host) — a timed
+# span containing one of these before the closing timer read is honest
+FENCE_ATTRS = {"block_until_ready", "device_get", "item", "fence",
+               "effects_barrier"}
+FENCE_NAMES = {"fence", "float", "int"}
+
+
+def _dotted(node: ast.AST) -> Optional[list[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None when the chain has calls etc."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _str_elems(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+    return []
+
+
+def _int_elems(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+@dataclass
+class FuncInfo:
+    node: FuncNode
+    qualname: str
+    params: list[str]
+    cls: Optional[str] = None        # owning class name, if a method
+    parent: Optional[FuncNode] = None  # enclosing function, if nested
+    locals_map: dict[str, "FuncInfo"] = field(default_factory=dict)
+
+
+@dataclass
+class JitInfo:
+    """One jit application: the wrapped local function + arg semantics."""
+    func: Optional[FuncInfo]
+    static: set[str] = field(default_factory=set)
+    donate: set[str] = field(default_factory=set)
+
+
+class JaxEngine:
+    def __init__(self, src: SourceFile, bench_scope: bool = False):
+        self.src = src
+        self.bench_scope = bench_scope
+        self.findings: list[Finding] = []
+        self._seen: set[tuple] = set()
+        self.imports = _ImportScan()
+        self.imports.visit(src.tree)
+        self.functions: dict[int, FuncInfo] = {}
+        self.module_funcs: dict[str, FuncInfo] = {}
+        self.class_methods: dict[str, dict[str, FuncInfo]] = {}
+        self._collect_functions()
+        self._parents: dict[int, ast.AST] = {}
+        for parent in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        self.jit_apps: list[JitInfo] = []
+        self.wrappers: dict[str, JitInfo] = {}  # bound name -> jit info
+        self._collect_jit_applications()
+
+    # -- public ------------------------------------------------------------
+    def run(self) -> list[Finding]:
+        self._run_taint()
+        self._check_static_args()
+        self._check_donation()
+        if self.bench_scope:
+            self._check_timing_spans()
+        return self.findings
+
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              scope: str = "") -> None:
+        key = (rule, getattr(node, "lineno", 0),
+               getattr(node, "col_offset", 0))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        f = self.src.finding(rule, node, message, scope)
+        if f is not None:
+            self.findings.append(f)
+
+    # -- structure collection ---------------------------------------------
+    def _collect_functions(self) -> None:
+        def stmts_of(body):
+            """Statements in ``body``, descending through control flow
+            (if/try/with/for/while) but NOT into defs/classes."""
+            for stmt in body:
+                yield stmt
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                for attr in ("body", "orelse", "finalbody"):
+                    yield from stmts_of(getattr(stmt, attr, []))
+                for h in getattr(stmt, "handlers", []):
+                    yield from stmts_of(h.body)
+
+        def walk(body, qualprefix, cls, parent):
+            infos = {}
+            for stmt in stmts_of(body):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    a = stmt.args
+                    params = [p.arg for p in
+                              a.posonlyargs + a.args + a.kwonlyargs]
+                    info = FuncInfo(
+                        node=stmt,
+                        qualname=(qualprefix + stmt.name),
+                        params=params, cls=cls, parent=parent,
+                    )
+                    self.functions[id(stmt)] = info
+                    infos[stmt.name] = info
+                    info.locals_map = walk(stmt.body, info.qualname + ".",
+                                           cls, stmt)
+                elif isinstance(stmt, ast.ClassDef):
+                    self.class_methods[stmt.name] = walk(
+                        stmt.body, qualprefix + stmt.name + ".",
+                        stmt.name, None,
+                    )
+            return infos
+
+        self.module_funcs = walk(self.src.tree.body, "", None, None)
+
+    def _resolve_call(self, call: ast.Call,
+                      ctx: Optional[FuncInfo]) -> Optional[FuncInfo]:
+        """Resolve a call target to a module-local FuncInfo."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            cur = ctx
+            while cur is not None:
+                if fn.id in cur.locals_map:
+                    return cur.locals_map[fn.id]
+                cur = (self.functions.get(id(cur.parent))
+                       if cur.parent is not None else None)
+            return self.module_funcs.get(fn.id)
+        if (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("self", "cls") and ctx is not None
+                and ctx.cls is not None):
+            return self.class_methods.get(ctx.cls, {}).get(fn.attr)
+        return None
+
+    # -- jit application discovery ----------------------------------------
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        """Is this expression jax.jit / jit / pjit / shard_map itself?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.imports.jit_names
+        parts = _dotted(node)
+        if parts is None:
+            return False
+        root, last = parts[0], parts[-1]
+        return (root in self.imports.jax_aliases and last in JIT_ATTRS)
+
+    def _jit_call_semantics(self, call: ast.Call,
+                            func: Optional[FuncInfo]) -> JitInfo:
+        info = JitInfo(func=func)
+        params = func.params if func is not None else []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                info.static |= set(_str_elems(kw.value))
+            elif kw.arg == "static_argnums":
+                for i in _int_elems(kw.value):
+                    if 0 <= i < len(params):
+                        info.static.add(params[i])
+            elif kw.arg == "donate_argnames":
+                info.donate |= set(_str_elems(kw.value))
+            elif kw.arg == "donate_argnums":
+                for i in _int_elems(kw.value):
+                    if 0 <= i < len(params):
+                        info.donate.add(params[i])
+        return info
+
+    def _collect_jit_applications(self) -> None:
+        # decorator form, incl. partial(jax.jit, ...) stacks
+        for info in self.functions.values():
+            for dec in info.node.decorator_list:
+                jit = None
+                if self._is_jit_expr(dec):
+                    jit = JitInfo(func=info)
+                elif isinstance(dec, ast.Call):
+                    fn = dec.func
+                    parts = _dotted(fn)
+                    is_partial = (
+                        parts is not None
+                        and (parts[-1] == "partial"
+                             or parts[0] in self.imports.partial_names)
+                    )
+                    if is_partial and dec.args \
+                            and self._is_jit_expr(dec.args[0]):
+                        jit = self._jit_call_semantics(dec, info)
+                    elif self._is_jit_expr(fn):
+                        # @jax.jit(static_argnames=...) config-call form
+                        jit = self._jit_call_semantics(dec, info)
+                if jit is not None:
+                    self.jit_apps.append(jit)
+                    self.wrappers.setdefault(info.node.name, jit)
+        # call form: jax.jit(f, ...) / functools.partial(jax.jit, ...)(f)
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            jit_call = None
+            if self._is_jit_expr(node.func):
+                jit_call = node
+            elif (isinstance(node.func, ast.Call)
+                  and node.func.args
+                  and self._is_jit_expr(node.func.args[0])):
+                parts = _dotted(node.func.func)
+                if parts is not None and (
+                        parts[-1] == "partial"
+                        or parts[0] in self.imports.partial_names):
+                    jit_call = node  # partial(jax.jit, kw)(f): kws on inner
+            if jit_call is None or not jit_call.args:
+                continue
+            target = jit_call.args[0]
+            func = None
+            if isinstance(target, ast.Name):
+                func = self.module_funcs.get(target.id)
+                if func is None:
+                    for fi in self.functions.values():
+                        if fi.node.name == target.id:
+                            func = fi
+                            break
+            if func is None:
+                continue
+            kw_src = (node.func if isinstance(node.func, ast.Call)
+                      and not self._is_jit_expr(node.func) else jit_call)
+            jit = self._jit_call_semantics(kw_src, func)
+            self.jit_apps.append(jit)
+            parent = self._parent_of(node)
+            if isinstance(parent, ast.Assign):
+                for t in parent.targets:
+                    if isinstance(t, ast.Name):
+                        self.wrappers[t.id] = jit
+        # tracing HOFs: lax.scan(step, ...), jax.vmap(f), ...
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = _dotted(node.func)
+            if parts is None or parts[-1] not in TRACING_HOFS:
+                continue
+            root = parts[0]
+            if root not in self.imports.jax_aliases \
+                    and root not in ("lax",) \
+                    and parts[-1] not in ("vmap", "grad", "value_and_grad",
+                                          "pmap"):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    fi = self._resolve_name_func(arg.id)
+                    if fi is not None:
+                        self.jit_apps.append(JitInfo(func=fi))
+
+    def _resolve_name_func(self, name: str) -> Optional[FuncInfo]:
+        if name in self.module_funcs:
+            return self.module_funcs[name]
+        for fi in self.functions.values():
+            if fi.node.name == name:
+                return fi
+        return None
+
+    def _parent_of(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    # -- taint analysis ----------------------------------------------------
+    def _run_taint(self) -> None:
+        worklist: list[tuple[FuncInfo, frozenset]] = []
+        for jit in self.jit_apps:
+            if jit.func is None:
+                continue
+            tainted = frozenset(
+                p for p in jit.func.params
+                if p not in jit.static and p not in ("self", "cls")
+            )
+            worklist.append((jit.func, tainted))
+        visited: set[tuple[int, frozenset]] = set()
+        while worklist:
+            func, tainted = worklist.pop()
+            key = (id(func.node), tainted)
+            if key in visited or len(visited) > 4000:
+                continue
+            visited.add(key)
+            walker = _TaintWalker(self, func, set(tainted))
+            walker.run()
+            for callee, callee_taint in walker.calls_out:
+                worklist.append((callee, callee_taint))
+
+
+    # -- PIO105: unhashable static args -----------------------------------
+    def _check_static_args(self) -> None:
+        for jit in self.jit_apps:
+            if jit.func is None or not jit.static:
+                continue
+            # static param with an unhashable default
+            a = jit.func.node.args
+            pos = a.posonlyargs + a.args
+            defaults = a.defaults
+            for p, d in zip(pos[len(pos) - len(defaults):], defaults):
+                if p.arg in jit.static and isinstance(d, UNHASHABLE_LITERALS):
+                    self._emit(
+                        "PIO105", d,
+                        f"static argument {p.arg!r} of "
+                        f"{jit.func.qualname}() has an unhashable default "
+                        "(jit static args are dict keys: every distinct "
+                        "value is a fresh compile, unhashable ones crash)",
+                        jit.func.qualname,
+                    )
+            for kd, d in zip(a.kwonlyargs, a.kw_defaults):
+                if d is not None and kd.arg in jit.static \
+                        and isinstance(d, UNHASHABLE_LITERALS):
+                    self._emit(
+                        "PIO105", d,
+                        f"static argument {kd.arg!r} of "
+                        f"{jit.func.qualname}() has an unhashable default",
+                        jit.func.qualname,
+                    )
+        # call sites of jitted wrappers binding literals to static params
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name):
+                continue
+            jit = self.wrappers.get(node.func.id)
+            if jit is None or jit.func is None or not jit.static:
+                continue
+            params = jit.func.params
+            for i, arg in enumerate(node.args):
+                if i < len(params) and params[i] in jit.static \
+                        and isinstance(arg, UNHASHABLE_LITERALS):
+                    self._emit(
+                        "PIO105", arg,
+                        f"unhashable literal bound to static argument "
+                        f"{params[i]!r} of {node.func.id}() — every call "
+                        "recompiles (or TypeErrors)",
+                    )
+            for kw in node.keywords:
+                if kw.arg in jit.static \
+                        and isinstance(kw.value, UNHASHABLE_LITERALS):
+                    self._emit(
+                        "PIO105", kw.value,
+                        f"unhashable literal bound to static argument "
+                        f"{kw.arg!r} of {node.func.id}()",
+                    )
+
+    # -- PIO107: donated-buffer reuse -------------------------------------
+    def _check_donation(self) -> None:
+        for node in ast.walk(self.src.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name):
+                continue
+            jit = self.wrappers.get(node.func.id)
+            if jit is None or jit.func is None or not jit.donate:
+                continue
+            params = jit.func.params
+            donated_names: list[str] = []
+            for i, arg in enumerate(node.args):
+                if i < len(params) and params[i] in jit.donate \
+                        and isinstance(arg, ast.Name):
+                    donated_names.append(arg.id)
+            for kw in node.keywords:
+                if kw.arg in jit.donate and isinstance(kw.value, ast.Name):
+                    donated_names.append(kw.value.id)
+            if not donated_names:
+                continue
+            assign = self._parent_of(node)
+            rebound: set[str] = set()
+            if isinstance(assign, ast.Assign):
+                for t in assign.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            rebound.add(n.id)
+            scope = self._enclosing_scope(node)
+            # a multi-line call's own argument lines are not "after" it
+            call_line = getattr(node, "end_lineno", None) or node.lineno
+            for name in donated_names:
+                if name in rebound:
+                    continue
+                use = self._use_after(scope, name, call_line)
+                if use is not None:
+                    self._emit(
+                        "PIO107", use,
+                        f"{name!r} was donated to {node.func.id}() on line "
+                        f"{call_line} (donate_argnums); its buffer may be "
+                        "reused by XLA — reading it afterwards is invalid",
+                    )
+
+    def _enclosing_scope(self, node: ast.AST) -> ast.AST:
+        cur = self._parent_of(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            cur = self._parent_of(cur)
+        return cur if cur is not None else self.src.tree
+
+    @staticmethod
+    def _use_after(scope: ast.AST, name: str,
+                   call_line: int) -> Optional[ast.AST]:
+        next_bind = None
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Name) and n.id == name \
+                    and isinstance(n.ctx, (ast.Store, ast.Del)) \
+                    and n.lineno > call_line:
+                if next_bind is None or n.lineno < next_bind:
+                    next_bind = n.lineno
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Name) and n.id == name \
+                    and isinstance(n.ctx, ast.Load) \
+                    and n.lineno > call_line \
+                    and (next_bind is None or n.lineno < next_bind):
+                return n
+        return None
+
+    # -- PIO108: unfenced timing spans (bench scope) -----------------------
+    def _is_time_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        parts = _dotted(node.func)
+        if parts is None:
+            return False
+        if len(parts) == 2 and parts[0] in self.imports.time_aliases \
+                and parts[1] in TIME_FUNCS:
+            return True
+        return len(parts) == 1 and parts[0] in self.imports.time_names
+
+    def _is_fence_call(self, node: ast.Call) -> bool:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in FENCE_ATTRS:
+                return True
+            parts = _dotted(fn)
+            if parts is not None and parts[0] in self.imports.np_aliases \
+                    and parts[-1] in ("asarray", "array"):
+                return True
+            return False
+        if isinstance(fn, ast.Name):
+            return fn.id in FENCE_NAMES
+        return False
+
+    # jax.* calls that are metadata/bookkeeping, not device compute
+    _JAX_NONCOMPUTE = {
+        "devices", "device_count", "local_device_count", "local_devices",
+        "process_index", "process_count", "default_backend",
+        "clear_caches", "profiler", "config", "trace", "named_scope",
+    }
+
+    def _is_device_call(self, node: ast.Call) -> bool:
+        parts = _dotted(node.func)
+        if parts is not None:
+            root = parts[0]
+            if parts[-1] in FENCE_ATTRS:
+                return False
+            if root in self.imports.jnp_aliases:
+                return True
+            if root in self.imports.jax_aliases and len(parts) > 1 \
+                    and not (set(parts[1:]) & self._JAX_NONCOMPUTE):
+                return True
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self.wrappers:
+            return True
+        return False
+
+    def _check_timing_spans(self) -> None:
+        scopes: list[ast.AST] = [self.src.tree] + [
+            fi.node for fi in self.functions.values()
+        ]
+        for scope in scopes:
+            starts: list[tuple[str, int]] = []
+            uses: list[tuple[str, int, ast.AST]] = []
+            body_nodes = list(ast.walk(scope))
+            own = [n for n in body_nodes
+                   if self._enclosing_scope(n) is scope
+                   or isinstance(scope, ast.Module)]
+            for n in own:
+                if isinstance(n, ast.Assign) and self._is_time_call(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            starts.append((t.id, n.lineno))
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub) \
+                        and self._is_time_call(n.left) \
+                        and isinstance(n.right, ast.Name):
+                    uses.append((n.right.id, n.lineno, n))
+            for name, use_line, use_node in uses:
+                cands = [ln for (nm, ln) in starts
+                         if nm == name and ln < use_line]
+                if not cands:
+                    continue
+                t0_line = max(cands)
+                device, fence = False, False
+                for n in own:
+                    if not isinstance(n, ast.Call):
+                        continue
+                    if not (t0_line < n.lineno <= use_line):
+                        continue
+                    if self._is_fence_call(n):
+                        fence = True
+                    elif self._is_device_call(n):
+                        device = True
+                if device and not fence:
+                    self._emit(
+                        "PIO108", use_node,
+                        f"timing span ({name!r} from line {t0_line}) "
+                        "covers device dispatch but no fence/"
+                        "block_until_ready — it measures dispatch, "
+                        "not execution",
+                    )
+
+
+class _ImportScan(ast.NodeVisitor):
+    """Module import aliases the engine needs to resolve names."""
+
+    def __init__(self):
+        self.jax_aliases: set[str] = set()
+        self.jnp_aliases: set[str] = set()
+        self.np_aliases: set[str] = set()
+        self.time_aliases: set[str] = set()
+        self.time_names: set[str] = set()    # from time import perf_counter
+        self.partial_names: set[str] = set()
+        self.jit_names: set[str] = set()     # from jax import jit/pjit
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            bound = a.asname or a.name.split(".")[0]
+            if a.name == "jax":
+                self.jax_aliases.add(bound)
+            elif a.name in ("jax.numpy",):
+                self.jnp_aliases.add(a.asname or "jax.numpy")
+            elif a.name == "numpy":
+                self.np_aliases.add(bound)
+            elif a.name == "time":
+                self.time_aliases.add(bound)
+            elif a.name == "functools":
+                pass
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for a in node.names:
+            bound = a.asname or a.name
+            if mod == "jax" and a.name == "numpy":
+                self.jnp_aliases.add(bound)
+            elif mod == "jax" and a.name in JIT_ATTRS:
+                self.jit_names.add(bound)
+            elif mod.startswith("jax") and a.name in JIT_ATTRS:
+                self.jit_names.add(bound)
+            elif mod == "functools" and a.name == "partial":
+                self.partial_names.add(bound)
+            elif mod == "time" and a.name in TIME_FUNCS:
+                self.time_names.add(bound)
+
+
+class _TaintWalker:
+    """Forward taint pass over one function body under one taint seed."""
+
+    def __init__(self, engine: JaxEngine, func: FuncInfo, tainted: set[str]):
+        self.e = engine
+        self.func = func
+        self.tainted = tainted
+        self.calls_out: list[tuple[FuncInfo, frozenset]] = []
+
+    def run(self) -> None:
+        # two passes so loop-carried taint reaches first-pass reads
+        self._walk_body(self.func.node.body)
+        self._walk_body(self.func.node.body)
+
+    # -- statements --------------------------------------------------------
+    def _walk_body(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # analyzed when called / scheduled separately
+        if isinstance(stmt, ast.Assign):
+            t = self.taint(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, t)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._bind(stmt.target, self.taint(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.taint(stmt.value)
+            if isinstance(stmt.target, ast.Name):
+                if t:
+                    self.tainted.add(stmt.target.id)
+                elif stmt.target.id in self.tainted:
+                    pass  # stays tainted
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if self._branch_taint(stmt.test):
+                self.e._emit(
+                    "PIO104", stmt.test,
+                    "Python control flow on a traced value: under jit "
+                    "this either crashes (ConcretizationTypeError) or "
+                    "recompiles per value — use lax.cond/jnp.where",
+                    self.func.qualname,
+                )
+            else:
+                self.taint(stmt.test)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if self._branch_taint(stmt.test):
+                self.e._emit(
+                    "PIO104", stmt.test,
+                    "assert on a traced value inside jit-traced code",
+                    self.func.qualname,
+                )
+        elif isinstance(stmt, ast.For):
+            it = self.taint(stmt.iter)
+            self._bind(stmt.target, it)
+            self._walk_body(stmt.body)
+            self._walk_body(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                t = self.taint(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, t)
+            self._walk_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body)
+            for h in stmt.handlers:
+                self._walk_body(h.body)
+            self._walk_body(stmt.orelse)
+            self._walk_body(stmt.finalbody)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.taint(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self.taint(stmt.value)
+        elif isinstance(stmt, (ast.Raise,)):
+            if stmt.exc is not None:
+                self.taint(stmt.exc)
+        elif isinstance(stmt, ast.Delete):
+            pass
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.taint(child)
+                elif isinstance(child, ast.stmt):
+                    self._walk_stmt(child)
+
+    def _bind(self, target: ast.expr, tainted: bool) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                if tainted:
+                    self.tainted.add(n.id)
+                else:
+                    self.tainted.discard(n.id)
+
+    def _branch_taint(self, test: ast.expr) -> bool:
+        """Taint of a branch condition, with identity/None checks
+        excluded: ``x is None`` / ``isinstance(x, T)`` inspect the python
+        value at trace time and are standard, safe jit idioms."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return False
+        if isinstance(test, ast.Call) \
+                and isinstance(test.func, ast.Name) \
+                and test.func.id in ("isinstance", "hasattr", "callable"):
+            return False
+        if isinstance(test, ast.BoolOp):
+            return any(self._branch_taint(v) for v in test.values)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._branch_taint(test.operand)
+        return self.taint(test)
+
+    # -- expressions -------------------------------------------------------
+    def taint(self, node: Optional[ast.expr]) -> bool:
+        """Evaluate taint of an expression, emitting findings for
+        host-sync / formatting uses of tainted values on the way."""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            base = self.taint(node.value)
+            if node.attr in SHAPE_ATTRS:
+                return False
+            return base
+        if isinstance(node, ast.Subscript):
+            return self.taint(node.value) or self.taint(node.slice)
+        if isinstance(node, ast.Call):
+            return self._taint_call(node)
+        if isinstance(node, ast.BinOp):
+            return self.taint(node.left) | self.taint(node.right)
+        if isinstance(node, ast.BoolOp):
+            return any([self.taint(v) for v in node.values])
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.Compare):
+            tainted = self.taint(node.left)
+            for c in node.comparators:
+                tainted |= self.taint(c)
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return False
+            return tainted
+        if isinstance(node, ast.IfExp):
+            t = self._branch_taint(node.test)
+            if t:
+                self.e._emit(
+                    "PIO104", node.test,
+                    "conditional expression on a traced value inside "
+                    "jit-traced code — use jnp.where/lax.select",
+                    self.func.qualname,
+                )
+            return self.taint(node.body) | self.taint(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self.taint(e) for e in node.elts])
+        if isinstance(node, ast.Dict):
+            return any([self.taint(v) for v in node.values]
+                       + [self.taint(k) for k in node.keys if k is not None])
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue) \
+                        and self.taint(v.value):
+                    self.e._emit(
+                        "PIO106", v.value,
+                        "f-string interpolation of a traced value: forces "
+                        "a host sync at trace time and bakes the traced "
+                        "value's repr into the compiled artifact",
+                        self.func.qualname,
+                    )
+            return False
+        if isinstance(node, ast.Starred):
+            return self.taint(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            tainted = False
+            for gen in node.generators:
+                t = self.taint(gen.iter)
+                self._bind(gen.target, t)
+                tainted |= t
+            if isinstance(node, ast.DictComp):
+                tainted |= self.taint(node.key) | self.taint(node.value)
+            else:
+                tainted |= self.taint(node.elt)
+            return tainted
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, (ast.NamedExpr,)):
+            t = self.taint(node.value)
+            self._bind(node.target, t)
+            return t
+        # fallback: any tainted child expression
+        return any([self.taint(c) for c in ast.iter_child_nodes(node)
+                    if isinstance(c, ast.expr)])
+
+    def _taint_call(self, node: ast.Call) -> bool:
+        fn = node.func
+        arg_taints = [self.taint(a) for a in node.args]
+        kw_taints = {kw.arg: self.taint(kw.value) for kw in node.keywords}
+        any_tainted = any(arg_taints) or any(kw_taints.values())
+
+        # host-sync checks -------------------------------------------------
+        if isinstance(fn, ast.Attribute) and fn.attr in ("item", "tolist"):
+            if self.taint(fn.value):
+                self.e._emit(
+                    "PIO101", node,
+                    f".{fn.attr}() on a traced value inside jit-traced "
+                    "code: blocks on device transfer (or "
+                    "ConcretizationTypeError under trace)",
+                    self.func.qualname,
+                )
+            return False
+        if isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool",
+                                                  "complex"):
+            if any_tainted:
+                self.e._emit(
+                    "PIO102", node,
+                    f"{fn.id}() forces a traced value to a Python scalar "
+                    "inside jit-traced code (ConcretizationTypeError / "
+                    "host sync)",
+                    self.func.qualname,
+                )
+            return False
+        parts = _dotted(fn)
+        if parts is not None and parts[0] in self.e.imports.np_aliases \
+                and parts[-1] in ("asarray", "array", "copy", "ascontiguousarray"):
+            if any_tainted:
+                self.e._emit(
+                    "PIO103", node,
+                    f"numpy {'.'.join(parts)}() on a traced value inside "
+                    "jit-traced code: device->host copy per call (use "
+                    "jnp equivalents, or materialize outside jit)",
+                    self.func.qualname,
+                )
+            return False
+        if isinstance(fn, ast.Name) and fn.id in ("str", "repr", "format"):
+            if any_tainted:
+                self.e._emit(
+                    "PIO106", node,
+                    f"{fn.id}() of a traced value inside jit-traced code "
+                    "leaks the trace-time repr into compiled constants",
+                    self.func.qualname,
+                )
+            return False
+        if isinstance(fn, ast.Attribute) and fn.attr == "format" \
+                and any_tainted:
+            self.e._emit(
+                "PIO106", node,
+                "str.format() of a traced value inside jit-traced code",
+                self.func.qualname,
+            )
+            return False
+
+        # untainting / neutral builtins -----------------------------------
+        if isinstance(fn, ast.Name) and fn.id in ("len", "isinstance",
+                                                  "hasattr", "getattr",
+                                                  "type", "print", "range"):
+            return False
+
+        # propagate into module-local callees -----------------------------
+        callee = self.e._resolve_call(node, self.func)
+        if callee is not None and callee.node is not self.func.node:
+            taints: set[str] = set()
+            params = callee.params
+            offset = 1 if params[:1] in (["self"], ["cls"]) \
+                and isinstance(fn, ast.Attribute) else 0
+            for i, t in enumerate(arg_taints):
+                if t and i + offset < len(params):
+                    taints.add(params[i + offset])
+            for name, t in kw_taints.items():
+                if t and name in params:
+                    taints.add(name)
+            # closure reads: a nested function sees our tainted locals
+            # (seeded as extra tainted names; harmless if unused there)
+            if callee.parent is self.func.node:
+                taints |= self.tainted
+            self.calls_out.append((callee, frozenset(taints)))
+        # method call on a tainted receiver stays tainted (.astype etc.)
+        recv_tainted = (isinstance(fn, ast.Attribute)
+                        and self.taint(fn.value))
+        return any_tainted or recv_tainted

@@ -140,7 +140,7 @@ class DeliveryQueue:
                     self.retries += 1
                 self._wake.wait(self.retry.backoff(entry.attempts))
                 self._wake.clear()
-                if self._closed_now() and not self._dq:
+                if self._stopping():
                     return
             else:
                 self.breaker.record_success()
@@ -153,6 +153,12 @@ class DeliveryQueue:
     def _closed_now(self) -> bool:
         with self._cond:
             return self._closed
+
+    def _stopping(self) -> bool:
+        # closed AND drained, read atomically — the drain thread checks
+        # this off-lock after a backoff nap
+        with self._cond:
+            return self._closed and not self._dq
 
     def _send(self, entry: _Entry) -> None:
         if self.fault_point is not None:

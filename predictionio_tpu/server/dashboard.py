@@ -9,7 +9,6 @@ index of completed evaluation instances with drill-down to
 from __future__ import annotations
 
 import html as _html
-import json
 import logging
 import urllib.parse
 from ..storage.registry import Storage

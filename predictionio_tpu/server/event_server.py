@@ -24,7 +24,6 @@ from __future__ import annotations
 import json
 import logging
 import sqlite3
-import threading
 import urllib.parse
 from typing import Any, Optional
 

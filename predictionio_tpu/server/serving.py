@@ -218,7 +218,9 @@ class EngineServer(HTTPServerBase):
         faults.check("reload.load_model")
         # serve with the params the instance was trained with; the current
         # engine.json may have drifted (engineInstanceToEngineParams parity)
-        engine_params = self.engine_params
+        with self._lock:
+            variant_params = self.engine_params
+        engine_params = variant_params
         rec = self.ctx.storage.get_metadata().engine_instance_get(instance_id)
         if rec is not None and rec.algorithms_params:
             try:
@@ -228,7 +230,7 @@ class EngineServer(HTTPServerBase):
                     "could not reconstruct params from instance %s; "
                     "using variant params", instance_id,
                 )
-                engine_params = self.engine_params
+                engine_params = variant_params
         algorithms, models, serving = prepare_deploy_components(
             self.engine, engine_params, instance_id, ctx=self.ctx
         )
@@ -418,9 +420,11 @@ class EngineServer(HTTPServerBase):
         counted, never raised."""
         if not self.config.log_url:
             return
+        with self._lock:
+            instance_id = self.instance_id
         payload = self.config.log_prefix + json.dumps({
             "engineInstance": {
-                "id": self.instance_id,
+                "id": instance_id,
                 "engineId": self.engine_id,
                 "engineVersion": self.engine_version,
                 "engineVariant": self.engine_variant,
@@ -430,27 +434,36 @@ class EngineServer(HTTPServerBase):
         self._log_queue.submit(self.config.log_url, payload.encode())
 
     def status_json(self) -> dict:
+        # snapshot the hot-swapped / request-updated state under the
+        # lock; the reload thread and in-flight queries mutate it
+        with self._lock:
+            instance_id = self.instance_id
+            request_count = self.request_count
+            avg_serving_sec = self.avg_serving_sec
+            last_serving_sec = self.last_serving_sec
+            batcher = self.batcher
+            last_reload_error = self.last_reload_error
         out = {
             "status": "alive",
-            "engineInstanceId": self.instance_id,
+            "engineInstanceId": instance_id,
             "engineId": self.engine_id,
             "engineVersion": self.engine_version,
             "engineVariant": self.engine_variant,
-            "requestCount": self.request_count,
-            "avgServingSec": self.avg_serving_sec,
-            "lastServingSec": self.last_serving_sec,
+            "requestCount": request_count,
+            "avgServingSec": avg_serving_sec,
+            "lastServingSec": last_serving_sec,
             "startTime": self.start_time,
         }
-        if self.batcher is not None:
+        if batcher is not None:
             out["microbatch"] = {
-                "batches": self.batcher.batches,
-                "requests": self.batcher.requests,
-                "maxBatchSeen": self.batcher.max_seen,
+                "batches": batcher.batches,
+                "requests": batcher.requests,
+                "maxBatchSeen": batcher.max_seen,
             }
         # failure observability: queue depths/drops, breaker states, and
         # the last reload error an operator should know about
         out["resilience"] = {
-            "lastReloadError": self.last_reload_error,
+            "lastReloadError": last_reload_error,
             "queryTimeoutSec": self.config.query_timeout_s,
             "feedback": self._feedback_queue.stats(),
             "remoteLog": self._log_queue.stats(),
@@ -475,11 +488,17 @@ class EngineServer(HTTPServerBase):
         def table(rows) -> str:
             return "<table border='1' cellpadding='4'>" + "".join(rows) + "</table>"
 
+        with self._lock:
+            instance_id = self.instance_id
+            request_count = self.request_count
+            avg_serving_sec = self.avg_serving_sec
+            last_serving_sec = self.last_serving_sec
+            ep = self.engine_params
         rec = self.ctx.storage.get_metadata().engine_instance_get(
-            self.instance_id
+            instance_id
         )
         engine_rows = [
-            row("Instance ID", self.instance_id),
+            row("Instance ID", instance_id),
             row("Engine ID", self.engine_id),
             row("Engine Version", self.engine_version),
             row("Variant", self.engine_variant),
@@ -494,11 +513,10 @@ class EngineServer(HTTPServerBase):
         )
         server_rows = [
             row("Start Time", started),
-            row("Request Count", self.request_count),
-            row("Average Serving Time", f"{self.avg_serving_sec:.4f} s"),
-            row("Last Serving Time", f"{self.last_serving_sec:.4f} s"),
+            row("Request Count", request_count),
+            row("Average Serving Time", f"{avg_serving_sec:.4f} s"),
+            row("Last Serving Time", f"{last_serving_sec:.4f} s"),
         ]
-        ep = self.engine_params
         comp_rows = [
             row(f"Data Source [{ep.data_source[0] or 'default'}]",
                 json.dumps(params_to_json(ep.data_source[1]))),

@@ -30,7 +30,6 @@ from ..controller import (
     IdentityPreparator,
     ModelPlacement,
     Params,
-    Serving,
     WorkflowContext,
 )
 from ..models.als import ALSConfig, train_als

@@ -1,0 +1,12 @@
+"""item() on host code (outside any trace) is fine."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_sum(x):
+    return jnp.sum(x)
+
+
+def host_read(x):
+    return good_sum(x).item()

@@ -1,0 +1,9 @@
+"""item() on a traced value inside jit -> PIO101."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_sum(x):
+    total = jnp.sum(x)
+    return total.item()  # EXPECT: PIO101

@@ -1,0 +1,10 @@
+"""float() of a STATIC argument is trace-time python — fine."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("factor",))
+def good_scale(x, factor):
+    s = float(factor)
+    return x * s

@@ -1,0 +1,8 @@
+"""float() forcing a traced value -> PIO102."""
+import jax
+
+
+@jax.jit
+def bad_scale(x, factor):
+    s = float(factor)  # EXPECT: PIO102
+    return x * s

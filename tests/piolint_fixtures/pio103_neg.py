@@ -1,0 +1,13 @@
+"""jnp.asarray stays on device; np.asarray of host data is host code."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_convert(x):
+    return jnp.asarray(x, jnp.float32) * 2.0
+
+
+def host_prepare(rows):
+    return good_convert(np.asarray(rows, np.float32))

@@ -1,0 +1,9 @@
+"""np.asarray on a traced value inside jit -> PIO103."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_convert(x):
+    host = np.asarray(x)  # EXPECT: PIO103
+    return host

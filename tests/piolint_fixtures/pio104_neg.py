@@ -1,0 +1,12 @@
+"""`is None` checks and shape branches are static under tracing — fine."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_branch(x, bias=None):
+    if bias is None:
+        bias = jnp.zeros_like(x)
+    if x.shape[0] > 2:
+        x = x + bias
+    return jnp.where(x > 0, x, -x)

@@ -1,0 +1,9 @@
+"""Python branch on a traced value -> PIO104."""
+import jax
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # EXPECT: PIO104
+        return x
+    return -x

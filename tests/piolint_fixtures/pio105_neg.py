@@ -1,0 +1,13 @@
+"""Tuples are hashable static args — fine."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("dims",))
+def pooled(x, dims):
+    return x.sum(axis=dims)
+
+
+def call_site(x):
+    return pooled(x, dims=(0, 1))

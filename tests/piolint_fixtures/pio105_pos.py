@@ -1,0 +1,13 @@
+"""Unhashable literal bound to a static jit argument -> PIO105."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("dims",))
+def pooled(x, dims):
+    return x.sum(axis=dims)
+
+
+def call_site(x):
+    return pooled(x, dims=[0, 1])  # EXPECT: PIO105

@@ -1,0 +1,8 @@
+"""Formatting static metadata (shape/dtype) is fine under tracing."""
+import jax
+
+
+@jax.jit
+def good_label(x):
+    msg = f"shape={x.shape} dtype={x.dtype}"
+    return x, msg

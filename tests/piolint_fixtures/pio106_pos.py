@@ -1,0 +1,8 @@
+"""f-string interpolation of a traced value -> PIO106."""
+import jax
+
+
+@jax.jit
+def bad_label(x):
+    msg = f"value={x}"  # EXPECT: PIO106
+    return x, msg

@@ -1,0 +1,14 @@
+"""Rebinding the donated name to the result is the intended idiom."""
+import jax
+
+
+def step_impl(state, delta):
+    return state + delta
+
+
+step = jax.jit(step_impl, donate_argnums=(0,))
+
+
+def advance(state, delta):
+    state = step(state, delta)
+    return state + 0

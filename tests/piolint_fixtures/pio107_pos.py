@@ -1,0 +1,16 @@
+"""Reading a buffer after donating it -> PIO107."""
+import jax
+import jax.numpy as jnp
+
+
+def step_impl(state, delta):
+    return state + delta
+
+
+step = jax.jit(step_impl, donate_argnums=(0,))
+
+
+def advance(state, delta):
+    new_state = step(state, delta)
+    check = jnp.sum(state)  # EXPECT: PIO107
+    return new_state, check

@@ -1,0 +1,11 @@
+"""Timing a device dispatch without a fence -> PIO108 (bench scope)."""
+import time
+
+import jax.numpy as jnp
+
+
+def bench_matmul(a, b):
+    t0 = time.perf_counter()
+    out = jnp.dot(a, b)
+    dt = time.perf_counter() - t0  # EXPECT: PIO108
+    return out, dt

@@ -1,0 +1,15 @@
+"""Write to a lock-guarded attribute without the lock -> PIO201."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        with self._lock:
+            self.total += n
+
+    def sneak(self, n):
+        self.total = n  # EXPECT: PIO201

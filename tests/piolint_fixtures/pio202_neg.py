@@ -1,0 +1,21 @@
+"""Reads under the lock; helper called only under the lock is lock-held."""
+import threading
+
+
+class Window:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.count = 0
+
+    def push(self, x):
+        with self._lock:
+            self.items.append(x)
+            self._bump()
+
+    def _bump(self):
+        self.count += 1
+
+    def peek(self):
+        with self._lock:
+            return self.items[-1]

@@ -1,0 +1,19 @@
+"""Read of a lock-guarded attribute without the lock -> PIO202.
+
+Also exercises mutation-through-method-call inference: ``append`` under
+the lock is what marks ``items`` as guarded.
+"""
+import threading
+
+
+class Window:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def push(self, x):
+        with self._lock:
+            self.items.append(x)
+
+    def peek(self):
+        return self.items[-1]  # EXPECT: PIO202

@@ -1,0 +1,15 @@
+"""acquire immediately followed by try/finally release — accepted."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.passes = 0
+
+    def careful(self):
+        self._lock.acquire()
+        try:
+            self.passes += 1
+        finally:
+            self._lock.release()

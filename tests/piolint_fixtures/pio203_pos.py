@@ -1,0 +1,13 @@
+"""Manual acquire with no try/finally release -> PIO203."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.passes = 0
+
+    def risky(self):
+        self._lock.acquire()  # EXPECT: PIO203
+        self.passes += 1
+        self._lock.release()

@@ -1,0 +1,243 @@
+"""piolint (predictionio_tpu/analysis) — the analyzer is itself
+regression-tested by the repo it guards:
+
+* fixture files under `piolint_fixtures/` carry ``# EXPECT: PIOxxx``
+  annotations; every rule must fire exactly where annotated (positive
+  fixtures) and stay quiet on the compliant twin (negative fixtures);
+* the full gate scope (predictionio_tpu/, bench*.py, tools/*.py) must
+  produce zero non-baseline findings — a new hazard anywhere in the
+  package turns this test red before it costs a TPU reservation;
+* inline ``# piolint: disable=`` and the baseline file must both
+  suppress, and ``--strict`` must un-suppress the baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from predictionio_tpu.analysis import (
+    RULES,
+    Baseline,
+    SourceFile,
+    analyze_paths,
+    load_baseline,
+)
+from predictionio_tpu.analysis.cli import (
+    analyze_file,
+    default_paths,
+    main,
+    repo_root,
+)
+from predictionio_tpu.analysis.jaxlint import JaxEngine
+from predictionio_tpu.analysis.locklint import LockEngine
+
+FIXTURES = Path(__file__).parent / "piolint_fixtures"
+EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(PIO\d+)")
+
+# PIO100 (parse failure) can't have a checked-in fixture — a broken .py
+# would trip every other tool that walks the tree; it is covered by
+# test_parse_error_is_finding below.
+FIXTURE_RULES = sorted(set(RULES) - {"PIO100"})
+
+
+def run_fixture(path: Path):
+    """Both engines, bench scope forced on (so PIO108 fixtures work
+    without living in a bench*.py path)."""
+    src = SourceFile.load(path, path.parent)
+    return JaxEngine(src, bench_scope=True).run() + LockEngine(src).run()
+
+
+def expected_findings(path: Path) -> set[tuple[str, int]]:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in EXPECT_RE.finditer(line):
+            out.add((m.group(1), i))
+    return out
+
+
+# -- fixture coverage ------------------------------------------------------
+
+def test_every_rule_has_fixtures():
+    for code in FIXTURE_RULES:
+        stem = code.lower()
+        assert (FIXTURES / f"{stem}_pos.py").exists(), f"missing {stem}_pos"
+        assert (FIXTURES / f"{stem}_neg.py").exists(), f"missing {stem}_neg"
+
+
+@pytest.mark.parametrize(
+    "path", sorted(FIXTURES.glob("*.py")), ids=lambda p: p.stem,
+)
+def test_fixture_expectations(path: Path):
+    got = {(f.rule, f.line) for f in run_fixture(path)}
+    want = expected_findings(path)
+    assert got == want, (
+        f"{path.name}: expected {sorted(want)}, analyzer said {sorted(got)}"
+    )
+
+
+def test_positive_fixtures_actually_positive():
+    # belt-and-braces: every _pos fixture must expect >= 1 finding of
+    # its own rule, so a gutted fixture can't silently pass
+    for code in FIXTURE_RULES:
+        path = FIXTURES / f"{code.lower()}_pos.py"
+        want = expected_findings(path)
+        assert any(rule == code for rule, _ in want), path.name
+
+
+# -- the analyzer over the repo it guards ----------------------------------
+
+def test_repo_scope_has_no_unbaselined_findings():
+    root = repo_root()
+    findings = analyze_paths(default_paths(root), root)
+    baseline = load_baseline(root / "piolint.baseline.json")
+    baseline.apply(findings)
+    active = [f.text() for f in findings if not f.baselined]
+    assert active == [], (
+        "new piolint findings in the gate scope — fix them or add a "
+        "justified baseline entry:\n" + "\n".join(active)
+    )
+
+
+def test_baseline_entries_all_match_a_real_finding():
+    # a baseline entry that matches nothing is stale debt bookkeeping
+    root = repo_root()
+    findings = analyze_paths(default_paths(root), root)
+    keys = {f.identity() for f in findings}
+    baseline = load_baseline(root / "piolint.baseline.json")
+    for e in baseline.entries:
+        ident = (e["path"], e["rule"], e["scope"], e["snippet"])
+        assert ident in keys, f"stale baseline entry: {e}"
+        assert e.get("justification"), f"baseline entry w/o reason: {e}"
+
+
+# -- suppression mechanics -------------------------------------------------
+
+VIOLATION = (
+    "import jax\n"
+    "import jax.numpy as jnp\n\n\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    return jnp.sum(x).item(){trailer}\n"
+)
+
+
+def _analyze_text(tmp_path: Path, text: str):
+    p = tmp_path / "snippet.py"
+    p.write_text(text)
+    return analyze_file(p, tmp_path)
+
+
+def test_inline_disable_suppresses(tmp_path):
+    clean = _analyze_text(
+        tmp_path, VIOLATION.format(trailer="  # piolint: disable=PIO101"))
+    assert clean == []
+
+
+def test_inline_disable_is_rule_specific(tmp_path):
+    still = _analyze_text(
+        tmp_path, VIOLATION.format(trailer="  # piolint: disable=PIO104"))
+    assert [f.rule for f in still] == ["PIO101"]
+
+
+def test_inline_disable_all(tmp_path):
+    clean = _analyze_text(
+        tmp_path, VIOLATION.format(trailer="  # piolint: disable"))
+    assert clean == []
+
+
+def test_baseline_suppresses_and_strict_unsuppresses(tmp_path):
+    p = tmp_path / "snippet.py"
+    p.write_text(VIOLATION.format(trailer=""))
+    # same root the CLI resolves against, so identities line up
+    findings = analyze_file(p)
+    assert [f.rule for f in findings] == ["PIO101"]
+    base_path = tmp_path / "base.json"
+    Baseline.from_findings(findings).save(base_path)
+
+    rc = main([str(p), "--baseline", str(base_path)])
+    assert rc == 0
+    rc = main([str(p), "--baseline", str(base_path), "--strict"])
+    assert rc == 1
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    p = tmp_path / "snippet.py"
+    p.write_text(VIOLATION.format(trailer=""))
+    findings = analyze_file(p)
+    base_path = tmp_path / "base.json"
+    Baseline.from_findings(findings).save(base_path)
+    # shift the whole file down two lines: identity is line-free
+    p.write_text("# moved\n# moved again\n" + VIOLATION.format(trailer=""))
+    rc = main([str(p), "--baseline", str(base_path)])
+    assert rc == 0
+
+
+# -- gate semantics --------------------------------------------------------
+
+def test_seeded_violation_fails_the_analyzer(tmp_path):
+    """The acceptance check behind `tools/gate.sh` exiting nonzero."""
+    p = tmp_path / "scratch.py"
+    p.write_text(VIOLATION.format(trailer=""))
+    assert main([str(p)]) == 1
+
+
+def test_seeded_lock_violation_fails_the_analyzer(tmp_path):
+    p = tmp_path / "scratch.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.depth = 0\n\n"
+        "    def put(self):\n"
+        "        with self._lock:\n"
+        "            self.depth += 1\n\n"
+        "    def drain(self):\n"
+        "        self.depth -= 1\n"
+    )
+    findings = analyze_file(p, tmp_path)
+    assert [f.rule for f in findings] == ["PIO201"]
+    assert main([str(p)]) == 1
+
+
+def test_fixture_corpus_never_scanned_implicitly():
+    # the deliberately-violating fixture corpus must not fail gate or
+    # pre-commit scans: directory expansion skips it (engines are run
+    # on the fixtures directly by the tests above)
+    assert main([str(Path(__file__).parent)]) == 0
+
+
+def test_parse_error_is_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def nope(:\n")
+    findings = analyze_file(p, tmp_path)
+    assert [f.rule for f in findings] == ["PIO100"]
+
+
+def test_cli_json_report(tmp_path, capsys):
+    p = tmp_path / "snippet.py"
+    p.write_text(VIOLATION.format(trailer=""))
+    report = tmp_path / "report.json"
+    rc = main([str(p), "--format", "json", "--report", str(report)])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["counts"]["active"] == 1
+    assert payload["findings"][0]["rule"] == "PIO101"
+    assert json.loads(report.read_text()) == payload
+
+
+def test_module_entrypoint_runs():
+    # `python -m predictionio_tpu.analysis --list-rules` works end to end
+    out = subprocess.run(
+        [sys.executable, "-m", "predictionio_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, cwd=repo_root(),
+    )
+    assert out.returncode == 0
+    for code in RULES:
+        assert code in out.stdout

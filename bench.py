@@ -294,7 +294,14 @@ def run_breakdown(args) -> None:
     from predictionio_tpu.models.als import ALSTrainer
     from predictionio_tpu.parallel.mesh import fence
 
+    from predictionio_tpu.obs import TRAIN_PHASE_SECONDS
+
     def emit(phase, seconds, **kw):
+        # every phase measurement also lands in the SAME
+        # pio_train_phase_seconds histogram family the workflow spans
+        # feed, so a bench run and a production train emit one metric
+        # schema (ALX-style comparability) instead of private timers
+        TRAIN_PHASE_SECONDS.labels(phase=f"bench.{phase}").observe(seconds)
         print(json.dumps({"metric": "als_phase_seconds", "phase": phase,
                           "value": round(seconds, 4), **kw}), flush=True)
 

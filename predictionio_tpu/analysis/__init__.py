@@ -1,13 +1,15 @@
 """piolint — JAX-aware static analysis + lock-discipline checking.
 
-Two AST engines over the package's own source (no imports, no jax, no
+Three AST engines over the package's own source (no imports, no jax, no
 device): the **JAX engine** (PIO1xx, `jaxlint.py`) walks functions
 reachable from ``jax.jit``/``pjit``/``shard_map`` tracing and flags
 host-device syncs, recompile hazards, donated-buffer reuse, and
 unfenced benchmark timing spans; the **concurrency engine** (PIO2xx,
 `locklint.py`) infers per-class lock discipline — which ``self._*``
 attributes are ever written under ``self._lock`` — and flags accesses
-on paths that don't hold the lock.
+on paths that don't hold the lock; the **clock engine** (PIO109,
+`timelint.py`) flags wall-clock ``time.time()`` t0/dt subtractions in
+``predictionio_tpu/`` — durations must come from monotonic clocks.
 
 Driver: ``python -m predictionio_tpu.analysis`` (see `cli.py`).
 Findings are suppressed inline with ``# piolint: disable=PIO101`` or

@@ -18,6 +18,7 @@ from typing import Optional
 from .core import RULES, Baseline, Finding, SourceFile, load_baseline
 from .jaxlint import JaxEngine
 from .locklint import LockEngine
+from .timelint import TimeEngine
 
 __all__ = ["analyze_file", "analyze_paths", "repo_root", "main"]
 
@@ -47,6 +48,17 @@ def _is_bench_scope(path: Path, root: Path) -> bool:
     return rel.name.startswith("bench") or (
         len(rel.parts) > 1 and rel.parts[0] == "tools"
     )
+
+
+def _is_pkg_scope(path: Path, root: Path) -> bool:
+    """PIO109 (wall-clock duration) scope: the package itself.  Bench
+    harnesses/tools keep wall clocks (fenced, coarse — PIO108 covers
+    their honesty); production code must not."""
+    try:
+        rel = path.resolve().relative_to(root.resolve())
+    except ValueError:
+        return False
+    return len(rel.parts) > 1 and rel.parts[0] == "predictionio_tpu"
 
 
 def default_paths(root: Optional[Path] = None) -> list[Path]:
@@ -93,6 +105,8 @@ def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
         src, bench_scope=_is_bench_scope(path, root)
     ).run()
     findings += LockEngine(src).run()
+    if _is_pkg_scope(path, root):
+        findings += TimeEngine(src).run()
     return findings
 
 

@@ -43,6 +43,8 @@ RULES: dict[str, str] = {
     "PIO107": "donated buffer reused after a donating jit call",
     "PIO108": "timing lie: time.* span over device work without a "
               "fence/block_until_ready (bench*/tools only)",
+    "PIO109": "wall-clock duration: time.time() t0/dt subtraction — "
+              "use monotonic()/perf_counter() (predictionio_tpu/ only)",
     "PIO201": "lock discipline: write to a lock-guarded attribute "
               "without holding the lock",
     "PIO202": "lock discipline: read of a lock-guarded attribute "

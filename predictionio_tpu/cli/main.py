@@ -96,6 +96,30 @@ def _out(msg: str) -> None:
     print(msg)
 
 
+def _apply_obs_flags(args) -> None:
+    """Wire the pio-obs knobs shared by the server/workflow commands:
+    ``--telemetry-dir`` (span JSONL journal location) and
+    ``--no-metrics`` (404 the /metrics exposition)."""
+    from ..obs import configure
+
+    configure(
+        journal_dir=getattr(args, "telemetry_dir", None),
+        metrics=(False if getattr(args, "no_metrics", False) else None),
+    )
+
+
+def _add_obs_args(p) -> None:
+    p.add_argument("--telemetry-dir", metavar="DIR",
+                   help="journal pio-obs spans as JSON lines to "
+                   "DIR/spans-<pid>.jsonl (default: in-memory ring "
+                   "only; PIO_TPU_TELEMETRY=1 journals under "
+                   "$PIO_TPU_HOME/telemetry)")
+    p.add_argument("--no-metrics", action="store_true",
+                   help="disable the GET /metrics Prometheus "
+                   "exposition (recording still happens; only the "
+                   "endpoint answers 404)")
+
+
 # --------------------------------------------------------------------------
 # app / accesskey ops (console/App.scala:34-498, console/AccessKey.scala)
 # --------------------------------------------------------------------------
@@ -756,6 +780,7 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("key")
 
     t = sub.add_parser("train", help="train an engine")
+    _add_obs_args(t)
     t.add_argument("--engine-json", default="engine.json")
     t.add_argument("--engine-factory")
     t.add_argument("--batch", default="")
@@ -775,6 +800,7 @@ def build_parser() -> argparse.ArgumentParser:
                    "trains on an unchanged table skip the sqlite scan")
 
     d = sub.add_parser("deploy", help="deploy an engine server")
+    _add_obs_args(d)
     d.add_argument("--scan-cache", action="store_true",
                    help="snapshot columnar event scans to npz keyed by a "
                    "table write-version (storage/scan_cache.py)")
@@ -817,6 +843,7 @@ def build_parser() -> argparse.ArgumentParser:
                    "one probe through")
 
     e = sub.add_parser("eval", help="run an evaluation sweep")
+    _add_obs_args(e)
     e.add_argument("evaluation",
                    help="dotted path to an Evaluation (or factory)")
     e.add_argument("engine_params_generator", nargs="?",
@@ -830,6 +857,7 @@ def build_parser() -> argparse.ArgumentParser:
                    "table write-version (storage/scan_cache.py)")
 
     ev = sub.add_parser("eventserver", help="run the event server")
+    _add_obs_args(ev)
     ev.add_argument("--ip", default="0.0.0.0")
     ev.add_argument("--port", type=int, default=7070)
     ev.add_argument("--stats", action="store_true", default=True)
@@ -843,10 +871,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "(decorrelated jitter grows it toward a 10x cap)")
 
     ad = sub.add_parser("adminserver", help="run the admin API server")
+    _add_obs_args(ad)
     ad.add_argument("--ip", default="127.0.0.1")
     ad.add_argument("--port", type=int, default=7071)
 
     db = sub.add_parser("dashboard", help="run the evaluation dashboard")
+    _add_obs_args(db)
     db.add_argument("--ip", default="127.0.0.1")
     db.add_argument("--port", type=int, default=9000)
 
@@ -949,6 +979,7 @@ def main(argv: Optional[list[str]] = None,
     if args.command == "help":
         build_parser().print_help()
         return 0
+    _apply_obs_flags(args)
     storage = storage or get_storage()
     try:
         return _DISPATCH[args.command](args, storage)

@@ -172,9 +172,14 @@ class MetricEvaluator:
         self.output_path = output_path
 
     def _score_one(self, ctx, engine, ep, workflow_params, ix, total):
-        eval_out = engine.eval(ctx, ep, workflow_params)
-        score = self.metric.calculate(ctx, eval_out)
-        other = [m.calculate(ctx, eval_out) for m in self.other_metrics]
+        from ..obs import phase_span
+
+        with phase_span("eval.sweep", attrs={"candidate": ix}):
+            eval_out = engine.eval(ctx, ep, workflow_params)
+            score = self.metric.calculate(ctx, eval_out)
+            other = [
+                m.calculate(ctx, eval_out) for m in self.other_metrics
+            ]
         # streamed from here so the parallel sweep shows live progress too
         logger.info(
             "MetricEvaluator: candidate %d/%d -> %s = %s",

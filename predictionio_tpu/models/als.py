@@ -519,6 +519,43 @@ _half_iteration = functools.partial(
 )(_half_iteration_impl)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "ks", "implicit", "weighted_lambda", "precision", "solver",
+        "gather_dtype", "gather_mode", "solver_mode", "subspace_size",
+        "stop_after",
+    ),
+)
+def _half_phase_probe(upd, opp, c_sorted, v_sorted, bucket_args, lam,
+                      alpha, *, ks, implicit, weighted_lambda, precision,
+                      solver, gather_dtype="float32", gather_mode="row",
+                      solver_mode="full", subspace_size=0,
+                      stop_after="gather"):
+    """Truncated half-iteration for pio-obs phase tracing: the same
+    kernel prefix ``tools/breakdown_matrix.py`` probes (gather only /
+    gather+Gram), jitted WITHOUT donation — the real, donating half
+    still consumes ``upd`` right after the probes run."""
+    return _solve_buckets(
+        None, opp, c_sorted, v_sorted, bucket_args, lam, alpha,
+        ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
+        precision=precision, solver=solver, gather_dtype=gather_dtype,
+        gather_mode=gather_mode, solver_mode=solver_mode,
+        subspace_size=subspace_size, upd_table=upd,
+        stop_after=stop_after,
+    )
+
+
+def _als_phase_trace_enabled() -> bool:
+    """``PIO_TPU_TRACE_ALS=1`` arms per-phase span recording.  Opt-in
+    because honest phase timing needs a fence per probe and per half —
+    the async dispatch pipelining ``run()`` normally rides is exactly
+    what the fences suspend (same trade bench.py makes)."""
+    import os
+
+    return os.environ.get("PIO_TPU_TRACE_ALS") == "1"
+
+
 def _solve_buckets(
     upd_write,             # callback(rows, x) -> new upd table/shard
     opp: jax.Array,        # [M, R] full opposite table (local or gathered)
@@ -1527,6 +1564,71 @@ class ALSTrainer:
             subspace_size=cfg.subspace_size,
         )
 
+    def _traced_half(self, upd, opp, side, side_name: str, it: int,
+                     lam: Optional[float]) -> jax.Array:
+        """One half-iteration with pio-obs phase spans (als.gather /
+        als.gram / als.solve), attributed by the fence-probe subtraction
+        idiom: time the gather-only truncation, the gather+Gram
+        truncation, and the full half, each fenced; the deltas are the
+        per-phase device times (ALX §5: per-phase timing is what makes
+        TPU factorization tunable).  Sharded placement has no probe
+        entry point — it records the fenced full half as ``als.half``.
+        """
+        import time
+
+        from ..obs import TRAIN_PHASE_SECONDS, get_tracer
+
+        tracer = get_tracer()
+        attrs = {"side": side_name, "iteration": it}
+
+        def timed(fn, warm: bool):
+            if warm:
+                fence(fn())  # compile outside the measured span
+            t0 = time.perf_counter()
+            out = fn()
+            fence(out)
+            return out, time.perf_counter() - t0
+
+        def emit(phase: str, dt: float) -> None:
+            tracer.record(phase, dt, attrs=attrs)
+            TRAIN_PHASE_SECONDS.labels(phase=phase).observe(dt)
+
+        if self.sharded:
+            new, t_full = timed(
+                lambda: self._half(upd, opp, side, lam=lam), warm=False
+            )
+            emit("als.half", t_full)
+            return new
+
+        cfg = self.cfg
+        lam_t = jnp.asarray(cfg.lam if lam is None else lam, jnp.float32)
+        alpha_t = jnp.asarray(cfg.alpha, jnp.float32)
+
+        def probe(stop):
+            return _half_phase_probe(
+                upd, opp, side["c_sorted"], side["v_sorted"],
+                side["buckets"], lam_t, alpha_t,
+                ks=side["ks"], implicit=cfg.implicit,
+                weighted_lambda=cfg.weighted_lambda,
+                precision=cfg.matmul_precision, solver=self.solver,
+                gather_dtype=cfg.gather_dtype,
+                gather_mode=cfg.gather_mode,
+                solver_mode=cfg.solver_mode,
+                subspace_size=cfg.subspace_size, stop_after=stop,
+            )
+
+        # the probes must run BEFORE the real half: it donates ``upd``
+        warm = it == 0
+        _, t_gather = timed(lambda: probe("gather"), warm)
+        _, t_gram_cum = timed(lambda: probe("gram"), warm)
+        new, t_full = timed(
+            lambda: self._half(upd, opp, side, lam=lam), warm=False
+        )
+        emit("als.gather", t_gather)
+        emit("als.gram", max(t_gram_cum - t_gather, 0.0))
+        emit("als.solve", max(t_full - t_gram_cum, 0.0))
+        return new
+
     def run(
         self,
         U: jax.Array,
@@ -1547,9 +1649,16 @@ class ALSTrainer:
         """
         U = jnp.array(U, copy=True)
         V = jnp.array(V, copy=True)
+        trace_phases = _als_phase_trace_enabled()
         for it in range(num_iterations):
-            U = self._half(U, V, self._user_side, lam=lam)
-            V = self._half(V, U, self._item_side, lam=lam)
+            if trace_phases:
+                U = self._traced_half(U, V, self._user_side, "user", it,
+                                      lam)
+                V = self._traced_half(V, U, self._item_side, "item", it,
+                                      lam)
+            else:
+                U = self._half(U, V, self._user_side, lam=lam)
+                V = self._half(V, U, self._item_side, lam=lam)
             logger.debug("ALS iteration %d/%d dispatched", it + 1,
                          num_iterations)
         # fence, not block_until_ready: the latter is a no-op on some

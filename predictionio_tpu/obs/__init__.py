@@ -1,0 +1,208 @@
+"""pio-obs: unified metrics, latency histograms, and trace propagation.
+
+The observability layer every server and workflow reports into
+(SURVEY §5 — the Spark-UI/evaluation-dashboard replacement, grown up):
+
+* :mod:`.registry` — process-wide :class:`MetricsRegistry`
+  (thread-safe Counter / Gauge / Histogram under sharded locks) with
+  Prometheus text exposition; all four HTTP servers mount it at
+  ``GET /metrics`` via ``server/http_base.py``.
+* :mod:`.trace` — :class:`Tracer`: trace ids minted at the serving
+  edge (or taken from the ``X-PIO-Trace`` request header), propagated
+  through ``DeliveryQueue`` payloads to the event server, spans
+  recorded into a bounded ring + optional JSONL journal under
+  ``$PIO_TPU_HOME/telemetry/``.
+
+This module owns the process-wide instances (``get_registry()`` /
+``get_tracer()``) and eagerly registers the standard metric families
+(the *metric name catalog* in docs/ARCHITECTURE.md) so every process's
+``/metrics`` exposes the same schema from its first scrape — ALX-style
+run comparability requires identical shapes, not just identical names.
+
+Pure stdlib, no package-internal imports: every other layer may depend
+on this module without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_latency_buckets,
+    log_buckets,
+)
+from .trace import (
+    Span,
+    TRACE_HEADER,
+    Tracer,
+    current_trace_id,
+    new_trace_id,
+    trace_scope,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "TRACE_HEADER",
+    "Tracer",
+    "configure",
+    "current_trace_id",
+    "default_latency_buckets",
+    "get_registry",
+    "get_tracer",
+    "log_buckets",
+    "metrics_enabled",
+    "new_trace_id",
+    "phase_span",
+    "render_prometheus",
+    "set_metrics_enabled",
+    "telemetry_home",
+    "trace_scope",
+]
+
+# breaker-state gauge encoding (pio_breaker_state)
+BREAKER_STATE_VALUES = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
+
+
+def telemetry_home() -> Path:
+    home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser(
+        "~/.predictionio_tpu"
+    )
+    return Path(home) / "telemetry"
+
+
+def _default_journal_dir() -> Optional[Path]:
+    explicit = os.environ.get("PIO_TPU_TELEMETRY_DIR")
+    if explicit:
+        return Path(explicit)
+    if os.environ.get("PIO_TPU_TELEMETRY") == "1":
+        return telemetry_home()
+    return None
+
+
+_registry = MetricsRegistry()
+_tracer = Tracer(journal_dir=_default_journal_dir())
+_metrics_enabled = True
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def metrics_enabled() -> bool:
+    return _metrics_enabled
+
+
+def set_metrics_enabled(enabled: bool) -> None:
+    """``/metrics`` answers 404 while disabled (``--no-metrics``);
+    recording keeps working — disabling exposition must not change
+    what the process measures."""
+    global _metrics_enabled
+    _metrics_enabled = bool(enabled)
+
+
+def configure(journal_dir: Optional[os.PathLike | str] = None,
+              metrics: Optional[bool] = None) -> None:
+    """CLI-facing knob bundle (``--telemetry-dir`` / ``--no-metrics``).
+    ``None`` leaves a setting unchanged."""
+    if journal_dir is not None:
+        _tracer.configure(journal_dir)
+    if metrics is not None:
+        set_metrics_enabled(metrics)
+
+
+def render_prometheus() -> str:
+    return _registry.render_prometheus()
+
+
+# -- standard families (the metric name catalog) ---------------------------
+# Registered at import so every process's /metrics carries the full
+# schema (zero-valued until first use).  Servers/workflows fetch these
+# by the same names — idempotent registration returns the same family.
+
+QUERY_LATENCY = _registry.histogram(
+    "pio_query_latency_seconds",
+    "End-to-end /queries.json serving latency (decode -> predict -> "
+    "serve -> encode)",
+)
+QUERIES_TOTAL = _registry.counter(
+    "pio_queries_total",
+    "Serving queries by outcome",
+    labels=("status",),
+)
+RELOADS_TOTAL = _registry.counter(
+    "pio_reloads_total",
+    "Hot model reloads by outcome",
+    labels=("result",),
+)
+BREAKER_STATE = _registry.gauge(
+    "pio_breaker_state",
+    "Circuit-breaker state per delivery queue "
+    "(0=closed, 1=half-open, 2=open)",
+    labels=("queue",),
+)
+DELIVERY_DEPTH = _registry.gauge(
+    "pio_delivery_queue_depth",
+    "Entries waiting in a bounded delivery queue",
+    labels=("queue",),
+)
+DELIVERY_TOTAL = _registry.counter(
+    "pio_delivery_total",
+    "Delivery-queue outcomes (submitted/delivered/dropped/retried)",
+    labels=("queue", "outcome"),
+)
+EVENTS_TOTAL = _registry.counter(
+    "pio_events_requests_total",
+    "Event-server bookkept requests by HTTP status",
+    labels=("status",),
+)
+EVENT_WRITE_LATENCY = _registry.histogram(
+    "pio_event_write_latency_seconds",
+    "Event-store write latency on the ingestion path",
+)
+RESILIENCE_TOTAL = _registry.counter(
+    "pio_resilience_events_total",
+    "Recovered-from trouble (retries etc.) by kind",
+    labels=("kind",),
+)
+TRAIN_PHASE_SECONDS = _registry.histogram(
+    "pio_train_phase_seconds",
+    "Workflow phase durations (train.run, eval.sweep, als.*)",
+    labels=("phase",),
+    buckets=log_buckets(1e-4, 10000.0, per_decade=4),
+)
+
+# materialize the unlabeled children now: a histogram family without a
+# child renders no bucket ladder, and the schema contract is that every
+# process's first scrape already shows the full (zero-valued) shape
+QUERY_LATENCY.child()
+EVENT_WRITE_LATENCY.child()
+
+
+@contextlib.contextmanager
+def phase_span(name: str, attrs: Optional[dict] = None) -> Iterator[dict]:
+    """Record one workflow phase BOTH ways: a span in the tracer (trace
+    correlation) and an observation in ``pio_train_phase_seconds``
+    (run-over-run comparability — iALS++-style solver sweeps are only
+    comparable when every run emits the same metric schema)."""
+    t0 = time.perf_counter()
+    with _tracer.span(name, attrs) as a:
+        yield a
+    TRAIN_PHASE_SECONDS.labels(phase=name).observe(
+        time.perf_counter() - t0
+    )

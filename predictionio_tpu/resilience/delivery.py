@@ -29,6 +29,8 @@ import urllib.request
 from typing import Optional
 
 from . import faults
+from ..obs import BREAKER_STATE_VALUES, BREAKER_STATE, DELIVERY_DEPTH, \
+    DELIVERY_TOTAL
 from .policy import CircuitBreaker, RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -37,12 +39,14 @@ __all__ = ["DeliveryQueue"]
 
 
 class _Entry:
-    __slots__ = ("url", "data", "attempts")
+    __slots__ = ("url", "data", "attempts", "headers")
 
-    def __init__(self, url: str, data: bytes):
+    def __init__(self, url: str, data: bytes,
+                 headers: Optional[dict] = None):
         self.url = url
         self.data = data
         self.attempts = 0
+        self.headers = headers or {}
 
 
 class DeliveryQueue:
@@ -76,24 +80,42 @@ class DeliveryQueue:
         self.dropped = 0
         self.retries = 0
         self.send_failures = 0
+        # pio-obs wiring: this queue's breaker state and depth as
+        # callback gauges, outcomes as counters.  Gauge children are
+        # keyed by queue name — the freshest same-named queue owns the
+        # child (the steady state: one live queue per name per process).
+        BREAKER_STATE.labels(queue=name).set_function(
+            lambda b=self.breaker: BREAKER_STATE_VALUES.get(b.state, -1.0)
+        )
+        DELIVERY_DEPTH.labels(queue=name).set_function(lambda: self.depth)
+        self._m_outcome = {
+            k: DELIVERY_TOTAL.labels(queue=name, outcome=k)
+            for k in ("submitted", "delivered", "dropped", "retried")
+        }
 
     # -- producer side -----------------------------------------------------
-    def submit(self, url: str, payload) -> bool:
+    def submit(self, url: str, payload,
+               headers: Optional[dict] = None) -> bool:
         """Enqueue one delivery; returns False when it displaced the
-        oldest queued entry (queue at capacity)."""
+        oldest queued entry (queue at capacity).  ``headers`` are extra
+        HTTP headers sent with the POST — trace propagation
+        (``X-PIO-Trace``) rides here."""
         data = (payload if isinstance(payload, (bytes, bytearray))
                 else json.dumps(payload).encode())
         kept = True
         with self._cond:
             if self._closed:
                 self.dropped += 1
+                self._m_outcome["dropped"].inc()
                 return False
             self.submitted += 1
+            self._m_outcome["submitted"].inc()
             if len(self._dq) >= self.capacity:
                 self._dq.popleft()
                 self.dropped += 1
+                self._m_outcome["dropped"].inc()
                 kept = False
-            self._dq.append(_Entry(url, data))
+            self._dq.append(_Entry(url, data, headers))
             if self._thread is None:
                 self._thread = threading.Thread(
                     target=self._drain, daemon=True,
@@ -132,12 +154,14 @@ class DeliveryQueue:
                         if self._dq and self._dq[0] is entry:
                             self._dq.popleft()
                         self.dropped += 1
+                        self._m_outcome["dropped"].inc()
                         logger.warning(
                             "%s delivery dropped after %d attempts: %s",
                             self.name, entry.attempts, e,
                         )
                         continue
                     self.retries += 1
+                    self._m_outcome["retried"].inc()
                 self._wake.wait(self.retry.backoff(entry.attempts))
                 self._wake.clear()
                 if self._stopping():
@@ -148,6 +172,7 @@ class DeliveryQueue:
                     if self._dq and self._dq[0] is entry:
                         self._dq.popleft()
                     self.delivered += 1
+                    self._m_outcome["delivered"].inc()
                     self._cond.notify_all()  # flush() waiters
 
     def _closed_now(self) -> bool:
@@ -165,7 +190,8 @@ class DeliveryQueue:
             faults.check(self.fault_point)
         req = urllib.request.Request(
             entry.url, data=entry.data,
-            headers={"Content-Type": "application/json"}, method="POST",
+            headers={"Content-Type": "application/json", **entry.headers},
+            method="POST",
         )
         # context manager: the response socket must close on every path
         with urllib.request.urlopen(req, timeout=self.timeout_s) as r:

@@ -84,6 +84,8 @@ class AdminServer(HTTPServerBase):
             server_logger = logger
 
             def do_GET(self):
+                if self._serve_metrics():
+                    return
                 path = urllib.parse.urlparse(self.path).path
                 if path == "/":
                     self._reply(200, {

@@ -3,7 +3,10 @@
 Re-expression of reference `tools/dashboard/Dashboard.scala:30-141`: an HTML
 index of completed evaluation instances with drill-down to
 ``evaluator_results.{txt,html,json}`` per instance, plus CORS headers
-(`dashboard/CorsSupport.scala`).
+(`dashboard/CorsSupport.scala`), plus the pio-obs **live metrics** page
+(``/metrics.html``: current registry samples + recent spans — the
+operator view next to the evaluation index; machines scrape
+``/metrics``).
 """
 
 from __future__ import annotations
@@ -11,6 +14,7 @@ from __future__ import annotations
 import html as _html
 import logging
 import urllib.parse
+from ..obs import get_registry, get_tracer
 from ..storage.registry import Storage
 from .http_base import HTTPServerBase, JsonRequestHandler
 
@@ -50,7 +54,46 @@ class DashboardServer(HTTPServerBase):
             "<table border='1'><tr><th>id</th><th>evaluation</th>"
             "<th>start</th><th>end</th><th>result</th><th>details</th></tr>"
             + "\n".join(rows)
-            + "</table></body></html>"
+            + "</table>"
+            "<p><a href='/metrics.html'>live metrics</a> &middot; "
+            "<a href='/metrics'>prometheus exposition</a></p>"
+            "</body></html>"
+        )
+
+    def metrics_html(self) -> str:
+        """Operator view of the process-wide registry + recent spans."""
+        reg = get_registry()
+        rows = []
+        for name, label_items, value in reg.collect():
+            lbl = ", ".join(f"{k}={v}" for k, v in label_items)
+            rows.append(
+                "<tr><td>{n}</td><td>{l}</td><td>{v}</td></tr>".format(
+                    n=_html.escape(name), l=_html.escape(lbl),
+                    v=_html.escape(f"{value:g}"),
+                )
+            )
+        spans = get_tracer().spans(limit=50)
+        span_rows = [
+            "<tr><td>{n}</td><td>{t}</td><td>{d:.3f}</td></tr>".format(
+                n=_html.escape(s.name),
+                t=_html.escape(s.trace_id or "-"),
+                d=s.duration_s * 1e3,
+            )
+            for s in reversed(spans)
+        ]
+        return (
+            "<html><head><title>live metrics</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace;padding:2px 8px}</style></head>"
+            "<body><h1>Live metrics</h1>"
+            "<p>Prometheus exposition at <a href='/metrics'>/metrics"
+            "</a>.</p>"
+            "<table border='1'><tr><th>metric</th><th>labels</th>"
+            "<th>value</th></tr>" + "\n".join(rows) + "</table>"
+            "<h2>Recent spans (newest first)</h2>"
+            "<table border='1'><tr><th>span</th><th>trace</th>"
+            "<th>ms</th></tr>" + "\n".join(span_rows) + "</table>"
+            "</body></html>"
         )
 
     def _make_handler(server: "DashboardServer"):
@@ -60,9 +103,15 @@ class DashboardServer(HTTPServerBase):
             extra_headers = (("Access-Control-Allow-Origin", "*"),)
 
             def do_GET(self):
+                if self._serve_metrics():
+                    return
                 path = urllib.parse.urlparse(self.path).path
                 if path == "/":
                     self._reply(200, server.index_html().encode(), "text/html")
+                    return
+                if path == "/metrics.html":
+                    self._reply(200, server.metrics_html().encode(),
+                                "text/html")
                     return
                 parts = [x for x in path.split("/") if x]
                 if len(parts) == 2 and parts[0] == "engine_instances":

@@ -24,9 +24,11 @@ from __future__ import annotations
 import json
 import logging
 import sqlite3
+import time
 import urllib.parse
 from typing import Any, Optional
 
+from ..obs import EVENT_WRITE_LATENCY, get_tracer, trace_scope
 from ..resilience import faults
 from ..resilience.policy import RetryPolicy
 from ..storage.event import Event, EventValidationError, parse_time
@@ -144,10 +146,19 @@ class EventServer(HTTPServerBase):
             faults.check("storage.write")
             return es.insert(event, app_id, channel_id)
 
-        return self.write_retry.call(
-            put, retry_on=TRANSIENT_STORAGE_ERRORS,
-            on_retry=self._note_retry("storage.write"),
-        )
+        # span + histogram cover the whole retried write: the client's
+        # view of how long ingestion held their request
+        t0 = time.perf_counter()
+        try:
+            return self.write_retry.call(
+                put, retry_on=TRANSIENT_STORAGE_ERRORS,
+                on_retry=self._note_retry("storage.write"),
+            )
+        finally:
+            dt = time.perf_counter() - t0
+            EVENT_WRITE_LATENCY.child().observe(dt)
+            get_tracer().record("events.write", dt,
+                                attrs={"event": event.event})
 
     @staticmethod
     def _find_kwargs(params: dict[str, list[str]]) -> dict[str, Any]:
@@ -208,6 +219,13 @@ class EventServer(HTTPServerBase):
             # ---- POST ----
             def do_POST(self):
                 path = self._route()
+                # propagate (never mint) the trace id: ingestion is a
+                # downstream hop — ids are born at the serving edge or
+                # the client
+                with trace_scope(self._trace_id()):
+                    self._do_post(path)
+
+            def _do_post(self, path):
                 try:
                     if path == "/events.json":
                         self._post_event()
@@ -304,11 +322,22 @@ class EventServer(HTTPServerBase):
                         validate=False,
                     )
 
+                def timed_put_batch():
+                    t0 = time.perf_counter()
+                    try:
+                        return server.write_retry.call(
+                            put_batch, retry_on=TRANSIENT_STORAGE_ERRORS,
+                            on_retry=server._note_retry("storage.write"),
+                        )
+                    finally:
+                        dt = time.perf_counter() - t0
+                        EVENT_WRITE_LATENCY.child().observe(dt)
+                        get_tracer().record(
+                            "events.write", dt, attrs={"n": len(valid)}
+                        )
+
                 try:
-                    ids = server.write_retry.call(
-                        put_batch, retry_on=TRANSIENT_STORAGE_ERRORS,
-                        on_retry=server._note_retry("storage.write"),
-                    ) if valid else []
+                    ids = timed_put_batch() if valid else []
                 except TRANSIENT_STORAGE_ERRORS as e:
                     # the batch contract is per-event statuses even when
                     # the store is down: valid events answer 503 (come
@@ -361,6 +390,8 @@ class EventServer(HTTPServerBase):
 
             # ---- GET ----
             def do_GET(self):
+                if self._serve_metrics():
+                    return
                 path = self._route()
                 try:
                     if path == "/":

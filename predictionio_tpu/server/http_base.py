@@ -1,12 +1,18 @@
 """Shared HTTP server plumbing for all four servers (event, serving, admin,
-dashboard): bind/serve/stop lifecycle and a JSON reply helper."""
+dashboard): bind/serve/stop lifecycle, a JSON reply helper, and the
+common ``GET /metrics`` Prometheus exposition mount (pio-obs)."""
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
+
+from ..obs import TRACE_HEADER, metrics_enabled, render_prometheus
+
+PROMETHEUS_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -18,6 +24,25 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         if self.server_logger is not None:
             self.server_logger.debug(fmt, *args)
+
+    def _serve_metrics(self) -> bool:
+        """Answer ``GET /metrics`` from the process-wide registry.
+        Every server's ``do_GET`` tries this first, so all four HTTP
+        surfaces expose the same exposition without per-server code.
+        Returns True when the request was handled."""
+        if urllib.parse.urlparse(self.path).path != "/metrics":
+            return False
+        if not metrics_enabled():
+            self._reply(404, {"message": "metrics disabled (--no-metrics)"})
+            return True
+        self._reply(200, render_prometheus().encode(),
+                    ctype=PROMETHEUS_CTYPE)
+        return True
+
+    def _trace_id(self) -> Optional[str]:
+        """The request's propagated trace id (``X-PIO-Trace``), if any."""
+        tid = self.headers.get(TRACE_HEADER)
+        return tid.strip() if tid else None
 
     def _reply(self, code: int, payload: Any,
                ctype: str = "application/json") -> None:

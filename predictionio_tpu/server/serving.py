@@ -34,6 +34,16 @@ from typing import Any, Callable, Optional
 from ..controller.base import WorkflowContext
 from .http_base import HTTPServerBase, JsonRequestHandler
 from ..controller.engine import Engine, EngineParams
+from ..obs import (
+    QUERIES_TOTAL,
+    QUERY_LATENCY,
+    RELOADS_TOTAL,
+    TRACE_HEADER,
+    Histogram,
+    get_tracer,
+    new_trace_id,
+    trace_scope,
+)
 from ..resilience import faults
 from ..resilience.delivery import DeliveryQueue
 from ..resilience.policy import (
@@ -203,11 +213,16 @@ class EngineServer(HTTPServerBase):
         self._feedback_queue = _queue("feedback", "http.feedback")
         self._log_queue = _queue("remote-log", "http.remote_log")
         self._load(instance_id)
-        # serving stats (CreateServer.scala:396-398)
+        # serving stats (CreateServer.scala:396-398).  Latency is
+        # histogram-backed (pio-obs): this instance's private histogram
+        # drives the /status percentiles + average, and the same deltas
+        # feed the process-wide pio_query_latency_seconds family that
+        # /metrics exposes — one measurement, two views.
         self.request_count = 0
-        self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
-        self.start_time = time.time()
+        self.start_time = time.time()  # wall clock: a TIMESTAMP, not a span
+        self._latency = Histogram()
+        self._m_latency = QUERY_LATENCY.child()
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # -- lifecycle --------------------------------------------------------
@@ -243,7 +258,7 @@ class EngineServer(HTTPServerBase):
         # batcher with microbatch_max=1 still needs its B=1 shapes
         warm_max = self.config.microbatch_max if batcher is not None else 0
         for algo, model in zip(algorithms, models):
-            t0 = time.time()
+            t0 = time.perf_counter()
             try:
                 # pass the batcher's real maximum so the warmup ladder
                 # covers every pow2 size the padding can dispatch; algos
@@ -266,7 +281,7 @@ class EngineServer(HTTPServerBase):
                     type(algo).__name__,
                 )
             else:
-                dt = time.time() - t0
+                dt = time.perf_counter() - t0
                 if dt > 0.05:
                     logger.info("%s warmed up in %.2fs",
                                 type(algo).__name__, dt)
@@ -334,20 +349,24 @@ class EngineServer(HTTPServerBase):
         )
         if latest is None:
             raise LookupError("no completed engine instance found")
-        try:
-            self._load(latest.id)
-        except Exception as e:
-            with self._lock:
-                self.last_reload_error = f"{type(e).__name__}: {e}"
-            raise
+        with get_tracer().span("serve.reload",
+                               attrs={"instance": latest.id}):
+            try:
+                self._load(latest.id)
+            except Exception as e:
+                with self._lock:
+                    self.last_reload_error = f"{type(e).__name__}: {e}"
+                RELOADS_TOTAL.labels(result="error").inc()
+                raise
         with self._lock:
             self.last_reload_error = None
+        RELOADS_TOTAL.labels(result="ok").inc()
         return latest.id
 
     # -- query path -------------------------------------------------------
     def predict_json(self, query_json: dict,
                      timeout_s: Optional[float] = None) -> Any:
-        t0 = time.time()
+        t0 = time.perf_counter()
         # the request's time budget: per-request override, else the
         # configured default, else unbounded (None costs nothing)
         budget = timeout_s if timeout_s is not None \
@@ -377,11 +396,15 @@ class EngineServer(HTTPServerBase):
             if deadline is not None:
                 deadline.check("query serving")
             result = serving.serve(query, predictions)
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         with self._lock:
             self.request_count += 1
             self.last_serving_sec = dt
-            self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+            instance_id = self.instance_id
+        self._latency.observe(dt)
+        self._m_latency.observe(dt)
+        get_tracer().record("serve.query", dt,
+                            attrs={"instance": instance_id})
         out = _result_to_json(result)
         if self.config.feedback and self.config.event_server_url:
             out = self._send_feedback(query_json, out)
@@ -407,7 +430,12 @@ class EngineServer(HTTPServerBase):
             f"{self.config.event_server_url}/events.json"
             f"?accessKey={self.config.access_key or ''}"
         )
-        self._feedback_queue.submit(url, event)
+        from ..obs import current_trace_id
+
+        tid = current_trace_id()
+        self._feedback_queue.submit(
+            url, event, headers={TRACE_HEADER: tid} if tid else None
+        )
         if isinstance(result_json, dict):
             result_json = {**result_json, "prId": pr_id}
         return result_json
@@ -433,16 +461,33 @@ class EngineServer(HTTPServerBase):
         })
         self._log_queue.submit(self.config.log_url, payload.encode())
 
+    def latency_stats(self) -> dict:
+        """Histogram-backed latency view for /status: the same buckets
+        /metrics exposes, so an operator's curl and their Grafana panel
+        cannot disagree.  ``avg`` keeps the old ``avgServingSec``
+        contract (now sum/count, no incremental-mean drift)."""
+        snap = self._latency.snapshot()
+        if snap["count"] == 0:
+            return {"count": 0, "avg": 0.0, "p50": 0.0, "p95": 0.0,
+                    "p99": 0.0}
+        return {
+            "count": snap["count"],
+            "avg": snap["sum"] / snap["count"],
+            "p50": self._latency.percentile(50, snap),
+            "p95": self._latency.percentile(95, snap),
+            "p99": self._latency.percentile(99, snap),
+        }
+
     def status_json(self) -> dict:
         # snapshot the hot-swapped / request-updated state under the
         # lock; the reload thread and in-flight queries mutate it
         with self._lock:
             instance_id = self.instance_id
             request_count = self.request_count
-            avg_serving_sec = self.avg_serving_sec
             last_serving_sec = self.last_serving_sec
             batcher = self.batcher
             last_reload_error = self.last_reload_error
+        lat = self.latency_stats()
         out = {
             "status": "alive",
             "engineInstanceId": instance_id,
@@ -450,8 +495,11 @@ class EngineServer(HTTPServerBase):
             "engineVersion": self.engine_version,
             "engineVariant": self.engine_variant,
             "requestCount": request_count,
-            "avgServingSec": avg_serving_sec,
+            "avgServingSec": lat["avg"],
             "lastServingSec": last_serving_sec,
+            "p50ServingSec": lat["p50"],
+            "p95ServingSec": lat["p95"],
+            "p99ServingSec": lat["p99"],
             "startTime": self.start_time,
         }
         if batcher is not None:
@@ -491,9 +539,9 @@ class EngineServer(HTTPServerBase):
         with self._lock:
             instance_id = self.instance_id
             request_count = self.request_count
-            avg_serving_sec = self.avg_serving_sec
             last_serving_sec = self.last_serving_sec
             ep = self.engine_params
+        lat = self.latency_stats()
         rec = self.ctx.storage.get_metadata().engine_instance_get(
             instance_id
         )
@@ -514,8 +562,11 @@ class EngineServer(HTTPServerBase):
         server_rows = [
             row("Start Time", started),
             row("Request Count", request_count),
-            row("Average Serving Time", f"{avg_serving_sec:.4f} s"),
+            row("Average Serving Time", f"{lat['avg']:.4f} s"),
             row("Last Serving Time", f"{last_serving_sec:.4f} s"),
+            row("Serving Time p50 / p95 / p99",
+                f"{lat['p50']:.4f} / {lat['p95']:.4f} / "
+                f"{lat['p99']:.4f} s"),
         ]
         comp_rows = [
             row(f"Data Source [{ep.data_source[0] or 'default'}]",
@@ -569,10 +620,19 @@ class EngineServer(HTTPServerBase):
         self.config.port = v
 
     def _make_handler(server: "EngineServer"):
+        # labeled counter children resolved ONCE: .labels() is a dict
+        # build + lock per call (~1.5 us), too hot for per-request use
+        m_ok = QUERIES_TOTAL.labels(status="ok")
+        m_bad = QUERIES_TOTAL.labels(status="bad_request")
+        m_timeout = QUERIES_TOTAL.labels(status="timeout")
+        m_err = QUERIES_TOTAL.labels(status="error")
+
         class Handler(JsonRequestHandler):
             server_logger = logger
 
             def do_GET(self):
+                if self._serve_metrics():
+                    return
                 if self.path == "/" or self.path.startswith("/?"):
                     # browsers get the HTML status page, everyone else the
                     # JSON document (reference served Twirl HTML here)
@@ -599,53 +659,71 @@ class EngineServer(HTTPServerBase):
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n) if n else b"{}"
                 if self.path.startswith("/queries.json"):
-                    try:
-                        query_json = json.loads(raw.decode() or "{}")
-                    except json.JSONDecodeError as e:
-                        self._reply(400, {"message": f"invalid JSON: {e}"})
-                        return
-                    # optional per-request budget: /queries.json?timeout=0.5
-                    timeout_s = None
-                    tv = urllib.parse.parse_qs(
-                        urllib.parse.urlparse(self.path).query
-                    ).get("timeout")
-                    if tv:
-                        try:
-                            timeout_s = float(tv[0])
-                        except ValueError:
-                            self._reply(
-                                400, {"message": f"bad timeout: {tv[0]!r}"}
-                            )
-                            return
-                    try:
-                        self._reply(200, server.predict_json(
-                            query_json, timeout_s=timeout_s))
-                    except DeadlineExceeded as e:
-                        # structured overload answer, not a hang: the
-                        # client can back off and retry
-                        self.extra_headers = [("Retry-After", "1")]
-                        self._reply(503, {
-                            "message": str(e),
-                            "error": "DeadlineExceeded",
-                        })
-                    except (KeyError, ValueError, TypeError) as e:
-                        self._reply(400, {"message": f"bad query: {e}"})
-                        server.remote_log(
-                            f"Query {raw.decode(errors='replace')} "
-                            f"is invalid: {e}"
-                        )
-                    except Exception as e:
-                        logger.exception("query failed")
-                        self._reply(500, {"message": str(e)})
-                        server.remote_log(
-                            f"Query {raw.decode(errors='replace')} "
-                            f"failed: {e}"
-                        )
+                    # trace propagation: honor the client's X-PIO-Trace
+                    # or mint one; either way the id is bound to this
+                    # thread (spans inherit it, feedback delivery
+                    # forwards it) and echoed on the response.
+                    # extra_headers is (re)assigned per request — a
+                    # keep-alive connection reuses this handler.
+                    tid = self._trace_id() or new_trace_id()
+                    self.extra_headers = [(TRACE_HEADER, tid)]
+                    with trace_scope(tid):
+                        self._post_query(raw)
                 elif self.path.startswith("/stop"):
                     self._reply(200, {"message": "stopping"})
                     threading.Thread(target=server.stop, daemon=True).start()
                 else:
                     self._reply(404, {"message": "not found"})
+
+            def _post_query(self, raw: bytes) -> None:
+                try:
+                    query_json = json.loads(raw.decode() or "{}")
+                except json.JSONDecodeError as e:
+                    m_bad.inc()
+                    self._reply(400, {"message": f"invalid JSON: {e}"})
+                    return
+                # optional per-request budget: /queries.json?timeout=0.5
+                timeout_s = None
+                tv = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                ).get("timeout")
+                if tv:
+                    try:
+                        timeout_s = float(tv[0])
+                    except ValueError:
+                        m_bad.inc()
+                        self._reply(
+                            400, {"message": f"bad timeout: {tv[0]!r}"}
+                        )
+                        return
+                try:
+                    self._reply(200, server.predict_json(
+                        query_json, timeout_s=timeout_s))
+                    m_ok.inc()
+                except DeadlineExceeded as e:
+                    # structured overload answer, not a hang: the
+                    # client can back off and retry
+                    m_timeout.inc()
+                    self.extra_headers.append(("Retry-After", "1"))
+                    self._reply(503, {
+                        "message": str(e),
+                        "error": "DeadlineExceeded",
+                    })
+                except (KeyError, ValueError, TypeError) as e:
+                    m_bad.inc()
+                    self._reply(400, {"message": f"bad query: {e}"})
+                    server.remote_log(
+                        f"Query {raw.decode(errors='replace')} "
+                        f"is invalid: {e}"
+                    )
+                except Exception as e:
+                    m_err.inc()
+                    logger.exception("query failed")
+                    self._reply(500, {"message": str(e)})
+                    server.remote_log(
+                        f"Query {raw.decode(errors='replace')} "
+                        f"failed: {e}"
+                    )
 
         return Handler
 

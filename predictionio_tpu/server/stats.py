@@ -14,6 +14,8 @@ from collections import Counter
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..obs import EVENTS_TOTAL, RESILIENCE_TOTAL
+
 __all__ = ["Stats", "StatsCollector", "KindedEvent"]
 
 
@@ -106,11 +108,18 @@ class StatsCollector:
             self._roll()
             self.lifetime.update(app_id, status, kinded)
             self.current.update(app_id, status, kinded)
+        # mirror into the process-wide registry (pio-obs): same counts,
+        # scrape-able as pio_events_requests_total{status=...} without
+        # the /stats.json auth round-trip.  Status alone keeps the
+        # label cardinality bounded; per-app drill-down stays in
+        # /stats.json where it always lived.
+        EVENTS_TOTAL.labels(status=str(status)).inc()
 
     def note(self, counter: str, n: int = 1) -> None:
         """Bump a named resilience counter (e.g. ``storage.write.retry``)."""
         with self._lock:
             self.resilience[counter] += n
+        RESILIENCE_TOTAL.labels(kind=counter).inc(n)
 
     def to_json(self, app_id: Optional[int] = None) -> dict:
         with self._lock:

@@ -44,11 +44,11 @@ def profile_trace(tag: str = "trace", enabled: Optional[bool] = None):
     import jax
 
     out = profile_dir(tag)
-    t0 = time.time()
+    t0 = time.perf_counter()
     with jax.profiler.trace(str(out)):
         yield out
     logger.info("profile '%s' captured in %.2fs -> %s",
-                tag, time.time() - t0, out)
+                tag, time.perf_counter() - t0, out)
 
 
 def profiled(tag: Optional[str] = None):

@@ -16,6 +16,7 @@ from ..controller.base import WorkflowContext
 from ..controller.engine import Engine, EngineParams
 from ..controller.evaluation import Evaluation, MetricEvaluatorResult
 from ..controller.fast_eval import FastEvalEngine
+from ..obs import phase_span
 from ..storage.event import format_time, now_utc
 from ..storage.metadata import EvaluationInstance
 from .params import WorkflowParams
@@ -96,9 +97,12 @@ def run_evaluation(
                 engine, evaluation.metric, evaluation.metrics,
                 evaluation.output_path,
             )
-        result = evaluation.run(
-            ctx, engine_params_list, wp, parallelism=parallelism
-        )
+        with phase_span("eval.run", attrs={
+            "instance": eval_id, "candidates": len(engine_params_list),
+        }):
+            result = evaluation.run(
+                ctx, engine_params_list, wp, parallelism=parallelism
+            )
         rec.status = "EVALCOMPLETED"
         rec.end_time = format_time(now_utc())
         rec.evaluator_results = result.to_one_liner()

@@ -19,6 +19,7 @@ from typing import Any, Optional
 from ..controller.base import TrainingInterrupted, WorkflowContext
 from ..controller.engine import Engine, EngineParams
 from ..controller.params import params_to_json
+from ..obs import phase_span
 from ..storage.event import format_time, now_utc
 from ..storage.metadata import EngineInstance
 from .model_io import NotPersisted, load_models, save_models
@@ -145,12 +146,15 @@ def run_train(
             md.engine_instance_update(ei)
         # keep the trained instances: persistence hooks may rely on state
         # the algorithm built during train
-        algos, models = engine.train_components(ctx, engine_params, wp)
+        with phase_span("train.run", attrs={"instance": instance_id}):
+            algos, models = engine.train_components(ctx, engine_params, wp)
         if wp.save_model:
             names = [n for n, _ in engine_params.algorithms]
-            save_models(
-                ctx, instance_id, list(zip(names, algos, models))
-            )
+            with phase_span("train.save_models",
+                            attrs={"instance": instance_id}):
+                save_models(
+                    ctx, instance_id, list(zip(names, algos, models))
+                )
         ei.status = "COMPLETED"
         ei.end_time = format_time(now_utc())
         if chief:

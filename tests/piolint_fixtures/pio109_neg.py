@@ -1,0 +1,30 @@
+"""Monotonic durations and legitimate wall-clock timestamps: quiet."""
+import time
+
+
+def measure(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def measure_monotonic(fn):
+    t0 = time.monotonic()
+    fn()
+    return time.monotonic() - t0
+
+
+def cutoff_timestamp(age_s):
+    # deriving a past TIMESTAMP from the wall clock is correct use
+    return time.time() - age_s
+
+
+def deadline_poll(budget_s):
+    deadline = time.time() + budget_s
+    while time.time() < deadline:
+        break
+    return deadline
+
+
+def start_stamp():
+    return time.time()  # a timestamp, not a duration

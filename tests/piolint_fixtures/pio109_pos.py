@@ -1,0 +1,22 @@
+"""Wall-clock t0/dt subtraction -> PIO109 (package scope)."""
+import time
+from time import time as now
+
+
+def measure(fn):
+    t0 = time.time()
+    fn()
+    return time.time() - t0  # EXPECT: PIO109
+
+
+def measure_two_stamps(fn):
+    t0 = time.time()
+    fn()
+    t1 = time.time()
+    return t1 - t0  # EXPECT: PIO109
+
+
+def measure_from_import(fn):
+    t0 = now()
+    fn()
+    return now() - t0  # EXPECT: PIO109

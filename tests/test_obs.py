@@ -1,0 +1,524 @@
+"""pio-obs (`predictionio_tpu/obs/`) — the observability layer the
+whole stack reports into:
+
+* registry concurrency: counters/histograms hammered from >= 8 threads
+  must land EXACT totals (sharded locks are an optimization, never a
+  correctness trade);
+* Prometheus exposition: golden-file text for a fixed registry, plus a
+  line-level parse of the live exposition;
+* trace propagation: an ``X-PIO-Trace`` id survives the full
+  serving -> feedback DeliveryQueue -> event-server round trip and is
+  carried by spans recorded at both hops;
+* chaos: the ``pio_breaker_state`` gauge flips open under an injected
+  delivery fault plan and closes again after recovery.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu import obs
+from predictionio_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+)
+
+UTC = dt.timezone.utc
+
+
+# -- registry: concurrency ---------------------------------------------------
+
+
+def _hammer(n_threads, fn):
+    errs = []
+
+    def worker(tid):
+        try:
+            fn(tid)
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(k,))
+          for k in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+
+
+def test_counter_concurrent_exact_total():
+    c = Counter()
+    per_thread = 10_000
+    _hammer(8, lambda tid: [c.inc() for _ in range(per_thread)])
+    assert c.value() == 8 * per_thread
+
+
+def test_counter_weighted_and_negative_rejected():
+    c = Counter()
+    c.inc(2.5)
+    assert c.value() == 2.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_histogram_concurrent_exact_count_and_buckets():
+    h = Histogram(buckets=(0.001, 0.01, 0.1, 1.0))
+    per_thread = 5_000
+    # each thread observes a fixed value landing in a known bucket
+    values = [0.0005, 0.005, 0.05, 0.5, 5.0, 0.0005, 0.005, 0.05]
+    _hammer(
+        8,
+        lambda tid: [h.observe(values[tid]) for _ in range(per_thread)],
+    )
+    snap = h.snapshot()
+    assert snap["count"] == 8 * per_thread
+    # buckets: 0.0005 x2 threads, 0.005 x2, 0.05 x2, 0.5 x1, +Inf x1
+    assert snap["counts"] == [2 * per_thread, 2 * per_thread,
+                              2 * per_thread, per_thread, per_thread]
+    assert snap["sum"] == pytest.approx(
+        per_thread * (0.0005 * 2 + 0.005 * 2 + 0.05 * 2 + 0.5 + 5.0)
+    )
+
+
+def test_gauge_set_inc_and_callback():
+    g = Gauge()
+    g.set(3)
+    g.inc()
+    g.dec(0.5)
+    assert g.value() == pytest.approx(3.5)
+    g.set_function(lambda: 42.0)
+    assert g.value() == 42.0
+    g.set_function(None)
+    assert g.value() == pytest.approx(3.5)
+    g.set_function(lambda: 1 / 0)  # broken callback must not raise
+    assert np.isnan(g.value())
+
+
+# -- registry: percentiles ---------------------------------------------------
+
+
+def test_histogram_percentiles_close_to_exact():
+    h = Histogram()  # default serving-latency buckets, 8/decade
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=np.log(3e-4), sigma=0.6, size=20_000)
+    for v in samples:
+        h.observe(float(v))
+    for q in (50, 95, 99):
+        exact = float(np.percentile(samples, q))
+        est = h.percentile(q)
+        # 8 buckets/decade => ~33% bucket width; interpolation should
+        # land well inside it
+        assert abs(est - exact) / exact < 0.12, (q, est, exact)
+
+
+def test_histogram_empty_and_overflow():
+    h = Histogram(buckets=(0.1, 1.0))
+    assert np.isnan(h.percentile(50))
+    h.observe(50.0)  # lands in +Inf
+    assert h.percentile(50) == 1.0  # capped at the last finite bound
+    assert h.snapshot()["counts"] == [0, 0, 1]
+
+
+def test_log_buckets_shape():
+    b = log_buckets(1e-3, 1.0, per_decade=2)
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] >= 1.0
+    assert all(x < y for x, y in zip(b, b[1:]))
+    with pytest.raises(ValueError):
+        log_buckets(0, 1)
+
+
+# -- registry: families + exposition ----------------------------------------
+
+
+def test_family_registration_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "help", labels=("k",))
+    b = reg.counter("x_total", "other help", labels=("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("other",))
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+    with pytest.raises(ValueError):
+        a.labels(wrong="v")
+    with pytest.raises(ValueError):
+        a.child()  # labeled family has no unlabeled child
+
+
+GOLDEN_EXPOSITION = """\
+# HELP demo_latency_seconds how long
+# TYPE demo_latency_seconds histogram
+demo_latency_seconds_bucket{le="0.25"} 1
+demo_latency_seconds_bucket{le="0.5"} 2
+demo_latency_seconds_bucket{le="+Inf"} 3
+demo_latency_seconds_sum 2.5
+demo_latency_seconds_count 3
+# HELP demo_requests_total requests served
+# TYPE demo_requests_total counter
+demo_requests_total{status="200"} 2
+demo_requests_total{status="500"} 1
+# HELP demo_up is it on
+# TYPE demo_up gauge
+demo_up 1
+"""
+
+
+def test_prometheus_exposition_golden():
+    """Byte-exact golden rendering of a fixed registry: the exposition
+    format is a wire contract, not a pretty-printer.  Values are dyadic
+    so float accumulation is exact."""
+    reg = MetricsRegistry()
+    c = reg.counter("demo_requests_total", "requests served",
+                    labels=("status",))
+    c.labels(status="200").inc(2)
+    c.labels(status="500").inc()
+    reg.gauge("demo_up", "is it on").child().set(1)
+    h = reg.histogram("demo_latency_seconds", "how long",
+                      buckets=(0.25, 0.5))
+    for v in (0.125, 0.375, 2.0):
+        h.child().observe(v)
+    assert reg.render_prometheus() == GOLDEN_EXPOSITION
+
+
+def test_live_exposition_parses():
+    """Every line of the process-wide registry's exposition must be a
+    comment or a valid sample (the obs_smoke parser enforces the same
+    grammar over HTTP)."""
+    import re
+
+    obs.QUERIES_TOTAL.labels(status="ok").inc()
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$"
+    )
+    for line in obs.render_prometheus().splitlines():
+        assert line.startswith("#") or sample.match(line), line
+
+
+# -- tracer ------------------------------------------------------------------
+
+
+def test_trace_scope_nesting_and_span_attrs():
+    t = obs.Tracer(capacity=16)
+    assert obs.current_trace_id() is None
+    with obs.trace_scope("t-outer"):
+        assert obs.current_trace_id() == "t-outer"
+        with obs.trace_scope(None):  # None keeps the outer id
+            assert obs.current_trace_id() == "t-outer"
+        with obs.trace_scope("t-inner"):
+            with t.span("work", {"k": "v"}):
+                time.sleep(0.001)
+        assert obs.current_trace_id() == "t-outer"
+    assert obs.current_trace_id() is None
+    (s,) = t.spans(name="work")
+    assert s.trace_id == "t-inner"
+    assert s.attrs == {"k": "v"}
+    assert s.duration_s >= 0.001
+
+
+def test_span_records_on_exception():
+    t = obs.Tracer(capacity=16)
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("x")
+    (s,) = t.spans(name="boom")
+    assert s.attrs["error"] == "RuntimeError"
+
+
+def test_ring_bounded():
+    t = obs.Tracer(capacity=8)
+    for k in range(50):
+        t.record("s", 0.0, attrs={"k": k})
+    spans = t.spans()
+    assert len(spans) == 8
+    assert spans[-1].attrs == {"k": 49}
+
+
+def test_journal_jsonl(tmp_path):
+    t = obs.Tracer(capacity=8, journal_dir=tmp_path)
+    with obs.trace_scope("t-j"):
+        t.record("jour", 0.5, attrs={"a": 1})
+    t.close()
+    path = t.journal_path()
+    lines = path.read_text().splitlines()
+    rec = json.loads(lines[-1])
+    assert rec["name"] == "jour"
+    assert rec["traceId"] == "t-j"
+    assert rec["durationSec"] == 0.5
+    assert rec["attrs"] == {"a": 1}
+
+
+# -- end-to-end: servers -----------------------------------------------------
+
+VARIANT = {
+    "datasource": {"params": {"appName": "obsapp"}},
+    "algorithms": [
+        {"name": "als",
+         "params": {"rank": 4, "numIterations": 2, "lambda": 0.1}}
+    ],
+}
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, dict(r.headers), json.loads(r.read().decode())
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture()
+def stack(storage_memory):
+    """Trained engine + event server + serving server with the
+    feedback loop wired (the two-hop path trace propagation crosses)."""
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.server import EngineServer, ServerConfig
+    from predictionio_tpu.server.event_server import (
+        EventServer, EventServerConfig,
+    )
+    from predictionio_tpu.storage import AccessKey, DataMap, Event
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import run_train
+
+    md = storage_memory.get_metadata()
+    app = md.app_insert("obsapp")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    rng = np.random.default_rng(5)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{u}",
+              target_entity_type="item", target_entity_id=f"i{i}",
+              properties=DataMap({"rating": float(rng.integers(1, 6))}),
+              event_time=dt.datetime(2020, 1, 1, tzinfo=UTC))
+        for u in range(6) for i in rng.choice(8, size=4, replace=False)
+    ]
+    es.insert_batch(evs, app_id=app.id)
+    ctx = WorkflowContext(storage=storage_memory)
+    engine = recommendation_engine()
+    ep = engine.params_from_variant(VARIANT)
+    iid = run_train(engine, ep, ctx=ctx, engine_variant="obs.json")
+
+    ev = EventServer(storage_memory, EventServerConfig(port=0))
+    ev.start_background()
+    srv = EngineServer(
+        engine, ep, iid, ctx=ctx,
+        config=ServerConfig(
+            port=0, microbatch="off", feedback=True,
+            event_server_url=f"http://127.0.0.1:{ev.config.port}",
+            access_key=key,
+        ),
+        engine_variant="obs.json",
+    )
+    srv.start_background()
+    yield srv, ev, key
+    srv.stop()
+    ev.stop()
+
+
+def test_trace_propagation_serving_to_eventserver(stack):
+    """A query with X-PIO-Trace: t-... yields spans carrying that id at
+    BOTH hops: serve.query (serving) and events.write (event server,
+    reached asynchronously through the feedback DeliveryQueue)."""
+    srv, ev, key = stack
+    tid = obs.new_trace_id()
+    code, headers, _ = _post(
+        f"http://127.0.0.1:{srv.config.port}/queries.json",
+        {"user": "u1", "num": 2},
+        headers={obs.TRACE_HEADER: tid},
+    )
+    assert code == 200
+    assert headers.get(obs.TRACE_HEADER) == tid
+    assert srv._feedback_queue.flush(15.0), "feedback never delivered"
+    tracer = obs.get_tracer()
+    assert tracer.spans(trace_id=tid, name="serve.query")
+    assert tracer.spans(trace_id=tid, name="events.write")
+
+
+def test_metrics_endpoint_serving_and_eventserver(stack):
+    srv, ev, _ = stack
+    _post(f"http://127.0.0.1:{srv.config.port}/queries.json",
+          {"user": "u2", "num": 2})
+    for port in (srv.config.port, ev.config.port):
+        code, text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200
+        assert "# TYPE pio_query_latency_seconds histogram" in text
+        assert "# TYPE pio_breaker_state gauge" in text
+    # the serving process served >= 1 query: the bucket ladder is live
+    code, text = _get(f"http://127.0.0.1:{srv.config.port}/metrics")
+    assert 'pio_query_latency_seconds_bucket{le="+Inf"}' in text
+
+
+def test_status_json_histogram_percentiles(stack):
+    srv, _, _ = stack
+    base = f"http://127.0.0.1:{srv.config.port}"
+    for k in range(5):
+        _post(f"{base}/queries.json", {"user": f"u{k % 6}", "num": 2})
+    _, text = _get(f"{base}/")
+    body = json.loads(text)
+    assert body["requestCount"] >= 5
+    assert body["avgServingSec"] > 0
+    p50, p95, p99 = (body["p50ServingSec"], body["p95ServingSec"],
+                     body["p99ServingSec"])
+    assert 0 < p50 <= p95 <= p99
+    # percentile contract vs the server's own histogram object
+    assert p50 == pytest.approx(srv._latency.percentile(50))
+
+
+def test_no_metrics_flag_404s_endpoint(stack):
+    srv, _, _ = stack
+    base = f"http://127.0.0.1:{srv.config.port}"
+    try:
+        obs.set_metrics_enabled(False)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"{base}/metrics")
+        assert exc.value.code == 404
+        exc.value.read()
+    finally:
+        obs.set_metrics_enabled(True)
+    code, _ = _get(f"{base}/metrics")
+    assert code == 200
+
+
+def test_admin_and_dashboard_expose_metrics(storage_memory):
+    from predictionio_tpu.server.admin import AdminServer
+    from predictionio_tpu.server.dashboard import DashboardServer
+
+    admin = AdminServer(storage_memory, port=0)
+    admin.start_background()
+    dash = DashboardServer(storage_memory, port=0)
+    dash.start_background()
+    try:
+        for port in (admin.port, dash.port):
+            code, text = _get(f"http://127.0.0.1:{port}/metrics")
+            assert code == 200
+            assert "# TYPE pio_query_latency_seconds histogram" in text
+        # the dashboard's operator page renders next to the eval index
+        code, html = _get(f"http://127.0.0.1:{dash.port}/metrics.html")
+        assert code == 200
+        assert "pio_query_latency_seconds" in html
+        code, html = _get(f"http://127.0.0.1:{dash.port}/")
+        assert "metrics.html" in html
+    finally:
+        admin.stop()
+        dash.stop()
+
+
+@pytest.mark.chaos
+def test_breaker_state_gauge_flips_under_fault(stack):
+    """Chaos contract: an injected http.feedback fault plan opens the
+    feedback breaker and pio_breaker_state{queue="feedback"} reads 2
+    (open); after the plan disarms and delivery recovers it reads 0."""
+    from predictionio_tpu.resilience import faults
+
+    srv, _, _ = stack
+    base = f"http://127.0.0.1:{srv.config.port}"
+    gauge = obs.BREAKER_STATE.labels(queue="feedback")
+    assert gauge.value() == 0.0
+    # tighten the breaker so the fault trips it fast
+    srv._feedback_queue.breaker.failure_threshold = 2
+    srv._feedback_queue.breaker.reset_timeout_s = 0.05
+    srv._feedback_queue.retry.base_s = 0.01
+    srv._feedback_queue.retry.cap_s = 0.02
+    faults.arm("http.feedback:nth=1,times=4", seed=11)
+    try:
+        _post(f"{base}/queries.json", {"user": "u1", "num": 2})
+        deadline = time.monotonic() + 10.0
+        while gauge.value() != 2.0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gauge.value() == 2.0, "breaker gauge never opened"
+        # the same flip must be visible on the wire
+        _, text = _get(f"{base}/metrics")
+        assert 'pio_breaker_state{queue="feedback"} 2' in text
+    finally:
+        faults.disarm()
+    assert srv._feedback_queue.flush(15.0)
+    deadline = time.monotonic() + 10.0
+    while gauge.value() != 0.0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert gauge.value() == 0.0, "breaker gauge never closed again"
+
+
+# -- delivery-queue + stats registry mirrors ---------------------------------
+
+
+def test_delivery_outcome_counters_mirrored():
+    from predictionio_tpu.resilience.delivery import DeliveryQueue
+
+    q = DeliveryQueue("obs-test-q", capacity=2)
+    sub = obs.DELIVERY_TOTAL.labels(queue="obs-test-q",
+                                    outcome="submitted")
+    drop = obs.DELIVERY_TOTAL.labels(queue="obs-test-q",
+                                     outcome="dropped")
+    before_sub, before_drop = sub.value(), drop.value()
+    q.close()  # closed queue: submit counts a drop
+    q.submit("http://127.0.0.1:9/x", {"a": 1})
+    assert sub.value() == before_sub
+    assert drop.value() == before_drop + 1
+
+
+def test_stats_collector_mirrors_to_registry(storage_memory):
+    from predictionio_tpu.server.stats import StatsCollector
+
+    sc = StatsCollector()
+    fam = obs.EVENTS_TOTAL.labels(status="201")
+    retry = obs.RESILIENCE_TOTAL.labels(kind="storage.write.retry")
+    before, before_r = fam.value(), retry.value()
+    sc.bookkeeping(1, 201)
+    sc.note("storage.write.retry", 3)
+    assert fam.value() == before + 1
+    assert retry.value() == before_r + 3
+    # the legacy /stats.json view is unchanged
+    j = sc.to_json()
+    assert j["resilience"]["storage.write.retry"] == 3
+
+
+# -- CLI flags ---------------------------------------------------------------
+
+
+def test_cli_obs_flags_parse_and_configure(tmp_path, monkeypatch):
+    from predictionio_tpu.cli.main import _apply_obs_flags, build_parser
+
+    p = build_parser()
+    args = p.parse_args([
+        "deploy", "--no-metrics", "--telemetry-dir", str(tmp_path),
+    ])
+    assert args.no_metrics is True
+    assert args.telemetry_dir == str(tmp_path)
+    try:
+        _apply_obs_flags(args)
+        assert obs.metrics_enabled() is False
+        assert obs.get_tracer().journal_path().parent == tmp_path
+    finally:
+        obs.set_metrics_enabled(True)
+        obs.get_tracer().configure(None)
+    # every server/workflow command takes the flags
+    for cmd in ("train", "eval", "eventserver", "adminserver",
+                "dashboard"):
+        extra = (["predictionio_tpu.workflow.fake.fake_evaluation"]
+                 if cmd == "eval" else [])
+        a = p.parse_args([cmd, *extra, "--no-metrics"])
+        assert a.no_metrics is True

@@ -36,6 +36,7 @@ from predictionio_tpu.analysis.cli import (
 )
 from predictionio_tpu.analysis.jaxlint import JaxEngine
 from predictionio_tpu.analysis.locklint import LockEngine
+from predictionio_tpu.analysis.timelint import TimeEngine
 
 FIXTURES = Path(__file__).parent / "piolint_fixtures"
 EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(PIO\d+)")
@@ -47,10 +48,13 @@ FIXTURE_RULES = sorted(set(RULES) - {"PIO100"})
 
 
 def run_fixture(path: Path):
-    """Both engines, bench scope forced on (so PIO108 fixtures work
-    without living in a bench*.py path)."""
+    """All three engines, bench + package scopes forced on (so the
+    PIO108 and PIO109 fixtures work without living at their real
+    scope paths)."""
     src = SourceFile.load(path, path.parent)
-    return JaxEngine(src, bench_scope=True).run() + LockEngine(src).run()
+    return (JaxEngine(src, bench_scope=True).run()
+            + LockEngine(src).run()
+            + TimeEngine(src).run())
 
 
 def expected_findings(path: Path) -> set[tuple[str, int]]:

@@ -43,6 +43,9 @@ else
   echo "  ruff not installed; skipping generic lint" >&2
 fi
 
-# 3) the full test suite
+# 3) the full test suite — includes the end-to-end smokes that boot
+# real servers: tools/chaos_smoke.py (via tests/test_chaos_smoke.py)
+# and tools/obs_smoke.py (via tests/test_obs_smoke.py: /metrics
+# exposition + trace propagation)
 echo "gate [3/3] pytest" >&2
 exec python -m pytest tests/ -q "$@"

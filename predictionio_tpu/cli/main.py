@@ -97,27 +97,41 @@ def _out(msg: str) -> None:
 
 
 def _apply_obs_flags(args) -> None:
-    """Wire the pio-obs knobs shared by the server/workflow commands:
-    ``--telemetry-dir`` (span JSONL journal location) and
-    ``--no-metrics`` (404 the /metrics exposition)."""
-    from ..obs import configure
+    """Wire the pio-obs/pio-xray knobs shared by the server/workflow
+    commands: ``--telemetry-dir`` (span JSONL journal location),
+    ``--no-metrics`` (404 the /metrics + /debug/xray mounts),
+    ``--xray-sample-s`` (device sampler cadence) and
+    ``--flight-capacity`` (slow-query flight recorder depth)."""
+    from ..obs import configure, get_flight_recorder, xray
 
     configure(
         journal_dir=getattr(args, "telemetry_dir", None),
         metrics=(False if getattr(args, "no_metrics", False) else None),
     )
+    sample_s = getattr(args, "xray_sample_s", None)
+    if sample_s is not None:
+        xray.set_sample_period(sample_s)
+    flight_n = getattr(args, "flight_capacity", None)
+    if flight_n is not None:
+        get_flight_recorder().set_capacity(flight_n)
 
 
 def _add_obs_args(p) -> None:
     p.add_argument("--telemetry-dir", metavar="DIR",
                    help="journal pio-obs spans as JSON lines to "
-                   "DIR/spans-<pid>.jsonl (default: in-memory ring "
-                   "only; PIO_TPU_TELEMETRY=1 journals under "
+                   "DIR/spans-<pid>.jsonl (size-capped rotated "
+                   "segments; default: in-memory ring only; "
+                   "PIO_TPU_TELEMETRY=1 journals under "
                    "$PIO_TPU_HOME/telemetry)")
     p.add_argument("--no-metrics", action="store_true",
                    help="disable the GET /metrics Prometheus "
-                   "exposition (recording still happens; only the "
-                   "endpoint answers 404)")
+                   "exposition and GET /debug/xray (recording still "
+                   "happens; only the endpoints answer 404)")
+    p.add_argument("--xray-sample-s", type=float, default=None,
+                   metavar="SEC",
+                   help="pio-xray device-memory sampler period "
+                   "(default: $PIO_TPU_XRAY_SAMPLE_S or 10; <= 0 "
+                   "disables the sampler)")
 
 
 # --------------------------------------------------------------------------
@@ -841,6 +855,11 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SEC",
                    help="seconds an open breaker waits before letting "
                    "one probe through")
+    d.add_argument("--flight-capacity", type=int, default=None,
+                   metavar="N",
+                   help="slow-query flight recorder keeps the N "
+                   "slowest requests' full span trees (default: "
+                   "$PIO_TPU_XRAY_FLIGHT_N or 16; see /debug/xray)")
 
     e = sub.add_parser("eval", help="run an evaluation sweep")
     _add_obs_args(e)

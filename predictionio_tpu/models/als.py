@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import xray
 from ..parallel.mesh import DATA_AXIS, fence, pad_to_multiple, replicated
 from ..storage.columnar import Ratings
 
@@ -413,6 +414,7 @@ def _plan_shard_layout(
     return perm, [ls.astype(np.int32) for ls in local_starts], max(L, 1)
 
 
+@xray.instrument("als.expand_sides")
 @jax.jit
 def _device_expand_sides(col_by_row, val_by_row, row_counts, val_scale):
     """Both sides' row-grouped ``(c_sorted, v_sorted)`` from a COO the
@@ -508,17 +510,23 @@ def _half_iteration_impl(
 
 
 # jitted entry point; the impl stays reachable for vmapped λ sweeps
-# (sweep_train_als), where the batching transform must see the raw fn
-_half_iteration = functools.partial(
-    jax.jit,
-    static_argnames=(
-        "ks", "implicit", "weighted_lambda", "precision", "solver",
-        "gather_dtype", "gather_mode", "solver_mode", "subspace_size",
-    ),
-    donate_argnums=(0,),
-)(_half_iteration_impl)
+# (sweep_train_als), where the batching transform must see the raw fn.
+# xray.instrument feeds the recompile detector: a λ sweep reuses the
+# executable (traced scalar -> same signature) while a bucket-layout or
+# rank change shows up as a signature delta on /debug/xray.
+_half_iteration = xray.instrument("als.half_iteration")(
+    functools.partial(
+        jax.jit,
+        static_argnames=(
+            "ks", "implicit", "weighted_lambda", "precision", "solver",
+            "gather_dtype", "gather_mode", "solver_mode", "subspace_size",
+        ),
+        donate_argnums=(0,),
+    )(_half_iteration_impl)
+)
 
 
+@xray.instrument("als.phase_probe")
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -984,7 +992,9 @@ def build_sharded_half(
     mapped = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=sharded2,
     )
-    return jax.jit(mapped, donate_argnums=(0,))
+    return xray.instrument("als.sharded_half")(
+        jax.jit(mapped, donate_argnums=(0,))
+    )
 
 
 def _resolve_solver(cfg: ALSConfig) -> str:
@@ -1797,8 +1807,8 @@ def sweep_train_als(
                 side["buckets"], lam, alpha, ks=side["ks"], **common,
             )
 
-        return jax.jit(
-            jax.vmap(one, in_axes=(0, 0, 0)), donate_argnums=(0,)
+        return xray.instrument("als.sweep_half")(
+            jax.jit(jax.vmap(one, in_axes=(0, 0, 0)), donate_argnums=(0,))
         )
 
     half_u = make_half(side_u)
@@ -1822,6 +1832,7 @@ def sweep_train_als(
 # --------------------------------------------------------------------------
 
 
+@xray.instrument("als.sq_err_sum")
 @jax.jit
 def _sq_err_sum(U, V, u, i, v):
     pred = jnp.sum(U[u] * V[i], axis=-1)

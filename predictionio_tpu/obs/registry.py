@@ -33,6 +33,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Callable, Iterable, Optional, Sequence
 
 __all__ = [
@@ -173,6 +174,14 @@ class Histogram:
     shard's lock; percentiles interpolate linearly inside the target
     bucket (the same estimate ``histogram_quantile`` makes), so the
     numbers on ``/status`` and in Grafana agree by construction.
+
+    *Exemplars* (pio-xray): ``observe(v, exemplar="t-...")`` remembers
+    the most recent exemplar string (a trace id) per bucket, so a slow
+    bucket on ``/metrics`` points at a concrete request whose span tree
+    the flight recorder / journal holds.  Stored outside the shards
+    (one small dict under its own lock — only callers that pass an
+    exemplar pay for it) and rendered as ``# EXEMPLAR`` comment lines,
+    which every 0.0.4 text parser ignores by definition.
     """
 
     kind = "histogram"
@@ -188,14 +197,37 @@ class Histogram:
         self._shards = tuple(
             _Shard(n_buckets=len(bounds)) for _ in range(_N_SHARDS)
         )
+        self._ex_lock = threading.Lock()
+        # bucket index -> (exemplar, observed value, wall timestamp)
+        self._exemplars: dict[int, tuple] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         i = bisect.bisect_left(self.bounds, v)
         shard = self._shards[_shard_index()]
         with shard.lock:
             shard.counts[i] += 1
             shard.total += v
             shard.n += 1
+        if exemplar is not None:
+            # last-exemplar-wins per bucket (the standard exemplar
+            # semantic); wall clock is a timestamp, not a duration
+            with self._ex_lock:
+                self._exemplars[i] = (exemplar, v, time.time())
+
+    def exemplar_items(self) -> list:
+        """``(le_label, exemplar, value, timestamp)`` per bucket that
+        has one, in bucket order."""
+        with self._ex_lock:
+            snap = dict(self._exemplars)
+        out = []
+        for i in sorted(snap):
+            le = (
+                _fmt_float(self.bounds[i]) if i < len(self.bounds)
+                else "+Inf"
+            )
+            ex, v, ts = snap[i]
+            out.append((le, ex, v, ts))
+        return out
 
     def snapshot(self) -> dict:
         """Merged view: per-bucket counts (non-cumulative), sum, count."""
@@ -325,11 +357,14 @@ class _Family:
             raise ValueError(f"{self.name} is labeled; use .labels()")
         return self.labels()
 
-    def collect(self) -> list:
+    def children(self) -> list:
+        """Sorted ``(label_items, child)`` snapshot."""
         with self._lock:
-            children = sorted(self._children.items())
+            return sorted(self._children.items())
+
+    def collect(self) -> list:
         out = []
-        for key, child in children:
+        for key, child in self.children():
             out += child.samples(self.name, key)
         return out
 
@@ -406,4 +441,30 @@ class MetricsRegistry:
                     lines.append(f"{name}{{{lbl}}} {_fmt_value(value)}")
                 else:
                     lines.append(f"{name} {_fmt_value(value)}")
+            if fam.kind == "histogram":
+                lines += _exemplar_lines(fam)
         return "\n".join(lines) + "\n"
+
+
+def _exemplar_lines(fam: _Family) -> list:
+    """``# EXEMPLAR`` comment lines for a histogram family's bucket
+    exemplars: legal-by-construction in text format 0.0.4 (parsers skip
+    comments), yet a ``grep t-xxxx /metrics-scrape`` finds the trace id
+    that a slow bucket points at — the /metrics -> journal -> flight
+    record walk is one grep."""
+    out = []
+    for label_items, child in fam.children():
+        items = getattr(child, "exemplar_items", None)
+        if items is None:
+            continue
+        base = ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in label_items
+        )
+        for le, ex, v, _ts in items():
+            lbl = (base + "," if base else "") + f'le="{le}"'
+            out.append(
+                f"# EXEMPLAR {fam.name}_bucket{{{lbl}}} "
+                f'trace_id="{_escape_label(str(ex))}" '
+                f"value={_fmt_value(v)}"
+            )
+    return out

@@ -104,11 +104,31 @@ class Span:
         }
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 class Tracer:
-    """Bounded span ring + optional JSONL journal."""
+    """Bounded span ring + optional JSONL journal.
+
+    The journal is **rotated**, not unbounded: once the active segment
+    exceeds ``max_segment_bytes`` it is renamed to
+    ``spans-<pid>.jsonl.1`` (older segments shift to ``.2`` ... and the
+    oldest beyond ``keep_segments`` is deleted), and writing continues
+    into a fresh active file.  A serving process that stays up for
+    weeks therefore holds at most ``(keep_segments + 1) *
+    max_segment_bytes`` of journal on disk instead of growing without
+    bound.  Env overrides: ``PIO_TPU_TELEMETRY_SEGMENT_BYTES`` /
+    ``PIO_TPU_TELEMETRY_KEEP``.
+    """
 
     def __init__(self, capacity: int = 4096,
-                 journal_dir: Optional[Path] = None):
+                 journal_dir: Optional[Path] = None,
+                 max_segment_bytes: Optional[int] = None,
+                 keep_segments: Optional[int] = None):
         self._lock = threading.Lock()
         self._ring: collections.deque[Span] = collections.deque(
             maxlen=capacity
@@ -116,11 +136,24 @@ class Tracer:
         self._journal_dir = Path(journal_dir) if journal_dir else None
         self._journal = None
         self._journal_failed = False
+        self._journal_bytes = 0
+        self._rotations = 0
+        self._segment_cap = (
+            max_segment_bytes if max_segment_bytes is not None
+            else _env_int("PIO_TPU_TELEMETRY_SEGMENT_BYTES", 16 << 20)
+        )
+        self._keep = (
+            keep_segments if keep_segments is not None
+            else _env_int("PIO_TPU_TELEMETRY_KEEP", 3)
+        )
         self.dropped_journal_writes = 0
 
     # -- configuration -----------------------------------------------------
-    def configure(self, journal_dir: Optional[os.PathLike | str]) -> None:
-        """(Re)point the JSONL journal; ``None`` disables it."""
+    def configure(self, journal_dir: Optional[os.PathLike | str],
+                  max_segment_bytes: Optional[int] = None,
+                  keep_segments: Optional[int] = None) -> None:
+        """(Re)point the JSONL journal; ``None`` disables it.  The
+        rotation knobs keep their current values unless given."""
         with self._lock:
             if self._journal is not None:
                 try:
@@ -129,7 +162,12 @@ class Tracer:
                     pass
             self._journal = None
             self._journal_failed = False
+            self._journal_bytes = 0
             self._journal_dir = Path(journal_dir) if journal_dir else None
+            if max_segment_bytes is not None:
+                self._segment_cap = max_segment_bytes
+            if keep_segments is not None:
+                self._keep = keep_segments
 
     def journal_path(self) -> Optional[Path]:
         with self._lock:
@@ -146,15 +184,52 @@ class Tracer:
                 self._journal_dir.mkdir(parents=True, exist_ok=True)
                 path = self._journal_dir / f"spans-{os.getpid()}.jsonl"
                 self._journal = open(path, "a", encoding="utf-8")
+                try:
+                    self._journal_bytes = path.stat().st_size
+                except OSError:
+                    self._journal_bytes = 0
             except OSError:
                 self._journal_failed = True
                 self.dropped_journal_writes += 1
                 return
         try:
-            self._journal.write(json.dumps(span.to_json()) + "\n")
+            line = json.dumps(span.to_json()) + "\n"
+            self._journal.write(line)
             self._journal.flush()
+            self._journal_bytes += len(line)
         except (OSError, ValueError):
             self.dropped_journal_writes += 1
+            return
+        if self._segment_cap and self._journal_bytes >= self._segment_cap:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Shift the segment chain and start a fresh active file.
+        Caller holds ``self._lock``.  Rotation failures disable the
+        journal (same contract as open failures) — they must never
+        raise into ``record`` on the serving path."""
+        try:
+            self._journal.close()
+        except OSError:
+            pass
+        self._journal = None
+        self._journal_bytes = 0
+        base = self._journal_dir / f"spans-{os.getpid()}.jsonl"
+        try:
+            oldest = base.with_name(base.name + f".{self._keep}")
+            if self._keep <= 0:
+                # keep-0: the capped active segment is simply discarded
+                base.unlink(missing_ok=True)
+            else:
+                oldest.unlink(missing_ok=True)
+                for k in range(self._keep - 1, 0, -1):
+                    seg = base.with_name(base.name + f".{k}")
+                    if seg.exists():
+                        seg.rename(base.with_name(base.name + f".{k + 1}"))
+                base.rename(base.with_name(base.name + ".1"))
+            self._rotations += 1
+        except OSError:
+            self._journal_failed = True
 
     # -- recording ---------------------------------------------------------
     def record(self, name: str, duration_s: float,
@@ -222,11 +297,19 @@ class Tracer:
             journaling = self._journal_dir is not None \
                 and not self._journal_failed
             dropped = self.dropped_journal_writes
+            seg_bytes = self._journal_bytes
+            seg_cap = self._segment_cap
+            keep = self._keep
+            rotations = self._rotations
         return {
             "depth": depth,
             "capacity": cap,
             "journaling": journaling,
             "droppedJournalWrites": dropped,
+            "segmentBytes": seg_bytes,
+            "segmentCapBytes": seg_cap,
+            "keepSegments": keep,
+            "rotations": rotations,
         }
 
     def close(self) -> None:

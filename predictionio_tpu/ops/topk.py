@@ -14,6 +14,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..obs import xray
+
 __all__ = ["topk_scores", "batch_topk_scores", "cosine_topk", "pow2_ceil"]
 
 
@@ -26,6 +28,10 @@ def pow2_ceil(x: int) -> int:
     return 1 << (max(int(x), 1) - 1).bit_length()
 
 
+# xray.instrument: these three are THE serving-path executables — a
+# mid-traffic recompile here (un-pow2'd k or batch) is precisely what
+# the /debug/xray recompile ring exists to catch
+@xray.instrument("topk.topk_scores")
 @functools.partial(jax.jit, static_argnames=("k",))
 def topk_scores(query_vec: jax.Array, table: jax.Array, k: int,
                 bias: jax.Array | None = None):
@@ -36,6 +42,7 @@ def topk_scores(query_vec: jax.Array, table: jax.Array, k: int,
     return jax.lax.top_k(scores, k)
 
 
+@xray.instrument("topk.batch_topk_scores")
 @functools.partial(jax.jit, static_argnames=("k",))
 def batch_topk_scores(query_vecs: jax.Array, table: jax.Array, k: int,
                       mask: jax.Array | None = None):
@@ -47,6 +54,7 @@ def batch_topk_scores(query_vecs: jax.Array, table: jax.Array, k: int,
     return jax.lax.top_k(scores, k)
 
 
+@xray.instrument("topk.cosine_topk")
 @functools.partial(jax.jit, static_argnames=("k",))
 def cosine_topk(query_vec: jax.Array, table: jax.Array, k: int):
     """Cosine similarity top-k (similarproduct template scoring)."""

@@ -73,8 +73,15 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     Training workflows recompile the same half-iteration programs every
     run; the persistent cache turns those 20-40 s TPU compiles into
     millisecond disk hits.  Default location: ``$PIO_TPU_HOME/jax_cache``.
+
+    Hit/miss/request counts surface as
+    ``pio_compile_cache_events_total{kind}`` (pio-xray hooks
+    ``jax.monitoring``), so a deploy's cold-start vs warm-start is
+    readable straight off ``/metrics``.
     """
     import os
+
+    from ..obs import xray
 
     if cache_dir is None:
         home = os.environ.get("PIO_TPU_HOME") or os.path.expanduser(
@@ -84,6 +91,9 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # the listeners must exist BEFORE the first compile books a cache
+    # event, or cold-start counts undercount
+    xray.note_compilation_cache(cache_dir)
 
 
 def _visible_devices():

@@ -56,6 +56,7 @@ class DashboardServer(HTTPServerBase):
             + "\n".join(rows)
             + "</table>"
             "<p><a href='/metrics.html'>live metrics</a> &middot; "
+            "<a href='/xray.html'>x-ray</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
         )
@@ -87,12 +88,89 @@ class DashboardServer(HTTPServerBase):
             "td{font-family:monospace;padding:2px 8px}</style></head>"
             "<body><h1>Live metrics</h1>"
             "<p>Prometheus exposition at <a href='/metrics'>/metrics"
-            "</a>.</p>"
+            "</a> &middot; compiler/device view at "
+            "<a href='/xray.html'>/xray.html</a>.</p>"
             "<table border='1'><tr><th>metric</th><th>labels</th>"
             "<th>value</th></tr>" + "\n".join(rows) + "</table>"
             "<h2>Recent spans (newest first)</h2>"
             "<table border='1'><tr><th>span</th><th>trace</th>"
             "<th>ms</th></tr>" + "\n".join(span_rows) + "</table>"
+            "</body></html>"
+        )
+
+    def xray_html(self) -> str:
+        """Operator view of the pio-xray payload: jit entry points,
+        the recompile ring (with signature deltas), device memory, and
+        the slow-query flight recorder.  Machines read /debug/xray."""
+        from ..obs.xray import xray_payload
+
+        p = xray_payload()
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        jit_rows = [
+            "<tr><td>{f}</td><td>{c}</td><td>{s}</td><td>{bc}</td>"
+            "<td>{t}</td></tr>".format(
+                f=esc(fn), c=st["calls"], s=st["signatures"],
+                bc=st["backendCompiles"],
+                t=f"{st['compileSecondsTotal']:.3f}",
+            )
+            for fn, st in sorted(p["jit"].items())
+        ]
+        rec_rows = []
+        for e in reversed(p["recompiles"]):
+            delta = e.get("delta") or {}
+            changed = "; ".join(
+                f"{c['arg']}: {c['from']} -> {c['to']}"
+                for c in delta.get("changed", [])
+            ) or "(first signature)"
+            rec_rows.append(
+                "<tr><td>{f}</td><td>{k}</td><td>{t}</td>"
+                "<td>{d}</td></tr>".format(
+                    f=esc(e["fn"]), k=esc(e["kind"]),
+                    t=esc(e.get("traceId") or "-"), d=esc(changed),
+                )
+            )
+        dev_rows = [
+            "<tr><td>{d}</td><td>{s}</td><td>{v}</td></tr>".format(
+                d=esc(s["device"]), s=esc(stat), v=f"{v:,}",
+            )
+            for s in p["devices"]["samples"]
+            for stat, v in sorted(s["stats"].items())
+        ]
+        flight_rows = [
+            "<tr><td>{t}</td><td>{ms:.2f}</td><td>{n}</td></tr>".format(
+                t=esc(w["traceId"]), ms=w["durationSec"] * 1e3,
+                n=w["spanCount"],
+            )
+            for w in p["flight"]["worst"]
+        ]
+        cache = p["compileCache"]
+        return (
+            "<html><head><title>x-ray</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace;padding:2px 8px}</style></head>"
+            "<body><h1>X-ray: compiler &amp; device</h1>"
+            "<p>JSON at <a href='/debug/xray'>/debug/xray</a>. "
+            "Compilation cache: "
+            f"<code>{esc(cache['dir'] or 'disabled')}</code> "
+            f"{esc(cache['events'] or '')}</p>"
+            "<h2>Instrumented jit entry points</h2>"
+            "<table border='1'><tr><th>fn</th><th>calls</th>"
+            "<th>signatures</th><th>backend compiles</th>"
+            "<th>compile s total</th></tr>"
+            + "\n".join(jit_rows) + "</table>"
+            "<h2>Recompile ring (newest first)</h2>"
+            "<table border='1'><tr><th>fn</th><th>kind</th>"
+            "<th>trace</th><th>signature delta</th></tr>"
+            + "\n".join(rec_rows) + "</table>"
+            "<h2>Device memory</h2>"
+            "<table border='1'><tr><th>device</th><th>stat</th>"
+            "<th>bytes</th></tr>" + "\n".join(dev_rows) + "</table>"
+            "<h2>Flight recorder (slowest requests)</h2>"
+            "<table border='1'><tr><th>trace</th><th>ms</th>"
+            "<th>spans</th></tr>" + "\n".join(flight_rows) + "</table>"
             "</body></html>"
         )
 
@@ -111,6 +189,10 @@ class DashboardServer(HTTPServerBase):
                     return
                 if path == "/metrics.html":
                     self._reply(200, server.metrics_html().encode(),
+                                "text/html")
+                    return
+                if path == "/xray.html":
+                    self._reply(200, server.xray_html().encode(),
                                 "text/html")
                     return
                 parts = [x for x in path.split("/") if x]

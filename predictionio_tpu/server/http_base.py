@@ -26,14 +26,22 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             self.server_logger.debug(fmt, *args)
 
     def _serve_metrics(self) -> bool:
-        """Answer ``GET /metrics`` from the process-wide registry.
-        Every server's ``do_GET`` tries this first, so all four HTTP
-        surfaces expose the same exposition without per-server code.
-        Returns True when the request was handled."""
-        if urllib.parse.urlparse(self.path).path != "/metrics":
+        """Answer the common observability mounts — ``GET /metrics``
+        (Prometheus exposition) and ``GET /debug/xray`` (compiler/
+        device/flight-recorder JSON, pio-xray) — from the process-wide
+        registry.  Every server's ``do_GET`` tries this first, so all
+        four HTTP surfaces expose the same pair without per-server
+        code.  Returns True when the request was handled."""
+        path = urllib.parse.urlparse(self.path).path
+        if path not in ("/metrics", "/debug/xray"):
             return False
         if not metrics_enabled():
             self._reply(404, {"message": "metrics disabled (--no-metrics)"})
+            return True
+        if path == "/debug/xray":
+            from ..obs.xray import xray_payload
+
+            self._reply(200, xray_payload())
             return True
         self._reply(200, render_prometheus().encode(),
                     ctype=PROMETHEUS_CTYPE)

@@ -40,9 +40,12 @@ from ..obs import (
     RELOADS_TOTAL,
     TRACE_HEADER,
     Histogram,
+    current_trace_id,
+    get_flight_recorder,
     get_tracer,
     new_trace_id,
     trace_scope,
+    xray,
 )
 from ..resilience import faults
 from ..resilience.delivery import DeliveryQueue
@@ -224,6 +227,11 @@ class EngineServer(HTTPServerBase):
         self._latency = Histogram()
         self._m_latency = QUERY_LATENCY.child()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # pio-xray: compile/cache events during warmup+serving book into
+        # /metrics, and the daemon device sampler keeps the per-device
+        # memory gauges fresh (registered like the breaker gauges above)
+        xray.install()
+        xray.start_sampler()
 
     # -- lifecycle --------------------------------------------------------
     def _load(self, instance_id: str) -> None:
@@ -401,10 +409,17 @@ class EngineServer(HTTPServerBase):
             self.request_count += 1
             self.last_serving_sec = dt
             instance_id = self.instance_id
-        self._latency.observe(dt)
-        self._m_latency.observe(dt)
+        # the request's trace id rides the histograms as a bucket
+        # exemplar AND keys the flight record — /metrics names a trace,
+        # the flight recorder holds its span tree, one grep joins them
+        tid = current_trace_id()
+        self._latency.observe(dt, exemplar=tid)
+        self._m_latency.observe(dt, exemplar=tid)
         get_tracer().record("serve.query", dt,
                             attrs={"instance": instance_id})
+        get_flight_recorder().offer(
+            tid, dt, name="serve.query", attrs={"instance": instance_id}
+        )
         out = _result_to_json(result)
         if self.config.feedback and self.config.event_server_url:
             out = self._send_feedback(query_json, out)
@@ -516,6 +531,16 @@ class EngineServer(HTTPServerBase):
             "feedback": self._feedback_queue.stats(),
             "remoteLog": self._log_queue.stats(),
         }
+        # pio-xray: the worst-N flight records (ids + durations; full
+        # span trees live on /debug/xray) and the histogram's bucket
+        # exemplars, so /status alone links a slow bucket to a trace id
+        out["xray"] = {
+            "flight": get_flight_recorder().summary(),
+            "latencyExemplars": [
+                {"le": le, "traceId": ex, "value": v}
+                for le, ex, v, _ts in self._latency.exemplar_items()
+            ],
+        }
         return out
 
     def status_html(self) -> str:
@@ -568,6 +593,15 @@ class EngineServer(HTTPServerBase):
                 f"{lat['p50']:.4f} / {lat['p95']:.4f} / "
                 f"{lat['p99']:.4f} s"),
         ]
+        worst = get_flight_recorder().summary()["worst"]
+        if worst:
+            server_rows.append(row(
+                "Slowest Requests (flight recorder)",
+                "; ".join(
+                    f"{w['traceId']} {w['durationSec'] * 1e3:.1f} ms"
+                    for w in worst[:5]
+                ) + " — span trees at /debug/xray",
+            ))
         comp_rows = [
             row(f"Data Source [{ep.data_source[0] or 'default'}]",
                 json.dumps(params_to_json(ep.data_source[1]))),

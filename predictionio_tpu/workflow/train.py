@@ -117,6 +117,14 @@ def run_train(
     """
     import jax
 
+    from ..obs import xray
+
+    # compile/device observability for the whole training run: every
+    # half-iteration compile books into pio_jit_compiles_total{fn} and
+    # the device sampler keeps the memory gauges live while we train
+    xray.install()
+    xray.start_sampler()
+
     ctx = ctx or WorkflowContext(mode="Training")
     wp = workflow_params or WorkflowParams()
     md = ctx.storage.get_metadata()

@@ -1,0 +1,439 @@
+"""pio-xray unit coverage: recompile detection + signature deltas,
+device gauges on the CPU backend, worst-N flight recorder exactness
+under concurrency, bench_gate threshold math, journal rotation, and
+histogram exemplars.  The end-to-end serving story lives in
+tools/xray_smoke.py (tests/test_xray_smoke.py)."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import Histogram, MetricsRegistry, Tracer, xray
+from predictionio_tpu.obs.flight import FlightRecorder
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "tools"))
+
+import bench_gate  # noqa: E402  (tools/ is scripts, not a package)
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+
+# -- recompile detector ----------------------------------------------------
+
+
+def _ring_for(fn_name):
+    return [e for e in xray.recompile_events() if e["fn"] == fn_name]
+
+
+def test_forced_recompile_increments_counter_and_records_delta():
+    """The acceptance scenario at unit scale: same fn, new shape."""
+    name = "test.xray_shape_churn"
+    f = xray.instrument(name)(jax.jit(lambda x: x * 2 + 1))
+    child = xray.JIT_COMPILES.labels(fn=name)
+    before = child.value()
+
+    f(jnp.ones((3,), jnp.float32))
+    f(jnp.ones((3,), jnp.float32))   # cached: no compile
+    f(jnp.ones((7,), jnp.float32))   # recompile
+
+    assert child.value() >= before + 2  # first compile + recompile
+    events = _ring_for(name)
+    assert [e["kind"] for e in events] == ["compile", "recompile"]
+    delta = events[-1]["delta"]
+    assert delta["changed"] == [
+        {"arg": "arg0", "from": "float32[3]", "to": "float32[7]"}
+    ]
+    assert events[-1]["nthSignature"] == 2
+    # compile wall time landed in the histogram family
+    assert xray.JIT_COMPILE_SECONDS.child().snapshot()["count"] >= 1
+
+
+def test_static_arg_change_shows_in_delta():
+    name = "test.xray_static_churn"
+    import functools
+
+    f = xray.instrument(name)(
+        functools.partial(jax.jit, static_argnames=("k",))(
+            lambda x, k: jax.lax.top_k(x, k)
+        )
+    )
+    x = jnp.arange(8.0)
+    f(x, k=2)
+    f(x, k=3)
+    events = _ring_for(name)
+    assert events[-1]["kind"] == "recompile"
+    assert {"arg": "k", "from": "2", "to": "3"} in (
+        events[-1]["delta"]["changed"]
+    )
+
+
+def test_dtype_change_is_a_new_signature():
+    name = "test.xray_dtype_churn"
+    f = xray.instrument(name)(jax.jit(lambda x: x + 1))
+    f(jnp.ones((4,), jnp.float32))
+    f(jnp.ones((4,), jnp.int32))
+    ev = _ring_for(name)[-1]
+    assert ev["delta"]["changed"][0]["from"] == "float32[4]"
+    assert ev["delta"]["changed"][0]["to"] == "int32[4]"
+
+
+def test_instrumented_wrapper_delegates_jit_attributes():
+    f = xray.instrument("test.xray_delegate")(jax.jit(lambda x: x))
+    f(jnp.ones(2))
+    # AOT + cache-introspection APIs must keep working through the
+    # wrapper (tests/test_als.py relies on _cache_size)
+    assert f._cache_size() >= 1
+    assert f.lower(jnp.ones(2)) is not None
+
+
+def test_lambda_like_traced_scalar_does_not_recompile():
+    name = "test.xray_traced_scalar"
+    f = xray.instrument(name)(jax.jit(lambda x, lam: x * lam))
+    x = jnp.ones((5,))
+    f(x, jnp.float32(0.1))
+    f(x, jnp.float32(0.7))  # traced scalar: same signature
+    assert len(_ring_for(name)) == 1
+
+
+def test_compile_cache_event_counter():
+    assert xray.install()
+    import jax.monitoring as monitoring
+
+    child = xray.COMPILE_CACHE_EVENTS.labels(kind="hit")
+    before = child.value()
+    monitoring.record_event("/jax/compilation_cache/cache_hits")
+    assert child.value() == before + 1
+
+
+def test_cost_analysis_opt_in(monkeypatch):
+    monkeypatch.setenv("PIO_TPU_XRAY_COST", "1")
+    name = "test.xray_cost"
+    f = xray.instrument(name)(jax.jit(lambda a, b: a @ b))
+    f(jnp.ones((8, 8)), jnp.ones((8, 8)))
+    st = xray.jit_stats()[name]
+    assert st["cost"]["flops"] > 0
+
+
+# -- device gauges ---------------------------------------------------------
+
+
+def test_memory_gauges_appear_on_cpu_backend():
+    keep = jnp.ones((128, 8), jnp.float32)  # a live array to account
+    samples = xray.sample_devices_once()
+    assert len(samples) >= 1
+    s0 = samples[0]
+    assert s0["device"].split(":")[0] == jax.default_backend()
+    assert s0["stats"], "every device must expose at least one stat"
+    if s0["source"] == "live_arrays":
+        assert s0["stats"]["live_bytes"] >= keep.nbytes
+    # the gauges render on the shared registry
+    from predictionio_tpu.obs import render_prometheus
+
+    text = render_prometheus()
+    assert "pio_device_memory_bytes{" in text
+    del keep
+
+
+def test_sampler_start_stop():
+    assert xray.start_sampler(period_s=0.05)
+    assert xray.start_sampler() is True  # idempotent
+    xray.stop_sampler()
+
+
+def test_xray_payload_json_serializable():
+    payload = xray.xray_payload()
+    parsed = json.loads(json.dumps(payload))
+    assert set(parsed) >= {
+        "monitoring", "jit", "recompiles", "compileCache", "devices",
+        "flight", "latencyExemplars",
+    }
+
+
+# -- flight recorder -------------------------------------------------------
+
+
+def test_flight_recorder_keeps_exactly_worst_n_under_concurrency():
+    rec = FlightRecorder(capacity=5)
+    tracer = Tracer(capacity=4096)
+    rng = np.random.default_rng(7)
+    durations = rng.permutation(np.linspace(0.001, 0.2, 200))
+
+    def worker(chunk):
+        for i, d in chunk:
+            tracer.record("serve.query", float(d), trace_id=f"t-{i}")
+            rec.offer(f"t-{i}", float(d), tracer=tracer)
+
+    items = list(enumerate(durations))
+    threads = [
+        threading.Thread(target=worker, args=(items[k::8],))
+        for k in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    records = rec.records()
+    assert len(records) == 5
+    kept = sorted(r["durationSec"] for r in records)
+    expected = sorted(durations)[-5:]
+    assert np.allclose(kept, expected)
+    # slowest-first ordering and captured span trees
+    assert records[0]["durationSec"] == max(durations)
+    assert all(r["spanCount"] >= 1 for r in records)
+    summary = rec.summary()
+    assert summary["offers"] == 200
+    assert len(summary["worst"]) == 5
+
+
+def test_flight_recorder_no_trace_id_never_admitted():
+    rec = FlightRecorder(capacity=2)
+    assert rec.offer(None, 1.0) is False
+    assert rec.records() == []
+
+
+def test_flight_recorder_set_capacity_trims():
+    rec = FlightRecorder(capacity=4)
+    tracer = Tracer(capacity=64)
+    for i in range(4):
+        rec.offer(f"t-{i}", float(i + 1), tracer=tracer)
+    rec.set_capacity(2)
+    kept = sorted(r["durationSec"] for r in rec.records())
+    assert kept == [3.0, 4.0]
+
+
+# -- bench gate ------------------------------------------------------------
+
+
+def _mk_history(tmp_path, values, **over):
+    base = {
+        "metric": "t_train_seconds", "unit": "s", "vs_baseline": None,
+        "platform": "tpu", "scale": 1.0, "fenced": True,
+        "recorded_at": "2026-08-01T00:00:00Z",
+    }
+    base.update(over)
+    p = tmp_path / "hist.jsonl"
+    with open(p, "w") as f:
+        for v in values:
+            f.write(json.dumps({**base, "value": v}) + "\n")
+    return p, base
+
+
+def test_bench_gate_flat_history_passes_and_3x_fails(tmp_path):
+    p, base = _mk_history(tmp_path, [100, 101, 99.5, 100.4, 99.0])
+    history = bench_gate.load_history(p)
+    ok = bench_gate.check_candidate(history, {**base, "value": 104.0})
+    assert ok["status"] == "ok"
+    bad = bench_gate.check_candidate(history, {**base, "value": 300.0})
+    assert bad["status"] == "regression"
+    assert bad["ratio"] > 2.9
+
+
+def test_bench_gate_noise_aware_threshold(tmp_path):
+    # noisy history (sigma ~15): a +25% candidate is inside 4 sigma,
+    # which a fixed 10% gate would have flagged as a regression
+    p, base = _mk_history(tmp_path, [85, 115, 90, 110, 88, 112])
+    history = bench_gate.load_history(p)
+    v = bench_gate.check_candidate(history, {**base, "value": 125.0})
+    assert v["status"] == "ok"
+    assert v["threshold"] > 110.0
+
+
+def test_bench_gate_min_sample_guard_and_unfenced(tmp_path):
+    p, base = _mk_history(tmp_path, [100, 101])
+    history = bench_gate.load_history(p)
+    v = bench_gate.check_candidate(history, {**base, "value": 500.0})
+    assert v["status"] == "insufficient"  # 2 < min_samples
+    v = bench_gate.check_candidate(
+        history + [dict(base, value=100.0)] * 3,
+        {**base, "value": 500.0, "fenced": False},
+    )
+    assert v["status"] == "unfenced"
+
+
+def test_bench_gate_keys_platform_and_scale_apart(tmp_path):
+    p, base = _mk_history(tmp_path, [100, 100, 100])
+    history = bench_gate.load_history(p)
+    # a CPU-fallback record must never be judged against TPU history
+    v = bench_gate.check_candidate(
+        history, {**base, "value": 9.0, "platform": "cpu", "scale": 0.02}
+    )
+    assert v["status"] == "insufficient"
+
+
+def test_bench_gate_cli_exit_codes(tmp_path):
+    p, base = _mk_history(tmp_path, [100, 101, 99.5, 100.4])
+    flat = tmp_path / "flat.json"
+    flat.write_text(json.dumps({**base, "value": 103.0}))
+    reg = tmp_path / "reg.json"
+    reg.write_text(json.dumps({**base, "value": 300.0}))
+    gate = str(ROOT / "tools" / "bench_gate.py")
+
+    def run(*a):
+        return subprocess.run(
+            [sys.executable, gate, "--history", str(p), *a],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    assert run("--check", str(flat)).returncode == 0
+    assert run("--check", str(reg)).returncode == 1
+    empty = tmp_path / "none.jsonl"
+    r = run("--history", str(empty), "--check")
+    # (second --history wins argparse; exercise both spellings anyway)
+    assert subprocess.run(
+        [sys.executable, gate, "--history", str(empty), "--check"],
+        capture_output=True, text=True, timeout=60,
+    ).returncode == 2
+    assert subprocess.run(
+        [sys.executable, gate, "--history", str(empty), "--check",
+         "--allow-empty"],
+        capture_output=True, text=True, timeout=60,
+    ).returncode == 0
+    assert r.returncode in (0, 2)
+
+
+def test_bench_gate_garbage_candidate_is_error_not_regression(tmp_path):
+    """A typo'd/unparseable candidate file must exit 2 (unusable
+    input), never 1 (false regression) or 0 (silent pass) — even under
+    --allow-empty."""
+    p, _base = _mk_history(tmp_path, [100, 101, 99.5])
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("this is not json")
+    gate = str(ROOT / "tools" / "bench_gate.py")
+    for extra in ([], ["--allow-empty"]):
+        r = subprocess.run(
+            [sys.executable, gate, "--history", str(p),
+             "--check", str(garbage), *extra],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 2, (extra, r.stdout, r.stderr)
+        assert "error" in r.stdout
+
+
+def test_bench_gate_real_history_check_allow_empty_passes():
+    """The gate.sh invocation against the repo's actual trajectory."""
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "bench_gate.py"),
+         "--check", "--allow-empty"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_bench_gate_append_canonicalizes(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    rec = bench_gate.append_history(
+        hist, {"metric": "m", "value": 1.5, "platform": "tpu",
+               "scale": 1.0, "fenced": True, "solver": "pallas"}
+    )
+    assert list(rec)[:8] == list(bench_gate.CANONICAL_FIELDS)
+    assert rec["solver"] == "pallas"
+    again = bench_gate.load_history(hist)[0]
+    assert again["value"] == 1.5 and again["fenced"] is True
+
+
+def test_write_pr_summary_merge(tmp_path):
+    path = tmp_path / "BENCH_PRX.json"
+    bench_gate.write_pr_summary(
+        {"metric": "train", "value": 10.0, "fenced": True}, path=path
+    )
+    bench_gate.write_pr_summary(
+        {"metric": "serving_p50", "value": 0.3, "fenced": True},
+        key="serving", path=path,
+    )
+    merged = json.loads(path.read_text())
+    assert merged["metric"] == "train"
+    assert merged["serving"]["metric"] == "serving_p50"
+    # re-writing the train record keeps the serving block
+    bench_gate.write_pr_summary(
+        {"metric": "train", "value": 11.0, "fenced": True}, path=path
+    )
+    merged = json.loads(path.read_text())
+    assert merged["value"] == 11.0
+    assert merged["serving"]["value"] == 0.3
+
+
+# -- journal rotation ------------------------------------------------------
+
+
+def test_journal_rotation_caps_disk(tmp_path):
+    tracer = Tracer(
+        capacity=64, journal_dir=tmp_path,
+        max_segment_bytes=600, keep_segments=2,
+    )
+    for i in range(200):
+        tracer.record("spin", 0.001, trace_id=f"t-{i:04d}",
+                      attrs={"pad": "x" * 40})
+    tracer.close()
+    import os
+
+    base = tmp_path / f"spans-{os.getpid()}.jsonl"
+    segs = sorted(p.name for p in tmp_path.glob("spans-*.jsonl*"))
+    # active + at most keep_segments rotated, nothing beyond .2
+    assert base.exists() or segs
+    assert not (tmp_path / (base.name + ".3")).exists()
+    assert (tmp_path / (base.name + ".1")).exists()
+    total = sum(
+        p.stat().st_size for p in tmp_path.glob("spans-*.jsonl*")
+    )
+    # bounded: (keep + active) segments, each ~cap + one record of slop
+    assert total <= (2 + 1) * (600 + 200)
+    stats = tracer.stats()
+    assert stats["rotations"] >= 1
+    assert stats["keepSegments"] == 2
+
+
+def test_journal_rotation_newest_spans_in_active_segment(tmp_path):
+    tracer = Tracer(capacity=8, journal_dir=tmp_path,
+                    max_segment_bytes=400, keep_segments=1)
+    for i in range(50):
+        tracer.record("s", 0.0, trace_id=f"t-{i:03d}")
+    import os
+
+    base = tmp_path / f"spans-{os.getpid()}.jsonl"
+    text = base.read_text() if base.exists() else ""
+    rotated = base.with_name(base.name + ".1")
+    assert "t-049" in text + (
+        rotated.read_text() if rotated.exists() else ""
+    )
+    tracer.close()
+
+
+# -- exemplars -------------------------------------------------------------
+
+
+def test_histogram_exemplars_and_render():
+    h = Histogram(buckets=(0.001, 0.01, 0.1))
+    h.observe(0.005, exemplar="t-slowish")
+    h.observe(0.0001)  # no exemplar: bucket stays bare
+    items = h.exemplar_items()
+    assert len(items) == 1
+    le, ex, v, ts = items[0]
+    assert (le, ex, v) == ("0.01", "t-slowish", 0.005)
+    reg = MetricsRegistry()
+    fam = reg.histogram("x_seconds", "t", buckets=(0.001, 0.01, 0.1))
+    fam.child().observe(0.005, exemplar="t-slowish")
+    text = reg.render_prometheus()
+    assert '# EXEMPLAR x_seconds_bucket{le="0.01"} ' \
+           'trace_id="t-slowish" value=0.005' in text
+    # comment lines must not break a strict sample parser
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            name, _, value = line.rpartition(" ")
+            float(value)
+
+
+def test_histogram_overflow_bucket_exemplar():
+    h = Histogram(buckets=(0.001,))
+    h.observe(5.0, exemplar="t-huge")
+    (le, ex, _v, _ts), = h.exemplar_items()
+    assert le == "+Inf" and ex == "t-huge"

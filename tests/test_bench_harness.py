@@ -9,6 +9,21 @@ import pytest
 import bench
 
 
+@pytest.fixture(autouse=True)
+def _isolated_artifacts(tmp_path, monkeypatch):
+    """bench.main() writes the canonical BENCH_PR<k>.json and reads
+    BENCH_HISTORY.jsonl at the repo root.  Tests that drive main() with
+    stubbed runners must never touch the real artifacts: an unstubbed
+    _write_pr_summary once committed a trajectory point whose "error"
+    field was the literal 'fail' sentinel from the stubs below."""
+    monkeypatch.setattr(bench, "HISTORY_PATH",
+                        tmp_path / "BENCH_HISTORY.jsonl")
+    monkeypatch.setattr(bench, "_write_pr_summary",
+                        lambda rec, fenced=None: None)
+    monkeypatch.setenv("PIO_TPU_PR_SUMMARY",
+                       str(tmp_path / "BENCH_PR_TEST.json"))
+
+
 @pytest.fixture()
 def patched(monkeypatch):
     calls = {"probe": [], "inner": []}

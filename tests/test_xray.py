@@ -341,6 +341,18 @@ def test_bench_gate_append_canonicalizes(tmp_path):
     assert again["value"] == 1.5 and again["fenced"] is True
 
 
+def test_pr_summary_path_env_override(tmp_path, monkeypatch):
+    """PIO_TPU_PR_SUMMARY must redirect the summary wholesale — the
+    isolation hook tests use so stubbed bench runs can never clobber
+    the real repo-root BENCH_PR<k>.json."""
+    target = tmp_path / "S.json"
+    monkeypatch.setenv("PIO_TPU_PR_SUMMARY", str(target))
+    assert bench_gate.pr_summary_path() == target
+    assert bench_gate.pr_summary_path(3) == target
+    monkeypatch.delenv("PIO_TPU_PR_SUMMARY")
+    assert bench_gate.pr_summary_path(3).name == "BENCH_PR3.json"
+
+
 def test_write_pr_summary_merge(tmp_path):
     path = tmp_path / "BENCH_PRX.json"
     bench_gate.write_pr_summary(

@@ -213,6 +213,12 @@ def pr_number() -> int:
 
 
 def pr_summary_path(k: Optional[int] = None) -> Path:
+    """``PIO_TPU_PR_SUMMARY`` redirects the summary wholesale (tests
+    point it at a tmp dir so a stubbed bench run can never clobber the
+    real repo-root artifact); otherwise BENCH_PR<k>.json at the root."""
+    env = os.environ.get("PIO_TPU_PR_SUMMARY")
+    if env:
+        return Path(env)
     return REPO_ROOT / f"BENCH_PR{pr_number() if k is None else k}.json"
 
 

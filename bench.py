@@ -165,6 +165,12 @@ def _parse_args(argv=None):
                     help="batched SPD solver override (default: "
                     "ALSConfig default); 'fused' = single-pass "
                     "gather+Gram+solve kernel on VMEM-fitting sides")
+    ap.add_argument("--fused-gather", default=None,
+                    choices=("auto", "taa", "dma"),
+                    help="in-kernel gather form of the fused kernel "
+                    "(ALSConfig.fused_gather): take_along_axis "
+                    "sub-gathers vs scalar-prefetched DMA row copies; "
+                    "'auto' = per-backend compile-and-run probe")
     ap.add_argument("--solver-mode", default=None,
                     choices=("full", "subspace"),
                     help="rank-sweep strategy: 'full' = R×R solve per "
@@ -237,6 +243,15 @@ def _parse_args(argv=None):
         "gather+gram / full-solve variants of the user half-iteration "
         "to localize the per-iteration cost",
     )
+    ap.add_argument(
+        "--fused-ab",
+        action="store_true",
+        help="fenced fused-vs-unfused A/B on the user half's "
+        "gather+Gram wall: times the unfused gather+Gram phase and the "
+        "fused full half on identical staged data and appends BOTH as "
+        "canonical BENCH_HISTORY.jsonl records so tools/bench_gate.py "
+        "gates the Gram phase; implies --inner semantics",
+    )
     args = ap.parse_args(argv)
     if args.phase_probe and not args.breakdown:
         ap.error("--phase-probe requires --breakdown")
@@ -274,6 +289,8 @@ def _prepare(args):
     extra = {}
     if args.solver:
         extra["solver"] = args.solver
+    if args.fused_gather and args.fused_gather != "auto":
+        extra["fused_gather"] = args.fused_gather
     if args.precision:
         extra["matmul_precision"] = args.precision
     if args.solver_mode:
@@ -431,8 +448,9 @@ def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
             precision=cfg.matmul_precision, solver=cfg.solver,
             gather_dtype=cfg.gather_dtype, gather_mode=cfg.gather_mode,
             solver_mode=cfg.solver_mode,
-            subspace_size=cfg.subspace_size, upd_table=upd_tab,
-            stop_after=stop_after,
+            subspace_size=cfg.subspace_size,
+            fused_gather=getattr(trainer, "fused_gather", None) or "taa",
+            upd_table=upd_tab, stop_after=stop_after,
         )
 
     lam = jnp.asarray(cfg.lam, jnp.float32)
@@ -466,6 +484,152 @@ def _run_phase_probe(jax, trainer, U, V, cfg, emit, rtt) -> None:
         timed(lambda: trainer._half(jnp.array(U, copy=True), V,
                                     trainer._user_side)),
     )
+
+
+def run_fused_ab(args) -> None:
+    """Fenced fused-vs-unfused A/B on the gather+Gram wall.
+
+    Stages ONE dataset, then times — all fenced, warm-first, identical
+    bucket layout — (a) the unfused user half truncated after
+    gather+Gram (``stop_after="gram"``: the 303 + 793 ms wall the fused
+    kernel exists to kill) and (b) the FULL fused user half (the fused
+    kernel is single-pass, so its gather+Gram cannot be timed apart
+    from its in-kernel solve — the comparison is therefore conservative
+    against the fused arm: it carries its solve and the factor scatter
+    while the unfused arm carries neither).  Both measurements append
+    to BENCH_HISTORY.jsonl as canonical fenced records
+    (``als_user_half_unfused_gather_gram_seconds`` /
+    ``als_user_half_fused_seconds``) so ``tools/bench_gate.py`` gates
+    the Gram phase like any other trajectory metric, keyed per
+    (metric, platform, scale).
+
+    Honesty contract: the fused record always carries
+    ``solver_requested``/``fused_gather_resolved`` and ``degraded`` on
+    probe-failure fallback, so a degraded run can never masquerade as a
+    fused measurement (it is still recorded — a fallback regression is
+    a regression too — just labeled).
+    """
+    import dataclasses
+    import functools
+
+    jax, (u, i, v, n_users, n_items), mesh, cfg0 = _prepare(args)
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.als import (
+        ALSConfig, ALSTrainer, _solve_buckets,
+    )
+    from predictionio_tpu.parallel.mesh import fence
+
+    # the two arms: identical data/layout knobs, only the solver path
+    # differs.  The unfused baseline pins solver="xla" (the measured
+    # wall); the fused arm honors --fused-gather (default auto).
+    base = {
+        f.name: getattr(cfg0, f.name) for f in dataclasses.fields(cfg0)
+    }
+    base.update(solver="xla", fused_gather="auto")
+    cfg_un = ALSConfig(**base)
+    cfg_fu = ALSConfig(**{
+        **base, "solver": "fused",
+        "fused_gather": args.fused_gather or "auto",
+    })
+
+    reps = 3
+    platform = str(jax.default_backend())
+
+    def emit_and_record(rec, summary_key):
+        print(json.dumps(rec), flush=True)
+        try:
+            gate = _bench_gate()
+            gate.append_history(HISTORY_PATH, rec)
+            # the fused-path record (fused_gather_resolved + degraded)
+            # also rides BENCH_PR<k>.json, nested so it never clobbers
+            # the orchestrated train record at the top level
+            gate.write_pr_summary(rec, key=summary_key)
+        except Exception as e:  # noqa: BLE001 — the print already landed
+            print(f"# WARNING: could not record fused A/B: {e}",
+                  file=sys.stderr, flush=True)
+
+    def timed(fn):
+        fence(fn())  # warm: compile outside the measured span
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn()
+        fence(out)
+        return (time.time() - t0) / reps
+
+    results = {}
+    for arm, cfg in (("unfused", cfg_un), ("fused", cfg_fu)):
+        trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
+                             staging=args.staging)
+        U, V = trainer.init_factors()
+        side = trainer._user_side
+        lam = jnp.asarray(cfg.lam, jnp.float32)
+        alpha = jnp.asarray(cfg.alpha, jnp.float32)
+        common = dict(
+            unit="s", platform=platform, scale=args.scale, fenced=True,
+            rank=cfg.rank, gather_dtype=cfg.gather_dtype,
+            precision=cfg.matmul_precision, n_ratings=int(len(v)),
+        )
+        if arm == "unfused":
+
+            @functools.partial(jax.jit, static_argnames=("ks", "stop_after"))
+            def probe(upd_tab, opp, c_sorted, v_sorted, buckets, lam_t,
+                      alpha_t, *, ks, stop_after):
+                return _solve_buckets(
+                    None, opp, c_sorted, v_sorted, buckets, lam_t,
+                    alpha_t, ks=ks, implicit=cfg.implicit,
+                    weighted_lambda=cfg.weighted_lambda,
+                    precision=cfg.matmul_precision, solver=cfg.solver,
+                    gather_dtype=cfg.gather_dtype,
+                    gather_mode=cfg.gather_mode,
+                    solver_mode=cfg.solver_mode,
+                    subspace_size=cfg.subspace_size, upd_table=upd_tab,
+                    stop_after=stop_after,
+                )
+
+            dt = timed(lambda: probe(
+                U, V, side["c_sorted"], side["v_sorted"],
+                side["buckets"], lam, alpha, ks=side["ks"],
+                stop_after="gram",
+            ))
+            results[arm] = dt
+            emit_and_record({
+                "metric": "als_user_half_unfused_gather_gram_seconds",
+                "value": round(dt, 5), "solver": trainer.solver,
+                **common,
+            }, "fused_ab_unfused")
+        else:
+            # the fused kernel is one pass: time the FULL half (its
+            # gather+Gram carries the in-kernel solve + the scatter)
+            dt = timed(
+                lambda: trainer._half(jnp.array(U, copy=True), V, side)
+            )
+            results[arm] = dt
+            emit_and_record({
+                "metric": "als_user_half_fused_seconds",
+                "value": round(dt, 5),
+                "solver": trainer.solver,
+                "solver_requested": cfg.solver,
+                **({"degraded": True}
+                   if trainer.solver != cfg.solver else {}),
+                "fused_gather_requested": cfg.fused_gather,
+                "fused_gather_resolved": trainer.fused_gather,
+                **common,
+            }, "fused_ab_fused")
+        del trainer, U, V
+
+    # derived headline (not a history record: a ratio of two gated
+    # metrics would double-judge the same movement); conservative by
+    # construction — the fused arm's time includes its solve + scatter
+    print(json.dumps({
+        "metric": "fused_vs_unfused_gather_gram_speedup",
+        "value": round(results["unfused"] / results["fused"], 3)
+        if results.get("fused") else None,
+        "note": "unfused gather+Gram phase over the FULL fused half "
+                "(fused includes solve+scatter); >= 1 means the fused "
+                "kernel beats the wall it replaces",
+        "platform": platform, "scale": args.scale,
+    }), flush=True)
 
 
 def run_inner(args) -> None:
@@ -503,6 +667,10 @@ def run_inner(args) -> None:
     wU, wV = warm.init_factors()
     warm.run(wU, wV, 1)
     solver_used = warm.solver   # after the pallas compile-probe
+    # the RESOLVED in-kernel gather form (None when fused degraded):
+    # every fused-path record must carry it so a probe-failure fallback
+    # can never masquerade as a fused measurement
+    fused_gather_used = getattr(warm, "fused_gather", None)
     del warm, wU, wV
     # the timed train is fence-free by design (per-step host round trips
     # would pollute the measurement), so it is one long silent stretch:
@@ -581,6 +749,13 @@ def run_inner(args) -> None:
                 **(
                     {"degraded": True}
                     if solver_used != cfg.solver else {}
+                ),
+                **(
+                    {
+                        "fused_gather_requested": cfg.fused_gather,
+                        "fused_gather_resolved": fused_gather_used,
+                    }
+                    if cfg.solver == "fused" else {}
                 ),
                 "solver_mode": cfg.solver_mode,
                 **(
@@ -1107,6 +1282,9 @@ def main() -> None:
     if args.pipeline:
         run_pipeline(args)
         return
+    if args.fused_ab:
+        run_fused_ab(args)
+        return
     if args.breakdown:
         run_breakdown(args)
         return
@@ -1125,6 +1303,8 @@ def main() -> None:
       + (["--gather-mode", args.gather_mode]
          if args.gather_mode else []) \
       + (["--solver", args.solver] if args.solver else []) \
+      + (["--fused-gather", args.fused_gather]
+         if args.fused_gather else []) \
       + (["--solver-mode", args.solver_mode] if args.solver_mode else []) \
       + (["--subspace-block", str(args.subspace_block)]
          if args.subspace_block is not None else []) \

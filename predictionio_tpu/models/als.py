@@ -102,6 +102,13 @@ class ALSConfig:
     # (ops/fused_als.py single-pass gather+Gram+solve kernel on sides
     # whose opposite table fits VMEM; other sides fall back to xla)
     solver: str = "xla"
+    # in-kernel gather form of the fused kernel (solver="fused" only):
+    # "taa" = same-shape take_along_axis(axis=0) sub-gathers (Mosaic
+    # tpu.dynamic_gather), "dma" = scalar-prefetched rolling-window
+    # async row copies, "auto" = per-backend compile-and-run probe
+    # (ops/fused_als.resolve_gather_impl; docs/PERF_PLAN.md §4).  The
+    # resolved value lands in bench artifacts as fused_gather_resolved.
+    fused_gather: str = "auto"
     # rank-sweep strategy: "full" solves the complete R×R normal
     # equations per row (today's behavior, the default); "subspace"
     # (iALS++, arXiv 2110.14044) sweeps the rank dimension in blocks of
@@ -159,6 +166,19 @@ class ALSConfig:
             raise ValueError(
                 f"solver must be 'xla', 'pallas' or 'fused', "
                 f"got {self.solver!r}"
+            )
+        if self.fused_gather not in ("auto", "taa", "dma"):
+            raise ValueError(
+                f"fused_gather must be 'auto', 'taa' or 'dma', "
+                f"got {self.fused_gather!r}"
+            )
+        if self.fused_gather != "auto" and self.solver != "fused":
+            # an explicit gather form with a non-fused solver would be
+            # silently ignored — the same foot-gun class as the other
+            # exact-equality knobs above
+            raise ValueError(
+                f"fused_gather={self.fused_gather!r} only applies to "
+                "solver='fused'"
             )
         if self.solver_mode not in ("full", "subspace"):
             raise ValueError(
@@ -491,6 +511,7 @@ def _half_iteration_impl(
     gather_mode: str = "row",
     solver_mode: str = "full",
     subspace_size: int = 0,
+    fused_gather: str = "taa",
 ) -> jax.Array:
     def write(acc, rows, x):
         acc = upd if acc is None else acc
@@ -504,7 +525,8 @@ def _half_iteration_impl(
         ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
         precision=precision, solver=solver, gather_dtype=gather_dtype,
         gather_mode=gather_mode, solver_mode=solver_mode,
-        subspace_size=subspace_size, upd_table=upd,
+        subspace_size=subspace_size, fused_gather=fused_gather,
+        upd_table=upd,
     )
     return upd if out is None else out
 
@@ -520,6 +542,7 @@ _half_iteration = xray.instrument("als.half_iteration")(
         static_argnames=(
             "ks", "implicit", "weighted_lambda", "precision", "solver",
             "gather_dtype", "gather_mode", "solver_mode", "subspace_size",
+            "fused_gather",
         ),
         donate_argnums=(0,),
     )(_half_iteration_impl)
@@ -532,14 +555,14 @@ _half_iteration = xray.instrument("als.half_iteration")(
     static_argnames=(
         "ks", "implicit", "weighted_lambda", "precision", "solver",
         "gather_dtype", "gather_mode", "solver_mode", "subspace_size",
-        "stop_after",
+        "fused_gather", "stop_after",
     ),
 )
 def _half_phase_probe(upd, opp, c_sorted, v_sorted, bucket_args, lam,
                       alpha, *, ks, implicit, weighted_lambda, precision,
                       solver, gather_dtype="float32", gather_mode="row",
                       solver_mode="full", subspace_size=0,
-                      stop_after="gather"):
+                      fused_gather="taa", stop_after="gather"):
     """Truncated half-iteration for pio-obs phase tracing: the same
     kernel prefix ``tools/breakdown_matrix.py`` probes (gather only /
     gather+Gram), jitted WITHOUT donation — the real, donating half
@@ -549,8 +572,8 @@ def _half_phase_probe(upd, opp, c_sorted, v_sorted, bucket_args, lam,
         ks=ks, implicit=implicit, weighted_lambda=weighted_lambda,
         precision=precision, solver=solver, gather_dtype=gather_dtype,
         gather_mode=gather_mode, solver_mode=solver_mode,
-        subspace_size=subspace_size, upd_table=upd,
-        stop_after=stop_after,
+        subspace_size=subspace_size, fused_gather=fused_gather,
+        upd_table=upd, stop_after=stop_after,
     )
 
 
@@ -582,6 +605,7 @@ def _solve_buckets(
     gather_mode: str = "row",
     solver_mode: str = "full",
     subspace_size: int = 0,
+    fused_gather: str = "taa",
     upd_table: Optional[jax.Array] = None,
     gram: Optional[jax.Array] = None,
     stop_after: Optional[str] = None,
@@ -623,12 +647,16 @@ def _solve_buckets(
 
     ``solver="fused"`` routes buckets through the single-pass Pallas
     kernel (`ops/fused_als.py`: in-kernel gather+Gram+regularize+
-    Gauss-Jordan, ~12 B/rating of HBM traffic).  VMEM-fitting opposite
-    tables stay resident; bigger ones STREAM through the kernel's third
-    grid axis in id-range-masked chunks — both ML-20M halves fuse.
-    Only shapes with no tile plan at all (`fused_tile_plan` None:
-    pathological chunk counts or a tiny VMEM budget) keep the XLA path
-    below.
+    Gauss-Jordan, ~12 B/rating of HBM traffic), using the RESOLVED
+    ``fused_gather`` impl ("taa" take_along_axis sub-gathers or "dma"
+    scalar-prefetched row copies — `ALSConfig.fused_gather`, resolved
+    by `_resolve_solver` before any trace).  Under "taa", VMEM-fitting
+    opposite tables stay resident and bigger ones STREAM through the
+    kernel's third grid axis in id-range-masked chunks; under "dma"
+    the table stays in HBM and rows arrive by async copy.  Only shapes
+    with no tile plan at all (`fused_tile_plan` None: pathological
+    chunk/sub-gather counts or a tiny VMEM/SMEM budget) keep the XLA
+    path below.
     """
     r = opp.shape[-1]
     nnz = c_sorted.shape[0]
@@ -671,7 +699,8 @@ def _solve_buckets(
         from ..ops.fused_als import fused_side_fits
 
         fused_side = fused_side_fits(
-            opp_g.shape[0], r, max(ks), opp_g.dtype.itemsize
+            opp_g.shape[0], r, max(ks), opp_g.dtype.itemsize,
+            fused_gather,
         )
     out = None
     for (rows, starts, counts), k in zip(bucket_args, ks):
@@ -699,7 +728,8 @@ def _solve_buckets(
             else:
                 reg = jnp.broadcast_to(lam_t, n_row.shape)
             x = fused_gather_gram_solve(
-                opp_g, idx, cwk, bwk, reg, g0, precision=prec
+                opp_g, idx, cwk, bwk, reg, g0, precision=prec,
+                gather_impl=fused_gather,
             )
             out = upd_write(out, rows, x)
             continue
@@ -886,6 +916,7 @@ def build_sharded_half(
     gather_mode: str = "row",
     solver_mode: str = "full",
     subspace_size: int = 0,
+    fused_gather: str = "taa",
 ):
     """ALX-style half-iteration over block-sharded factor tables.
 
@@ -978,7 +1009,7 @@ def build_sharded_half(
             precision=precision, solver=solver,
             gather_dtype=gather_dtype, gather_mode=gather_mode,
             solver_mode=solver_mode, subspace_size=subspace_size,
-            upd_table=upd_full, gram=gram,
+            fused_gather=fused_gather, upd_table=upd_full, gram=gram,
         )
         return upd if out is None else out
 
@@ -997,13 +1028,19 @@ def build_sharded_half(
     )
 
 
-def _resolve_solver(cfg: ALSConfig) -> str:
+def _resolve_solver(cfg: ALSConfig) -> tuple[str, Optional[str]]:
     """Compile-probe kernel-backed solvers; degrade to "xla" on failure.
 
-    ``"pallas"`` probes the Gauss-Jordan solve kernel at this rank;
-    ``"fused"`` probes the fused gather+Gram+solve kernel (whose
-    speculative op is the in-VMEM dynamic gather).  Both cache per
-    (backend, shape) so trainers after the first pay nothing.
+    Returns ``(solver, fused_gather_resolved)``: ``"pallas"`` probes
+    the Gauss-Jordan solve kernel at this rank; ``"fused"`` resolves
+    the in-kernel gather form (``cfg.fused_gather``; ``"auto"`` walks
+    the per-backend probe order) and probes the EXACT (shape, dtype,
+    precision, gather-impl) kernel variant production would run.  A
+    fused request that resolves to no runnable variant degrades to
+    ``("xla", None)`` — the loud-degradation artifacts
+    (``solver_requested``/``degraded``/``fused_gather_resolved``) make
+    that visible in every bench record.  All probes cache per
+    (backend, variant) so trainers after the first pay nothing.
     """
     if cfg.solver == "pallas":
         from ..ops.solve import pallas_solver_ok
@@ -1015,17 +1052,22 @@ def _resolve_solver(cfg: ALSConfig) -> str:
         if cfg.solver_mode == "subspace" and 0 < cfg.subspace_size < cfg.rank:
             dim = cfg.subspace_size
         if not pallas_solver_ok(dim):
-            return "xla"
+            return "xla", None
     elif cfg.solver == "fused":
-        from ..ops.fused_als import fused_solver_ok
+        from ..ops.fused_als import resolve_gather_impl
 
         tb = 2 if cfg.gather_dtype == "bfloat16" else 4
-        # probe the exact kernel variant production will run: precision
-        # is a static arg of the pallas lowering, so probing HIGHEST
-        # would not validate a "default"-precision train
-        if not fused_solver_ok(512, cfg.rank, tb, cfg.matmul_precision):
-            return "xla"
-    return cfg.solver
+        # probe the exact kernel variant production will run: precision,
+        # table dtype, and gather impl are all static args of the
+        # pallas lowering, so probing any other variant validates a
+        # different kernel
+        impl = resolve_gather_impl(
+            512, cfg.rank, tb, cfg.matmul_precision, cfg.fused_gather
+        )
+        if impl is None:
+            return "xla", None
+        return "fused", impl
+    return cfg.solver, None
 
 
 class ALSTrainer:
@@ -1060,8 +1102,10 @@ class ALSTrainer:
         # compile-probed and degrade to XLA with a warning if the kernel
         # doesn't lower on this backend (round 2: a Mosaic regression
         # was only caught on the real chip; a user's train must survive
-        # the next one)
-        self.solver = _resolve_solver(cfg)
+        # the next one).  fused_gather is the RESOLVED in-kernel gather
+        # form (None unless the fused kernel is live) — bench artifacts
+        # record it as fused_gather_resolved
+        self.solver, self.fused_gather = _resolve_solver(cfg)
 
         n_dev = self.mesh.size if self.mesh is not None else 1
         # sharded factor tables need a real mesh and row counts divisible
@@ -1147,6 +1191,7 @@ class ALSTrainer:
             gather_mode=cfg.gather_mode,
             solver_mode=cfg.solver_mode,
             subspace_size=cfg.subspace_size,
+            fused_gather=self.fused_gather or "taa",
         )
         self._sharded_user_half = build_sharded_half(
             self.mesh, ks=self._user_side["ks"], **common
@@ -1216,7 +1261,7 @@ class ALSTrainer:
         self.mesh = mesh
         self.n_users = n_users
         self.n_items = n_items
-        self.solver = _resolve_solver(cfg)
+        self.solver, self.fused_gather = _resolve_solver(cfg)
         n_dev = mesh.size
         self.sharded = True
         self.staging = "sharded-distributed"
@@ -1572,6 +1617,7 @@ class ALSTrainer:
             gather_mode=cfg.gather_mode,
             solver_mode=cfg.solver_mode,
             subspace_size=cfg.subspace_size,
+            fused_gather=self.fused_gather or "taa",
         )
 
     def _traced_half(self, upd, opp, side, side_name: str, it: int,
@@ -1624,7 +1670,9 @@ class ALSTrainer:
                 gather_dtype=cfg.gather_dtype,
                 gather_mode=cfg.gather_mode,
                 solver_mode=cfg.solver_mode,
-                subspace_size=cfg.subspace_size, stop_after=stop,
+                subspace_size=cfg.subspace_size,
+                fused_gather=self.fused_gather or "taa",
+                stop_after=stop,
             )
 
         # the probes must run BEFORE the real half: it donates ``upd``
@@ -1798,6 +1846,7 @@ def sweep_train_als(
         precision=cfg.matmul_precision, solver=cfg.solver,
         gather_dtype=cfg.gather_dtype, gather_mode=cfg.gather_mode,
         solver_mode=cfg.solver_mode, subspace_size=cfg.subspace_size,
+        fused_gather=trainer.fused_gather or "taa",
     )
 
     def make_half(side):

@@ -13,8 +13,12 @@ This module makes both visible:
   {kind}`` so cold-start and warm-start deploys are distinguishable on
   ``/metrics``.  Attribution of a compile to a *function* rides a
   thread-local set by :func:`instrument`-wrapped entry points (the
-  repo's jitted ALS halves and top-k scorers); compiles outside any
-  tracked call book under ``fn="untracked"``.
+  repo's jitted ALS halves, the fused gather+Gram+solve kernel's
+  pallas entries — ``als.fused``, whose signature carries the tile
+  plan, table dtype, precision, and gather-impl statics, so a fused
+  recompile's per-arg delta names exactly which of them churned — and
+  the top-k scorers); compiles outside any tracked call book under
+  ``fn="untracked"``.
 * **Recompilation detector.**  :func:`instrument` wraps a jitted
   callable and fingerprints every call's arg signature (shapes /
   dtypes / static kwargs).  A signature never seen before means XLA is
@@ -482,7 +486,15 @@ class _Instrumented:
 def instrument(name: str) -> Callable[[Callable], Callable]:
     """Decorator: ``instrument("als.half")(jax.jit(f))``.  Installing
     the monitoring listeners rides along — by the time an instrumented
-    fn exists, the process is a jax process."""
+    fn exists, the process is a jax process.
+
+    Instrumented seams (grep for ``xray.instrument(`` to re-derive):
+    ``als.half_iteration`` / ``als.phase_probe`` / ``als.sharded_half``
+    / ``als.sweep_half`` / ``als.expand_sides`` / ``als.sq_err_sum``
+    (models/als.py), ``als.fused`` (ops/fused_als.py — BOTH gather
+    impls' pallas entries share the name; the impl shows up in the
+    signature via the entry fn and its static tile-plan kwargs), and
+    ``topk.*`` (ops/topk.py)."""
 
     def deco(fn: Callable) -> Callable:
         install()

@@ -7,33 +7,58 @@ einsums at an effective ~17 GB/s — 303 ms gather + 793 ms Gram per user
 half vs a ~10 ms MXU roofline.  At rank 64 the opposite (item) factor
 table is only ~7 MB f32 (~3.5 MB bf16): it FITS IN VMEM.  This kernel
 keeps the whole table resident and, per batch tile, streams only the
-``[TB, KC]`` rating-index/weight blocks from HBM:
+``[TB, KC]`` rating-index/weight blocks from HBM.
 
-* grid ``(B/TB, K/KC)``; the K axis is innermost so the ``[TB, R, R]``
-  normal-equation accumulators live in VMEM scratch across K chunks;
-* per chunk: one **in-VMEM dynamic row gather** ``table[idx]``
-  (``jnp.take`` — the Mosaic-support question the round-2 perf plan
-  flagged; `interpret=True` proves the math, the on-chip probe in
-  `tools/measure_tpu.sh` proves the lowering), then two MXU
-  contractions accumulate ``A += (cw·rows)ᵀ rows`` and ``b += bw·rows``;
-* on the last chunk: regularize and solve in place with the same
-  augmented Gauss-Jordan used by ``ops/solve.py``, writing only
-  ``x[TB, R]``.
+Round 5 proved on silicon that the original in-kernel ``jnp.take`` row
+gather NEVER lowers: Mosaic's gather rule
+(jax/_src/pallas/mosaic/lowering.py:2481-2484) accepts only
+``take_along_axis``-shaped operands.  The kernel now implements the two
+Mosaic-lowerable forms ``tools/probe_gather.py`` was built to arbitrate,
+selectable via ``ALSConfig(fused_gather=...)``:
 
-HBM traffic drops from ~256 bytes/rating (the materialized expansion)
-to ~12 bytes/rating (idx + two weights).
+* ``"taa"`` — same-shape ``take_along_axis(axis=0)`` sub-gathers: the
+  row ids are broadcast across lanes and the ``[TB*KC]`` id vector is
+  processed as ``ceil(TB*KC/MC)`` gathers of the ``[MC, R]`` table
+  chunk (each lowers to ``tpu.dynamic_gather`` along sublanes).  Keeps
+  the streamed-table third grid axis: tables beyond VMEM flow through
+  in id-range-masked chunks exactly as before.
+* ``"dma"`` — an in-kernel rolling-window ``pltpu.make_async_copy`` row
+  loop: the indices are scalar-prefetched to SMEM
+  (``PrefetchScalarGridSpec``) and each needed row is one async HBM ->
+  VMEM copy with ``_DMA_WINDOW`` outstanding.  Lowers by construction;
+  the table never occupies VMEM at all, so there is no streamed grid
+  and no id-range masking — the open question is pure issue rate,
+  answered on-chip by ``probe_gather``/``fused_smoke``.
 
-Tables BEYOND VMEM (the ML-20M user table, ~35 MB) run the same kernel
-TILED: a third grid axis streams the table through VMEM in chunks, and
-each chunk's contribution is masked by an id-range test before the
-accumulation.  The chunk reads are big contiguous DMAs at full HBM
-bandwidth — the opposite of the random-gather slow path the unfused
-expansion pays — so the item half's table traffic is
-``batch_tiles x |table|`` (~15 GB ≈ 20 ms at v5e bandwidth for ML-20M)
-instead of ~5 GB at the measured 17 GB/s gather rate (~300 ms).
+``fused_gather="auto"`` resolves per backend: ``resolve_gather_impl``
+ranks the forms with the SAME probe library the measurement battery
+runs (`ops/gather_probe.preferred_order`) and commits to the first form
+whose full-kernel compile-and-run probe (`fused_solver_ok`) passes.
+
+Mixed precision (the GPU-MF recipe, arXiv 1808.03843: reduced-precision
+operands, full-precision accumulation): the kernel accepts a bf16
+factor table — halving the resident-table VMEM footprint AND the
+streamed/DMA'd bytes, so ``fused_tile_plan`` residency reaches twice
+the table height — and keeps the gathered rows in the table dtype
+through both MXU contractions while accumulating the normal equations
+in fp32 VMEM scratch (``preferred_element_type=f32``; ``precision``
+threads through unchanged).  Regularization and the in-place augmented
+Gauss-Jordan solve stay f32.
+
+Per chunk: the gather, then two MXU contractions accumulate
+``A += (cw·rows)ᵀ rows`` and ``b += bw·rows``; on the last chunk the
+kernel regularizes and solves in place with the same augmented
+Gauss-Jordan used by ``ops/solve.py``, writing only ``x[TB, R]``.  HBM
+traffic drops from ~256 bytes/rating (the materialized expansion) to
+~12 bytes/rating (idx + two weights).
+
 ``models/als._solve_buckets`` routes any side through the kernel when a
-tile plan exists; ``fused_tile_plan`` caps the chunk count so
-pathological shapes fall back to XLA.
+tile plan exists; ``fused_tile_plan`` caps the chunk count (and, for
+``"taa"``, the unrolled sub-gather count; for ``"dma"``, the SMEM
+footprint of a batch tile's indices) so pathological shapes fall back
+to XLA.  Every jit entry is wrapped ``xray.instrument("als.fused")`` so
+a new tile plan, precision, table dtype, or gather impl shows up as a
+recompile with a per-arg delta at ``/debug/xray``.
 
 Reference provenance: this fuses what MLlib ALS does in separate stages
 per block (gather factors, accumulate YtY·normal equations, solve —
@@ -52,14 +77,20 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .solve import _EPS, solver_vmem_budget
+from ..obs import xray
+from .solve import _EPS, solver_smem_budget, solver_vmem_budget
 
 __all__ = [
+    "GATHER_IMPLS",
     "fused_gather_gram_solve",
     "fused_side_fits",
     "fused_solver_ok",
     "fused_tile_plan",
+    "resolve_gather_impl",
 ]
+
+# the Mosaic-lowerable in-kernel gather forms (docs/PERF_PLAN.md §4)
+GATHER_IMPLS = ("taa", "dma")
 
 
 def _pad8(n: int) -> int:
@@ -68,6 +99,12 @@ def _pad8(n: int) -> int:
 
 def _pad128(n: int) -> int:
     return max(-(-n // 128) * 128, 128)
+
+
+def _pad_sub(n: int, itemsize: int = 4) -> int:
+    """Pad to the dtype's memory-tile sublane count (8 f32 / 16 bf16)."""
+    s = max(32 // max(itemsize, 1), 8)
+    return max(-(-n // s) * s, s)
 
 
 # Cap on streamed table chunks.  The per-chunk re-read of the
@@ -80,21 +117,47 @@ def _pad128(n: int) -> int:
 # working-set math stops being the dominant consideration.
 _MAX_TABLE_CHUNKS = 64
 
+# Cap on the "taa" impl's unrolled same-shape sub-gathers per chunk
+# (ceil(TB*KC/MC) take_along_axis calls): each is a full [MC, R] pass,
+# so past this count both the compile size and the VMEM-bandwidth waste
+# (g*MC rows touched for TB*KC wanted) stop being worth a kernel.
+_MAX_TAA_SUBGATHERS = 32
 
-def fused_tile_plan(m: int, r: int, k: int, table_bytes: int = 4):
+# rolling window of outstanding row DMAs in the "dma" impl
+_DMA_WINDOW = 16
+
+
+def fused_tile_plan(
+    m: int, r: int, k: int, table_bytes: int = 4, gather_impl: str = "taa"
+):
     """Choose ``(TB, KC, MC)`` so the working set fits the VMEM budget.
 
     ``MC`` is the table-chunk height: ``MC >= M`` means the whole table
     is VMEM-resident (single chunk, no masking waste); smaller tables
     stream through in ``ceil(M/MC)`` chunks along the kernel's third
     grid axis.  Accounts for the PADDED footprints (Mosaic tiles the
-    trailing two dims to (8, 128) for f32): the double-buffered
-    ``[MC, R]`` table chunk, the ``[TB, R, R]`` + ``[TB, R, R+1]`` +
-    ``[TB, R]`` scratches, the ``[TB, KC, R]`` gathered chunk, and the
-    double-buffered ``[TB, KC]`` input / ``[TB, R]`` output blocks.
-    Returns ``None`` when no plan fits within ``_MAX_TABLE_CHUNKS``
-    (caller falls back to the XLA path).
+    trailing two dims to (8, 128) for f32, (16, 128) for bf16): the
+    double-buffered ``[MC, R]`` table chunk, the ``[TB, R, R]`` +
+    ``[TB, R, R+1]`` + ``[TB, R]`` f32 scratches, the ``[TB, KC, R]``
+    gathered chunk (in the TABLE dtype — a bf16 table halves it), and
+    the double-buffered ``[TB, KC]`` input / ``[TB, R]`` output blocks.
+
+    ``gather_impl="taa"`` additionally requires the unrolled sub-gather
+    count ``ceil(TB*KC/MC)`` within ``_MAX_TAA_SUBGATHERS``.
+
+    ``gather_impl="dma"`` budgets differently: the table stays in HBM
+    (rows arrive by per-row DMA into a ``[TB*KC, R]`` scratch), the
+    indices live in SMEM (``solver_smem_budget`` must hold one batch
+    tile's ``[TB, Kpad]`` int32 block), and ``MC`` is always the padded
+    table height (no streaming, no masking).
+
+    Returns ``None`` when no plan fits (caller falls back to XLA).
     """
+    if gather_impl not in GATHER_IMPLS:
+        raise ValueError(
+            f"gather_impl must be one of {GATHER_IMPLS}, "
+            f"got {gather_impl!r}"
+        )
     budget = int(solver_vmem_budget() * 0.9)
     r8, r128, w128 = _pad8(r), _pad128(r), _pad128(r + 1)
     m8 = _pad8(m)
@@ -109,32 +172,138 @@ def fused_tile_plan(m: int, r: int, k: int, table_bytes: int = 4):
             a_scr = tb * r8 * r128 * 4
             m_scr = tb * r8 * w128 * 4
             b_scr = _pad8(tb) * r128 * 4
-            rows = tb * _pad8(kc_eff) * r128 * 4
-            io = 3 * 2 * _pad8(tb) * _pad128(kc_eff) * 4  # idx/cw/bw x2
+            rows = (
+                tb * _pad_sub(kc_eff, table_bytes) * r128 * table_bytes
+            )
             out = 2 * _pad8(tb) * r128 * 4
             gram0 = r8 * r128 * 4
+            if gather_impl == "dma":
+                # idx rides SMEM (scalar prefetch), so VMEM holds only
+                # the two weight blocks; the table never enters VMEM
+                io = 2 * 2 * _pad8(tb) * _pad128(kc_eff) * 4
+                fixed = a_scr + m_scr + b_scr + rows + io + out + gram0
+                kp = -(-k // kc_eff) * kc_eff
+                if (
+                    fixed <= budget
+                    and tb * kp * 4 <= solver_smem_budget()
+                ):
+                    return tb, kc_eff, m8
+                continue
+            io = 3 * 2 * _pad8(tb) * _pad128(kc_eff) * 4  # idx/cw/bw x2
             fixed = a_scr + m_scr + b_scr + rows + io + out + gram0
             avail = budget - fixed
             if avail <= 0:
                 continue
             # whole table resident (single chunk, not double-buffered)?
             if m8 * r128 * table_bytes <= avail:
-                return tb, kc_eff, m8
+                if -(-(tb * kc_eff) // m8) <= _MAX_TAA_SUBGATHERS:
+                    return tb, kc_eff, m8
+                # tiny table under a big tile: the unroll would explode;
+                # a smaller tile may still make residency work
+                continue
             # else stream chunks (double-buffered by the pipeline);
             # remember the largest-tile streaming plan as the fallback
             if best_stream is None:
                 mc = (avail // 2 // (r128 * table_bytes)) // 8 * 8
-                if mc >= 8 and -(-m8 // mc) <= _MAX_TABLE_CHUNKS:
+                if (
+                    mc >= 8
+                    and -(-m8 // mc) <= _MAX_TABLE_CHUNKS
+                    and -(-(tb * kc_eff) // mc) <= _MAX_TAA_SUBGATHERS
+                ):
                     best_stream = (tb, kc_eff, int(mc))
     return best_stream
 
 
-def fused_side_fits(m: int, r: int, k_max: int, table_bytes: int = 4) -> bool:
-    """Does a fused tile plan (resident or streamed table) exist?"""
-    return fused_tile_plan(m, r, max(k_max, 1), table_bytes) is not None
+def fused_side_fits(
+    m: int, r: int, k_max: int, table_bytes: int = 4,
+    gather_impl: str = "taa",
+) -> bool:
+    """Does a fused tile plan exist for this side and gather impl?"""
+    return fused_tile_plan(
+        m, r, max(k_max, 1), table_bytes, gather_impl
+    ) is not None
 
 
-def _fused_kernel(
+def _gj_solve_writeback(a_scr, b_scr, m_scr, reg_ref, x_ref):
+    """Regularize + augmented Gauss-Jordan in place; write x[TB, R].
+
+    The same no-pivot elimination as ``ops/solve.py`` (safe: ALS always
+    solves ``Gram + reg·I ≻ 0``), on the fp32 accumulators.
+    """
+    tb, r, _ = a_scr.shape
+    w = r + 1
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    rows_i = jax.lax.broadcasted_iota(jnp.int32, (1, r), 1)
+    eye = (
+        jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)
+        == jax.lax.broadcasted_iota(jnp.int32, (r, r), 1)
+    ).astype(jnp.float32)
+    m_scr[:, :, :r] = a_scr[:] + reg_ref[:][:, :, None] * eye[None]
+    m_scr[:, :, r:w] = b_scr[:][:, :, None]
+
+    def gj_step(p, _):
+        M = m_scr[:]
+        ohr = (rows_i == p).astype(M.dtype)
+        ohc = (lanes == p).astype(M.dtype)
+        pr = jnp.sum(M * ohr[:, :, None], axis=1)
+        d = jnp.sum(pr * ohc, axis=-1)
+        prn = pr / jnp.where(jnp.abs(d) > _EPS, d, _EPS)[:, None]
+        col = jnp.sum(M * ohc[:, None, :], axis=-1)
+        colz = jnp.where(rows_i == p, 0.0, col)
+        upd = M - colz[:, :, None] * prn[:, None, :]
+        m_scr[:] = jnp.where(ohr[:, :, None] > 0, prn[:, None, :], upd)
+        return 0
+
+    jax.lax.fori_loop(0, r, gj_step, 0)
+    x_ref[:] = m_scr[:, :, r]
+
+
+def _accumulate(rows, cw, bw, a_scr, b_scr, precision):
+    """The two MXU contractions: fp32 accumulation over operands kept
+    in the TABLE dtype (bf16 tables feed the MXU bf16 operands — the
+    mixed-precision half of the GPU-MF recipe; the weights are cast
+    DOWN to match so the big ``rows`` operand is never silently
+    promoted and re-materialized in f32)."""
+    wdt = rows.dtype
+    rw = rows * cw.astype(wdt)[:, :, None]
+    a_scr[:] += jax.lax.dot_general(
+        rw, rows, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+    b_scr[:] += jax.lax.dot_general(
+        bw.astype(wdt), rows, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32, precision=precision,
+    )
+
+
+# ------------------------------------------------------------- taa --
+
+def _taa_rows(table_ref, safe, tb, kc, mc, r):
+    """``ceil(TB*KC/MC)`` same-shape ``take_along_axis(axis=0)``
+    sub-gathers (the Mosaic ``tpu.dynamic_gather`` form): the flat id
+    vector is padded to a multiple of MC, each MC-slice is broadcast
+    across the lane dim to the table chunk's own ``[MC, R]`` shape, and
+    the gathered slabs concatenate back to ``[TB, KC, R]``."""
+    flat_n = tb * kc
+    g = -(-flat_n // mc)
+    pad = g * mc - flat_n
+    flat = safe.reshape(flat_n)
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((pad,), jnp.int32)]
+        )
+    parts = []
+    for s in range(g):
+        sl = jax.lax.slice_in_dim(flat, s * mc, (s + 1) * mc, axis=0)
+        idx_b = jnp.broadcast_to(sl[:, None], (mc, r))
+        parts.append(jnp.take_along_axis(table_ref[:], idx_b, axis=0))
+    rows = parts[0] if g == 1 else jnp.concatenate(parts, axis=0)
+    return jax.lax.slice_in_dim(rows, 0, flat_n, axis=0).reshape(
+        tb, kc, r
+    )
+
+
+def _fused_kernel_taa(
     gram0_ref,   # [R, R] f32 (YtY for implicit mode; zeros otherwise)
     table_ref,   # [MC, R] opposite-table chunk (f32 or bf16)
     idx_ref,     # [TB, KC] int32 (masked entries point at row 0)
@@ -165,60 +334,25 @@ def _fused_kernel(
 
     # ids owned by THIS table chunk contribute; the rest are masked out
     # of the weights (single-chunk tables: the mask is all-true and the
-    # clip a no-op).  The in-VMEM dynamic row gather is the op whose
-    # Mosaic lowering the on-chip probe checks.
+    # clip a no-op)
     local = idx_ref[:] - t * mc
     inr = ((local >= 0) & (local < mc)).astype(jnp.float32)
     safe = jnp.clip(local, 0, mc - 1)
-    rows = jnp.take(
-        table_ref[:], safe.reshape(tb * kc), axis=0
-    ).reshape(tb, kc, r).astype(jnp.float32)
-    rw = rows * (cw_ref[:] * inr)[:, :, None]
-    # MXU: batched [KC, R]ᵀ[KC, R] -> [R, R] per tile row
-    a_scr[:] += jax.lax.dot_general(
-        rw, rows, (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
-    b_scr[:] += jax.lax.dot_general(
-        bw_ref[:] * inr, rows, (((1,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32, precision=precision,
+    rows = _taa_rows(table_ref, safe, tb, kc, mc, r)
+    _accumulate(
+        rows, cw_ref[:] * inr, bw_ref[:] * inr, a_scr, b_scr, precision
     )
 
     @pl.when((t == nt - 1) & (j == nj - 1))
     def _solve():
-        w = r + 1
-        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
-        rows_i = jax.lax.broadcasted_iota(jnp.int32, (1, r), 1)
-        eye = (
-            jax.lax.broadcasted_iota(jnp.int32, (r, r), 0)
-            == jax.lax.broadcasted_iota(jnp.int32, (r, r), 1)
-        ).astype(jnp.float32)
-        m_scr[:, :, :r] = (
-            a_scr[:] + reg_ref[:][:, :, None] * eye[None]
-        )
-        m_scr[:, :, r:w] = b_scr[:][:, :, None]
-
-        def gj_step(p, _):
-            M = m_scr[:]
-            ohr = (rows_i == p).astype(M.dtype)
-            ohc = (lanes == p).astype(M.dtype)
-            pr = jnp.sum(M * ohr[:, :, None], axis=1)
-            d = jnp.sum(pr * ohc, axis=-1)
-            prn = pr / jnp.where(jnp.abs(d) > _EPS, d, _EPS)[:, None]
-            col = jnp.sum(M * ohc[:, None, :], axis=-1)
-            colz = jnp.where(rows_i == p, 0.0, col)
-            upd = M - colz[:, :, None] * prn[:, None, :]
-            m_scr[:] = jnp.where(ohr[:, :, None] > 0, prn[:, None, :], upd)
-            return 0
-
-        jax.lax.fori_loop(0, r, gj_step, 0)
-        x_ref[:] = m_scr[:, :, r]
+        _gj_solve_writeback(a_scr, b_scr, m_scr, reg_ref, x_ref)
 
 
+@xray.instrument("als.fused")
 @functools.partial(
     jax.jit, static_argnames=("tb", "kc", "mc", "interpret", "precision")
 )
-def _fused_padded(
+def _fused_padded_taa(
     gram0, table, idx, cw, bw, reg, *, tb, kc, mc, interpret, precision
 ):
     bp, kp = idx.shape
@@ -232,7 +366,7 @@ def _fused_padded(
         (lambda i, t, j: (0, 0)) if mp == mc else (lambda i, t, j: (t, 0))
     )
     return pl.pallas_call(
-        functools.partial(_fused_kernel, precision=precision),
+        functools.partial(_fused_kernel_taa, precision=precision),
         out_shape=jax.ShapeDtypeStruct((bp, r), jnp.float32),
         grid=grid,
         in_specs=[
@@ -260,9 +394,122 @@ def _fused_padded(
     )(gram0, table, idx, cw, bw, reg)
 
 
+# ------------------------------------------------------------- dma --
+
+def _fused_kernel_dma(
+    idx_sref,    # [Bp, Kp] int32, scalar-prefetched to SMEM
+    gram0_ref,   # [R, R] f32
+    table_ref,   # [Mp, R] FULL table in ANY (HBM); rows arrive by DMA
+    cw_ref,      # [TB, KC] f32
+    bw_ref,      # [TB, KC] f32
+    reg_ref,     # [TB, 1] f32
+    x_ref,       # [TB, R] f32 out
+    rows_scr,    # [TB*KC, R] table-dtype landing pad for the row DMAs
+    a_scr,       # [TB, R, R] f32
+    b_scr,       # [TB, R] f32
+    m_scr,       # [TB, R, R+1] f32
+    sem,         # DMA semaphores, rolling window
+    *,
+    precision,
+):
+    i, j = pl.program_id(0), pl.program_id(1)
+    nj = pl.num_programs(1)
+    tb, kc = cw_ref.shape
+    r = gram0_ref.shape[0]
+    n = tb * kc
+    window = _DMA_WINDOW
+
+    @pl.when(j == 0)
+    def _init():
+        a_scr[:] = jnp.broadcast_to(
+            gram0_ref[:][None], (tb, r, r)
+        ).astype(jnp.float32)
+        b_scr[:] = jnp.zeros((tb, r), jnp.float32)
+
+    # one row DMA per (tile-row, chunk-col) with a rolling window of
+    # outstanding copies; wait re-materializes the same (src, dst, sem)
+    # triple, the probe-validated idiom
+    def issue(k):
+        row = idx_sref[i * tb + k // kc, j * kc + k % kc]
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(row, 1)],
+            rows_scr.at[pl.ds(k, 1)],
+            sem.at[k % window],
+        )
+
+    def body(k, _):
+        @pl.when(k >= window)
+        def _wait():
+            issue(k - window).wait()
+
+        issue(k).start()
+        return 0
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+    def drain(k, _):
+        issue(n - window + k).wait()
+        return 0
+
+    jax.lax.fori_loop(0, window, drain, 0)
+
+    rows = rows_scr[:].reshape(tb, kc, r)
+    # no id-range mask: the whole table is addressable from HBM, and
+    # masked entries already carry zero weights (idx contract: they
+    # point at row 0)
+    _accumulate(rows, cw_ref[:], bw_ref[:], a_scr, b_scr, precision)
+
+    @pl.when(j == nj - 1)
+    def _solve():
+        _gj_solve_writeback(a_scr, b_scr, m_scr, reg_ref, x_ref)
+
+
+@xray.instrument("als.fused")
+@functools.partial(
+    jax.jit, static_argnames=("tb", "kc", "interpret", "precision")
+)
+def _fused_padded_dma(
+    gram0, table, idx, cw, bw, reg, *, tb, kc, interpret, precision
+):
+    bp, kp = idx.shape
+    mp, r = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bp // tb, kp // kc),
+        in_specs=[
+            pl.BlockSpec((r, r), lambda i, j, idx_s: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((tb, kc), lambda i, j, idx_s: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, kc), lambda i, j, idx_s: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, 1), lambda i, j, idx_s: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tb, r), lambda i, j, idx_s: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((tb * kc, r), table.dtype),
+            pltpu.VMEM((tb, r, r), jnp.float32),
+            pltpu.VMEM((tb, r), jnp.float32),
+            pltpu.VMEM((tb, r, r + 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((_DMA_WINDOW,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_fused_kernel_dma, precision=precision),
+        out_shape=jax.ShapeDtypeStruct((bp, r), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(idx, gram0, table, cw, bw, reg)
+
+
+# ------------------------------------------------------------ entry --
+
 def fused_gather_gram_solve(
     table,          # [M, R] opposite factor table (f32 or bf16)
-    idx,            # [B, K] int32 opposite ids, masked entries arbitrary
+    idx,            # [B, K] int32 opposite ids, masked entries point at 0
     cw,             # [B, K] f32 Gram weights (0 where masked)
     bw,             # [B, K] f32 rhs weights (0 where masked)
     reg,            # [B]    f32 ridge diagonal
@@ -270,24 +517,34 @@ def fused_gather_gram_solve(
     interpret: bool | None = None,
     plan: tuple | None = None,
     precision=None,
+    gather_impl: str = "taa",
 ):
     """One fused normal-equation build + solve for a bucket of rows.
 
     Returns ``x[B, R]`` solving ``(gram0 + Σₖ cwₖ·vₖvₖᵀ + reg·I) x =
     Σₖ bwₖ·vₖ`` with ``vₖ = table[idx[:, k]]``.  Masking rides the
     weights: a masked entry's ``cw = bw = 0`` makes its gathered row
-    irrelevant (so ``idx`` may safely point anywhere, conventionally 0).
+    irrelevant (``idx`` must point at a valid row, conventionally 0 —
+    the ``"dma"`` impl really fetches it).
 
-    ``plan`` overrides the ``(TB, KC, MC)`` tile plan — used by the
-    compile probe to force the streamed multi-chunk grid on a small
-    table; production callers leave it None.
+    ``gather_impl`` selects the Mosaic-lowerable in-kernel gather form
+    (``GATHER_IMPLS``; module docstring).  ``plan`` overrides the
+    ``(TB, KC, MC)`` tile plan — used by the compile probe to force the
+    streamed multi-chunk grid on a small table; production callers
+    leave it None.
 
     ``precision`` is the MXU precision for the two in-kernel
     contractions — the same ``lax.Precision`` knob the unfused Gram
     einsums honor (``ALSConfig.matmul_precision``).  ``None`` means
-    HIGHEST: RMSE parity is the default contract, and callers feeding a
-    bf16 table opt down explicitly.
+    HIGHEST: RMSE parity is the default contract.  A bf16 table bounds
+    operand precision regardless (the contraction operands stay in the
+    table dtype; only the accumulators are f32).
     """
+    if gather_impl not in GATHER_IMPLS:
+        raise ValueError(
+            f"gather_impl must be one of {GATHER_IMPLS}, "
+            f"got {gather_impl!r}"
+        )
     if precision is None:
         precision = jax.lax.Precision.HIGHEST
     else:
@@ -297,11 +554,14 @@ def fused_gather_gram_solve(
     b, k = idx.shape
     m, r = table.shape
     if plan is None:
-        plan = fused_tile_plan(m, r, k, table.dtype.itemsize)
+        plan = fused_tile_plan(
+            m, r, k, table.dtype.itemsize, gather_impl
+        )
     if plan is None:
         raise ValueError(
-            f"fused ALS kernel: no tile plan for table [{m}, {r}] "
-            f"within the VMEM budget ({solver_vmem_budget()} B)"
+            f"fused ALS kernel ({gather_impl}): no tile plan for table "
+            f"[{m}, {r}] within the VMEM budget "
+            f"({solver_vmem_budget()} B)"
         )
     tb, kc, mc = plan
     bp = -(-b // tb) * tb
@@ -319,49 +579,87 @@ def fused_gather_gram_solve(
     reg = jnp.pad(
         reg.astype(jnp.float32), (0, bp - b), constant_values=1.0
     )[:, None]
-    x = _fused_padded(
-        gram0.astype(jnp.float32), table, idx, cw, bw, reg,
-        tb=tb, kc=kc, mc=mc, interpret=bool(interpret),
-        precision=precision,
-    )
+    gram0 = gram0.astype(jnp.float32)
+    if gather_impl == "dma":
+        # the scalar-prefetched [bs, Kp] index slab must fit SMEM: slice
+        # the batch dim so each pallas_call's slab stays under budget
+        # (equal tb-multiple slices share one compiled executable)
+        bs = max(
+            tb,
+            (solver_smem_budget() // max(kp * 4, 1)) // tb * tb,
+        )
+        outs = [
+            _fused_padded_dma(
+                gram0, table, idx[lo:lo + bs], cw[lo:lo + bs],
+                bw[lo:lo + bs], reg[lo:lo + bs],
+                tb=tb, kc=kc, interpret=bool(interpret),
+                precision=precision,
+            )
+            for lo in range(0, bp, bs)
+        ]
+        x = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+    else:
+        x = _fused_padded_taa(
+            gram0, table, idx, cw, bw, reg,
+            tb=tb, kc=kc, mc=mc, interpret=bool(interpret),
+            precision=precision,
+        )
     return x[:b]
 
 
-# (backend, m, r) -> probe result; process-wide like the GJ solver probe
+# (backend, m, r, bytes, precision, impl) -> probe result; process-wide
+# like the GJ solver probe
 _PROBE_CACHE: dict[tuple, bool] = {}
 
 
 def fused_solver_ok(
-    m: int, r: int, table_bytes: int = 4, precision=None
+    m: int, r: int, table_bytes: int = 4, precision=None,
+    gather_impl: str = "taa",
 ) -> bool:
-    """Compile-and-run probe for the fused kernel.
+    """Compile-and-run probe for ONE fused-kernel variant.
 
-    The kernel's speculative ops are the in-VMEM dynamic gather
-    (``jnp.take`` on a VMEM table) and the streamed-table grid (a third
-    grid axis with an id-range-masked gather) — M selects between the
-    resident and streamed shapes in production, so BOTH are probed on
-    small tables (a forced multi-chunk plan stands in for the big-table
-    case; the pipeline shape, not the table height, is what lowering
-    depends on).  ``precision`` must be the value production will run
-    with: it is a static arg of the pallas lowering, so a probe at a
-    different precision validates a different kernel variant.  Round 2
+    The kernel's speculative ops are the in-kernel gather form
+    (``take_along_axis`` sub-gathers for ``"taa"``; the scalar-prefetch
+    DMA row loop for ``"dma"``) and, for ``"taa"``, the streamed-table
+    grid — M selects between the resident and streamed shapes in
+    production, so BOTH are probed on small tables (a forced
+    multi-chunk plan stands in for the big-table case; the pipeline
+    shape, not the table height, is what lowering depends on).
+    ``precision`` and ``table_bytes`` must be the values production
+    will run with: both are static args of the pallas lowering, so a
+    probe at a different variant validates a different kernel.  Round 2
     proved kernels must be probed ON the target backend before
-    production use.  Cached per (backend, m, r, bytes, precision).
+    production use; round 5 proved a kernel can pass every interpret
+    test and still never lower.  Cached per (backend, m, r, bytes,
+    precision, impl).
     """
     import logging
 
     logger = logging.getLogger(__name__)
+    if gather_impl not in GATHER_IMPLS:
+        raise ValueError(
+            f"gather_impl must be one of {GATHER_IMPLS}, "
+            f"got {gather_impl!r}"
+        )
     prec = (
         jax.lax.Precision.HIGHEST if precision is None
         else jax.lax.Precision(precision)
     )
-    key = (jax.default_backend(), int(m), int(r), int(table_bytes), prec)
+    key = (
+        jax.default_backend(), int(m), int(r), int(table_bytes), prec,
+        gather_impl,
+    )
     cached = _PROBE_CACHE.get(key)
     if cached is not None:
         return cached
-    if fused_tile_plan(m, r, 8, table_bytes) is None:
+    if fused_tile_plan(m, r, 8, table_bytes, gather_impl) is None:
         _PROBE_CACHE[key] = False
         return False
+    # "taa" must also prove the streamed multi-chunk grid; "dma" has no
+    # streamed shape (the table never enters VMEM)
+    probe_plans = (
+        (None, (8, 128, 64)) if gather_impl == "taa" else (None,)
+    )
     try:
         dtype = jnp.bfloat16 if table_bytes == 2 else jnp.float32
         idx = jnp.zeros((8, 8), jnp.int32)
@@ -371,17 +669,18 @@ def fused_solver_ok(
         # b = 8·1 -> x = 8/(8r+1)·1
         want = 8.0 / (8.0 * r + 1.0)
         ok = True
-        for probe_plan in (None, (8, 128, 64)):  # resident, streamed x2
+        for probe_plan in probe_plans:
             table = jnp.ones((128, r), dtype)
             x = fused_gather_gram_solve(
                 table, idx, one, one, reg, plan=probe_plan,
-                precision=prec,
+                precision=prec, gather_impl=gather_impl,
             )
             got = float(np.asarray(x[0, :1])[0])
             if abs(got - want) >= 1e-4:
                 logger.warning(
-                    "fused ALS kernel probe (%s) returned %g (want %g) "
-                    "at r=%d; using the unfused path",
+                    "fused ALS kernel probe (%s, %s) returned %g "
+                    "(want %g) at r=%d; using the unfused path",
+                    gather_impl,
                     "streamed" if probe_plan else "resident",
                     got, want, r,
                 )
@@ -389,10 +688,41 @@ def fused_solver_ok(
                 break
     except Exception as e:  # noqa: BLE001 — any compile/lowering error
         logger.warning(
-            "fused ALS kernel unavailable at m=%d r=%d on %r (%s); "
-            "using the unfused path",
-            m, r, jax.default_backend(), e,
+            "fused ALS kernel (%s) unavailable at m=%d r=%d on %r "
+            "(%s); using the unfused path",
+            gather_impl, m, r, jax.default_backend(), e,
         )
         ok = False
     _PROBE_CACHE[key] = ok
     return ok
+
+
+def resolve_gather_impl(
+    m: int, r: int, table_bytes: int = 4, precision=None,
+    requested: str = "auto",
+) -> str | None:
+    """Resolve ``ALSConfig(fused_gather=...)`` to a runnable impl.
+
+    An explicit request is probed as-is (``None`` if its kernel does
+    not pass on this backend — the caller degrades to XLA, loudly).
+    ``"auto"`` walks the per-backend preference order from the SAME
+    probe library the measurement battery runs
+    (`ops/gather_probe.preferred_order`: static documentation order
+    off-TPU, measured gather timings on silicon) and commits to the
+    first impl whose full-kernel compile-and-run probe passes.
+    """
+    if requested in GATHER_IMPLS:
+        return requested if fused_solver_ok(
+            m, r, table_bytes, precision, requested
+        ) else None
+    if requested != "auto":
+        raise ValueError(
+            f"fused_gather must be 'auto' or one of {GATHER_IMPLS}, "
+            f"got {requested!r}"
+        )
+    from .gather_probe import preferred_order
+
+    for impl in preferred_order(r, table_bytes):
+        if fused_solver_ok(m, r, table_bytes, precision, impl):
+            return impl
+    return None

@@ -1,0 +1,400 @@
+"""Mosaic-lowerable gather forms: importable probe library.
+
+Round-5 on-chip finding (docs/PERF_PLAN.md §0): the fused ALS kernel's
+flat ``jnp.take(table, flat_idx)`` does NOT lower on TPU — Mosaic's
+``lax.gather`` rule (jax/_src/pallas/mosaic/lowering.py:2481-2484,
+jax 0.9.0) requires ``take_along_axis`` semantics: input, indices and
+output sharing one 2D shape, gathering along axis 0 or 1
+(``tpu.dynamic_gather``).  ``tools/probe_gather.py`` was built to
+arbitrate the lowerable replacements on the real chip; this module is
+the library form of those probes (A-D) so that
+
+* the fused kernel's ``fused_gather="auto"`` resolution can reuse the
+  SAME compile-and-run arbitration (`preferred_order`) instead of a
+  drifting copy of it, and
+* ``tools/probe_gather.py`` stays a thin CLI over functions the test
+  suite can exercise in interpret mode (the ``--smoke`` gate step).
+
+The probe forms:
+
+  A. ``taa0_gather`` — same-shape ``take_along_axis(axis=0)``: indices
+     broadcast across lanes; the form the fused kernel's ``"taa"``
+     gather impl unrolls as ``ceil(TB*KC/MC)`` sub-gathers per chunk.
+  B. ``taa1_gather`` — the transposed lane-dim variant (axis=1 on
+     ``[R, M]``); measured for completeness, not used by the kernel
+     (a lane-dim gather of rank-R columns wastes the sublane dim).
+  C. ``dma_row_gather`` — in-kernel rolling-window
+     ``pltpu.make_async_copy`` row loop, indices scalar-prefetched to
+     SMEM (``PrefetchScalarGridSpec``); the kernel's ``"dma"`` impl.
+  D. ``xla_take`` — the XLA ``jnp.take`` baseline on identical shapes
+     (what the unfused path pays); the bar every Pallas form must beat.
+
+Off-TPU everything runs through the Pallas interpreter: that validates
+shapes and math (the CPU smoke) and answers nothing about Mosaic
+lowering — ``preferred_order`` therefore returns the static
+documentation order off-TPU and only measures on the real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "dma_row_gather",
+    "preferred_order",
+    "probe_dma",
+    "probe_taa0",
+    "probe_taa1",
+    "probe_xla_grouped_take",
+    "probe_xla_take",
+    "smoke",
+    "taa0_gather",
+    "taa1_gather",
+    "xla_take",
+]
+
+_DMA_WINDOW = 16
+
+
+def _interpret() -> bool:
+    # off-TPU the probes run in interpret mode: validates shapes/logic
+    # (a CPU smoke), answers nothing about Mosaic lowering
+    return jax.default_backend() != "tpu"
+
+
+def _bench(fn, *args, reps=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps, out
+
+
+# ---------------------------------------------------------------- A --
+
+def _taa0_kernel(table_ref, idx_ref, out_ref):
+    # idx_ref [N, R] (row id broadcast across lanes); supported form:
+    # out[i, j] = table[idx[i, j], j]
+    out_ref[:] = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def taa0_gather(table, idx):
+    """Same-shape ``take_along_axis(axis=0)`` gather as a Pallas call.
+
+    ``table [N, R]``, ``idx [N, R]`` (row ids broadcast across lanes)
+    -> ``[N, R]``.  The Mosaic-supported ``tpu.dynamic_gather`` form.
+    """
+    n, r = table.shape
+    return pl.pallas_call(
+        _taa0_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, r), table.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(table, idx)
+
+
+def probe_taa0(n, r, dtype) -> dict:
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(n, r)).astype(np.float32)
+    ).astype(dtype)
+    rows = rng.integers(0, n, size=(n,)).astype(np.int32)
+    idx = jnp.asarray(np.broadcast_to(rows[:, None], (n, r)).copy())
+    try:
+        dt, out = _bench(taa0_gather, table, idx)
+        good = bool(
+            np.allclose(
+                np.asarray(out, np.float32),
+                np.asarray(table, np.float32)[rows],
+                atol=1e-2,
+            )
+        )
+        return dict(metric="taa_axis0", n=n, r=r,
+                    dtype=str(jnp.dtype(dtype).name), ok=good,
+                    seconds=dt, ns_per_row=dt / n * 1e9)
+    except Exception as e:  # noqa: BLE001 — lowering failures are data
+        return dict(metric="taa_axis0", n=n, r=r, ok=False,
+                    error=repr(e)[:300])
+
+
+# ---------------------------------------------------------------- B --
+
+def _taa1_kernel(table_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def taa1_gather(table, idx):
+    """Lane-dim ``take_along_axis(axis=1)`` on ``[R, M]`` (form B)."""
+    r, m = table.shape
+    return pl.pallas_call(
+        _taa1_kernel,
+        out_shape=jax.ShapeDtypeStruct((r, m), table.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(table, idx)
+
+
+def probe_taa1(m, r, dtype) -> dict:
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(r, m)).astype(np.float32)
+    ).astype(dtype)
+    cols = rng.integers(0, m, size=(m,)).astype(np.int32)
+    idx = jnp.asarray(np.broadcast_to(cols[None, :], (r, m)).copy())
+    try:
+        dt, out = _bench(taa1_gather, table, idx)
+        good = bool(
+            np.allclose(
+                np.asarray(out, np.float32),
+                np.asarray(table, np.float32)[:, cols],
+                atol=1e-2,
+            )
+        )
+        return dict(metric="taa_axis1", m=m, r=r, ok=good, seconds=dt,
+                    ns_per_col=dt / m * 1e9)
+    except Exception as e:  # noqa: BLE001
+        return dict(metric="taa_axis1", m=m, r=r, ok=False,
+                    error=repr(e)[:300])
+
+
+# ---------------------------------------------------------------- C --
+
+def _dma_kernel(idx_ref, table_ref, out_ref, sem):
+    # idx_ref is scalar-prefetched (SMEM); issue one row DMA per output
+    # row with a rolling window of _DMA_WINDOW outstanding copies.
+    nout = out_ref.shape[0]
+    window = _DMA_WINDOW
+
+    def issue(k):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(idx_ref[k], 1)],
+            out_ref.at[pl.ds(k, 1)],
+            sem.at[k % window],
+        )
+
+    def body(k, _):
+        @pl.when(k >= window)
+        def _wait():
+            issue(k - window).wait()  # same (src, dst, sem) triple
+
+        issue(k).start()
+        return 0
+
+    jax.lax.fori_loop(0, nout, body, 0)
+
+    def drain(k, _):
+        issue(nout - window + k).wait()
+        return 0
+
+    jax.lax.fori_loop(0, window, drain, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("nout",))
+def dma_row_gather(table, idx, *, nout):
+    """Rolling-window async row-copy gather (form C): ``table [M, R]``
+    stays in ANY/HBM, ``idx [nout]`` is scalar-prefetched to SMEM, one
+    ``make_async_copy`` per output row."""
+    _, r = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_DMA_WINDOW,))],
+    )
+    return pl.pallas_call(
+        _dma_kernel,
+        out_shape=jax.ShapeDtypeStruct((nout, r), table.dtype),
+        grid_spec=grid_spec,
+        interpret=_interpret(),
+    )(idx, table)
+
+
+def probe_dma(m, nout, r, dtype) -> dict:
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(m, r)).astype(np.float32)
+    ).astype(dtype)
+    rows = rng.integers(0, m, size=(nout,)).astype(np.int32)
+    idx = jnp.asarray(rows)
+    try:
+        dt, out = _bench(
+            functools.partial(dma_row_gather, nout=nout), table, idx
+        )
+        good = bool(
+            np.allclose(
+                np.asarray(out, np.float32),
+                np.asarray(table, np.float32)[rows],
+                atol=1e-2,
+            )
+        )
+        return dict(metric="dma_row_gather", m=m, nout=nout, r=r,
+                    ok=good, seconds=dt, ns_per_row=dt / nout * 1e9)
+    except Exception as e:  # noqa: BLE001
+        return dict(metric="dma_row_gather", m=m, nout=nout, r=r,
+                    ok=False, error=repr(e)[:300])
+
+
+# ---------------------------------------------------------------- E --
+
+def probe_xla_grouped_take(m, nout, r, dtype, group=None) -> list[dict]:
+    """Grouped slab gather, BOTH layouts, vs the plain row take.
+
+    Hypothesis for the measured ~17 GB/s of the plain row gather: each
+    rank-64 row is 256 B but the memory system moves (8,128)/(16,128)
+    tiles, a 16-32x waste.  Returns TWO records per call:
+
+    - ``xla_grouped3d_take`` — the PRODUCTION form
+      (`ALSConfig(gather_mode="grouped")`): gather [G, R] slices of the
+      3D view [M/G, G, R], whose trailing dims are the tiled ones, so
+      one gathered slice is whole tiles.
+    - ``xla_grouped_take`` — the 2D lane-slab [M/G, G*R] CONTROL arm:
+      its slab rows are 1 sublane tall, so the tile-height waste
+      remains; it should NOT beat the baseline.
+
+    ``group`` defaults to the dtype's tile sublane count (8 f32 /
+    16 bf16), matching production's ``grp`` exactly."""
+    if group is None:
+        group = 8 * (4 // jnp.dtype(dtype).itemsize)
+    mg = -(-m // group) * group
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(mg, r)).astype(np.float32)
+    ).astype(dtype)
+    idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
+
+    def grouped_lanes(t, i):
+        # 2D lane-slab form [M/G, G*R]: the G rows lie along LANES, so
+        # one slab row is 1 sublane tall — kept as the control arm that
+        # should NOT beat the tile-height waste
+        g = jnp.take(t.reshape(mg // group, group * r), i // group, axis=0)
+        sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
+        return jnp.take_along_axis(
+            g.reshape(nout, group, r), sel, axis=1
+        )[:, 0, :]
+
+    def grouped_tiles(t, i):
+        # 3D tile-slab form [M/G, G, R] (same bytes): trailing (G, R)
+        # dims are the tiled ones, so a gathered [G, R] slice is whole
+        # tiles — the production ALSConfig(gather_mode="grouped") form
+        g = jnp.take(t.reshape(mg // group, group, r), i // group, axis=0)
+        sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
+        return jnp.take_along_axis(g, sel, axis=1)[:, 0, :]
+
+    ref = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
+    want = np.asarray(ref(table, idx), np.float32)
+    bytes_useful = nout * r * table.dtype.itemsize
+    out = []
+    for name, fn in (("xla_grouped_take", grouped_lanes),
+                     ("xla_grouped3d_take", grouped_tiles)):
+        dt, got = _bench(jax.jit(fn), table, idx)
+        good = bool(
+            np.allclose(np.asarray(got, np.float32), want, atol=1e-2)
+        )
+        out.append(dict(metric=name, m=m, nout=nout, r=r, group=group,
+                        dtype=table.dtype.name, ok=good, seconds=dt,
+                        ns_per_row=dt / nout * 1e9,
+                        useful_gbps=bytes_useful / dt / 1e9))
+    return out
+
+
+# ---------------------------------------------------------------- D --
+
+def xla_take(table, idx):
+    """The XLA row-take baseline on identical shapes (form D)."""
+    return jnp.take(table, idx, axis=0)
+
+
+def probe_xla_take(m, nout, r, dtype) -> dict:
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.normal(size=(m, r)).astype(np.float32)
+    ).astype(dtype)
+    idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
+    take = jax.jit(xla_take)
+    dt, _ = _bench(take, table, idx)
+    bytes_moved = nout * r * table.dtype.itemsize
+    return dict(metric="xla_take", m=m, nout=nout, r=r,
+                dtype=table.dtype.name, seconds=dt,
+                ns_per_row=dt / nout * 1e9,
+                effective_gbps=bytes_moved / dt / 1e9)
+
+
+# -- arbitration ------------------------------------------------------------
+
+# fused-kernel gather impls in documentation order; "taa" first because
+# the sub-gather form keeps the MXU pipeline fed from VMEM while the DMA
+# loop's issue rate is the open on-chip question (PERF_PLAN §4 item 2)
+_STATIC_ORDER = ("taa", "dma")
+
+# (backend, r, table_bytes) -> measured preference order
+_ORDER_CACHE: dict[tuple, tuple] = {}
+
+
+def preferred_order(r: int = 64, table_bytes: int = 4) -> tuple:
+    """Gather-impl preference order for ``fused_gather="auto"``.
+
+    Off-TPU (interpret mode: every form "lowers", timings are
+    meaningless) this is the static documentation order — deterministic,
+    which the CPU test suite depends on.  On TPU it compile-and-runs the
+    small form-A and form-C probes once per (backend, rank, dtype) and
+    ranks the forms that actually lowered by measured per-row gather
+    time; forms that failed sort last so ``resolve_gather_impl`` still
+    probes them (the standalone probe and the full kernel can disagree —
+    only `fused_solver_ok` is authoritative for the kernel).
+    """
+    if jax.default_backend() != "tpu":
+        return _STATIC_ORDER
+    key = (jax.default_backend(), int(r), int(table_bytes))
+    cached = _ORDER_CACHE.get(key)
+    if cached is not None:
+        return cached
+    dtype = jnp.bfloat16 if table_bytes == 2 else jnp.float32
+    n = 2048
+    results = {
+        "taa": probe_taa0(n, r, dtype),
+        "dma": probe_dma(n, n, r, dtype),
+    }
+
+    def rank_key(impl):
+        rec = results[impl]
+        ok = bool(rec.get("ok"))
+        return (not ok, rec.get("ns_per_row", float("inf")))
+
+    order = tuple(sorted(_STATIC_ORDER, key=rank_key))
+    _ORDER_CACHE[key] = order
+    return order
+
+
+def smoke(r: int = 16) -> list[dict]:
+    """Small-shape run of every probe form: CPU interpret-mode shape and
+    logic validation (the gate.sh step), no lowering claims.  Returns
+    the records; raises nothing — a failed form carries ok=False."""
+    recs = [
+        probe_xla_take(512, 256, r, jnp.float32),
+        probe_taa0(256, r, jnp.float32),
+        probe_taa0(256, r, jnp.bfloat16),
+        probe_taa1(256, r, jnp.float32),
+        probe_dma(512, 256, r, jnp.float32),
+    ]
+    recs.extend(probe_xla_grouped_take(512, 256, r, jnp.float32))
+    return recs

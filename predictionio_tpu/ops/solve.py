@@ -52,6 +52,7 @@ __all__ = [
     "spd_solve_batched",
     "cholesky_solve_batched",
     "pallas_solver_ok",
+    "solver_smem_budget",
     "solver_vmem_budget",
     "solver_tile_footprint",
 ]
@@ -103,6 +104,24 @@ def solver_vmem_budget() -> int:
     if env:
         return int(env)
     return 16 << 20
+
+
+def solver_smem_budget() -> int:
+    """Per-core SMEM budget (bytes) for scalar-prefetched operands.
+
+    The fused kernel's ``"dma"`` gather impl prefetches a batch tile's
+    ``[TB, Kpad]`` int32 index block to SMEM
+    (``PrefetchScalarGridSpec``); SMEM is the scalar core's memory and
+    far smaller than VMEM, with no public query API either.  256 KiB is
+    a deliberately conservative planning default — the on-chip
+    ``fused_smoke``/``probe_gather`` battery is what validates the real
+    ceiling; ``PIO_TPU_SMEM_BYTES`` overrides it the same way
+    ``PIO_TPU_VMEM_BYTES`` overrides the VMEM budget.
+    """
+    env = os.environ.get("PIO_TPU_SMEM_BYTES")
+    if env:
+        return int(env)
+    return 256 << 10
 
 
 def solver_tile_footprint(tb: int, r: int) -> int:

@@ -343,6 +343,9 @@ class ALSAlgorithmParams(Params):
     # batched SPD solver: "xla" | "pallas" | "fused" (compile-probed;
     # degrades to xla if the kernel doesn't lower on this backend)
     solver: str = "xla"
+    # fused kernel's in-kernel gather form ("auto" | "taa" | "dma" —
+    # engine.json key fusedGather; models/als.py ALSConfig.fused_gather)
+    fused_gather: str = "auto"
     # rank-sweep strategy: "full" (R×R solve per row) | "subspace"
     # (iALS++ block sweep — engine.json keys solverMode/subspaceSize;
     # models/als.py ALSConfig.solver_mode)
@@ -393,6 +396,7 @@ class ALSAlgorithm(Algorithm):
             gather_dtype=p.gather_dtype,
             gather_mode=p.gather_mode,
             solver=p.solver,
+            fused_gather=p.fused_gather,
             solver_mode=p.solver_mode,
             subspace_size=p.subspace_size,
             factor_placement=p.factor_placement,

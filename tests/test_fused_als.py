@@ -1,8 +1,12 @@
 """Fused gather+Gram+solve kernel (`ops/fused_als.py`): interpret-mode
 parity against the unfused `_solve_buckets` path, per-side routing, tile
-sizing, and fail-safe degradation.  The on-chip lowering answer (the
-in-VMEM dynamic gather Mosaic question) comes from
-`tools/measure_tpu.sh` `fused_smoke`; everything here proves the math.
+sizing, and fail-safe degradation — for BOTH Mosaic-lowerable gather
+impls ("taa" take_along_axis sub-gathers, "dma" scalar-prefetched row
+copies) on resident AND forced-streamed plans, including indices that
+cross (8,128) tile boundaries, masked out-of-chunk ids, tail blocks,
+and the bf16-table/fp32-accumulation path.  The on-chip lowering
+answer comes from `tools/measure_tpu.sh` `fused_smoke` /
+`probe_gather`; everything here proves the math.
 """
 
 import numpy as np
@@ -10,10 +14,12 @@ import pytest
 
 from predictionio_tpu.models.als import ALSConfig, ALSTrainer, train_als
 from predictionio_tpu.ops.fused_als import (
+    GATHER_IMPLS,
     fused_gather_gram_solve,
     fused_side_fits,
     fused_solver_ok,
     fused_tile_plan,
+    resolve_gather_impl,
 )
 
 
@@ -128,8 +134,9 @@ def test_fused_mixed_routing_when_one_side_too_big(monkeypatch):
     real_fits = fmod.fused_side_fits
     calls = []
 
-    def gated(m, r, k_max, table_bytes=4):
-        fits = m <= ni and real_fits(m, r, k_max, table_bytes)
+    def gated(m, r, k_max, table_bytes=4, gather_impl="taa"):
+        fits = m <= ni and real_fits(m, r, k_max, table_bytes,
+                                     gather_impl)
         calls.append((m, fits))
         return fits
 
@@ -210,6 +217,246 @@ def test_probe_ok_in_interpret_mode(monkeypatch):
 
     monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
     assert fused_solver_ok(512, 8)
+
+
+# -- gather-impl parity suite (the PR-7 rewrite contract) --------------------
+
+
+def _dense_solve(table, idx, cw, bw, reg, gram0=None):
+    """Float64 per-row dense reference for the kernel's math."""
+    B, K = idx.shape
+    M, R = table.shape
+    t64 = np.asarray(table, np.float64)
+    out = np.zeros((B, R))
+    for b in range(B):
+        A = (np.zeros((R, R)) if gram0 is None
+             else np.asarray(gram0, np.float64).copy())
+        rhs = np.zeros(R)
+        for k in range(K):
+            row = t64[idx[b, k]]
+            A += float(cw[b, k]) * np.outer(row, row)
+            rhs += float(bw[b, k]) * row
+        A += float(reg[b]) * np.eye(R)
+        out[b] = np.linalg.solve(A, rhs)
+    return out
+
+
+def _parity_case(seed=0, M=300, R=8, B=11, K=24):
+    """Well-conditioned case with deliberately nasty index structure:
+    ids pinned onto (8,128) memory-tile boundaries (rows 0/7/8/127/128/
+    255/256/M-1 — the sublane- and lane-tile seams of the padded
+    table), plus masked entries whose weights are zero and whose ids
+    point at row 0 per the kernel contract.  B=11/K=24 are NOT
+    tile-multiples, so batch and K tails are always exercised."""
+    rng = np.random.default_rng(seed)
+    table = rng.normal(size=(M, R)).astype(np.float32)
+    idx = rng.integers(0, M, size=(B, K)).astype(np.int32)
+    boundary = np.array([0, 7, 8, 9, 127, 128, 129, 255, 256, M - 1],
+                        np.int32)
+    idx[:, : len(boundary)] = boundary[None, :]
+    mask = (rng.random((B, K)) < 0.8).astype(np.float32)
+    mask[:, -2:] = 0.0                      # guaranteed masked tail
+    idx = np.where(mask > 0, idx, 0).astype(np.int32)
+    val = (rng.random((B, K)) * 2 + 0.5).astype(np.float32)
+    cw = mask
+    bw = (val * mask).astype(np.float32)
+    reg = (rng.random(B).astype(np.float32) + 2.0)  # well-conditioned
+    return table, idx, cw, bw, reg
+
+
+@pytest.mark.parametrize("impl", GATHER_IMPLS)
+def test_gather_impl_matches_kernel_math_resident(impl):
+    """Both impls reproduce the dense normal-equation solve to 1e-5 on
+    a resident plan, tile-boundary ids and masked entries included."""
+    table, idx, cw, bw, reg = _parity_case()
+    plan = fused_tile_plan(table.shape[0], table.shape[1],
+                           idx.shape[1], 4, impl)
+    assert plan is not None and plan[2] >= table.shape[0]
+    x = np.asarray(fused_gather_gram_solve(
+        table, idx, cw, bw, reg, gather_impl=impl
+    ))
+    want = _dense_solve(table, idx, cw, bw, reg)
+    np.testing.assert_allclose(x, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("impl", GATHER_IMPLS)
+def test_gather_impl_forced_streamed_plan(impl):
+    """The forced multi-chunk plan (the big-table pipeline shape): for
+    "taa" this exercises the third grid axis + id-range masking with
+    ids scattered across EVERY chunk (out-of-chunk ids masked per
+    chunk); "dma" has no streamed grid — the same plan override must
+    still give identical results (mc only affects table padding)."""
+    table, idx, cw, bw, reg = _parity_case(seed=3)
+    x = np.asarray(fused_gather_gram_solve(
+        table, idx, cw, bw, reg, plan=(8, 128, 64), gather_impl=impl
+    ))
+    assert -(-table.shape[0] // 64) > 1  # really multi-chunk for taa
+    want = _dense_solve(table, idx, cw, bw, reg)
+    np.testing.assert_allclose(x, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("impl", GATHER_IMPLS)
+def test_gather_impls_bitwise_identical_outputs(impl):
+    """Each impl gathers the SAME rows — against the original flat-take
+    semantics (numpy fancy indexing) the gathered Gram systems must
+    agree to f32 accumulation noise, so cross-impl outputs match far
+    tighter than the dense-reference bound."""
+    table, idx, cw, bw, reg = _parity_case(seed=7)
+    ref = np.asarray(fused_gather_gram_solve(
+        table, idx, cw, bw, reg, gather_impl="taa"
+    ))
+    got = np.asarray(fused_gather_gram_solve(
+        table, idx, cw, bw, reg, gather_impl=impl
+    ))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("impl", GATHER_IMPLS)
+def test_gather_impl_bf16_table_fp32_accum(impl):
+    """bf16 table operands with fp32 accumulation: the mixed-precision
+    contract is ~bf16 operand noise (<1% relative), NOT f32 parity —
+    and must hold on both impls and both plan shapes."""
+    table, idx, cw, bw, reg = _parity_case(seed=11)
+    want = _dense_solve(table, idx, cw, bw, reg)
+    scale = np.abs(want).max()
+    import jax.numpy as jnp
+
+    t16 = jnp.asarray(table).astype(jnp.bfloat16)
+    for plan in (None, (8, 128, 64)):
+        x = np.asarray(fused_gather_gram_solve(
+            t16, idx, cw, bw, reg, plan=plan, gather_impl=impl
+        ))
+        rel = np.abs(x - want).max() / scale
+        assert rel < 0.01, (impl, plan, rel)
+
+
+@pytest.mark.parametrize("impl", GATHER_IMPLS)
+def test_fused_train_rmse_within_1pct_of_unfused(impl):
+    """End-to-end ALS: each impl's bf16-table train must land within
+    the 1% RMSE parity bound vs the f32 unfused reference (the
+    acceptance bound the on-chip A/B gates against)."""
+    from predictionio_tpu.models.als import rmse
+
+    u, i, v, nu, ni = _toy(seed=13)
+    kw = dict(rank=5, num_iterations=4, lam=0.05)
+    ref = train_als((u, i, v), nu, ni, ALSConfig(**kw))
+    rmse_ref = rmse(ref, u, i, v)
+    got = train_als((u, i, v), nu, ni, ALSConfig(
+        solver="fused", fused_gather=impl,
+        gather_dtype="bfloat16", **kw))
+    rmse_got = rmse(got, u, i, v)
+    assert abs(rmse_got - rmse_ref) <= 0.01 * max(rmse_ref, 1e-9), (
+        impl, rmse_ref, rmse_got,
+    )
+
+
+def test_dma_smem_budget_slices_batches(monkeypatch):
+    """A tight SMEM budget must slice the dma impl's batch dim (each
+    pallas_call's scalar-prefetch slab under budget) without changing
+    results; an impossibly tight one must kill the plan entirely."""
+    table, idx, cw, bw, reg = _parity_case(seed=17, B=24)
+    ref = np.asarray(fused_gather_gram_solve(
+        table, idx, cw, bw, reg, gather_impl="dma"
+    ))
+    # 8 rows x 128 padded K x 4 B = 4096 B per tile: a 4 KiB budget
+    # forces bs == tb == 8, i.e. 3 slices for B=24
+    monkeypatch.setenv("PIO_TPU_SMEM_BYTES", str(4096))
+    plan = fused_tile_plan(table.shape[0], table.shape[1],
+                           idx.shape[1], 4, "dma")
+    assert plan is not None and plan[0] == 8
+    sliced = np.asarray(fused_gather_gram_solve(
+        table, idx, cw, bw, reg, gather_impl="dma"
+    ))
+    np.testing.assert_allclose(sliced, ref, rtol=1e-6, atol=1e-6)
+    monkeypatch.setenv("PIO_TPU_SMEM_BYTES", str(64))
+    assert fused_tile_plan(table.shape[0], table.shape[1],
+                           idx.shape[1], 4, "dma") is None
+    assert not fused_side_fits(table.shape[0], table.shape[1],
+                               idx.shape[1], 4, "dma")
+
+
+def test_fused_gather_config_validation():
+    with pytest.raises(ValueError, match="fused_gather"):
+        ALSConfig(solver="fused", fused_gather="take")
+    with pytest.raises(ValueError, match="only applies"):
+        ALSConfig(solver="xla", fused_gather="taa")
+    # the default composes with every solver
+    assert ALSConfig(solver="pallas").fused_gather == "auto"
+
+
+def test_resolve_gather_impl_auto_and_explicit(monkeypatch):
+    from predictionio_tpu.ops import fused_als as fmod
+
+    monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
+    # interpret mode: every impl passes; auto commits to the static
+    # preference order's head
+    assert resolve_gather_impl(512, 8) == "taa"
+    assert resolve_gather_impl(512, 8, requested="dma") == "dma"
+    with pytest.raises(ValueError, match="fused_gather"):
+        resolve_gather_impl(512, 8, requested="nope")
+    # a dead impl resolves to the next candidate under auto, None when
+    # requested explicitly
+    monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
+    real_ok = fmod.fused_solver_ok
+
+    def taa_dead(m, r, table_bytes=4, precision=None, gather_impl="taa"):
+        if gather_impl == "taa":
+            return False
+        return real_ok(m, r, table_bytes, precision, gather_impl)
+
+    monkeypatch.setattr(fmod, "fused_solver_ok", taa_dead)
+    assert fmod.resolve_gather_impl(512, 8) == "dma"
+    assert fmod.resolve_gather_impl(512, 8, requested="taa") is None
+
+
+def test_trainer_resolves_and_records_gather_impl(monkeypatch):
+    """ALSTrainer exposes the RESOLVED impl (the bench-honesty field):
+    live fused -> the impl; degraded fused -> ("xla", None)."""
+    from predictionio_tpu.ops import fused_als as fmod
+
+    u, i, v, nu, ni = _toy(seed=19)
+    tr = ALSTrainer((u, i, v), nu, ni,
+                    ALSConfig(rank=5, num_iterations=2, solver="fused",
+                              fused_gather="dma"))
+    assert tr.solver == "fused" and tr.fused_gather == "dma"
+    assert np.isfinite(tr.train().user_factors).all()
+    # non-fused solvers carry None
+    tr2 = ALSTrainer((u, i, v), nu, ni, ALSConfig(rank=5,
+                                                  num_iterations=1))
+    assert tr2.fused_gather is None
+
+    monkeypatch.setattr(fmod, "_PROBE_CACHE", {})
+    monkeypatch.setattr(
+        fmod, "fused_solver_ok", lambda *a, **k: False
+    )
+    tr3 = ALSTrainer((u, i, v), nu, ni,
+                     ALSConfig(rank=5, num_iterations=1, solver="fused"))
+    assert tr3.solver == "xla" and tr3.fused_gather is None
+
+
+def test_fused_recompiles_land_in_xray_ring():
+    """The fused entries are xray-instrumented as "als.fused": a tile-
+    plan change (forced streamed plan) and a gather-impl change must
+    each register a new signature — the /debug/xray visibility the
+    loud-degradation contract requires."""
+    from predictionio_tpu.obs import xray
+
+    # shapes unique to THIS test: signatures are structural, so reusing
+    # another test's shapes would register nothing under -p no:randomly
+    table, idx, cw, bw, reg = _parity_case(seed=23, M=320, R=6, B=13,
+                                           K=26)
+    before = xray.jit_stats().get("als.fused", {}).get("signatures", 0)
+    fused_gather_gram_solve(table, idx, cw, bw, reg, gather_impl="taa")
+    fused_gather_gram_solve(table, idx, cw, bw, reg, gather_impl="taa",
+                            plan=(8, 128, 64))
+    fused_gather_gram_solve(table, idx, cw, bw, reg, gather_impl="dma")
+    stats = xray.jit_stats().get("als.fused")
+    assert stats is not None, "als.fused never registered with xray"
+    assert stats.get("signatures", 0) >= before + 3
+    fused_events = [
+        e for e in xray.recompile_events() if e.get("fn") == "als.fused"
+    ]
+    assert fused_events, "no als.fused recompile ring entries"
 
 
 @pytest.mark.parametrize("r", [96, 128])

@@ -13,6 +13,8 @@ single-process read.
 """
 
 import datetime as dt
+import functools
+import os
 import socket
 import subprocess
 import sys
@@ -26,6 +28,83 @@ from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
 
 UTC = dt.timezone.utc
 WORKER = Path(__file__).parent / "_multihost_worker.py"
+
+
+# -- multiprocess-collectives capability gate --------------------------------
+#
+# Every spawning test below needs jax.distributed collectives across
+# REAL processes.  Some jaxlib builds' CPU backend refuses them
+# ("Multiprocess computations aren't implemented on the CPU backend"),
+# which made these 7 tests fail ENVIRONMENTALLY on every tier-1 run
+# since PR 3 — red noise that buried real regressions.  Detect the
+# capability once at collection time with a minimal 2-process
+# broadcast probe (the exact op the workers die on) and skip loudly
+# when it is absent; where collectives exist (a fixed jaxlib, a real
+# multihost runner) the suite runs in full.  PIO_TPU_RUN_MULTIHOST=1
+# skips the probe and forces the tests to run (e.g. to re-confirm the
+# failure mode or exercise a candidate jaxlib).
+
+_COLLECTIVES_PROBE = """
+import sys
+import jax
+jax.distributed.initialize(
+    sys.argv[1], num_processes=2, process_id=int(sys.argv[2])
+)
+import numpy as np
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(np.ones(1))
+print("COLLECTIVES_OK")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@functools.lru_cache(maxsize=1)
+def _collectives_unavailable_reason():
+    """None when 2-process jax.distributed collectives work on this
+    backend; otherwise the specific failure (the skip reason)."""
+    if os.environ.get("PIO_TPU_RUN_MULTIHOST") == "1":
+        return None
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _COLLECTIVES_PROBE, coordinator,
+             str(p)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for p in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return "2-process collectives probe timed out after 120s"
+        outs.append((p.returncode, out or ""))
+    if all(rc == 0 and "COLLECTIVES_OK" in out for rc, out in outs):
+        return None
+    bad = next((o for rc, o in outs if rc != 0), outs[0][1])
+    tail = bad.strip().splitlines()[-1][-300:] if bad.strip() else "?"
+    return (
+        "this jax backend cannot run multiprocess collectives "
+        f"(2-process broadcast probe failed: {tail}); the multihost "
+        "suite is environmental here — run it where collectives exist, "
+        "or force with PIO_TPU_RUN_MULTIHOST=1"
+    )
+
+
+needs_collectives = pytest.mark.skipif(
+    _collectives_unavailable_reason() is not None,
+    reason=str(_collectives_unavailable_reason()),
+)
 
 
 def _make_events(n_users=12, n_items=8, seed=0):
@@ -48,12 +127,6 @@ def _make_events(n_users=12, n_items=8, seed=0):
                     )
                 )
     return events
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def test_shard_masks_partition_events(tmp_path):
@@ -121,6 +194,7 @@ def _spawn_workers(nprocs, args_of, timeout=300, device_count=0):
     return results
 
 
+@needs_collectives
 @pytest.mark.parametrize("nprocs", [2, 4])
 def test_multi_process_ingest_and_train(tmp_path, nprocs):
     """jax.distributed CPU processes each read their shard; the gathered
@@ -175,6 +249,7 @@ def test_multi_process_ingest_and_train(tmp_path, nprocs):
         )
 
 
+@needs_collectives
 def test_two_process_run_train_end_to_end(tmp_path):
     """The FULL workflow across 2 processes sharing one storage home:
     run_train (sharded ingest, SPMD train, chief-only metadata/model
@@ -235,6 +310,7 @@ def test_two_process_run_train_end_to_end(tmp_path):
     )
 
 
+@needs_collectives
 @pytest.mark.parametrize(
     "nprocs,device_count",
     [(2, 2), (4, 0)],
@@ -310,6 +386,7 @@ def test_sharded_coo_distributed_trainer(tmp_path, nprocs, device_count):
         )
 
 
+@needs_collectives
 def test_run_train_no_full_coo_end_to_end(tmp_path):
     """The FULL workflow with datasource coo='local' + sharded placement:
     run_train never gathers the rating set to any process, yet trains,
@@ -371,6 +448,7 @@ def test_run_train_no_full_coo_end_to_end(tmp_path):
     )
 
 
+@needs_collectives
 def test_sharded_distributed_trainer_fused_solver(tmp_path):
     """The fused gather+Gram+solve kernel inside the distributed
     sharded-COO path (2 jax.distributed processes x 2 devices): the

@@ -86,10 +86,41 @@ def main() -> None:
     say.append(f"## GJ solver lowers: {lowered if gj else 'absent'}\n")
     fs = _lines(d / "fused_smoke.json")
     if fs:
-        oks = {r["metric"]: r.get("ok") for r in fs if "ok" in r}
+        # probes are per gather impl since the round-7 rewrite; key by
+        # (metric, impl) so taa and dma rows don't collapse
+        oks = {
+            (r["metric"] + (f"[{r['impl']}]" if r.get("impl") else "")):
+            r.get("ok", r.get("plan", r.get("impl")))
+            for r in fs if "ok" in r or "plan" in r or "impl" in r
+        }
         say.append(f"## Fused kernel probes: {oks or 'no ok fields'}\n")
     else:
         say.append("## Fused kernel probes: absent\n")
+
+    # ---- fused-vs-unfused gather+Gram A/B ----
+    ab_rows = []
+    for stem in ("fused_ab", "fused_ab_taa", "fused_ab_dma",
+                 "fused_ab_bf16"):
+        for r in _lines(d / f"{stem}.json"):
+            if r.get("metric") in (
+                "als_user_half_unfused_gather_gram_seconds",
+                "als_user_half_fused_seconds",
+                "fused_vs_unfused_gather_gram_speedup",
+            ):
+                ab_rows.append((stem, r))
+    if ab_rows:
+        say.append("## Fused-vs-unfused gather+Gram A/B\n")
+        for stem, r in ab_rows:
+            tag = (f" impl={r['fused_gather_resolved']}"
+                   if r.get("fused_gather_resolved") else "")
+            deg = " DEGRADED" if r.get("degraded") else ""
+            say.append(
+                f"- {stem}: {r['metric']} = {r.get('value')}"
+                f"{tag}{deg}"
+            )
+        say.append("")
+    else:
+        say.append("## Fused-vs-unfused A/B: absent\n")
 
     # ---- config matrix ----
     mx = [r for r in _lines(d / "config_matrix.json")

@@ -36,12 +36,27 @@ CONFIGS = [
     ("gather_grouped_bf16",
      {"gather_mode": "grouped", "gather_dtype": "bfloat16"}, "auto"),
     ("precision_high", {"matmul_precision": "high"}, "auto"),
+    # the fused gather+Gram+solve kernel, per gather form (auto =
+    # probe-arbitrated; the explicit rows pin each Mosaic-lowerable
+    # form so the matrix answers WHICH form wins, not just whether one
+    # does).  Each row's record carries fused_gather_resolved +
+    # degraded, so a probe-failure fallback reads as exactly that.
+    ("solver_fused_auto", {"solver": "fused"}, "auto"),
+    ("solver_fused_taa", {"solver": "fused", "fused_gather": "taa"},
+     "auto"),
+    ("solver_fused_dma", {"solver": "fused", "fused_gather": "dma"},
+     "auto"),
+    ("solver_fused_bf16",
+     {"solver": "fused", "gather_dtype": "bfloat16"}, "auto"),
     ("best_pallas_bf16_high",
      {"solver": "pallas", "gather_dtype": "bfloat16",
       "matmul_precision": "high"}, "auto"),
     ("best_plus_grouped",
      {"solver": "pallas", "gather_dtype": "bfloat16",
       "matmul_precision": "high", "gather_mode": "grouped"}, "auto"),
+    ("best_fused_bf16_high",
+     {"solver": "fused", "gather_dtype": "bfloat16",
+      "matmul_precision": "high"}, "auto"),
     ("staging_host", {}, "host"),
 ]
 
@@ -117,6 +132,9 @@ def main() -> None:
                 "solver": trainer.solver,
                 **({"degraded": True}
                    if trainer.solver != cfg.solver else {}),
+                **({"fused_gather_requested": cfg.fused_gather,
+                    "fused_gather_resolved": trainer.fused_gather}
+                   if cfg.solver == "fused" else {}),
                 "staging": trainer.staging,
                 "achieved_tflops_per_s": round(flops / per_iter / 1e12, 3),
                 "mfu": (round(flops / per_iter / peak, 5)

@@ -22,7 +22,7 @@ fi
 
 # 1) piolint: JAX-aware static analysis + lock discipline (PIO1xx/PIO2xx)
 REPORT="${PIOLINT_REPORT:-/tmp/piolint_report.json}"
-echo "gate [1/5] piolint (report: $REPORT)" >&2
+echo "gate [1/6] piolint (report: $REPORT)" >&2
 if ! python -m predictionio_tpu.analysis --format text \
        --report "$REPORT" "${PIOLINT_ARGS[@]+"${PIOLINT_ARGS[@]}"}"; then
   echo "gate FAILED: piolint found non-baseline findings" >&2
@@ -34,7 +34,7 @@ fi
 
 # 2) generic lint (ruff: pyflakes + isort per pyproject.toml) — the CI
 # image doesn't ship ruff, so absence is a skip, not a failure
-echo "gate [2/5] ruff" >&2
+echo "gate [2/6] ruff" >&2
 if command -v ruff >/dev/null 2>&1; then
   ruff check . || { echo "gate FAILED: ruff" >&2; exit 1; }
 elif python -m ruff --version >/dev/null 2>&1; then
@@ -43,12 +43,30 @@ else
   echo "  ruff not installed; skipping generic lint" >&2
 fi
 
-# 3) pio-xray smoke: boots a trained engine server with the ALS phase
+# 3) gather-form + fused-kernel smoke: every Mosaic-lowerable gather
+# form's math in interpret mode (tools/probe_gather.py --smoke — shape/
+# logic validation, NO lowering claims; lowering is answered on-chip by
+# the measure_tpu.sh battery) plus the fused-kernel interpret parity
+# suite — cheap-first so a kernel math break fails in ~1 min, not after
+# the full suite
+echo "gate [3/6] gather probe smoke + fused interpret parity" >&2
+if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+     python tools/probe_gather.py --smoke > /tmp/probe_gather_smoke.json; then
+  echo "gate FAILED: gather-form smoke (see /tmp/probe_gather_smoke.json)" >&2
+  exit 1
+fi
+if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+     python -m pytest tests/test_fused_als.py -q -p no:cacheprovider; then
+  echo "gate FAILED: fused-kernel interpret parity suite" >&2
+  exit 1
+fi
+
+# 4) pio-xray smoke: boots a trained engine server with the ALS phase
 # tracer armed, forces a serving-path recompile, and asserts the
 # compiler-observability contract (pio_jit_compiles_total increments,
 # /debug/xray's recompile ring parses and carries the signature delta,
 # exemplar trace ids resolve to flight-recorder span trees)
-echo "gate [3/5] xray smoke" >&2
+echo "gate [4/6] xray smoke" >&2
 XRAY_OUT="${XRAY_SMOKE_OUT:-/tmp/xray_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PIO_TPU_TRACE_ALS=1 \
      python tools/xray_smoke.py --out "$XRAY_OUT"; then
@@ -56,22 +74,22 @@ if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PIO_TPU_TRACE_ALS=1 \
   exit 1
 fi
 
-# 4) bench trajectory gate: the newest fenced BENCH_HISTORY.jsonl
+# 5) bench trajectory gate: the newest fenced BENCH_HISTORY.jsonl
 # record must sit within the noise-aware threshold of its rolling
 # median baseline; --allow-empty keeps the gate green until the
 # trajectory is >= min-samples deep (it still fails on a judged
 # regression)
-echo "gate [4/5] bench trajectory (tools/bench_gate.py)" >&2
+echo "gate [5/6] bench trajectory (tools/bench_gate.py)" >&2
 if ! python tools/bench_gate.py --check --allow-empty; then
   echo "gate FAILED: bench trajectory regressed beyond noise" >&2
   echo "  inspect: python tools/bench_gate.py --check" >&2
   exit 1
 fi
 
-# 5) the full test suite — includes the end-to-end smokes that boot
+# 6) the full test suite — includes the end-to-end smokes that boot
 # real servers: tools/chaos_smoke.py (via tests/test_chaos_smoke.py),
 # tools/obs_smoke.py (/metrics exposition + trace propagation) and
 # tools/xray_smoke.py again under pytest env isolation
 # (tests/test_xray_smoke.py)
-echo "gate [5/5] pytest" >&2
+echo "gate [6/6] pytest" >&2
 exec python -m pytest tests/ -q "$@"

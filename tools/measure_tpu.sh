@@ -51,33 +51,50 @@ for R in (10, 64, 128):
 print({'metric': 'gj_kernel_smoke', 'lowered': True})
 "
 
-# does the FUSED gather+Gram+solve kernel lower?  Its speculative op is
-# the in-VMEM dynamic row gather (jnp.take on a resident table) — the
-# exact Mosaic-support question docs/PERF_PLAN.md 4 told us to answer
-# on-chip before trusting the kernel.  Probes f32 and bf16 tables at
-# rank 64 + an ML-20M-shaped table, then times one fused bucket.
+# does the FUSED gather+Gram+solve kernel lower?  Round 5 answered NO
+# for the original flat jnp.take; the kernel now carries the two
+# Mosaic-lowerable gather forms (docs/PERF_PLAN.md 4): "taa"
+# take_along_axis sub-gathers and the "dma" scalar-prefetch row-copy
+# loop.  Probes EVERY (impl, dtype) variant at rank 64 — the
+# jaxlib-upgrade regression canary — plus the auto-resolution, then
+# times one fused bucket per impl on both ML-20M-shaped tables.
 run fused_smoke         python -c "
 import time, numpy as np, jax, jax.numpy as jnp
-from predictionio_tpu.ops.fused_als import fused_solver_ok, fused_gather_gram_solve, fused_tile_plan
+from predictionio_tpu.ops.fused_als import (
+    GATHER_IMPLS, fused_solver_ok, fused_gather_gram_solve,
+    fused_tile_plan, resolve_gather_impl)
 from predictionio_tpu.parallel.mesh import fence
-print({'metric': 'fused_probe_f32_r64', 'ok': fused_solver_ok(512, 64, 4)})
-print({'metric': 'fused_probe_bf16_r64', 'ok': fused_solver_ok(512, 64, 2)})
-print({'metric': 'fused_tile_plan_ml20m_f32', 'plan': fused_tile_plan(26744, 64, 4096, 4)})
-print({'metric': 'fused_tile_plan_ml20m_bf16', 'plan': fused_tile_plan(26744, 64, 4096, 2)})
+for impl in GATHER_IMPLS:
+    print({'metric': 'fused_probe_f32_r64', 'impl': impl,
+           'ok': fused_solver_ok(512, 64, 4, gather_impl=impl)})
+    print({'metric': 'fused_probe_bf16_r64', 'impl': impl,
+           'ok': fused_solver_ok(512, 64, 2, gather_impl=impl)})
+    print({'metric': 'fused_tile_plan_ml20m_f32', 'impl': impl,
+           'plan': fused_tile_plan(26744, 64, 4096, 4, impl)})
+    print({'metric': 'fused_tile_plan_ml20m_bf16', 'impl': impl,
+           'plan': fused_tile_plan(26744, 64, 4096, 2, impl)})
+print({'metric': 'fused_gather_resolved_auto_f32',
+       'impl': resolve_gather_impl(512, 64, 4)})
+print({'metric': 'fused_gather_resolved_auto_bf16',
+       'impl': resolve_gather_impl(512, 64, 2)})
 rng = np.random.default_rng(0)
-for M, name in ((26744, 'item_table_resident'), (138493, 'user_table_streamed')):
-    R, B, K = 64, 4096, 128
-    tbl = jnp.asarray(rng.normal(size=(M, R)).astype(np.float32)).astype(jnp.bfloat16)
-    idx = jnp.asarray(rng.integers(0, M, size=(B, K)).astype(np.int32))
-    w = jnp.ones((B, K), jnp.float32)
-    reg = jnp.ones((B,), jnp.float32)
-    x = fused_gather_gram_solve(tbl, idx, w, w, reg); fence(x)
-    t0 = time.time()
-    for _ in range(5):
-        x = fused_gather_gram_solve(tbl, idx, w, w, reg)
-    fence(x)
-    print({'metric': 'fused_bucket_seconds', 'side': name, 'M': M, 'B': B, 'K': K,
-           'plan': fused_tile_plan(M, R, K, 2), 'value': (time.time()-t0)/5})
+for impl in GATHER_IMPLS:
+    if not fused_solver_ok(512, 64, 2, gather_impl=impl):
+        continue
+    for M, name in ((26744, 'item_table'), (138493, 'user_table')):
+        R, B, K = 64, 4096, 128
+        tbl = jnp.asarray(rng.normal(size=(M, R)).astype(np.float32)).astype(jnp.bfloat16)
+        idx = jnp.asarray(rng.integers(0, M, size=(B, K)).astype(np.int32))
+        w = jnp.ones((B, K), jnp.float32)
+        reg = jnp.ones((B,), jnp.float32)
+        x = fused_gather_gram_solve(tbl, idx, w, w, reg, gather_impl=impl); fence(x)
+        t0 = time.time()
+        for _ in range(5):
+            x = fused_gather_gram_solve(tbl, idx, w, w, reg, gather_impl=impl)
+        fence(x)
+        print({'metric': 'fused_bucket_seconds', 'impl': impl, 'side': name,
+               'M': M, 'B': B, 'K': K, 'plan': fused_tile_plan(M, R, K, 2, impl),
+               'value': (time.time()-t0)/5})
 "
 
 # the full config A/B matrix in ONE process (one backend init, one
@@ -87,11 +104,22 @@ for M, name in ((26744, 'item_table_resident'), (138493, 'user_table_streamed'))
 # steps (each paid its own backend init; VERDICT-r5-era cleanup).
 STEP_TIMEOUT=2400 run config_matrix python tools/breakdown_matrix.py
 
-# which Mosaic-supported gather form can replace the fused kernel's
-# unsupported jnp.take (round-5: lowering.py:2484 rejects it)?  Times
+# which Mosaic-supported gather form wins inside the fused kernel
+# (round-5: lowering.py:2484 rejects the flat jnp.take)?  Times
 # take_along_axis sublane/lane gathers, DMA row-copy loops, and the
-# XLA take baseline — the data that decides the fused-kernel rewrite.
+# XLA take baseline — the same library arbitration fused_gather="auto"
+# applies in-process (ops/gather_probe.preferred_order).
 run probe_gather        python tools/probe_gather.py
+
+# the fenced fused-vs-unfused gather+Gram phase A/B per gather form:
+# appends canonical als_user_half_{fused,unfused_gather_gram}_seconds
+# records to BENCH_HISTORY.jsonl so bench_gate.py gates the Gram phase
+# (ROADMAP item 3 target: >=2x on the combined gather+Gram wall at
+# rank 64, RMSE within the 1% bound — the matrix rows carry the RMSE)
+run fused_ab            python bench.py --fused-ab
+run fused_ab_taa        python bench.py --fused-ab --fused-gather taa
+run fused_ab_dma        python bench.py --fused-ab --fused-gather dma
+run fused_ab_bf16       python bench.py --fused-ab --gather-dtype bfloat16
 
 # the A/Bs (device staging is the default at full scale)
 run breakdown           python bench.py --breakdown --phase-probe --profile "$OUT/trace"

@@ -1,337 +1,123 @@
-"""On-chip probes for Mosaic-lowerable dynamic-gather forms.
+"""On-chip probes for Mosaic-lowerable dynamic-gather forms (CLI).
 
 Round-5 finding: the fused ALS kernel's ``jnp.take(table, flat_idx)``
 does NOT lower on TPU — Mosaic's ``lax.gather`` rule
 (jax/_src/pallas/mosaic/lowering.py:2481-2484, jax 0.9.0) requires
-``input.shape == indices.shape[:-1] == output.shape`` (i.e.
-``take_along_axis`` semantics along axis 0 or 1), while the kernel
-needs ``[TB*KC, R]`` rows out of an ``[MC, R]`` table.
+``take_along_axis`` semantics.  The probe implementations now live in
+``predictionio_tpu/ops/gather_probe.py`` so the fused kernel's
+``fused_gather="auto"`` resolution reuses the SAME compile-and-run
+arbitration this battery step records; this file is the thin CLI the
+measurement battery (``tools/measure_tpu.sh``) and the gate's CPU
+smoke invoke.
 
-This script measures, on the real chip, every candidate replacement:
+This script measures, on the real chip, every candidate form:
 
   A. same-shape ``take_along_axis(axis=0)`` sub-gathers — indices
-     broadcast across lanes, ``ceil(TB*KC/MC)`` gathers per chunk;
+     broadcast across lanes (the fused kernel's ``"taa"`` impl);
   B. the transposed lane-dim variant (``axis=1`` on ``[R, M]``);
   C. an in-kernel rolling-window ``pltpu.make_async_copy`` row loop
-     (indices scalar-prefetched to SMEM);
+     (indices scalar-prefetched to SMEM — the ``"dma"`` impl);
   D. the XLA ``jnp.take`` baseline on identical shapes (what the
      unfused path pays today), f32 and bf16.
 
 Each probe prints one JSON line; lowering failures print
 ``{"ok": false, "error": ...}`` instead of raising, so the battery can
 run this unattended.  Decision rule: a Pallas form wins if its
-per-element gather time beats D's; otherwise the fused kernel stays
-retired and docs/PERF_PLAN.md records why.
+per-element gather time beats D's; ``resolve_gather_impl`` applies the
+same ordering in-process, and docs/PERF_PLAN.md §4 records the
+standing answer.
+
+``--smoke`` runs every form at small shapes (CPU interpret-mode shape
+and logic validation for ``tools/gate.sh`` — NO lowering claims) and
+exits nonzero if any form's math is wrong.
 """
 
 from __future__ import annotations
 
-import functools
+import argparse
 import json
-import time
+import sys
+from pathlib import Path
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
-def _interpret() -> bool:
-    # off-TPU the probes run in interpret mode: validates shapes/logic
-    # (a CPU smoke), answers nothing about Mosaic lowering
-    return jax.default_backend() != "tpu"
+from predictionio_tpu.ops import gather_probe as gp  # noqa: E402
 
 
-def _bench(fn, *args, reps=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps, out
+def _emit(rec) -> None:
+    print(json.dumps(rec), flush=True)
 
 
-def _emit(**kw):
-    print(json.dumps(kw), flush=True)
+def run_smoke() -> int:
+    """Small-shape run of every form: interpret-mode math validation."""
+    _emit({"metric": "probe_env", "backend": jax.default_backend(),
+           "mode": "smoke",
+           "note": "shape/logic validation only — lowering claims "
+                   "require a TPU backend"})
+    recs = gp.smoke()
+    bad = 0
+    for rec in recs:
+        _emit(rec)
+        if rec.get("ok") is False:
+            bad += 1
+    _emit({"metric": "probe_smoke_summary", "forms": len(recs),
+           "failed": bad, "ok": bad == 0})
+    return 1 if bad else 0
 
 
-# ---------------------------------------------------------------- A --
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="small-shape CPU interpret-mode validation of "
+                    "every gather form (the gate.sh step); exits "
+                    "nonzero on any math mismatch")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return run_smoke()
 
-def _taa0_kernel(table_ref, idx_ref, out_ref):
-    # idx_ref [N, R] (row id broadcast across lanes); supported form:
-    # out[i, j] = table[idx[i, j], j]
-    out_ref[:] = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=0)
-
-
-@functools.partial(jax.jit, static_argnames=())
-def _taa0(table, idx):
-    n, r = table.shape
-    return pl.pallas_call(
-        _taa0_kernel,
-        out_shape=jax.ShapeDtypeStruct((n, r), table.dtype),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=_interpret(),
-    )(table, idx)
-
-
-def probe_taa0(n, r, dtype):
-    rng = np.random.default_rng(0)
-    table = jnp.asarray(
-        rng.normal(size=(n, r)).astype(np.float32)
-    ).astype(dtype)
-    rows = rng.integers(0, n, size=(n,)).astype(np.int32)
-    idx = jnp.asarray(np.broadcast_to(rows[:, None], (n, r)).copy())
-    try:
-        dt, out = _bench(_taa0, table, idx)
-        good = bool(
-            np.allclose(
-                np.asarray(out, np.float32),
-                np.asarray(table, np.float32)[rows],
-                atol=1e-2,
-            )
-        )
-        _emit(metric="taa_axis0", n=n, r=r, dtype=str(dtype.dtype.name
-              if hasattr(dtype, "dtype") else dtype), ok=good,
-              seconds=dt, ns_per_row=dt / n * 1e9)
-    except Exception as e:  # noqa: BLE001
-        _emit(metric="taa_axis0", n=n, r=r, ok=False,
-              error=repr(e)[:300])
-
-
-# ---------------------------------------------------------------- B --
-
-def _taa1_kernel(table_ref, idx_ref, out_ref):
-    out_ref[:] = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=1)
-
-
-@functools.partial(jax.jit, static_argnames=())
-def _taa1(table, idx):
-    r, m = table.shape
-    return pl.pallas_call(
-        _taa1_kernel,
-        out_shape=jax.ShapeDtypeStruct((r, m), table.dtype),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=_interpret(),
-    )(table, idx)
-
-
-def probe_taa1(m, r, dtype):
-    rng = np.random.default_rng(0)
-    table = jnp.asarray(
-        rng.normal(size=(r, m)).astype(np.float32)
-    ).astype(dtype)
-    cols = rng.integers(0, m, size=(m,)).astype(np.int32)
-    idx = jnp.asarray(np.broadcast_to(cols[None, :], (r, m)).copy())
-    try:
-        dt, out = _bench(_taa1, table, idx)
-        good = bool(
-            np.allclose(
-                np.asarray(out, np.float32),
-                np.asarray(table, np.float32)[:, cols],
-                atol=1e-2,
-            )
-        )
-        _emit(metric="taa_axis1", m=m, r=r, ok=good, seconds=dt,
-              ns_per_col=dt / m * 1e9)
-    except Exception as e:  # noqa: BLE001
-        _emit(metric="taa_axis1", m=m, r=r, ok=False,
-              error=repr(e)[:300])
-
-
-# ---------------------------------------------------------------- C --
-
-def _dma_kernel(idx_ref, table_ref, out_ref, sem):
-    # idx_ref is scalar-prefetched (SMEM); issue one row DMA per output
-    # row with a rolling window of WINDOW outstanding copies.
-    nout = out_ref.shape[0]
-    window = 16
-
-    def issue(k):
-        return pltpu.make_async_copy(
-            table_ref.at[pl.ds(idx_ref[k], 1)],
-            out_ref.at[pl.ds(k, 1)],
-            sem.at[k % window],
-        )
-
-    def body(k, _):
-        @pl.when(k >= window)
-        def _wait():
-            issue(k - window).wait()  # same (src, dst, sem) triple
-
-        issue(k).start()
-        return 0
-
-    jax.lax.fori_loop(0, nout, body, 0)
-
-    def drain(k, _):
-        issue(nout - window + k).wait()
-        return 0
-
-    jax.lax.fori_loop(0, window, drain, 0)
-
-
-@functools.partial(jax.jit, static_argnames=("nout",))
-def _dma_gather(table, idx, *, nout):
-    _, r = table.shape
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(1,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((16,))],
-    )
-    return pl.pallas_call(
-        _dma_kernel,
-        out_shape=jax.ShapeDtypeStruct((nout, r), table.dtype),
-        grid_spec=grid_spec,
-        interpret=_interpret(),
-    )(idx, table)
-
-
-def probe_dma(m, nout, r, dtype):
-    rng = np.random.default_rng(0)
-    table = jnp.asarray(
-        rng.normal(size=(m, r)).astype(np.float32)
-    ).astype(dtype)
-    rows = rng.integers(0, m, size=(nout,)).astype(np.int32)
-    idx = jnp.asarray(rows)
-    try:
-        dt, out = _bench(
-            functools.partial(_dma_gather, nout=nout), table, idx
-        )
-        good = bool(
-            np.allclose(
-                np.asarray(out, np.float32),
-                np.asarray(table, np.float32)[rows],
-                atol=1e-2,
-            )
-        )
-        _emit(metric="dma_row_gather", m=m, nout=nout, r=r, ok=good,
-              seconds=dt, ns_per_row=dt / nout * 1e9)
-    except Exception as e:  # noqa: BLE001
-        _emit(metric="dma_row_gather", m=m, nout=nout, r=r, ok=False,
-              error=repr(e)[:300])
-
-
-# ---------------------------------------------------------------- E --
-
-def probe_xla_grouped_take(m, nout, r, dtype, group=None):
-    """Grouped slab gather, BOTH layouts, vs the plain row take.
-
-    Hypothesis for the measured ~17 GB/s of the plain row gather: each
-    rank-64 row is 256 B but the memory system moves (8,128)/(16,128)
-    tiles, a 16-32x waste.  Emits TWO metrics per call:
-
-    - ``xla_grouped3d_take`` — the PRODUCTION form
-      (`ALSConfig(gather_mode="grouped")`): gather [G, R] slices of the
-      3D view [M/G, G, R], whose trailing dims are the tiled ones, so
-      one gathered slice is whole tiles.
-    - ``xla_grouped_take`` — the 2D lane-slab [M/G, G*R] CONTROL arm:
-      its slab rows are 1 sublane tall, so the tile-height waste
-      remains; it should NOT beat the baseline.
-
-    ``group`` defaults to the dtype's tile sublane count (8 f32 /
-    16 bf16), matching production's ``grp`` exactly."""
-    if group is None:
-        group = 8 * (4 // jnp.dtype(dtype).itemsize)
-    mg = -(-m // group) * group
-    rng = np.random.default_rng(0)
-    table = jnp.asarray(
-        rng.normal(size=(mg, r)).astype(np.float32)
-    ).astype(dtype)
-    idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
-
-    def grouped_lanes(t, i):
-        # 2D lane-slab form [M/G, G*R]: the G rows lie along LANES, so
-        # one slab row is 1 sublane tall — kept as the control arm that
-        # should NOT beat the tile-height waste
-        g = jnp.take(t.reshape(mg // group, group * r), i // group, axis=0)
-        sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
-        return jnp.take_along_axis(
-            g.reshape(nout, group, r), sel, axis=1
-        )[:, 0, :]
-
-    def grouped_tiles(t, i):
-        # 3D tile-slab form [M/G, G, R] (same bytes): trailing (G, R)
-        # dims are the tiled ones, so a gathered [G, R] slice is whole
-        # tiles — the production ALSConfig(gather_mode="grouped") form
-        g = jnp.take(t.reshape(mg // group, group, r), i // group, axis=0)
-        sel = jnp.broadcast_to((i % group)[:, None, None], (nout, 1, r))
-        return jnp.take_along_axis(g, sel, axis=1)[:, 0, :]
-
-    ref = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
-    want = np.asarray(ref(table, idx), np.float32)
-    bytes_useful = nout * r * table.dtype.itemsize
-    for name, fn in (("xla_grouped_take", grouped_lanes),
-                     ("xla_grouped3d_take", grouped_tiles)):
-        dt, out = _bench(jax.jit(fn), table, idx)
-        good = bool(
-            np.allclose(np.asarray(out, np.float32), want, atol=1e-2)
-        )
-        _emit(metric=name, m=m, nout=nout, r=r, group=group,
-              dtype=table.dtype.name, ok=good, seconds=dt,
-              ns_per_row=dt / nout * 1e9,
-              useful_gbps=bytes_useful / dt / 1e9)
-
-
-# ---------------------------------------------------------------- D --
-
-def probe_xla_take(m, nout, r, dtype):
-    rng = np.random.default_rng(0)
-    table = jnp.asarray(
-        rng.normal(size=(m, r)).astype(np.float32)
-    ).astype(dtype)
-    idx = jnp.asarray(rng.integers(0, m, size=(nout,)).astype(np.int32))
-    take = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
-    dt, _ = _bench(take, table, idx)
-    bytes_moved = nout * r * table.dtype.itemsize
-    _emit(metric="xla_take", m=m, nout=nout, r=r,
-          dtype=table.dtype.name, seconds=dt,
-          ns_per_row=dt / nout * 1e9,
-          effective_gbps=bytes_moved / dt / 1e9)
-
-
-def main():
-    _emit(metric="probe_env", backend=jax.default_backend(),
-          device=str(jax.devices()[0]))
+    _emit({"metric": "probe_env", "backend": jax.default_backend(),
+           "device": str(jax.devices()[0])})
     r = 64
     # guaranteed-lowerable XLA rows FIRST: the speculative Pallas forms
     # below can hit pathological Mosaic compiles, and a dying step must
     # still leave the rows the grouped-gather decision needs
-    _emit(metric="section", form="xla_take_baseline")
+    _emit({"metric": "section", "form": "xla_take_baseline"})
     for dtype in (jnp.float32, jnp.bfloat16):
-        probe_xla_take(26744, 32768, r, dtype)
-        probe_xla_take(138493, 32768, r, dtype)
+        _emit(gp.probe_xla_take(26744, 32768, r, dtype))
+        _emit(gp.probe_xla_take(138493, 32768, r, dtype))
     # r=128: are lane-padded (full-vreg) rows gathered faster per byte?
-    probe_xla_take(26744, 32768, 128, jnp.float32)
-    _emit(metric="section", form="xla_grouped_take")
+    _emit(gp.probe_xla_take(26744, 32768, 128, jnp.float32))
+    _emit({"metric": "section", "form": "xla_grouped_take"})
     for dtype in (jnp.float32, jnp.bfloat16):
         # group defaults to the dtype's tile height (8 f32 / 16 bf16)
-        probe_xla_grouped_take(26744, 32768, r, dtype)
-        probe_xla_grouped_take(138493, 32768, r, dtype)
-    # speculative Pallas forms (fused-kernel rewrite candidates)
+        for rec in gp.probe_xla_grouped_take(26744, 32768, r, dtype):
+            _emit(rec)
+        for rec in gp.probe_xla_grouped_take(138493, 32768, r, dtype):
+            _emit(rec)
+    # speculative Pallas forms (the fused kernel's gather impls)
     for dtype in (jnp.float32, jnp.bfloat16):
         name = jnp.dtype(dtype).name
-        _emit(metric="section", form="taa_axis0", dtype=name)
+        _emit({"metric": "section", "form": "taa_axis0", "dtype": name})
         for n in (8, 256, 2048, 8192, 26744):
-            probe_taa0(n, r, dtype)
-    _emit(metric="section", form="taa_axis1")
-    probe_taa1(4096, r, jnp.float32)
-    probe_taa1(26744, r, jnp.float32)
-    _emit(metric="section", form="dma_row_gather")
-    for nout in (4096, 32768):
-        probe_dma(26744, nout, r, jnp.float32)
+            _emit(gp.probe_taa0(n, r, dtype))
+    _emit({"metric": "section", "form": "taa_axis1"})
+    _emit(gp.probe_taa1(4096, r, jnp.float32))
+    _emit(gp.probe_taa1(26744, r, jnp.float32))
+    _emit({"metric": "section", "form": "dma_row_gather"})
+    for dtype in (jnp.float32, jnp.bfloat16):
+        for nout in (4096, 32768):
+            _emit(gp.probe_dma(26744, nout, r, dtype))
+    # the in-process arbitration the fused kernel's "auto" mode applies
+    # (measured order on TPU, static documentation order elsewhere)
+    _emit({"metric": "gather_impl_preferred_order",
+           "backend": jax.default_backend(),
+           "order": list(gp.preferred_order(r, 4)),
+           "order_bf16": list(gp.preferred_order(r, 2))})
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -6,7 +6,8 @@ Re-expression of reference `tools/console/Console.scala:128-737` +
   app new|list|show|delete|data-delete|channel-new|channel-delete
   accesskey new|list|delete
   template list|get
-  train | deploy | undeploy | eval | eventserver | adminserver | dashboard
+  train | deploy | undeploy | foldin | eval | eventserver | adminserver
+  dashboard
   build | unregister | run | import | export | status | upgrade | version
 
 There is no sbt: `build` validates the engine variant and registers an
@@ -415,6 +416,7 @@ def cmd_deploy(args, storage: Storage) -> int:
             feedback_capacity=args.feedback_capacity,
             breaker_failures=args.breaker_failures,
             breaker_reset_s=args.breaker_reset,
+            foldin_poll_s=args.foldin_poll,
         ),
         engine_id=engine_id,
         engine_variant=str(args.engine_json),
@@ -439,6 +441,72 @@ def cmd_deploy(args, storage: Storage) -> int:
         pass
     _out(f"Deploying engine instance {iid} on {args.ip}:{args.port}")
     server.serve_forever()
+    return 0
+
+
+def cmd_foldin(args, storage: Storage) -> int:
+    """pio-live: incremental ALS fold-in (one-shot or --watch daemon).
+
+    Scans the event store past the per-(app, channel) watermark, solves
+    the touched/new factor rows against the frozen opposite table, and
+    publishes delta links that a deployed engine server
+    (``deploy --foldin-poll``) patches in live — fresh events become
+    fresh predictions without ``pio train`` or ``/reload``."""
+    from ..controller.base import WorkflowContext
+    from ..live import FoldInRunner
+    from ..parallel.mesh import enable_compilation_cache
+    from ..tools.template_gallery import verify_template_min_version
+
+    enable_compilation_cache()
+    verify_template_min_version(Path(args.engine_json).parent)
+    engine, ep, variant = load_engine_from_variant(
+        args.engine_json, args.engine_factory
+    )
+    md = storage.get_metadata()
+    engine_id = variant.get("id", "default")
+    if args.engine_instance_id:
+        iid = args.engine_instance_id
+        if md.engine_instance_get(iid) is None:
+            _out(f"Error: engine instance '{iid}' not found.")
+            return 1
+    else:
+        latest = md.engine_instance_get_latest_completed(
+            engine_id, "1", str(args.engine_json)
+        )
+        if latest is None:
+            _out("Error: no completed engine instance found; "
+                 "run train first.")
+            return 1
+        iid = latest.id
+    ctx = WorkflowContext(storage=storage, mode="Serving")
+    try:
+        runner = FoldInRunner(
+            storage, engine, ep, iid, channel_id=args.channel, ctx=ctx,
+            from_now=args.from_now,
+        )
+    except ValueError as e:
+        _out(f"Error: {e}")
+        return 1
+    _out(f"Fold-in on instance {iid} (app {runner.app_id}, "
+         f"watermark rowid {runner.cursor}, chain seq {runner.seq})")
+    if args.watch:
+        _out(f"Watching for events every {args.interval}s "
+             "(Ctrl-C to stop)...")
+        try:
+            runner.watch(
+                interval_s=args.interval,
+                max_cycles=args.max_cycles,
+                on_cycle=lambda s: _out(json.dumps(s)),
+            )
+        except KeyboardInterrupt:
+            _out("Stopped.")
+        return 0
+    stats = runner.cycle()
+    if stats is None:
+        _out(f"No new events past watermark rowid {runner.cursor}; "
+             "nothing to fold in.")
+    else:
+        _out(json.dumps(stats))
     return 0
 
 
@@ -860,6 +928,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help="slow-query flight recorder keeps the N "
                    "slowest requests' full span trees (default: "
                    "$PIO_TPU_XRAY_FLIGHT_N or 16; see /debug/xray)")
+    d.add_argument("--foldin-poll", type=float, default=None,
+                   metavar="SEC",
+                   help="pio-live: poll for fold-in delta links every "
+                   "SEC seconds and patch them into the serving model "
+                   "in place (factor rows + top-k index, no "
+                   "stop-the-world reload); pair with a `pio-tpu "
+                   "foldin --watch` daemon")
+
+    fi = sub.add_parser(
+        "foldin",
+        help="pio-live: fold new events into the deployed model "
+        "incrementally (no full retrain)",
+    )
+    _add_obs_args(fi)
+    fi.add_argument("--engine-json", default="engine.json")
+    fi.add_argument("--engine-factory")
+    fi.add_argument("--engine-instance-id",
+                    help="fold into this instance (default: latest "
+                    "completed)")
+    fi.add_argument("--channel", type=int, default=0)
+    fi.add_argument("--watch", action="store_true",
+                    help="keep running: poll the event-store watermark "
+                    "and fold in whenever it advances")
+    fi.add_argument("--interval", type=float, default=5.0,
+                    metavar="SEC",
+                    help="watch-mode poll period (default 5s)")
+    fi.add_argument("--max-cycles", type=int, default=None,
+                    help="stop --watch after N non-empty fold-in "
+                    "cycles (smoke/bench harnesses)")
+    fi.add_argument("--from-now", action="store_true",
+                    help="on the FIRST run (no watermark, no chain): "
+                    "start the cursor at the store's current high-water "
+                    "mark instead of re-folding the history the full "
+                    "train already saw")
 
     e = sub.add_parser("eval", help="run an evaluation sweep")
     _add_obs_args(e)
@@ -969,6 +1071,7 @@ _DISPATCH = {
     "accesskey": cmd_accesskey,
     "train": cmd_train,
     "deploy": cmd_deploy,
+    "foldin": cmd_foldin,
     "eval": cmd_eval,
     "eventserver": cmd_eventserver,
     "adminserver": cmd_adminserver,

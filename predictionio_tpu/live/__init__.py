@@ -1,0 +1,51 @@
+"""pio-live: incremental ALS fold-in and delta model push.
+
+The online-learning subsystem closing the gap between fresh events and
+fresh predictions without a full ``pio train`` + stop-the-world
+``/reload`` (ROADMAP open item #1):
+
+* :mod:`.watermark` — per-(app, channel) rowid high-water-mark cursor
+  over the event store, persisted next to the model; yields only events
+  since the last fold-in.
+* :mod:`.foldin` — the fixed-capacity jitted row solver: touched user
+  rows (and brand-new item rows) solved against the frozen opposite
+  factor table, reusing `models/als.py`'s ``_solve_buckets`` /
+  ``_spd_solve`` machinery; padded pow2 shapes keep the compile cache
+  warm across cycles (verify at ``/debug/xray``:
+  ``live.foldin_solve``).
+* :mod:`.apply` — applies a persisted delta link to an in-memory model
+  (atomic attribute swaps + append-only id maps + row-wise device
+  table patch: no reader lock, no re-upload).
+* :mod:`.daemon` — :class:`FoldInRunner`: scan -> solve -> publish as
+  a versioned delta chain (`workflow/model_io.py`), driven by
+  ``pio-tpu foldin [--watch]``.
+
+The serving side (`server/serving.py`) polls the chain and applies new
+links under its state lock; `bench_foldin.py` measures event -> fresh
+prediction freshness end to end.
+"""
+
+from .apply import apply_model_delta, model_supports_deltas
+from .daemon import FoldInRunner
+from .foldin import FoldInPlan, FoldInSolver, compute_foldin
+from .watermark import (
+    WATERMARK_FILE,
+    ScanBatch,
+    Watermark,
+    WatermarkStore,
+    scan_new_ratings,
+)
+
+__all__ = [
+    "FoldInPlan",
+    "FoldInRunner",
+    "FoldInSolver",
+    "ScanBatch",
+    "WATERMARK_FILE",
+    "Watermark",
+    "WatermarkStore",
+    "apply_model_delta",
+    "compute_foldin",
+    "model_supports_deltas",
+    "scan_new_ratings",
+]

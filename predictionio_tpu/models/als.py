@@ -610,7 +610,11 @@ def _solve_buckets(
     gram: Optional[jax.Array] = None,
     stop_after: Optional[str] = None,
 ):
-    """Shared bucket-solve math for the replicated and sharded paths.
+    """Shared bucket-solve math for the replicated and sharded paths
+    (and the pio-live fold-in: `live/foldin.py` routes its
+    fixed-capacity single-bucket row solves through this same function
+    with a write callback that returns the solved block, so online
+    fold-in and offline training can never drift apart numerically).
 
     ``solver_mode="subspace"`` (iALS++, arXiv 2110.14044) replaces the
     per-row full R×R normal-equation solve with a sweep over rank

@@ -187,11 +187,54 @@ TRAIN_PHASE_SECONDS = _registry.histogram(
     buckets=log_buckets(1e-4, 10000.0, per_decade=4),
 )
 
+# pio-live (incremental fold-in) families: the daemon side books cycles
+# / scanned events / produced rows + per-phase timings; the serving side
+# books delta applies and keeps the freshness/lag gauges live.  Gauges
+# read 0 until pio-live runs — the fields stay absent from status JSON
+# when the subsystem is off, but the /metrics schema is always complete.
+FOLDIN_CYCLES_TOTAL = _registry.counter(
+    "pio_foldin_cycles_total",
+    "Fold-in daemon cycles by outcome (ok/empty/error)",
+    labels=("result",),
+)
+FOLDIN_EVENTS_TOTAL = _registry.counter(
+    "pio_foldin_events_total",
+    "Events consumed past the fold-in watermark",
+)
+FOLDIN_ROWS_TOTAL = _registry.counter(
+    "pio_foldin_rows_total",
+    "Factor rows produced by fold-in solves",
+    labels=("side", "kind"),  # side=user|item, kind=patched|appended
+)
+FOLDIN_PHASE_SECONDS = _registry.histogram(
+    "pio_foldin_phase_seconds",
+    "Fold-in phase durations (live.scan/solve/publish/apply)",
+    labels=("phase",),
+    buckets=log_buckets(1e-4, 1000.0, per_decade=4),
+)
+FOLDIN_APPLIES_TOTAL = _registry.counter(
+    "pio_foldin_applies_total",
+    "Serving-side delta applications by outcome",
+    labels=("result",),
+)
+MODEL_FRESHNESS_SECONDS = _registry.gauge(
+    "pio_model_freshness_seconds",
+    "Seconds since the serving model last advanced "
+    "(full load or applied fold-in delta)",
+)
+FOLDIN_WATERMARK_LAG = _registry.gauge(
+    "pio_foldin_watermark_lag",
+    "Event-store rows written past the last applied fold-in watermark",
+)
+
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every
 # process's first scrape already shows the full (zero-valued) shape
 QUERY_LATENCY.child()
 EVENT_WRITE_LATENCY.child()
+FOLDIN_EVENTS_TOTAL.child()
+MODEL_FRESHNESS_SECONDS.child()
+FOLDIN_WATERMARK_LAG.child()
 
 
 @contextlib.contextmanager

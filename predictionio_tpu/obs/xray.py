@@ -493,8 +493,10 @@ def instrument(name: str) -> Callable[[Callable], Callable]:
     / ``als.sweep_half`` / ``als.expand_sides`` / ``als.sq_err_sum``
     (models/als.py), ``als.fused`` (ops/fused_als.py — BOTH gather
     impls' pallas entries share the name; the impl shows up in the
-    signature via the entry fn and its static tile-plan kwargs), and
-    ``topk.*`` (ops/topk.py)."""
+    signature via the entry fn and its static tile-plan kwargs),
+    ``topk.*`` (ops/topk.py), and ``live.foldin_solve``
+    (live/foldin.py — a steady fold-in daemon must show one signature
+    per padded (B, K) rung, not one per cycle)."""
 
     def deco(fn: Callable) -> Callable:
         install()

@@ -48,6 +48,13 @@ class DashboardServer(HTTPServerBase):
                     res=_html.escape(ev.evaluator_results),
                 )
             )
+        # pio-live row: one recent-events link per app (rowid-cursor
+        # backed — see events_html), next to the evaluations table
+        app_links = " &middot; ".join(
+            f"<a href='/events.html?app={a.id}'>{_html.escape(a.name)}"
+            f" (id {a.id})</a>"
+            for a in md.app_get_all()
+        ) or "(no apps)"
         return (
             "<html><head><title>predictionio_tpu dashboard</title></head>"
             "<body><h1>Completed evaluations</h1>"
@@ -55,10 +62,61 @@ class DashboardServer(HTTPServerBase):
             "<th>start</th><th>end</th><th>result</th><th>details</th></tr>"
             + "\n".join(rows)
             + "</table>"
+            "<p>Recent events (pio-live): " + app_links + "</p>"
             "<p><a href='/metrics.html'>live metrics</a> &middot; "
             "<a href='/xray.html'>x-ray</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
+        )
+
+    def events_html(self, app_id: int, channel_id: int = 0,
+                    limit: int = 50) -> str:
+        """Newest events of an (app, channel), via the event store's
+        indexed rowid cursor (`SQLiteEventStore.find_rows_since`
+        ``newest_first`` — one B-tree range read) instead of a
+        full-table scan + time sort.  Stores without the cursor API
+        (memory backend) fall back to the reversed time-ordered
+        ``find``."""
+        es = self.storage.get_event_store()
+        rows = []
+        if hasattr(es, "find_since"):
+            pairs, _ = es.find_since(
+                app_id, channel_id, cursor=0, limit=limit,
+                newest_first=True,
+            )
+        else:
+            pairs = [
+                (0, e)
+                for e in es.find(
+                    app_id, channel_id, limit=limit, reversed=True
+                )
+            ]
+        for rowid, e in pairs:
+            rows.append(
+                "<tr><td>{rid}</td><td>{ev}</td><td>{ent}</td>"
+                "<td>{tgt}</td><td>{t}</td></tr>".format(
+                    rid=rowid or "-",
+                    ev=_html.escape(e.event),
+                    ent=_html.escape(
+                        f"{e.entity_type}/{e.entity_id}"
+                    ),
+                    tgt=_html.escape(
+                        f"{e.target_entity_type}/{e.target_entity_id}"
+                        if e.target_entity_id else "-"
+                    ),
+                    t=_html.escape(str(e.event_time)),
+                )
+            )
+        return (
+            "<html><head><title>recent events</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace;padding:2px 8px}</style></head>"
+            f"<body><h1>Recent events — app {app_id}"
+            f"{f' channel {channel_id}' if channel_id else ''}</h1>"
+            "<table border='1'><tr><th>rowid</th><th>event</th>"
+            "<th>entity</th><th>target</th><th>time</th></tr>"
+            + "\n".join(rows) + "</table>"
+            "<p><a href='/'>back</a></p></body></html>"
         )
 
     def metrics_html(self) -> str:
@@ -190,6 +248,23 @@ class DashboardServer(HTTPServerBase):
                 if path == "/metrics.html":
                     self._reply(200, server.metrics_html().encode(),
                                 "text/html")
+                    return
+                if path == "/events.html":
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    try:
+                        app_id = int(q.get("app", ["-1"])[0])
+                        channel = int(q.get("channel", ["0"])[0])
+                        limit = min(int(q.get("n", ["50"])[0]), 500)
+                    except ValueError:
+                        self._reply(400, b"bad query", "text/plain")
+                        return
+                    self._reply(
+                        200,
+                        server.events_html(app_id, channel, limit).encode(),
+                        "text/html",
+                    )
                     return
                 if path == "/xray.html":
                     self._reply(200, server.xray_html().encode(),

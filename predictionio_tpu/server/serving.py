@@ -35,6 +35,10 @@ from ..controller.base import WorkflowContext
 from .http_base import HTTPServerBase, JsonRequestHandler
 from ..controller.engine import Engine, EngineParams
 from ..obs import (
+    FOLDIN_APPLIES_TOTAL,
+    FOLDIN_PHASE_SECONDS,
+    FOLDIN_WATERMARK_LAG,
+    MODEL_FRESHNESS_SECONDS,
     QUERIES_TOTAL,
     QUERY_LATENCY,
     RELOADS_TOTAL,
@@ -77,7 +81,8 @@ class ServerConfig:
                  delivery_timeout_s: float = 2.0,
                  breaker_failures: int = 5,
                  breaker_reset_s: float = 10.0,
-                 retry_seed: Optional[int] = None):
+                 retry_seed: Optional[int] = None,
+                 foldin_poll_s: Optional[float] = None):
         self.host = host
         self.port = port
         self.feedback = feedback
@@ -105,6 +110,11 @@ class ServerConfig:
         self.breaker_failures = breaker_failures
         self.breaker_reset_s = breaker_reset_s
         self.retry_seed = retry_seed
+        # pio-live: poll the model dir for fold-in delta links every N
+        # seconds and patch them into the serving model in place (no
+        # stop-the-world reload).  None = off; deltas already on disk
+        # at (re)load time are still caught up once.
+        self.foldin_poll_s = foldin_poll_s
 
 
 def _takes_max_batch(fn: Callable) -> bool:
@@ -215,7 +225,22 @@ class EngineServer(HTTPServerBase):
 
         self._feedback_queue = _queue("feedback", "http.feedback")
         self._log_queue = _queue("remote-log", "http.remote_log")
+        # pio-live delta-poll machinery, built before the first _load
+        # (which catches up on any chain already on disk): repeated
+        # apply failures open the breaker — polling pauses, the stale
+        # model keeps serving, exactly the failed-/reload semantics
+        self._foldin_breaker = CircuitBreaker(
+            failure_threshold=self.config.breaker_failures,
+            reset_timeout_s=self.config.breaker_reset_s,
+        )
+        self._foldin_stop = threading.Event()
         self._load(instance_id)
+        if self.config.foldin_poll_s:
+            threading.Thread(
+                target=self._foldin_poll_loop,
+                daemon=True,
+                name="foldin-poll",
+            ).start()
         # serving stats (CreateServer.scala:396-398).  Latency is
         # histogram-backed (pio-obs): this instance's private histogram
         # drives the /status percentiles + average, and the same deltas
@@ -300,6 +325,17 @@ class EngineServer(HTTPServerBase):
             self.serving = serving
             self.instance_id = instance_id
             self.batcher = batcher
+            # pio-live bookkeeping restarts with every full (re)load:
+            # the delta chain is per instance, and a fresh full model
+            # IS the freshness anchor
+            self.foldin_applied_seq = {}
+            self.foldin_watermark = None
+            self.foldin_deltas_applied = 0
+            self.last_foldin_error = None
+            self.model_advanced_mono = time.monotonic()
+        # catch up on delta links already published for this instance
+        # (a redeploy/reload must not serve staler than the chain)
+        self._apply_available_deltas()
 
     def _make_batcher(self, algorithms, models):
         """Build the query micro-batcher for this (algorithms, models)
@@ -370,6 +406,137 @@ class EngineServer(HTTPServerBase):
             self.last_reload_error = None
         RELOADS_TOTAL.labels(result="ok").inc()
         return latest.id
+
+    # -- pio-live delta apply ---------------------------------------------
+    def _apply_available_deltas(self) -> int:
+        """Apply any fold-in delta links (pio-live) newer than what this
+        server already holds, IN PLACE under the state lock — factor
+        rows and the device-resident top-k index are patched row-wise;
+        queries in flight keep scoring on the tables they snapshotted,
+        the next query sees the folded-in rows.  No ``reload()``, no
+        warmup, no batcher rebuild: the model OBJECTS stay the same,
+        only their row contents advance.
+
+        A torn or gapped chain truncates cleanly (``load_model_delta_
+        chain``): the good prefix applies, the rest waits — stale rows
+        beat corrupted rows.  Returns the number of links applied."""
+        from ..live.apply import apply_model_delta, model_supports_deltas
+        from ..workflow.model_io import load_model_delta_chain, model_key
+
+        with self._lock:
+            iid = self.instance_id
+            models = self.models
+            ep = self.engine_params
+            applied_seq = dict(self.foldin_applied_seq)
+        base_dir = self.ctx.storage.model_data_dir() / iid
+        names = [n for n, _ in ep.algorithms]
+        n_applied = 0
+        for ax, (name, model) in enumerate(zip(names, models)):
+            if not model_supports_deltas(model):
+                continue
+            key = model_key(iid, ax, name)
+            chain, err = load_model_delta_chain(
+                base_dir, key, after_seq=applied_seq.get(key, 0)
+            )
+            if err:
+                with self._lock:
+                    self.last_foldin_error = err
+                logger.warning("fold-in chain for %s: %s", key, err)
+            for d in chain:
+                t0 = time.perf_counter()
+                with self._lock:
+                    if self.instance_id != iid:
+                        # a reload swapped instances mid-walk; the new
+                        # instance's own catch-up already ran
+                        return n_applied
+                    apply_model_delta(model, d)
+                    self.foldin_applied_seq[key] = d.seq
+                    self.foldin_watermark = d.watermark
+                    self.foldin_deltas_applied += 1
+                    self.model_advanced_mono = time.monotonic()
+                    self.last_foldin_error = None
+                dt = time.perf_counter() - t0
+                FOLDIN_APPLIES_TOTAL.labels(result="ok").inc()
+                FOLDIN_PHASE_SECONDS.labels(phase="live.apply").observe(dt)
+                get_tracer().record(
+                    "live.apply", dt,
+                    attrs={"instance": iid, "seq": d.seq},
+                )
+                n_applied += 1
+        return n_applied
+
+    def _foldin_poll_loop(self) -> None:
+        """Delta-poll daemon thread (``--foldin-poll``): breaker-guarded
+        and deadline-scoped so a sick storage volume degrades to a
+        paused poll + stale model, never a wedged serving thread."""
+        interval = float(self.config.foldin_poll_s)
+        while not self._foldin_stop.wait(interval):
+            if not self._foldin_breaker.allow():
+                continue
+            try:
+                with deadline_scope(Deadline.after(max(interval, 1.0))):
+                    self._apply_available_deltas()
+            except Exception as e:
+                logger.exception(
+                    "fold-in delta apply failed; serving keeps the "
+                    "stale model"
+                )
+                with self._lock:
+                    self.last_foldin_error = f"{type(e).__name__}: {e}"
+                FOLDIN_APPLIES_TOTAL.labels(result="error").inc()
+                self._foldin_breaker.record_failure()
+            else:
+                self._foldin_breaker.record_success()
+            self._refresh_foldin_gauges()
+
+    def _foldin_status(self) -> dict:
+        """The pio-live status fields, or {} while the subsystem is off
+        (no poll configured and no delta ever applied) — status JSON
+        stays byte-compatible for deployments that never fold in."""
+        with self._lock:
+            active = (
+                self.config.foldin_poll_s is not None
+                or self.foldin_deltas_applied > 0
+                # a torn/gapped chain with zero applies must still
+                # surface: the operator is one lastFoldinError away
+                # from knowing why the model is stale
+                or self.last_foldin_error is not None
+            )
+            if not active:
+                return {}
+            advanced_mono = self.model_advanced_mono
+            wm = self.foldin_watermark
+            err = self.last_foldin_error
+            applied = self.foldin_deltas_applied
+        freshness = max(time.monotonic() - advanced_mono, 0.0)
+        lag = 0
+        if wm:
+            try:
+                es = self.ctx.storage.get_event_store()
+                if hasattr(es, "max_rowid"):
+                    lag = max(
+                        es.max_rowid(
+                            int(wm.get("appId", -1)),
+                            int(wm.get("channelId", 0)),
+                        ) - int(wm.get("rowid", 0)),
+                        0,
+                    )
+            except Exception:
+                lag = 0
+        out = {
+            "modelFreshnessSec": freshness,
+            "foldinWatermarkLag": lag,
+            "foldinDeltasApplied": applied,
+            "foldinBreakerState": self._foldin_breaker.state,
+        }
+        if err:
+            out["lastFoldinError"] = err
+        MODEL_FRESHNESS_SECONDS.child().set(freshness)
+        FOLDIN_WATERMARK_LAG.child().set(float(lag))
+        return out
+
+    def _refresh_foldin_gauges(self) -> None:
+        self._foldin_status()  # computing the fields also sets the gauges
 
     # -- query path -------------------------------------------------------
     def predict_json(self, query_json: dict,
@@ -523,6 +690,8 @@ class EngineServer(HTTPServerBase):
                 "requests": batcher.requests,
                 "maxBatchSeen": batcher.max_seen,
             }
+        # pio-live: model freshness + watermark lag (absent when off)
+        out.update(self._foldin_status())
         # failure observability: queue depths/drops, breaker states, and
         # the last reload error an operator should know about
         out["resilience"] = {
@@ -593,6 +762,14 @@ class EngineServer(HTTPServerBase):
                 f"{lat['p50']:.4f} / {lat['p95']:.4f} / "
                 f"{lat['p99']:.4f} s"),
         ]
+        live = self._foldin_status()
+        if live:
+            server_rows.append(row(
+                "Model Freshness (pio-live)",
+                f"{live['modelFreshnessSec']:.1f} s since last advance; "
+                f"watermark lag {live['foldinWatermarkLag']} rows; "
+                f"{live['foldinDeltasApplied']} deltas applied",
+            ))
         worst = get_flight_recorder().summary()["worst"]
         if worst:
             server_rows.append(row(
@@ -635,8 +812,9 @@ class EngineServer(HTTPServerBase):
 
     def stop(self) -> None:
         super().stop()
-        # release the delivery drain threads (pending entries are
-        # abandoned — the process is going away)
+        # release the delta-poll and delivery drain threads (pending
+        # entries are abandoned — the process is going away)
+        self._foldin_stop.set()
         self._feedback_queue.close()
         self._log_queue.close()
 

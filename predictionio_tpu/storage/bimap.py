@@ -178,6 +178,46 @@ class StringIndex:
         """int indices -> id object array (single gather)."""
         return self._ids[np.asarray(ixs)]
 
+    def append(self, ids: Iterable[str]) -> np.ndarray:
+        """Append-only growth (pio-live fold-in): add unseen ids in
+        first-appearance order; returns int32 indices for EVERY given
+        id (already-present ids resolve to their existing index, so a
+        replayed delta maps idempotently).
+
+        Existing indices never change meaning — ``_ids`` only grows —
+        so a reader holding a decode view stays correct for every
+        index it could have seen.  The new rows are published to
+        ``_ids`` BEFORE their ``_to_ix`` entries appear: a concurrent
+        ``get`` either misses (pre-append behavior) or hits an id whose
+        row is already decodable.  Single-writer (the fold-in daemon /
+        the serving delta-apply path, which holds the server state
+        lock); concurrent readers need no lock.
+        """
+        ids = list(ids)
+        out = np.empty(len(ids), dtype=np.int32)
+        fresh: list[str] = []
+        fresh_ix: dict[str, int] = {}
+        base = len(self._ids)
+        for j, s in enumerate(ids):
+            ix = self._to_ix.get(s)
+            if ix is None:
+                # duplicate within THIS batch: first occurrence wins
+                ix = fresh_ix.get(s)
+                if ix is None:
+                    ix = base + len(fresh)
+                    fresh_ix[s] = ix
+                    fresh.append(s)
+            out[j] = ix
+        if fresh:
+            self._ids = np.concatenate(
+                [self._ids, np.asarray(fresh, dtype=object)]
+            )
+            for k, s in enumerate(fresh):
+                self._to_ix[s] = base + k
+            # the pandas lookup index is rebuilt lazily on next bulk use
+            self._pd_index = None
+        return out
+
 
 class EntityIdIxMap:
     """Entity id <-> contiguous index map (reference `EntityMap.scala:27-60`,

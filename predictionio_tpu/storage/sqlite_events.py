@@ -21,6 +21,7 @@ from __future__ import annotations
 import contextlib
 import datetime as _dt
 import json
+import logging
 import os
 import re
 import sqlite3
@@ -45,6 +46,8 @@ from .event import (
 from .levents import NO_TARGET, EventStore, TargetFilter
 
 __all__ = ["SQLiteEventStore", "SCHEMA_VERSION"]
+
+logger = logging.getLogger(__name__)
 
 # Versioned schema + forward migrations — the capability the reference
 # ships as 0.8.x->0.9 HBase upgrade tooling
@@ -814,6 +817,86 @@ class SQLiteEventStore(EventStore):
         ):
             scan_cache.store_ratings(cache_key, out)
         return out
+
+    # -- incremental scans (pio-live watermark cursor) --------------------
+    def max_rowid(self, app_id: int, channel_id: int = 0) -> int:
+        """Largest rowid of the (app, channel) table (0 when empty): the
+        event store's high-water mark.  ``MAX(rowid)`` is answered off
+        the table B-tree root, not a scan."""
+        t = self._ensure_table(app_id, channel_id)
+        row = self._conn.execute(f"SELECT MAX(rowid) FROM {t}").fetchone()
+        return int(row[0]) if row and row[0] is not None else 0
+
+    def find_rows_since(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        cursor: int = 0,
+        limit: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        newest_first: bool = False,
+    ) -> tuple[list[tuple], int]:
+        """Raw rows written after a rowid watermark, in insertion order.
+
+        Returns ``(rows, new_cursor)`` where each row is ``(rowid,
+        <the 11 storage columns of _row>)`` with ``rowid > cursor``,
+        rowid-ascending, and ``new_cursor`` is the largest rowid
+        returned (== ``cursor`` when nothing is new).  The rowid is the
+        table's B-tree key, so this is an INDEXED range scan — the
+        incremental primitive the pio-live fold-in watermark and the
+        dashboard's recent-events view share, instead of re-scanning
+        the whole table per poll.
+
+        Semantics callers rely on:
+
+        * rowids are assigned monotonically by sqlite while the table's
+          max row is never deleted; ``INSERT OR REPLACE`` of an
+          existing event_id assigns a FRESH rowid, so updated events
+          re-enter the scan window (a fold-in wants exactly that).
+        * ``limit`` bounds one page; advancing ``cursor`` to the
+          returned ``new_cursor`` and calling again pages through a
+          backlog without skipping or repeating rows.
+        * ``newest_first=True`` reverses the order (dashboard view);
+          the cursor contract is unchanged (``new_cursor`` is still the
+          max rowid seen).
+        """
+        t = self._ensure_table(app_id, channel_id)
+        where = ["rowid > ?"]
+        params: list = [int(cursor)]
+        if event_names is not None:
+            qs = ",".join("?" * len(event_names))
+            where.append(f"event IN ({qs})")
+            params.extend(event_names)
+        sql = (
+            f"SELECT rowid, * FROM {t} WHERE {' AND '.join(where)} "
+            f"ORDER BY rowid {'DESC' if newest_first else 'ASC'}"
+        )
+        if limit is not None and limit >= 0:
+            sql += " LIMIT ?"
+            params.append(limit)
+        rows = self._conn.execute(sql, params).fetchall()
+        new_cursor = int(cursor)
+        if rows:
+            new_cursor = max(int(r[0]) for r in rows)
+        return rows, new_cursor
+
+    def find_since(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        cursor: int = 0,
+        limit: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        newest_first: bool = False,
+    ) -> tuple[list[tuple[int, Event]], int]:
+        """:meth:`find_rows_since` decoded to ``(rowid, Event)`` pairs."""
+        rows, new_cursor = self.find_rows_since(
+            app_id, channel_id, cursor, limit, event_names, newest_first
+        )
+        return (
+            [(int(r[0]), self._event_from_row(r[1:])) for r in rows],
+            new_cursor,
+        )
 
     # -- columnar batch read (PEvents analogue) ---------------------------
     def find_columnar(

@@ -40,6 +40,60 @@ class DeviceTableMixin:
             "_dev_item_factors", self.item_factors, dtype
         )
 
+    def patch_device_item_rows(
+        self, ixs, rows, appended: Optional[np.ndarray] = None
+    ) -> None:
+        """pio-live delta apply: patch every CACHED device item table in
+        place (row writes + appends) instead of dropping the caches and
+        re-uploading the whole table on the next query.
+
+        The device tables are the serve-time top-k index — every query's
+        score matmul reads them — so this is what makes a fold-in visible
+        to predictions without a stop-the-world reload.  Normalized
+        caches get their patched rows re-normalized (in f32, matching
+        ``device_item_factors_normalized``).  Caches that don't exist
+        yet are left absent: they'll be built lazily from the already-
+        patched host table.  Each updated array is swapped in with one
+        attribute rebind, so a concurrent reader sees the old table or
+        the new one, never a torn row.
+        """
+        import jax.numpy as jnp
+
+        if len(ixs) == 0 and (appended is None or len(appended) == 0):
+            return
+        ixs_d = jnp.asarray(np.asarray(ixs, np.int32))
+        rows_np = np.asarray(rows, np.float32)
+        app_np = (
+            np.asarray(appended, np.float32)
+            if appended is not None and len(appended) else None
+        )
+
+        def norm(a: np.ndarray) -> np.ndarray:
+            return a / (
+                np.linalg.norm(a, axis=-1, keepdims=True) + 1e-9
+            )
+
+        for attr in list(vars(self)):
+            plain = attr.startswith("_dev_item_factors_")
+            normed = attr.startswith("_dev_item_factors_norm_")
+            if not plain:
+                continue
+            dev = getattr(self, attr)
+            src_rows = norm(rows_np) if normed else rows_np
+            src_app = (
+                None if app_np is None
+                else (norm(app_np) if normed else app_np)
+            )
+            if src_app is not None:
+                dev = jnp.concatenate(
+                    [dev, jnp.asarray(src_app).astype(dev.dtype)], axis=0
+                )
+            if len(rows_np):
+                dev = dev.at[ixs_d].set(
+                    jnp.asarray(src_rows).astype(dev.dtype)
+                )
+            setattr(self, attr, dev)
+
     def device_item_factors_normalized(self, dtype: Optional[str] = None):
         """Row-normalized table for cosine scoring — normalized once (in
         f32, then cast), not per request."""

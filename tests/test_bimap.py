@@ -112,3 +112,39 @@ def test_encode_survives_pre_upgrade_pickle():
     finally:
         bimap._BULK_ENCODE_MIN = old
     assert out[0] == 0 and out[1] == 2 and out[2] == -1
+
+
+def test_string_index_append_only_growth():
+    """pio-live fold-in: append unseen ids, resolve existing ones, keep
+    every old index meaning (decode views stay valid)."""
+    import numpy as np
+
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    idx = StringIndex.from_values(["a", "b", "c"])
+    old_ids = idx.ids
+    out = idx.append(["b", "x", "a", "y", "x"])
+    # existing resolve to current ix; new get appended in first-seen
+    # order; an in-batch duplicate resolves to its first assignment
+    assert out.tolist() == [1, 3, 0, 4, 3]
+    assert len(idx) == 5
+    assert idx["x"] == 3 and idx["y"] == 4
+    assert idx.id_of(3) == "x"
+    # old indices unchanged
+    assert [idx[s] for s in ("a", "b", "c")] == [0, 1, 2]
+    assert list(old_ids) == ["a", "b", "c"]  # old decode view intact
+    # append is idempotent for already-known ids
+    again = idx.append(["x", "y"])
+    assert again.tolist() == [3, 4] and len(idx) == 5
+    # encode/decode see the grown index (and the pandas path rebuilds)
+    enc = idx.encode(np.asarray(["y", "zz"], dtype=object))
+    assert enc.tolist() == [4, -1]
+    assert idx.decode(np.asarray([3, 4])).tolist() == ["x", "y"]
+
+
+def test_string_index_append_empty_is_noop():
+    from predictionio_tpu.storage.bimap import StringIndex
+
+    idx = StringIndex.from_values(["a"])
+    out = idx.append([])
+    assert out.tolist() == [] and len(idx) == 1

@@ -110,3 +110,108 @@ def test_als_sharded_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(
         resumed.user_factors, full.user_factors, rtol=1e-5, atol=1e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# pio-live delta chain: torn / half-written links must fall back cleanly
+# to the last full model (same contract as the torn-newest-step restore)
+# ---------------------------------------------------------------------------
+
+
+def _mk_delta(seq, rank=4, base_users=10, base_items=6, n_new=1):
+    from predictionio_tpu.workflow.model_io import ModelDelta
+
+    rng = np.random.default_rng(seq)
+    return ModelDelta(
+        seq=seq,
+        meta={
+            "instance": "inst", "key": "k",
+            "baseUsers": base_users, "baseItems": base_items,
+            "watermark": {"appId": 1, "channelId": 0,
+                          "rowid": 100 + seq},
+        },
+        user_rows_ix=np.asarray([0, 3], np.int32),
+        user_rows=rng.normal(size=(2, rank)).astype(np.float32),
+        new_user_ids=np.asarray(
+            [f"nu{seq}_{j}" for j in range(n_new)], dtype=np.str_
+        ),
+        new_user_rows=rng.normal(size=(n_new, rank)).astype(np.float32),
+        item_rows_ix=np.zeros(0, np.int32),
+        item_rows=np.zeros((0, rank), np.float32),
+        new_item_ids=np.asarray([], dtype=np.str_),
+        new_item_rows=np.zeros((0, rank), np.float32),
+    )
+
+
+def test_delta_roundtrip_and_chain_order(tmp_path):
+    from predictionio_tpu.workflow import model_io as mio
+
+    d1, d2 = _mk_delta(1), _mk_delta(2, base_users=11)
+    p1 = mio.save_model_delta(tmp_path, "k", d1)
+    mio.save_model_delta(tmp_path, "k", d2)
+    assert p1.exists()
+    back = mio.load_model_delta(p1)
+    np.testing.assert_array_equal(back.user_rows, d1.user_rows)
+    assert back.new_user_ids.tolist() == ["nu1_0"]
+    assert back.watermark["rowid"] == 101
+    chain, err = mio.load_model_delta_chain(tmp_path, "k")
+    assert err is None and [d.seq for d in chain] == [1, 2]
+    # after_seq resumes mid-chain
+    chain2, err2 = mio.load_model_delta_chain(tmp_path, "k",
+                                              after_seq=1)
+    assert err2 is None and [d.seq for d in chain2] == [2]
+
+
+def test_torn_delta_truncates_chain_not_crash(tmp_path):
+    """A half-written link (crash mid-write, truncated upload) must
+    yield the good prefix — serving falls back toward the full model,
+    never consumes garbage."""
+    from predictionio_tpu.workflow import model_io as mio
+
+    for seq in (1, 2, 3):
+        mio.save_model_delta(tmp_path, "k", _mk_delta(seq))
+    p2 = tmp_path / mio.delta_file_name("k", 2)
+    raw = p2.read_bytes()
+    p2.write_bytes(raw[: len(raw) // 2])  # torn mid-file
+    chain, err = mio.load_model_delta_chain(tmp_path, "k")
+    assert [d.seq for d in chain] == [1]
+    assert err is not None and "unreadable" in err
+    # torn FIRST link -> empty chain == serve the full model as-is
+    p1 = tmp_path / mio.delta_file_name("k", 1)
+    p1.write_bytes(b"")
+    chain0, err0 = mio.load_model_delta_chain(tmp_path, "k")
+    assert chain0 == [] and err0 is not None
+
+
+def test_delta_chain_gap_truncates(tmp_path):
+    """Appended-row indices make a gapped chain unapplicable: stop at
+    the gap instead of corrupting row addressing."""
+    from predictionio_tpu.workflow import model_io as mio
+
+    mio.save_model_delta(tmp_path, "k", _mk_delta(1))
+    mio.save_model_delta(tmp_path, "k", _mk_delta(3))
+    chain, err = mio.load_model_delta_chain(tmp_path, "k")
+    assert [d.seq for d in chain] == [1]
+    assert err is not None and "gap" in err
+
+
+def test_delta_tmp_orphans_ignored(tmp_path):
+    from predictionio_tpu.workflow import model_io as mio
+
+    mio.save_model_delta(tmp_path, "k", _mk_delta(1))
+    # a crashed writer's orphan must not shadow real links
+    (tmp_path / "k-delta-00000002.npz.tmp").write_bytes(b"partial")
+    chain, err = mio.load_model_delta_chain(tmp_path, "k")
+    assert [d.seq for d in chain] == [1] and err is None
+
+
+def test_delta_version_refused_when_newer(tmp_path):
+    from predictionio_tpu.workflow import model_io as mio
+
+    d = _mk_delta(1)
+    d.meta["version"] = mio.DELTA_VERSION + 1
+    p = mio.save_model_delta(tmp_path, "k", d)
+    with pytest.raises(ValueError, match="newer"):
+        mio.load_model_delta(p)
+    chain, err = mio.load_model_delta_chain(tmp_path, "k")
+    assert chain == [] and err is not None

@@ -896,3 +896,111 @@ def test_compact_reclaims_space_both_stores(tmp_path):
     assert after < before / 4, (before, after)
     assert list(sh.find(app_id=1)) == []
     sh.close()
+
+
+# ---------------------------------------------------------------------------
+# pio-live since-cursor queries (rowid watermark — the fold-in scan +
+# dashboard recent-events primitive)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["sqlite_mem", "sqlite_file"])
+def cursor_store(request, tmp_path):
+    s = (
+        SQLiteEventStore(":memory:")
+        if request.param == "sqlite_mem"
+        else SQLiteEventStore(tmp_path / "cursor.db")
+    )
+    s.init_channel(1)
+    yield s
+    s.close()
+
+
+def test_find_since_empty_store(cursor_store):
+    assert cursor_store.max_rowid(1) == 0
+    rows, cur = cursor_store.find_rows_since(1, cursor=0)
+    assert rows == [] and cur == 0
+
+
+def test_find_since_only_new_rows(cursor_store):
+    _load(cursor_store)
+    pairs, cur = cursor_store.find_since(1, cursor=0)
+    assert len(pairs) == len(EVENTS)
+    assert cur == cursor_store.max_rowid(1)
+    # rowid-ascending == insertion order
+    assert [rid for rid, _ in pairs] == sorted(rid for rid, _ in pairs)
+    # nothing new past the cursor
+    pairs2, cur2 = cursor_store.find_since(1, cursor=cur)
+    assert pairs2 == [] and cur2 == cur
+    # one more event enters the window alone
+    eid = cursor_store.insert(
+        Event(event="rate", entity_type="user", entity_id="u9",
+              target_entity_type="item", target_entity_id="i9",
+              properties=DataMap({"rating": 1.0}), event_time=_t(9)),
+        app_id=1,
+    )
+    pairs3, cur3 = cursor_store.find_since(1, cursor=cur)
+    assert len(pairs3) == 1 and pairs3[0][1].event_id == eid
+    assert cur3 > cur
+
+
+def test_find_since_pages_through_backlog(cursor_store):
+    _load(cursor_store)
+    seen = []
+    cur = 0
+    while True:
+        pairs, cur2 = cursor_store.find_since(1, cursor=cur, limit=2)
+        if not pairs:
+            break
+        assert len(pairs) <= 2
+        seen.extend(e.event_id for _, e in pairs)
+        assert cur2 > cur
+        cur = cur2
+    all_ids = [e.event_id for e in cursor_store.find(app_id=1)]
+    assert sorted(seen) == sorted(all_ids)
+
+
+def test_find_since_event_name_filter(cursor_store):
+    _load(cursor_store)
+    pairs, cur = cursor_store.find_since(1, cursor=0,
+                                         event_names=["rate"])
+    assert {e.event for _, e in pairs} == {"rate"}
+    # the cursor still reflects only the ROWS RETURNED — filtered scans
+    # advance past what they saw, not past the whole table
+    assert cur <= cursor_store.max_rowid(1)
+
+
+def test_replace_reenters_scan_window(cursor_store):
+    """INSERT OR REPLACE re-keys the event: the correction shows up
+    past the old watermark (the fold-in wants corrected ratings)."""
+    ids = _load(cursor_store)
+    _, cur = cursor_store.find_since(1, cursor=0)
+    fixed = Event(
+        event="rate", entity_type="user", entity_id="u1",
+        target_entity_type="item", target_entity_id="i1",
+        properties=DataMap({"rating": 1.0}), event_time=_t(1),
+        event_id=ids[1],
+    )
+    cursor_store.insert(fixed, app_id=1)
+    pairs, cur2 = cursor_store.find_since(1, cursor=cur)
+    assert len(pairs) == 1
+    assert pairs[0][1].event_id == ids[1]
+    assert pairs[0][1].properties["rating"] == 1.0
+    assert cur2 > cur
+
+
+def test_find_since_newest_first(cursor_store):
+    _load(cursor_store)
+    pairs, cur = cursor_store.find_since(1, cursor=0, limit=3,
+                                         newest_first=True)
+    rids = [rid for rid, _ in pairs]
+    assert rids == sorted(rids, reverse=True)
+    assert len(pairs) == 3
+    assert cur == cursor_store.max_rowid(1)
+
+
+def test_find_since_channels_are_separate(cursor_store):
+    cursor_store.init_channel(1, 5)
+    _load(cursor_store)
+    pairs, _ = cursor_store.find_since(1, channel_id=5, cursor=0)
+    assert pairs == []

@@ -55,6 +55,27 @@ def main() -> None:
                     "EngineServer over HTTP (full product path: JSON "
                     "-> auth-free route -> micro-batcher -> device -> "
                     "JSON), A/B'ing microbatch on vs off")
+    ap.add_argument("--concurrency", type=int, default=0,
+                    help="closed-loop load at ONE concurrency point "
+                    "via tools/loadgen.py (multi-process workers over "
+                    "real HTTP; reports QPS + p50/p99 + per-segment "
+                    "breakdown)")
+    ap.add_argument("--sweep",
+                    help="comma-separated concurrency sweep (e.g. "
+                    "1,4,16,64): per-point records plus the "
+                    "serving_qps_at_slo summary the bench gate judges")
+    ap.add_argument("--duration-s", type=float, default=3.0,
+                    help="measured window per sweep point (default 3)")
+    ap.add_argument("--slo-ms", type=float, default=25.0,
+                    help="p99 SLO for the QPS@SLO summary (default 25)")
+    ap.add_argument("--loadgen-mode", choices=("process", "thread"),
+                    default="process",
+                    help="loadgen worker kind (process = no client "
+                    "GIL, the honest default)")
+    ap.add_argument("--append-history", action="store_true",
+                    help="append the sweep's fenced records to "
+                    "BENCH_HISTORY.jsonl (the canonical trajectory "
+                    "tools/bench_gate.py gates on)")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
@@ -211,6 +232,10 @@ def main() -> None:
 
         for metric, predict_one in make_modes():
             lats, wall = run_clients(predict_one)
+            # locked snapshot: the counters are mutated under the
+            # batcher's condition variable
+            mb = (batcher.stats()
+                  if metric.startswith("serving_microbatched") else None)
             chist = Histogram()
             for v in lats:
                 chist.observe(float(v))
@@ -233,9 +258,9 @@ def main() -> None:
                         "qps": round(len(lats) / wall, 1),
                         "single_thread_p50_ms": round(p50 * 1e3, 3),
                         **(
-                            {"max_batch_seen": batcher.max_seen,
-                             "batches": batcher.batches}
-                            if metric.startswith("serving_microbatched")
+                            {"max_batch_seen": mb["maxBatchSeen"],
+                             "batches": mb["batches"]}
+                            if mb is not None
                             else {}
                         ),
                     }
@@ -266,18 +291,16 @@ def main() -> None:
     if args.http:
         _bench_http(args, model, rng)
 
+    if args.sweep or args.concurrency > 0:
+        _bench_sweep(args, model, rng)
 
-def _bench_http(args, model, rng) -> None:
-    """Full product path under concurrent HTTP load: a deployed
-    EngineServer with the recommendation algorithm serving the
-    synthetic model, N urllib clients, microbatch on vs off."""
-    import concurrent.futures
-    import json as _json
-    import urllib.request
 
+def _prebuilt_engine(model):
+    """A deployable engine whose 'training' hands back the prebuilt
+    synthetic model (what the serving benches measure is the serving
+    path, never training)."""
     from predictionio_tpu.controller.base import DataSource, WorkflowContext
     from predictionio_tpu.controller.engine import SimpleEngine
-    from predictionio_tpu.server.serving import EngineServer, ServerConfig
     from predictionio_tpu.storage.registry import Storage
     from predictionio_tpu.templates.recommendation import (
         ALSAlgorithm, Query as RecQuery,
@@ -314,17 +337,49 @@ def _bench_http(args, model, rng) -> None:
     # user's model dir per bench run
     iid = run_train(engine, ep, ctx=ctx, engine_variant="bench.json",
                     workflow_params=WorkflowParams(save_model=False))
+    return engine, ep, iid, ctx
+
+
+def _boot_server(engine, ep, iid, ctx, microbatch):
+    from predictionio_tpu.server.serving import EngineServer, ServerConfig
+
+    srv = EngineServer(
+        engine, ep, iid, ctx=ctx,
+        config=ServerConfig(port=0, microbatch=microbatch),
+        engine_variant="bench.json",
+    )
+    srv.start_background()
+    return srv
+
+
+def _warm_batch_ladder(srv, num: int, top: int) -> None:
+    """Pre-compile every pow2 batch executable the padded batcher can
+    dispatch up to ``top`` (a mid-run first-compile would land in the
+    reported p99)."""
+    if srv.batcher is None:
+        return
+    dq = srv.query_decoder({"user": "u0", "num": num})
+    bsz = 1
+    while bsz <= min(64, top):
+        srv.batcher.batch_fn([dq] * bsz)
+        bsz *= 2
+
+
+def _bench_http(args, model, rng) -> None:
+    """Full product path under concurrent HTTP load: a deployed
+    EngineServer with the recommendation algorithm serving the
+    synthetic model, N urllib clients, microbatch on vs off."""
+    import concurrent.futures
+    import json as _json
+    import urllib.request
+
+    engine, ep, iid, ctx = _prebuilt_engine(model)
 
     per_thread = max(args.n // args.threads, 25)
     users = rng.integers(0, args.users, (args.threads, per_thread))
 
     def measure(microbatch):
-        srv = EngineServer(
-            engine, ep, iid, ctx=ctx,
-            config=ServerConfig(port=0, microbatch=microbatch),
-            engine_variant="bench.json",
-        )
-        srv.start_background()
+        srv = _boot_server(engine, ep, iid, ctx, microbatch)
         base = f"http://127.0.0.1:{srv.config.port}"
 
         def one(u):
@@ -353,12 +408,7 @@ def _bench_http(args, model, rng) -> None:
         # (a mid-run first-compile would land in the reported p99), then
         # one HTTP round per thread; stats reset so the JSON describes
         # timed traffic only
-        if srv.batcher is not None:
-            dq = srv.query_decoder({"user": "u0", "num": args.num})
-            bsz = 1
-            while bsz <= min(64, args.threads * 2):
-                srv.batcher.batch_fn([dq] * bsz)
-                bsz *= 2
+        _warm_batch_ladder(srv, args.num, args.threads * 2)
         with concurrent.futures.ThreadPoolExecutor(args.threads) as ex:
             list(ex.map(lambda t: one(int(users[t, 0])),
                         range(args.threads)))  # warm
@@ -391,6 +441,162 @@ def _bench_http(args, model, rng) -> None:
             "qps": round(qps, 1),
             **({"max_batch_seen": stats["maxBatchSeen"]} if stats else {}),
         }), flush=True)
+
+
+def _bench_sweep(args, model, rng) -> None:
+    """pio-pulse closed-loop concurrency sweep (``--sweep 1,4,16`` /
+    ``--concurrency N``): a real deployed EngineServer, multi-process
+    loadgen workers over real HTTP, per-point QPS + exact p50/p99 +
+    per-segment decomposition (registry deltas around each window), a
+    ``serving_qps_at_slo`` summary the bench gate judges upward, and
+    the sweep artifact ``/pulse.html`` renders.
+
+    Timings are host-complete by construction (every response is fully
+    drained by the closed-loop worker before its latency is recorded),
+    hence ``fenced: true`` on the records."""
+    import jax
+
+    sys.path.insert(0, str(Path(__file__).parent / "tools"))
+    import bench_gate
+    import loadgen
+
+    from predictionio_tpu.obs import telemetry_home
+    from predictionio_tpu.obs.timeline import (
+        SERVE_SEGMENTS, SERVE_SEGMENT_SECONDS,
+    )
+
+    points_c = (
+        [int(x) for x in args.sweep.split(",")] if args.sweep
+        else [args.concurrency]
+    )
+    engine, ep, iid, ctx = _prebuilt_engine(model)
+    srv = _boot_server(engine, ep, iid, ctx, microbatch="auto")
+    base = f"http://127.0.0.1:{srv.config.port}"
+    _warm_batch_ladder(srv, args.num, max(points_c) * 2)
+    payloads = [
+        json.dumps({"user": f"u{int(u)}", "num": args.num})
+        for u in rng.integers(0, args.users, 256)
+    ]
+
+    def seg_snapshot():
+        return {
+            s: SERVE_SEGMENT_SECONDS.labels(segment=s).snapshot()
+            for s in SERVE_SEGMENTS
+        }
+
+    platform = args.platform or jax.default_backend()
+    points = []
+    for c in points_c:
+        before = seg_snapshot()
+        res = loadgen.run_load(
+            f"{base}/queries.json", payloads, c, args.duration_s,
+            mode=args.loadgen_mode,
+        )
+        after = seg_snapshot()
+        # mean per-segment share of this window's requests: the server
+        # and bench share one process, so the registry deltas are the
+        # exact server-side decomposition of the window's traffic
+        segments_ms = {}
+        for s in SERVE_SEGMENTS:
+            dc = after[s]["count"] - before[s]["count"]
+            ds = after[s]["sum"] - before[s]["sum"]
+            segments_ms[s] = round(ds / dc * 1e3, 4) if dc else 0.0
+        point = {
+            "concurrency": c,
+            "qps": round(res["qps"], 1),
+            "p50_ms": round(res["p50_ms"], 3),
+            "p99_ms": round(res["p99_ms"], 3),
+            "completed": res["completed"],
+            "errors": res["errors"],
+            "truncated": res["truncated"],
+            "segments_ms": segments_ms,
+        }
+        points.append(point)
+        rec = {
+            "metric": f"serving_p99_ms_c{c}",
+            "value": point["p99_ms"],
+            "unit": "ms",
+            "direction": "down",
+            "platform": platform,
+            "scale": None,
+            "fenced": True,
+            "qps": point["qps"],
+            "p50_ms": point["p50_ms"],
+            "duration_s": args.duration_s,
+            "loadgen_mode": args.loadgen_mode,
+            "errors": res["errors"],
+            "items": args.items,
+            "rank": args.rank,
+            "segments_ms": segments_ms,
+        }
+        print(json.dumps(rec), flush=True)
+        if args.append_history:
+            bench_gate.append_history(bench_gate.DEFAULT_HISTORY, rec)
+    mb = srv.batcher.stats() if srv.batcher is not None else None
+    srv.stop()
+
+    sweep_doc = {
+        "recorded_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "slo_ms": args.slo_ms,
+        "platform": platform,
+        "items": args.items,
+        "rank": args.rank,
+        "points": points,
+        **({"microbatch": mb} if mb else {}),
+    }
+    ok_points = [
+        p for p in points
+        if p["p99_ms"] <= args.slo_ms and p["errors"] == 0
+    ]
+    if ok_points:
+        best = max(ok_points, key=lambda p: p["qps"])
+        sweep_doc["qps_at_slo"] = best["qps"]
+        sweep_doc["concurrency_at_slo"] = best["concurrency"]
+        rec = {
+            "metric": "serving_qps_at_slo",
+            "value": best["qps"],
+            "unit": "qps",
+            "direction": "up",
+            "platform": platform,
+            "scale": None,
+            "fenced": True,
+            "slo_ms": args.slo_ms,
+            "concurrency": best["concurrency"],
+            "p99_ms": best["p99_ms"],
+            "sweep": [p["concurrency"] for p in points],
+            "duration_s": args.duration_s,
+            "loadgen_mode": args.loadgen_mode,
+            "items": args.items,
+            "rank": args.rank,
+        }
+        print(json.dumps(rec), flush=True)
+        if args.append_history:
+            bench_gate.append_history(bench_gate.DEFAULT_HISTORY, rec)
+        try:
+            bench_gate.write_pr_summary(rec, key="serving_sweep")
+        except Exception as e:
+            print(f"# WARNING: could not write bench summary: {e}",
+                  file=sys.stderr)
+    else:
+        # no record is written: a 0-QPS "measurement" would poison the
+        # rolling baseline; the operator sees WHY instead
+        print(
+            f"# WARNING: no sweep point met the p99 SLO of "
+            f"{args.slo_ms} ms; no serving_qps_at_slo record written",
+            file=sys.stderr,
+        )
+    # the /pulse.html sweep artifact (dashboard renders the latest)
+    sweep_dir = telemetry_home() / "sweeps"
+    try:
+        sweep_dir.mkdir(parents=True, exist_ok=True)
+        (sweep_dir / "latest.json").write_text(
+            json.dumps(sweep_doc, indent=1) + "\n"
+        )
+    except OSError as e:
+        print(f"# WARNING: could not write sweep artifact: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

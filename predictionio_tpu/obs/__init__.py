@@ -252,11 +252,12 @@ def phase_span(name: str, attrs: Optional[dict] = None) -> Iterator[dict]:
 
 
 # pio-xray (compiler/device observability + slow-query flight recorder)
-# imports last: both modules read this package's shared registry/tracer
-# via ``from . import ...`` and register their metric families at
-# import, so every process's first scrape carries the X-ray schema too.
-# Neither imports jax at module level — obs stays jax-free.
-from . import xray  # noqa: E402
+# and pio-pulse (request-lifecycle timeline decomposition) import last:
+# these modules read this package's shared registry/tracer via
+# ``from . import ...`` and register their metric families at import,
+# so every process's first scrape carries the full schema.  None
+# imports jax at module level — obs stays jax-free.
+from . import timeline, xray  # noqa: E402
 from .flight import FlightRecorder, get_flight_recorder  # noqa: E402
 
-__all__ += ["FlightRecorder", "get_flight_recorder", "xray"]
+__all__ += ["FlightRecorder", "get_flight_recorder", "timeline", "xray"]

@@ -137,6 +137,11 @@ class FlightRecorder:
         for _, _, r in sorted(snap, reverse=True):
             item = {k: r[k] for k in
                     ("traceId", "name", "durationSec", "at", "spanCount")}
+            if "attrs" in r:
+                # capture-time context (pulse segment decomposition,
+                # pio-live modelFreshnessSec/foldinSeq): a worst-N line
+                # on /status explains itself without the span tree
+                item["attrs"] = r["attrs"]
             if spans:
                 item["spans"] = r["spans"]
             worst.append(item)

@@ -12,9 +12,10 @@ operator view next to the evaluation index; machines scrape
 from __future__ import annotations
 
 import html as _html
+import json
 import logging
 import urllib.parse
-from ..obs import get_registry, get_tracer
+from ..obs import get_registry, get_tracer, telemetry_home
 from ..storage.registry import Storage
 from .http_base import HTTPServerBase, JsonRequestHandler
 
@@ -65,6 +66,7 @@ class DashboardServer(HTTPServerBase):
             "<p>Recent events (pio-live): " + app_links + "</p>"
             "<p><a href='/metrics.html'>live metrics</a> &middot; "
             "<a href='/xray.html'>x-ray</a> &middot; "
+            "<a href='/pulse.html'>pulse</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
         )
@@ -232,6 +234,129 @@ class DashboardServer(HTTPServerBase):
             "</body></html>"
         )
 
+    def pulse_html(self) -> str:
+        """Operator view of the pio-pulse request-lifecycle layer: the
+        per-segment decomposition of serving and ingest latency, the
+        micro-batcher's concurrency saturation counters, and the
+        latest closed-loop sweep (``bench_serving.py --sweep`` writes
+        ``telemetry/sweeps/latest.json``)."""
+        from ..obs.timeline import (
+            EVENT_SEGMENTS,
+            EVENTS_SEGMENT_SECONDS,
+            MICROBATCH_BATCH_SIZE,
+            MICROBATCH_QUEUE_DEPTH,
+            MICROBATCH_ROLE_TOTAL,
+            SERVE_INFLIGHT,
+            SERVE_SEGMENTS,
+            SERVE_SEGMENT_SECONDS,
+        )
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        def seg_rows(family, segments):
+            rows = []
+            for s in segments:
+                child = family.labels(segment=s)
+                snap = child.snapshot()
+                n = snap["count"]
+                mean = (snap["sum"] / n * 1e3) if n else 0.0
+                p95 = child.percentile(95, snap) * 1e3 if n else 0.0
+                rows.append(
+                    "<tr><td>{s}</td><td>{n}</td><td>{m:.3f}</td>"
+                    "<td>{p:.3f}</td></tr>".format(
+                        s=esc(s), n=n, m=mean, p=p95,
+                    )
+                )
+            return rows
+
+        seg_table = (
+            "<table border='1'><tr><th>segment</th><th>count</th>"
+            "<th>mean ms</th><th>p95 ms</th></tr>"
+        )
+        bs = MICROBATCH_BATCH_SIZE.child()
+        bs_snap = bs.snapshot()
+        roles = {
+            dict(key).get("role", "?"): child.value()
+            for key, child in MICROBATCH_ROLE_TOTAL.children()
+        }
+        sat_rows = [
+            "<tr><td>inflight</td><td>{:g}</td></tr>".format(
+                SERVE_INFLIGHT.child().value()),
+            "<tr><td>batcher queue depth</td><td>{:g}</td></tr>".format(
+                MICROBATCH_QUEUE_DEPTH.child().value()),
+            "<tr><td>batches dispatched</td><td>{}</td></tr>".format(
+                bs_snap["count"]),
+            "<tr><td>mean batch size</td><td>{:.2f}</td></tr>".format(
+                bs_snap["sum"] / bs_snap["count"]
+                if bs_snap["count"] else 0.0),
+            "<tr><td>leader / follower requests</td>"
+            "<td>{:g} / {:g}</td></tr>".format(
+                roles.get("leader", 0.0), roles.get("follower", 0.0)),
+        ]
+        sweep_html = "<p>(no sweep recorded yet — run "
+        sweep_html += "<code>bench_serving.py --sweep 1,4,16</code>)</p>"
+        sweep_path = telemetry_home() / "sweeps" / "latest.json"
+        try:
+            sweep = json.loads(sweep_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            sweep = None
+        if sweep:
+            rows = []
+            for p in sweep.get("points", ()):
+                segs = "; ".join(
+                    f"{k} {v:.2f}" for k, v in
+                    sorted(p.get("segments_ms", {}).items(),
+                           key=lambda kv: -kv[1])[:4]
+                )
+                rows.append(
+                    "<tr><td>{c}</td><td>{q:.1f}</td><td>{p50:.2f}</td>"
+                    "<td>{p99:.2f}</td><td>{e}</td><td>{s}</td>"
+                    "</tr>".format(
+                        c=p.get("concurrency"), q=p.get("qps", 0.0),
+                        p50=p.get("p50_ms", 0.0),
+                        p99=p.get("p99_ms", 0.0),
+                        e=p.get("errors", 0), s=esc(segs),
+                    )
+                )
+            slo = sweep.get("slo_ms")
+            qps = sweep.get("qps_at_slo")
+            sweep_html = (
+                "<p>recorded {at} on {plat}; QPS@SLO(p99 &le; "
+                "{slo} ms) = <b>{qps}</b></p>"
+                "<table border='1'><tr><th>concurrency</th><th>qps</th>"
+                "<th>p50 ms</th><th>p99 ms</th><th>errors</th>"
+                "<th>top segments (mean ms)</th></tr>".format(
+                    at=esc(sweep.get("recorded_at", "?")),
+                    plat=esc(sweep.get("platform", "?")),
+                    slo=esc(slo), qps=esc(qps if qps is not None
+                                          else "(no point met SLO)"),
+                ) + "\n".join(rows) + "</table>"
+            )
+        return (
+            "<html><head><title>pulse</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace;padding:2px 8px}</style></head>"
+            "<body><h1>Pulse: request lifecycle &amp; saturation</h1>"
+            "<p>Segment histograms at <a href='/metrics'>/metrics</a> "
+            "(pio_serve_segment_seconds / pio_events_segment_seconds); "
+            "on-demand profiler at <code>/debug/profile?seconds=S</code> "
+            "on any server.</p>"
+            "<h2>Serving segments</h2>"
+            + seg_table
+            + "\n".join(seg_rows(SERVE_SEGMENT_SECONDS, SERVE_SEGMENTS))
+            + "</table>"
+            "<h2>Event-ingest segments</h2>"
+            + seg_table
+            + "\n".join(seg_rows(EVENTS_SEGMENT_SECONDS, EVENT_SEGMENTS))
+            + "</table>"
+            "<h2>Concurrency saturation</h2>"
+            "<table border='1'><tr><th>gauge</th><th>value</th></tr>"
+            + "\n".join(sat_rows) + "</table>"
+            "<h2>Latest closed-loop sweep</h2>" + sweep_html +
+            "</body></html>"
+        )
+
     def _make_handler(server: "DashboardServer"):
         class Handler(JsonRequestHandler):
             server_logger = logger
@@ -268,6 +393,10 @@ class DashboardServer(HTTPServerBase):
                     return
                 if path == "/xray.html":
                     self._reply(200, server.xray_html().encode(),
+                                "text/html")
+                    return
+                if path == "/pulse.html":
+                    self._reply(200, server.pulse_html().encode(),
                                 "text/html")
                     return
                 parts = [x for x in path.split("/") if x]

@@ -28,7 +28,7 @@ import time
 import urllib.parse
 from typing import Any, Optional
 
-from ..obs import EVENT_WRITE_LATENCY, get_tracer, trace_scope
+from ..obs import EVENT_WRITE_LATENCY, get_tracer, timeline, trace_scope
 from ..resilience import faults
 from ..resilience.policy import RetryPolicy
 from ..storage.event import Event, EventValidationError, parse_time
@@ -247,7 +247,13 @@ class EventServer(HTTPServerBase):
                     self._reply(500, {"message": str(e)})
 
             def _post_event(self):
+                # pulse ingest timeline (auth/parse/store_write/reply):
+                # the tail of ingestion latency decomposes the same way
+                # serving queries do.  Only the 201 path observes —
+                # rejected requests have no meaningful decomposition.
+                tl = timeline.Timeline("events")
                 app_id, channel_id, allowed = self._auth()
+                tl.mark("auth")
                 try:
                     event = Event.from_json(json.loads(self._body().decode()))
                 except (EventValidationError, json.JSONDecodeError,
@@ -255,6 +261,7 @@ class EventServer(HTTPServerBase):
                     self._book(app_id, 400)
                     self._reply(400, {"message": str(e)})
                     return
+                tl.mark("parse")
                 try:
                     eid = server.insert_event(event, app_id, channel_id, allowed)
                 except AuthError as e:
@@ -265,8 +272,11 @@ class EventServer(HTTPServerBase):
                     self._book(app_id, 503)
                     self._reply_503(e)
                     return
+                tl.mark("store_write")
                 self._book(app_id, 201, event)
                 self._reply(201, {"eventId": eid})
+                tl.mark("reply")
+                tl.finish()
 
             def _post_batch(self):
                 """Batch insert: per-event status

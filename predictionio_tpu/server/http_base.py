@@ -19,6 +19,13 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     """Base handler: HTTP/1.1 keep-alive + JSON/body helpers."""
 
     protocol_version = "HTTP/1.1"
+    # the reply is two send() calls (buffered headers, then body);
+    # without TCP_NODELAY, Nagle holds the body segment until the
+    # client's delayed ACK — measured as a ~40 ms stall on EVERY
+    # keep-alive POST (pio-pulse loadgen found it; connection-per-
+    # request clients like urllib never hit it, which is why the
+    # earlier benches didn't see it)
+    disable_nagle_algorithm = True
     server_logger = None  # subclasses set a logging.Logger
 
     def log_message(self, fmt, *args):
@@ -27,13 +34,14 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
     def _serve_metrics(self) -> bool:
         """Answer the common observability mounts — ``GET /metrics``
-        (Prometheus exposition) and ``GET /debug/xray`` (compiler/
-        device/flight-recorder JSON, pio-xray) — from the process-wide
-        registry.  Every server's ``do_GET`` tries this first, so all
-        four HTTP surfaces expose the same pair without per-server
-        code.  Returns True when the request was handled."""
+        (Prometheus exposition), ``GET /debug/xray`` (compiler/device/
+        flight-recorder JSON, pio-xray) and ``GET /debug/profile``
+        (blocking on-demand jax.profiler capture, pio-pulse) — from the
+        process-wide registry.  Every server's ``do_GET`` tries this
+        first, so all four HTTP surfaces expose the same set without
+        per-server code.  Returns True when the request was handled."""
         path = urllib.parse.urlparse(self.path).path
-        if path not in ("/metrics", "/debug/xray"):
+        if path not in ("/metrics", "/debug/xray", "/debug/profile"):
             return False
         if not metrics_enabled():
             self._reply(404, {"message": "metrics disabled (--no-metrics)"})
@@ -43,9 +51,38 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 
             self._reply(200, xray_payload())
             return True
+        if path == "/debug/profile":
+            self._serve_profile()
+            return True
         self._reply(200, render_prometheus().encode(),
                     ctype=PROMETHEUS_CTYPE)
         return True
+
+    def _serve_profile(self) -> None:
+        """``GET /debug/profile?seconds=S``: capture a jax.profiler
+        trace into ``$PIO_TPU_HOME/telemetry/profiles/`` with pulse
+        segments bridged as TraceAnnotations, and answer the artifact
+        manifest.  Blocks this handler thread for S (clamped) seconds —
+        the other ThreadingHTTPServer threads keep serving, which is
+        exactly what a live capture wants to observe."""
+        from ..obs import timeline
+
+        qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        try:
+            seconds = float(qs.get("seconds", ["2"])[0])
+        except ValueError:
+            self._reply(400, {
+                "message": f"bad seconds: {qs['seconds'][0]!r}"
+            })
+            return
+        try:
+            self._reply(200, timeline.capture_profile(seconds))
+        except timeline.ProfileBusy as e:
+            self._reply(409, {"message": str(e)})
+        except Exception as e:
+            self._reply(500, {
+                "message": f"profile capture failed: {e}"
+            })
 
     def _trace_id(self) -> Optional[str]:
         """The request's propagated trace id (``X-PIO-Trace``), if any."""

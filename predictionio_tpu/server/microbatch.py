@@ -42,7 +42,25 @@ import threading
 import time
 from typing import Any, Callable, Sequence
 
+from ..obs.timeline import (
+    MICROBATCH_BATCH_SIZE,
+    MICROBATCH_QUEUE_DEPTH,
+    MICROBATCH_ROLE_TOTAL,
+    MICROBATCH_WAIT_SECONDS,
+    annotate,
+    current_timeline,
+)
+
 __all__ = ["MicroBatcher", "dispatchable_sizes"]
+
+# pulse saturation metrics, children cached at import (labels() is too
+# hot for the per-submit path); process-wide like pio_query_latency —
+# one serving process hosts one live batcher
+_m_queue_depth = MICROBATCH_QUEUE_DEPTH.child()
+_m_batch_size = MICROBATCH_BATCH_SIZE.child()
+_m_batch_wait = MICROBATCH_WAIT_SECONDS.child()
+_m_leader = MICROBATCH_ROLE_TOTAL.labels(role="leader")
+_m_follower = MICROBATCH_ROLE_TOTAL.labels(role="follower")
 
 # distinguishes "no result produced" from a legitimate None result —
 # batch_fns whose valid outputs include None must not have them
@@ -78,13 +96,23 @@ def dispatchable_sizes(max_batch: int) -> list[int]:
 
 
 class _Entry:
-    __slots__ = ("item", "done", "value", "error")
+    # t_enq/t_claim/t_run0/t_run1 are the pulse timeline stamps: set by
+    # whichever thread performs the transition (enqueue by the caller,
+    # claim by the leader, run bracketing by the executing thread) and
+    # read by the caller AFTER ``done`` — the condition variable's
+    # release/acquire orders the writes before the read
+    __slots__ = ("item", "done", "value", "error",
+                 "t_enq", "t_claim", "t_run0", "t_run1")
 
     def __init__(self, item):
         self.item = item
         self.done = False
         self.value = _UNSET
         self.error: Exception | None = None
+        self.t_enq = time.perf_counter()
+        self.t_claim = None
+        self.t_run0 = None
+        self.t_run1 = None
 
 
 class MicroBatcher:
@@ -121,19 +149,41 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._pending: list[_Entry] = []
         self._running = False
-        # observability: how the batcher is actually coalescing
+        # observability: how the batcher is actually coalescing.
+        # Mutated only under _cond; read through stats() (bare reads
+        # tore under concurrency — serving status JSON and the benches
+        # all go through the locked snapshot now)
         self.batches = 0
         self.requests = 0
         self.max_seen = 0
+        self.leaders = 0
+        self.followers = 0
 
     def reset_stats(self) -> None:
         with self._cond:
             self.batches = self.requests = self.max_seen = 0
+            self.leaders = self.followers = 0
+
+    def stats(self) -> dict:
+        """Locked snapshot of the coalescing counters plus the live
+        queue depth — the ONE way to read them (status JSON, benches,
+        /pulse.html)."""
+        with self._cond:
+            return {
+                "batches": self.batches,
+                "requests": self.requests,
+                "maxBatchSeen": self.max_seen,
+                "leaders": self.leaders,
+                "followers": self.followers,
+                "queueDepth": len(self._pending),
+            }
 
     def submit(self, item: Any) -> Any:
         entry = _Entry(item)
+        led_own = False
         with self._cond:
             self._pending.append(entry)
+            _m_queue_depth.set(float(len(self._pending)))
             # wake a leader sitting in its accumulation window (no-op
             # for followers: they re-check state and wait again)
             self._cond.notify_all()
@@ -145,12 +195,49 @@ class MicroBatcher:
                     self._running = True
                     batch = self._pending[: self.max_batch]
                     del self._pending[: len(batch)]
+                    now = time.perf_counter()
+                    for e in batch:
+                        e.t_claim = now
+                    _m_queue_depth.set(float(len(self._pending)))
+                    # role bookkeeping: with > max_batch entries ahead,
+                    # the claimed batch may not include our own entry —
+                    # then we led for OTHERS and our request is still a
+                    # follower of some later batch
+                    if any(e is entry for e in batch):
+                        led_own = True
                     self._lead(batch)
                     continue  # re-check: our entry is done (we led it)
                 self._cond.wait()
+            if led_own:
+                self.leaders += 1
+            else:
+                self.followers += 1
+        (_m_leader if led_own else _m_follower).inc()
+        # credit the caller's pulse timeline with what this entry
+        # actually experienced (error requests decompose too)
+        self._book_timeline(entry)
         if entry.error is not None:
             raise entry.error
         return entry.value if entry.value is not _UNSET else None
+
+    @staticmethod
+    def _book_timeline(entry: _Entry) -> None:
+        """Book queue_wait/batch_wait/device from the entry stamps onto
+        the thread's current timeline.  Residual time inside the submit
+        region (condition wake latency, a solo retry after a failed
+        batch) is attributed to ``device`` by add_block, so the
+        timeline's segment sum still equals wall time."""
+        tl = current_timeline()
+        if tl is None:
+            return
+        parts = []
+        if entry.t_claim is not None:
+            parts.append(("queue_wait", entry.t_claim - entry.t_enq))
+            if entry.t_run0 is not None:
+                parts.append(("batch_wait", entry.t_run0 - entry.t_claim))
+                if entry.t_run1 is not None:
+                    parts.append(("device", entry.t_run1 - entry.t_run0))
+        tl.add_block(parts, residual_to="device")
 
     def _lead(self, batch: list[_Entry]) -> None:
         """Run one batch on the calling thread.  Called with the lock
@@ -177,8 +264,14 @@ class MicroBatcher:
                         break
                     self._cond.wait(left)
                     take = self.max_batch - len(batch)
-                    batch += self._pending[:take]
+                    absorbed = self._pending[:take]
                     del self._pending[:take]
+                    if absorbed:
+                        now = time.perf_counter()
+                        for e in absorbed:
+                            e.t_claim = now
+                        batch += absorbed
+                        _m_queue_depth.set(float(len(self._pending)))
             self._cond.release()
             try:
                 self._run_batch(batch)
@@ -219,7 +312,18 @@ class MicroBatcher:
             n = len(items)
             if self.pad_batches and n > 1:
                 items = items + [items[-1]] * (_pad_size(n) - n)
-            results = self.batch_fn(items)
+            t0 = time.perf_counter()
+            for e in batch:
+                e.t_run0 = t0
+            if batch[0].t_claim is not None:
+                # accumulation-window cost: first claim -> dispatch
+                _m_batch_wait.observe(max(t0 - batch[0].t_claim, 0.0))
+            _m_batch_size.observe(float(n))
+            with annotate(f"pio.device.batch{len(items)}"):
+                results = self.batch_fn(items)
+            t1 = time.perf_counter()
+            for e in batch:
+                e.t_run1 = t1
             if len(results) != len(items):
                 raise RuntimeError(
                     f"batch_fn returned {len(results)} results "

@@ -48,9 +48,11 @@ from ..obs import (
     get_flight_recorder,
     get_tracer,
     new_trace_id,
+    timeline,
     trace_scope,
     xray,
 )
+from ..obs.timeline import SERVE_INFLIGHT, annotate
 from ..resilience import faults
 from ..resilience.delivery import DeliveryQueue
 from ..resilience.policy import (
@@ -65,6 +67,10 @@ from ..workflow.train import prepare_deploy_components
 logger = logging.getLogger(__name__)
 
 __all__ = ["EngineServer", "ServerConfig"]
+
+# pulse: the serving edge's saturation gauge, child cached at import
+# (labels()/child() lookups are too hot for the per-request path)
+_m_inflight = SERVE_INFLIGHT.child()
 
 
 class ServerConfig:
@@ -541,36 +547,70 @@ class EngineServer(HTTPServerBase):
     # -- query path -------------------------------------------------------
     def predict_json(self, query_json: dict,
                      timeout_s: Optional[float] = None) -> Any:
+        # pulse timeline: adopt the HTTP handler's (its t0 covers body
+        # read + JSON decode, and it adds the socket-write segment
+        # after the reply) or own a fresh one for direct callers
+        # (benches, tests) — either way the batcher finds it via the
+        # thread-local scope and credits queue/batch/device waits
+        tl = timeline.current_timeline()
+        owned = tl is None
+        if owned:
+            tl = timeline.Timeline("serve")
         t0 = time.perf_counter()
-        # the request's time budget: per-request override, else the
-        # configured default, else unbounded (None costs nothing)
-        budget = timeout_s if timeout_s is not None \
-            else self.config.query_timeout_s
-        deadline = Deadline.after(budget) if budget is not None else None
-        query = self.query_decoder(query_json)
-        with self._lock:
-            algorithms, models, serving, batcher = (
-                self.algorithms, self.models, self.serving, self.batcher,
-            )
-        faults.check("device.dispatch")
-        with deadline_scope(deadline):
-            if deadline is not None:
-                # checked at the device boundary: dispatching a batched
-                # XLA call for a request whose client gave up wastes the
-                # one resource concurrency shares — the device queue
-                deadline.check("query device dispatch")
-            if batcher is not None:
-                # concurrent requests coalesce into one batched device
-                # call (serve() stays per-request on the caller's thread)
-                predictions = batcher.submit(query)
-            else:
-                predictions = [
-                    algo.predict(model, query)
-                    for algo, model in zip(algorithms, models)
-                ]
-            if deadline is not None:
-                deadline.check("query serving")
-            result = serving.serve(query, predictions)
+        _m_inflight.inc()
+        try:
+            with timeline.timeline_scope(tl), annotate("pio.serve.query"):
+                # the request's time budget: per-request override, else
+                # the configured default, else unbounded (None costs
+                # nothing)
+                budget = timeout_s if timeout_s is not None \
+                    else self.config.query_timeout_s
+                deadline = Deadline.after(budget) \
+                    if budget is not None else None
+                query = self.query_decoder(query_json)
+                tl.mark("parse")
+                with self._lock:
+                    algorithms, models, serving, batcher = (
+                        self.algorithms, self.models, self.serving,
+                        self.batcher,
+                    )
+                    # pio-live attribution, captured with the snapshot:
+                    # a slow query concurrent with a fold-in apply is
+                    # explicable from its flight record alone
+                    freshness = (
+                        time.monotonic() - self.model_advanced_mono
+                    )
+                    foldin_seq = max(
+                        self.foldin_applied_seq.values(), default=0
+                    )
+                faults.check("device.dispatch")
+                tl.mark("auth")
+                with deadline_scope(deadline):
+                    if deadline is not None:
+                        # checked at the device boundary: dispatching a
+                        # batched XLA call for a request whose client
+                        # gave up wastes the one resource concurrency
+                        # shares — the device queue
+                        deadline.check("query device dispatch")
+                    if batcher is not None:
+                        # concurrent requests coalesce into one batched
+                        # device call (serve() stays per-request on the
+                        # caller's thread); the batcher books the
+                        # queue_wait/batch_wait/device segments
+                        predictions = batcher.submit(query)
+                    else:
+                        predictions = [
+                            algo.predict(model, query)
+                            for algo, model in zip(algorithms, models)
+                        ]
+                        tl.mark("device")
+                    if deadline is not None:
+                        deadline.check("query serving")
+                    result = serving.serve(query, predictions)
+                out = _result_to_json(result)
+                tl.mark("serialize")
+        finally:
+            _m_inflight.dec()
         dt = time.perf_counter() - t0
         with self._lock:
             self.request_count += 1
@@ -578,16 +618,28 @@ class EngineServer(HTTPServerBase):
             instance_id = self.instance_id
         # the request's trace id rides the histograms as a bucket
         # exemplar AND keys the flight record — /metrics names a trace,
-        # the flight recorder holds its span tree, one grep joins them
+        # the flight recorder holds its span tree, one grep joins them.
+        # The segment decomposition + pio-live freshness ride BOTH the
+        # span attrs and the flight record, so a worst-N entry already
+        # says which segment ate the time (write lands only in the
+        # histogram family: the record is captured before the socket
+        # write).
         tid = current_trace_id()
         self._latency.observe(dt, exemplar=tid)
         self._m_latency.observe(dt, exemplar=tid)
-        get_tracer().record("serve.query", dt,
-                            attrs={"instance": instance_id})
+        attrs = {
+            "instance": instance_id,
+            "modelFreshnessSec": round(max(freshness, 0.0), 3),
+            "segmentsMs": tl.snapshot_ms(),
+        }
+        if foldin_seq:
+            attrs["foldinSeq"] = foldin_seq
+        get_tracer().record("serve.query", dt, attrs=attrs)
         get_flight_recorder().offer(
-            tid, dt, name="serve.query", attrs={"instance": instance_id}
+            tid, dt, name="serve.query", attrs=attrs
         )
-        out = _result_to_json(result)
+        if owned:
+            tl.finish()
         if self.config.feedback and self.config.event_server_url:
             out = self._send_feedback(query_json, out)
         return out
@@ -685,11 +737,9 @@ class EngineServer(HTTPServerBase):
             "startTime": self.start_time,
         }
         if batcher is not None:
-            out["microbatch"] = {
-                "batches": batcher.batches,
-                "requests": batcher.requests,
-                "maxBatchSeen": batcher.max_seen,
-            }
+            # locked snapshot — the counters are mutated under the
+            # batcher's condition by whichever thread leads a batch
+            out["microbatch"] = batcher.stats()
         # pio-live: model freshness + watermark lag (absent when off)
         out.update(self._foldin_status())
         # failure observability: queue depths/drops, breaker states, and
@@ -879,15 +929,19 @@ class EngineServer(HTTPServerBase):
                     # keep-alive connection reuses this handler.
                     tid = self._trace_id() or new_trace_id()
                     self.extra_headers = [(TRACE_HEADER, tid)]
-                    with trace_scope(tid):
-                        self._post_query(raw)
+                    # the handler owns the pulse timeline: its t0
+                    # precedes JSON decode, and only the handler can
+                    # time the socket write of the reply
+                    tl = timeline.Timeline("serve")
+                    with trace_scope(tid), timeline.timeline_scope(tl):
+                        self._post_query(raw, tl)
                 elif self.path.startswith("/stop"):
                     self._reply(200, {"message": "stopping"})
                     threading.Thread(target=server.stop, daemon=True).start()
                 else:
                     self._reply(404, {"message": "not found"})
 
-            def _post_query(self, raw: bytes) -> None:
+            def _post_query(self, raw: bytes, tl) -> None:
                 try:
                     query_json = json.loads(raw.decode() or "{}")
                 except json.JSONDecodeError as e:
@@ -911,6 +965,11 @@ class EngineServer(HTTPServerBase):
                 try:
                     self._reply(200, server.predict_json(
                         query_json, timeout_s=timeout_s))
+                    # close the timeline on the success path only:
+                    # error replies have no meaningful decomposition
+                    # and would pollute the per-segment histograms
+                    tl.mark("write")
+                    tl.finish()
                     m_ok.inc()
                 except DeadlineExceeded as e:
                     # structured overload answer, not a hang: the

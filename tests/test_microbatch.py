@@ -181,6 +181,109 @@ def test_accumulation_window():
     assert len(sizes) <= 2
 
 
+def test_barrier_driven_coalescing_and_padded_slicing():
+    """Deterministic leader/follower drill (pio-pulse): the first
+    leader is parked on an event while 7 more submits queue behind it;
+    on release, exactly ONE follower-batch forms with all 7 entries,
+    the padding rounds it to 8, and every caller gets ITS OWN result
+    sliced back out of the padded batch."""
+    first_entered = threading.Event()
+    release = threading.Event()
+    seen_sizes = []
+
+    def batch_fn(xs):
+        seen_sizes.append(len(xs))
+        if len(seen_sizes) == 1:
+            first_entered.set()
+            assert release.wait(10)
+        return [x * 10 for x in xs]
+
+    b = MicroBatcher(batch_fn, max_batch=64, pad_batches=True)
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        f0 = ex.submit(b.submit, 1)
+        assert first_entered.wait(10)
+        rest = [ex.submit(b.submit, x) for x in range(2, 9)]
+        # deterministic: wait until ALL 7 are parked behind the leader
+        deadline = time.time() + 10
+        while True:
+            with b._cond:
+                if len(b._pending) == 7:
+                    break
+            assert time.time() < deadline, "arrivals never queued"
+            time.sleep(0.002)
+        release.set()
+        assert f0.result(10) == 10
+        assert [f.result(10) for f in rest] == [
+            x * 10 for x in range(2, 9)
+        ]
+    # batch 1: the solo leader (no padding at n=1); batch 2: the 7
+    # coalesced entries padded to 8 — results sliced back to 7
+    assert seen_sizes == [1, 8]
+    stats = b.stats()
+    assert stats["batches"] == 2
+    assert stats["requests"] == 8
+    assert stats["maxBatchSeen"] == 7  # pre-padding coalesced size
+    assert stats["leaders"] == 2
+    assert stats["followers"] == 6
+    assert stats["queueDepth"] == 0
+
+
+def test_submit_books_timeline_segments():
+    """A submit under an active pulse timeline credits queue_wait /
+    batch_wait / device; the segment sum stays equal to the covered
+    wall time (the accounting identity)."""
+    from predictionio_tpu.obs.timeline import Timeline, timeline_scope
+
+    def batch_fn(xs):
+        time.sleep(0.02)
+        return list(xs)
+
+    b = MicroBatcher(batch_fn)
+    tl = Timeline("serve")
+    with timeline_scope(tl):
+        assert b.submit(5) == 5
+    segs = tl.segments
+    assert {"queue_wait", "batch_wait", "device"} <= set(segs)
+    assert segs["device"] >= 0.015  # the sleep lands in device
+    assert sum(segs.values()) == pytest.approx(
+        tl._last - tl.t0, abs=1e-6
+    )
+
+
+def test_stats_snapshot_is_consistent_under_concurrency():
+    """stats() reads under the lock: batches/requests/roles move
+    together — a torn read (requests advanced, batches not) can never
+    be observed through the snapshot."""
+    def batch_fn(xs):
+        time.sleep(0.001)
+        return list(xs)
+
+    b = MicroBatcher(batch_fn, max_batch=8)
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            s = b.stats()
+            # every counted batch contributes >= 1 request, and roles
+            # are booked once per finished submit
+            if s["batches"] > s["requests"]:
+                torn.append(s)
+            if s["leaders"] + s["followers"] > s["requests"]:
+                torn.append(s)
+
+    r = threading.Thread(target=reader)
+    r.start()
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        assert sorted(ex.map(b.submit, range(200))) == list(range(200))
+    stop.set()
+    r.join(5)
+    assert torn == []
+    final = b.stats()
+    assert final["requests"] == 200
+    assert final["leaders"] + final["followers"] == 200
+
+
 def test_engine_server_auto_gating(storage_memory):
     """"auto" batches only when every algorithm has a REAL
     batch_predict; the base-class fallback would serialize inside the

@@ -1,0 +1,230 @@
+"""pio-pulse load harness + QPS@SLO gating: tools/loadgen.py's exact
+reservoir merging and closed-loop accounting, and tools/bench_gate.py's
+direction-aware judgment (a throughput collapse fails the gate exactly
+like a latency blow-up — the acceptance criterion's seeded 3x
+regression lives here)."""
+
+import json
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import bench_gate  # noqa: E402
+import loadgen  # noqa: E402
+
+
+# -- loadgen ---------------------------------------------------------------
+
+
+def test_percentile_matches_numpy_exactly():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 7, 100, 999):
+        vals = sorted(rng.uniform(0, 10, n).tolist())
+        for q in (0, 25, 50, 90, 99, 100):
+            assert loadgen.percentile(vals, q) == pytest.approx(
+                float(np.percentile(vals, q)), rel=1e-12, abs=1e-12
+            )
+    assert np.isnan(loadgen.percentile([], 50))
+
+
+class _StubHandler:
+    """Tiny threaded HTTP server for loadgen tests (no jax, no engine:
+    what's under test is the harness)."""
+
+    def __enter__(self):
+        from http.server import (
+            BaseHTTPRequestHandler, ThreadingHTTPServer,
+        )
+
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                with outer.lock:
+                    outer.hits += 1
+                    code = 500 if outer.fail_next > 0 else 200
+                    if outer.fail_next > 0:
+                        outer.fail_next -= 1
+                body = b'{"ok": true}'
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.hits = 0
+        self.fail_next = 0
+        self.lock = threading.Lock()
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_address[1]
+        threading.Thread(
+            target=self.srv.serve_forever, daemon=True
+        ).start()
+        return self
+
+    def __exit__(self, *exc):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def test_loadgen_merges_worker_reservoirs_exactly():
+    with _StubHandler() as stub:
+        res = loadgen.run_load(
+            f"http://127.0.0.1:{stub.port}/q", ['{"x": 1}'],
+            concurrency=3, duration_s=0.6, mode="thread",
+        )
+    # exact merge: completed == sum of per-worker requests - errors,
+    # and the merged reservoir holds every sample
+    assert res["errors"] == 0
+    assert res["completed"] == sum(
+        w["requests"] for w in res["workers"]
+    )
+    assert len(res["latencies"]) == res["completed"]
+    assert res["latencies"] == sorted(res["latencies"])
+    assert res["p50_ms"] == pytest.approx(
+        float(np.percentile(res["latencies"], 50)) * 1e3
+    )
+    assert res["p99_ms"] >= res["p50_ms"]
+    assert not res["truncated"]
+    assert res["qps"] > 0
+    # closed-loop accounting: the server saw every request (workers'
+    # warm requests included)
+    assert stub.hits >= res["completed"]
+
+
+def test_loadgen_counts_non_200_as_errors():
+    with _StubHandler() as stub:
+        with stub.lock:
+            stub.fail_next = 5
+        res = loadgen.run_load(
+            f"http://127.0.0.1:{stub.port}/q", ['{"x": 1}'],
+            concurrency=2, duration_s=0.4, mode="thread",
+        )
+    # the 5 rigged 500s (minus any absorbed by untimed warm requests)
+    # are errors, never silently folded into the latency sample
+    assert res["errors"] >= 3
+    assert res["completed"] == len(res["latencies"])
+
+
+def test_loadgen_validates_inputs():
+    with pytest.raises(ValueError, match="concurrency"):
+        loadgen.run_load("http://x/q", ["{}"], 0, 1.0)
+    with pytest.raises(ValueError, match="payload"):
+        loadgen.run_load("http://x/q", [], 1, 1.0)
+    with pytest.raises(ValueError, match="http"):
+        loadgen.run_load("https://x/q", ["{}"], 1, 1.0, mode="thread")
+
+
+# -- direction-aware bench gate --------------------------------------------
+
+
+def _qps_rec(value, **extra):
+    return {
+        "metric": "serving_qps_at_slo", "value": value, "unit": "qps",
+        "direction": "up", "platform": "cpu", "scale": None,
+        "fenced": True, **extra,
+    }
+
+
+def _lat_rec(value, **extra):
+    return {
+        "metric": "serving_p99_ms_c16", "value": value, "unit": "ms",
+        "direction": "down", "platform": "cpu", "scale": None,
+        "fenced": True, **extra,
+    }
+
+
+def test_metric_direction_field_and_name_heuristics():
+    assert bench_gate.metric_direction(_qps_rec(100)) == "up"
+    assert bench_gate.metric_direction(_lat_rec(5)) == "down"
+    # records without the field fall back to the metric name, so
+    # history written by other tools still gates the right way
+    assert bench_gate.metric_direction(
+        {"metric": "serving_qps_at_slo"}) == "up"
+    assert bench_gate.metric_direction(
+        {"metric": "ingest_events_per_s"}) == "up"
+    assert bench_gate.metric_direction(
+        {"metric": "train_seconds"}) == "down"
+
+
+def test_throughput_3x_collapse_fails_the_gate():
+    history = [_qps_rec(v) for v in (300.0, 310.0, 305.0, 308.0)]
+    verdict = bench_gate.check_candidate(history, _qps_rec(100.0))
+    assert verdict["status"] == "regression"
+    assert verdict["direction"] == "up"
+    # threshold sits BELOW the median for an upward metric
+    assert verdict["threshold"] < verdict["baselineMedian"]
+    # within-noise wobble passes
+    ok = bench_gate.check_candidate(history, _qps_rec(295.0))
+    assert ok["status"] == "ok"
+    # ... and a throughput IMPROVEMENT is never a regression
+    up = bench_gate.check_candidate(history, _qps_rec(900.0))
+    assert up["status"] == "ok"
+
+
+def test_latency_direction_still_gates_upward_values():
+    history = [_lat_rec(v) for v in (10.0, 10.5, 9.8, 10.2)]
+    bad = bench_gate.check_candidate(history, _lat_rec(30.0))
+    assert bad["status"] == "regression"
+    assert bad["direction"] == "down"
+    good = bench_gate.check_candidate(history, _lat_rec(10.4))
+    assert good["status"] == "ok"
+    fast = bench_gate.check_candidate(history, _lat_rec(3.0))
+    assert fast["status"] == "ok"
+
+
+def test_seeded_3x_qps_regression_fails_gate_cli(tmp_path):
+    """The acceptance drill end-to-end through the CLI: a history of
+    real-shaped serving_qps_at_slo records, a candidate at value/3,
+    exit code 1."""
+    hist = tmp_path / "hist.jsonl"
+    for v in (950.0, 980.0, 955.0):
+        bench_gate.append_history(hist, _qps_rec(v, slo_ms=25.0))
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_qps_rec(955.0 / 3, slo_ms=25.0)))
+    rc = bench_gate.main([
+        "--history", str(hist), "--check", str(cand),
+    ])
+    assert rc == 1
+    # the same candidate at baseline scale passes
+    cand.write_text(json.dumps(_qps_rec(960.0, slo_ms=25.0)))
+    assert bench_gate.main([
+        "--history", str(hist), "--check", str(cand),
+    ]) == 0
+    # and with only 2 baseline records the gate abstains (exit 2)
+    short = tmp_path / "short.jsonl"
+    for v in (950.0, 980.0):
+        bench_gate.append_history(short, _qps_rec(v))
+    cand.write_text(json.dumps(_qps_rec(100.0)))
+    assert bench_gate.main([
+        "--history", str(short), "--check", str(cand),
+    ]) == 2
+
+
+def test_qps_records_separate_from_latency_keys(tmp_path):
+    """serving_qps_at_slo and serving_p99_ms_c{N} live under different
+    (metric, platform, scale) keys: one can never dilute the other's
+    baseline."""
+    hist = tmp_path / "hist.jsonl"
+    for v in (950.0, 980.0, 955.0):
+        bench_gate.append_history(hist, _qps_rec(v))
+    for v in (8.0, 8.5, 7.9):
+        bench_gate.append_history(hist, _lat_rec(v))
+    history = bench_gate.load_history(hist)
+    qps_bad = bench_gate.check_candidate(history, _qps_rec(200.0))
+    lat_bad = bench_gate.check_candidate(history, _lat_rec(30.0))
+    assert qps_bad["status"] == lat_bad["status"] == "regression"
+    assert qps_bad["nSamples"] == lat_bad["nSamples"] == 3

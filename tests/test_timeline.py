@@ -1,0 +1,395 @@
+"""pio-pulse request-lifecycle timelines (`obs/timeline.py`): the
+accounting-identity property (segments are non-negative and sum to the
+measured end-to-end wall time), segment threading through predict_json
+/ the HTTP handler / the micro-batcher / the event-server ingest route,
+flight-record decomposition attrs, the on-demand profiler capture, and
+the dashboard /pulse.html view."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.obs import QUERY_LATENCY, get_tracer
+from predictionio_tpu.obs.timeline import (
+    EVENT_SEGMENTS,
+    EVENTS_SEGMENT_SECONDS,
+    SERVE_SEGMENTS,
+    SERVE_SEGMENT_SECONDS,
+    ProfileBusy,
+    Timeline,
+    capture_profile,
+    current_timeline,
+    mark,
+    timeline_scope,
+)
+
+
+def _busy(ms: float) -> None:
+    end = time.perf_counter() + ms / 1e3
+    while time.perf_counter() < end:
+        pass
+
+
+# -- the accounting identity ------------------------------------------------
+
+
+def test_marks_sum_to_elapsed():
+    tl = Timeline("serve")
+    for seg, ms in (("parse", 2), ("auth", 1), ("device", 5),
+                    ("serialize", 1), ("write", 2)):
+        _busy(ms)
+        tl.mark(seg)
+    segs = tl.segments
+    assert all(v >= 0 for v in segs.values())
+    total = sum(segs.values())
+    # everything between t0 and the last mark is attributed somewhere
+    assert total == pytest.approx(tl._last - tl.t0, abs=1e-6)
+
+
+def test_add_block_credits_residual_to_final_segment():
+    tl = Timeline("serve")
+    tl.mark("auth")
+    _busy(6)  # the composite region: 6 ms of wall time ...
+    # ... of which only 2 were measured by the interior stamps
+    tl.add_block([("queue_wait", 0.001), ("device", 0.001)],
+                 residual_to="device")
+    segs = tl.segments
+    assert segs["queue_wait"] == pytest.approx(0.001)
+    # device got its measured share PLUS the ~4 ms residual
+    assert segs["device"] >= 0.004
+    assert sum(segs.values()) == pytest.approx(
+        tl._last - tl.t0, abs=1e-6
+    )
+
+
+def test_timeline_property_random_walks():
+    """Property: for ANY interleaving of marks and add_blocks, segments
+    stay non-negative and sum exactly to the covered wall time."""
+    rng = np.random.default_rng(42)
+    names = list(SERVE_SEGMENTS)
+    for _ in range(25):
+        tl = Timeline("serve")
+        for _step in range(rng.integers(1, 8)):
+            _busy(float(rng.uniform(0.1, 1.5)))
+            if rng.random() < 0.5:
+                tl.mark(str(rng.choice(names)))
+            else:
+                parts = [
+                    (str(rng.choice(names)),
+                     float(rng.uniform(0, 0.0005)))
+                    for _ in range(rng.integers(0, 3))
+                ]
+                tl.add_block(parts, residual_to="device")
+        assert all(v >= -1e-12 for v in tl.segments.values())
+        covered = tl._last - tl.t0
+        assert sum(tl.segments.values()) == pytest.approx(
+            covered, rel=1e-6, abs=1e-6
+        )
+        assert tl.elapsed() >= covered
+
+
+def test_scope_is_thread_local_and_nests():
+    outer, inner = Timeline("serve"), Timeline("serve")
+    assert current_timeline() is None
+    with timeline_scope(outer):
+        assert current_timeline() is outer
+        with timeline_scope(inner):
+            assert current_timeline() is inner
+        assert current_timeline() is outer
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(current_timeline())
+        )
+        t.start()
+        t.join()
+        assert seen == [None]  # other threads don't inherit
+    assert current_timeline() is None
+    mark("parse")  # no scope: free no-op, must not raise
+
+
+def test_finish_observes_into_family():
+    before = SERVE_SEGMENT_SECONDS.labels(segment="device").snapshot()
+    tl = Timeline("serve")
+    _busy(0.2)
+    tl.mark("device")
+    segs = tl.finish()
+    after = SERVE_SEGMENT_SECONDS.labels(segment="device").snapshot()
+    assert after["count"] == before["count"] + 1
+    assert after["sum"] >= before["sum"] + segs["device"] * 0.99
+    # snapshot_ms rounds for span attrs
+    assert tl.snapshot_ms()["device"] == pytest.approx(
+        segs["device"] * 1e3, abs=0.002
+    )
+
+
+# -- serving integration ----------------------------------------------------
+
+
+def _tiny_server(storage_memory, microbatch="auto", port=0):
+    from predictionio_tpu.controller.base import (
+        Algorithm, DataSource, WorkflowContext,
+    )
+    from predictionio_tpu.controller.engine import SimpleEngine
+    from predictionio_tpu.server.serving import EngineServer, ServerConfig
+    from predictionio_tpu.workflow.train import run_train
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return 1
+
+    class BatchedAlgo(Algorithm):
+        def train(self, ctx, data):
+            return {"w": 2}
+
+        def predict(self, model, query):
+            return {"y": model["w"] * query.get("x", 0)}
+
+        def batch_predict(self, model, queries):
+            return [self.predict(model, q) for q in queries]
+
+    ctx = WorkflowContext(storage=storage_memory)
+    engine = SimpleEngine(DS, BatchedAlgo)
+    ep = engine.params_from_variant({})
+    iid = run_train(engine, ep, ctx=ctx)
+    return EngineServer(
+        engine, ep, iid, ctx=ctx,
+        config=ServerConfig(port=port, microbatch=microbatch),
+    )
+
+
+def _seg_counts(family, segments):
+    return {s: family.labels(segment=s).snapshot()["count"]
+            for s in segments}
+
+
+def _wait_counts(family, segments, expected, timeout=5.0):
+    """The handler books its timeline AFTER the reply bytes go out, so
+    a client that just got its response may read the family a few
+    microseconds early — poll instead of racing."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        counts = _seg_counts(family, segments)
+        if counts == expected:
+            return counts
+        time.sleep(0.01)
+    return _seg_counts(family, segments)
+
+
+def test_predict_json_owns_timeline_and_books_all_segments(
+        storage_memory):
+    srv = _tiny_server(storage_memory, microbatch="auto")
+    before = _seg_counts(SERVE_SEGMENT_SECONDS, SERVE_SEGMENTS)
+    n = 5
+    for k in range(n):
+        assert srv.predict_json({"x": k}) == {"y": 2 * k}
+    after = _seg_counts(SERVE_SEGMENT_SECONDS, SERVE_SEGMENTS)
+    # a direct (handler-less) call books everything except the socket
+    # write, which only the HTTP handler can time
+    for s in ("parse", "auth", "queue_wait", "batch_wait", "device",
+              "serialize"):
+        assert after[s] - before[s] == n, s
+    assert after["write"] == before["write"]
+
+
+def test_http_handler_adds_write_segment_and_flight_decomposes(
+        storage_memory):
+    from predictionio_tpu.obs import get_flight_recorder
+
+    srv = _tiny_server(storage_memory)
+    srv.start_background()
+    try:
+        base = f"http://127.0.0.1:{srv.config.port}"
+        before = _seg_counts(SERVE_SEGMENT_SECONDS, SERVE_SEGMENTS)
+        lat_before = QUERY_LATENCY.child().snapshot()
+        tid = "t-pulse-http"
+        req = urllib.request.Request(
+            f"{base}/queries.json", data=b'{"x": 3}',
+            headers={"Content-Type": "application/json",
+                     "X-PIO-Trace": tid},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert json.loads(r.read().decode()) == {"y": 6}
+        expected = {s: c + 1 for s, c in before.items()}
+        after = _wait_counts(SERVE_SEGMENT_SECONDS, SERVE_SEGMENTS,
+                             expected)
+        assert after == expected
+        # per-process accounting: the new segment mass must cover the
+        # new e2e latency mass (the handler window contains the
+        # predict window)
+        lat_after = QUERY_LATENCY.child().snapshot()
+        seg_sum = sum(
+            SERVE_SEGMENT_SECONDS.labels(segment=s).snapshot()["sum"]
+            for s in SERVE_SEGMENTS
+        )
+        assert lat_after["count"] == lat_before["count"] + 1
+        # the span carries the decomposition ...
+        spans = get_tracer().spans(trace_id=tid, name="serve.query")
+        assert spans, "serve.query span missing"
+        segs_ms = spans[-1].attrs["segmentsMs"]
+        assert {"parse", "auth", "queue_wait", "batch_wait",
+                "device", "serialize"} <= set(segs_ms)
+        assert spans[-1].attrs["modelFreshnessSec"] >= 0
+        # ... and so does the flight record (worst-N admits this one:
+        # the recorder is process-global, capacity >= 1)
+        rec = get_flight_recorder().record_for(tid)
+        if rec is not None:  # may be evicted by slower suite traffic
+            assert "segmentsMs" in rec["attrs"]
+            assert "modelFreshnessSec" in rec["attrs"]
+        del seg_sum
+    finally:
+        srv.stop()
+
+
+def test_status_json_microbatch_uses_locked_snapshot(storage_memory):
+    srv = _tiny_server(storage_memory)
+    srv.predict_json({"x": 1})
+    mb = srv.status_json()["microbatch"]
+    assert {"batches", "requests", "maxBatchSeen", "leaders",
+            "followers", "queueDepth"} <= set(mb)
+    assert mb["requests"] >= 1
+    assert mb["queueDepth"] == 0
+
+
+def test_event_server_books_ingest_segments(storage_memory):
+    from predictionio_tpu.server.event_server import (
+        EventServer, EventServerConfig,
+    )
+    from predictionio_tpu.storage import AccessKey
+
+    md = storage_memory.get_metadata()
+    app = md.app_insert("pulseapp")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    ev = EventServer(storage_memory, EventServerConfig(port=0))
+    ev.start_background()
+    try:
+        before = _seg_counts(EVENTS_SEGMENT_SECONDS, EVENT_SEGMENTS)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ev.config.port}/events.json"
+            f"?accessKey={key}",
+            data=json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": "u1", "targetEntityType": "item",
+                "targetEntityId": "i1",
+                "properties": {"rating": 5.0},
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status == 201
+        expected = {s: c + 1 for s, c in before.items()}
+        after = _wait_counts(EVENTS_SEGMENT_SECONDS, EVENT_SEGMENTS,
+                             expected)
+        assert after == expected
+        # a rejected request books nothing (no decomposition to pollute
+        # the family with)
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{ev.config.port}/events.json"
+            f"?accessKey={key}",
+            data=b"not json",
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad, timeout=15)
+        time.sleep(0.1)  # give a (buggy) late booking time to land
+        final = _seg_counts(EVENTS_SEGMENT_SECONDS, EVENT_SEGMENTS)
+        assert final == after
+    finally:
+        ev.stop()
+
+
+# -- profiler capture -------------------------------------------------------
+
+
+def test_capture_profile_writes_nonempty_artifact(tmp_path):
+    import jax.numpy as jnp
+
+    stop = threading.Event()
+
+    def work():
+        while not stop.is_set():
+            (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    try:
+        res = capture_profile(0.3, out_dir=tmp_path)
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert res["totalBytes"] > 0
+    assert res["files"]
+    assert str(tmp_path) in res["dir"]
+
+
+def test_capture_profile_rejects_concurrent_capture(tmp_path):
+    results = {}
+
+    def first():
+        results["first"] = capture_profile(0.8, out_dir=tmp_path)
+
+    t = threading.Thread(target=first)
+    t.start()
+    time.sleep(0.25)  # first capture is inside its sleep window
+    with pytest.raises(ProfileBusy):
+        capture_profile(0.1, out_dir=tmp_path)
+    t.join(timeout=15)
+    assert results["first"]["totalBytes"] >= 0
+
+
+def test_profile_endpoint_over_http(storage_memory, tmp_path,
+                                    monkeypatch):
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    srv = _tiny_server(storage_memory)
+    srv.start_background()
+    try:
+        base = f"http://127.0.0.1:{srv.config.port}"
+        with urllib.request.urlopen(
+            f"{base}/debug/profile?seconds=0.2", timeout=60
+        ) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["totalBytes"] > 0
+        assert str(tmp_path) in doc["dir"]
+        # bad seconds is a 400, not a wedge
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/debug/profile?seconds=abc", timeout=15
+            )
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+# -- dashboard --------------------------------------------------------------
+
+
+def test_pulse_html_renders_segments_and_sweep(storage_memory, tmp_path,
+                                               monkeypatch):
+    from predictionio_tpu.server.dashboard import DashboardServer
+
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    dash = DashboardServer(storage_memory, port=0)
+    html = dash.pulse_html()
+    for s in SERVE_SEGMENTS:
+        assert s in html
+    assert "no sweep recorded yet" in html
+    sweep_dir = tmp_path / "telemetry" / "sweeps"
+    sweep_dir.mkdir(parents=True)
+    (sweep_dir / "latest.json").write_text(json.dumps({
+        "recorded_at": "2026-08-04T00:00:00Z", "slo_ms": 25.0,
+        "platform": "cpu", "qps_at_slo": 1234.5,
+        "concurrency_at_slo": 16,
+        "points": [{"concurrency": 16, "qps": 1234.5, "p50_ms": 1.0,
+                    "p99_ms": 9.0, "errors": 0,
+                    "segments_ms": {"device": 0.8, "queue_wait": 0.1}}],
+    }))
+    html = dash.pulse_html()
+    assert "1234.5" in html
+    assert "device 0.80" in html

@@ -131,6 +131,24 @@ def comparable(rec: dict) -> bool:
     )
 
 
+# metric-name fallbacks for records written before the explicit
+# ``direction`` field existed; throughput-shaped names gate upward
+_UP_HINTS = ("qps", "_per_s", "throughput", "events_per")
+
+
+def metric_direction(rec: dict) -> str:
+    """Which way is worse for this metric: ``down`` (latency/seconds —
+    a regression is a LARGER value, the original gate semantics) or
+    ``up`` (throughput — a regression is a SMALLER value).  The
+    record's explicit ``direction`` field wins; otherwise the metric
+    name decides, so pre-existing history records need no rewrite."""
+    d = rec.get("direction")
+    if d in ("up", "down"):
+        return d
+    m = str(rec.get("metric") or "")
+    return "up" if any(h in m for h in _UP_HINTS) else "down"
+
+
 # -- the check -------------------------------------------------------------
 
 
@@ -172,11 +190,21 @@ def check_candidate(
     mad = median(abs(v - med) for v in base)
     sigma = 1.4826 * mad  # robust sigma: MAD -> stddev for a normal
     margin = max(min_rel * med, noise_mult * sigma)
-    threshold = med + margin
     value = float(candidate["value"])
+    # same rolling-median + MAD math both ways; only the failing side
+    # flips — a throughput (direction=up) collapse gates exactly like a
+    # latency blow-up
+    direction = metric_direction(candidate)
+    if direction == "up":
+        threshold = med - margin
+        regressed = value < threshold
+    else:
+        threshold = med + margin
+        regressed = value > threshold
     return {
-        "status": "regression" if value > threshold else "ok",
+        "status": "regression" if regressed else "ok",
         "key": list(key),
+        "direction": direction,
         "value": value,
         "baselineMedian": med,
         "robustSigma": sigma,

@@ -22,7 +22,7 @@ fi
 
 # 1) piolint: JAX-aware static analysis + lock discipline (PIO1xx/PIO2xx)
 REPORT="${PIOLINT_REPORT:-/tmp/piolint_report.json}"
-echo "gate [1/7] piolint (report: $REPORT)" >&2
+echo "gate [1/8] piolint (report: $REPORT)" >&2
 if ! python -m predictionio_tpu.analysis --format text \
        --report "$REPORT" "${PIOLINT_ARGS[@]+"${PIOLINT_ARGS[@]}"}"; then
   echo "gate FAILED: piolint found non-baseline findings" >&2
@@ -34,7 +34,7 @@ fi
 
 # 2) generic lint (ruff: pyflakes + isort per pyproject.toml) — the CI
 # image doesn't ship ruff, so absence is a skip, not a failure
-echo "gate [2/7] ruff" >&2
+echo "gate [2/8] ruff" >&2
 if command -v ruff >/dev/null 2>&1; then
   ruff check . || { echo "gate FAILED: ruff" >&2; exit 1; }
 elif python -m ruff --version >/dev/null 2>&1; then
@@ -49,7 +49,7 @@ fi
 # the measure_tpu.sh battery) plus the fused-kernel interpret parity
 # suite — cheap-first so a kernel math break fails in ~1 min, not after
 # the full suite
-echo "gate [3/7] gather probe smoke + fused interpret parity" >&2
+echo "gate [3/8] gather probe smoke + fused interpret parity" >&2
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/probe_gather.py --smoke > /tmp/probe_gather_smoke.json; then
   echo "gate FAILED: gather-form smoke (see /tmp/probe_gather_smoke.json)" >&2
@@ -66,7 +66,7 @@ fi
 # compiler-observability contract (pio_jit_compiles_total increments,
 # /debug/xray's recompile ring parses and carries the signature delta,
 # exemplar trace ids resolve to flight-recorder span trees)
-echo "gate [4/7] xray smoke" >&2
+echo "gate [4/8] xray smoke" >&2
 XRAY_OUT="${XRAY_SMOKE_OUT:-/tmp/xray_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PIO_TPU_TRACE_ALS=1 \
      python tools/xray_smoke.py --out "$XRAY_OUT"; then
@@ -74,11 +74,25 @@ if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PIO_TPU_TRACE_ALS=1 \
   exit 1
 fi
 
-# 5) pio-live smoke: event server + engine server over sqlite, events
+# 5) pio-pulse smoke: boots a real engine + event server, fires
+# concurrent closed-loop load through tools/loadgen.py, and asserts the
+# request-lifecycle decomposition contract (every segment present in
+# /metrics with equal counts, segment sums reconcile with the e2e
+# latency histogram, saturation metrics move, /debug/profile produces a
+# non-empty jax.profiler artifact, flight records carry segmentsMs)
+echo "gate [5/8] pulse smoke" >&2
+PULSE_OUT="${PULSE_SMOKE_OUT:-/tmp/pulse_smoke.json}"
+if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+     python tools/pulse_smoke.py --out "$PULSE_OUT"; then
+  echo "gate FAILED: pulse smoke (see $PULSE_OUT)" >&2
+  exit 1
+fi
+
+# 6) pio-live smoke: event server + engine server over sqlite, events
 # for an unseen user, one fold-in cycle, non-fallback predictions with
 # ZERO /reload calls and a stable fold-in kernel signature — the
 # event->fresh-prediction contract end to end
-echo "gate [5/7] foldin smoke" >&2
+echo "gate [6/8] foldin smoke" >&2
 FOLDIN_OUT="${FOLDIN_SMOKE_OUT:-/tmp/foldin_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/foldin_smoke.py --out "$FOLDIN_OUT"; then
@@ -91,7 +105,7 @@ fi
 # median baseline; --allow-empty keeps the gate green until the
 # trajectory is >= min-samples deep (it still fails on a judged
 # regression)
-echo "gate [6/7] bench trajectory (tools/bench_gate.py)" >&2
+echo "gate [7/8] bench trajectory (tools/bench_gate.py)" >&2
 if ! python tools/bench_gate.py --check --allow-empty; then
   echo "gate FAILED: bench trajectory regressed beyond noise" >&2
   echo "  inspect: python tools/bench_gate.py --check" >&2
@@ -103,5 +117,5 @@ fi
 # tools/obs_smoke.py (/metrics exposition + trace propagation),
 # tools/xray_smoke.py and tools/foldin_smoke.py again under pytest env
 # isolation (tests/test_xray_smoke.py, tests/test_foldin_smoke.py)
-echo "gate [7/7] pytest" >&2
+echo "gate [8/8] pytest" >&2
 exec python -m pytest tests/ -q "$@"

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Closed-loop multi-process HTTP load generator (pio-pulse).
+
+``bench_serving.py --threads`` measures concurrency with client threads
+in the SAME interpreter as the server — past ~8 workers the client-side
+GIL serializes the measurement and the reported p99 is the client's,
+not the server's.  This module is the honest load edge for the
+QPS@SLO gate:
+
+* **Closed-loop workers**: each worker issues its next request only
+  after the previous response is fully read, so offered load always
+  equals ``concurrency`` in-flight requests — the classic closed-loop
+  model whose measured throughput at a latency SLO is well-defined
+  (open-loop generators conflate queueing delay with service time the
+  moment the server saturates).
+* **Process workers by default** (``mode="process"``, spawn context):
+  N real interpreters, zero shared GIL, persistent keep-alive
+  connections (one per worker — closed-loop semantics need exactly
+  one in-flight request per connection).  ``mode="thread"`` exists for
+  cheap in-process tests.
+* **Exact merging**: every worker keeps its RAW per-request latency
+  list (bounded by ``reservoir_cap`` as an OOM guard, default 200k —
+  far above anything a bench window produces) and the parent merges by
+  concatenation, so percentiles over the merged sample are exact order
+  statistics, not histogram interpolations.  If any worker ever hits
+  the cap the result says so (``truncated``) instead of silently
+  reporting approximate percentiles.
+
+The module is deliberately import-light (pure stdlib): spawn-mode
+workers re-import only this file, so fanning out 64 processes costs
+interpreter startup, not a jax/numpy import storm.
+
+Usage::
+
+    python tools/loadgen.py --url http://127.0.0.1:8000/queries.json \
+        --payload '{"user": "u1", "num": 10}' --concurrency 16 \
+        --duration 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import multiprocessing
+import queue as queue_mod
+import socket
+import sys
+import threading
+import time
+import urllib.parse
+
+__all__ = ["percentile", "run_load"]
+
+DEFAULT_RESERVOIR_CAP = 200_000
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """Exact order-statistic percentile with linear interpolation
+    (numpy's default ``linear`` method) over an ALREADY SORTED list —
+    kept stdlib so workers and parents never import numpy."""
+    n = len(sorted_vals)
+    if n == 0:
+        return float("nan")
+    if n == 1:
+        return float(sorted_vals[0])
+    rank = (q / 100.0) * (n - 1)
+    lo = int(rank)
+    hi = min(lo + 1, n - 1)
+    frac = rank - lo
+    return float(sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac)
+
+
+def _split_url(url: str) -> tuple:
+    u = urllib.parse.urlparse(url)
+    if u.scheme != "http":
+        raise ValueError(f"loadgen speaks plain http, got {url!r}")
+    host = u.hostname or "127.0.0.1"
+    port = u.port or 80
+    path = u.path or "/"
+    if u.query:
+        path += "?" + u.query
+    return host, port, path
+
+
+class _Conn:
+    """One persistent keep-alive connection; reconnects on error (the
+    server may have closed an idle connection between windows)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float):
+        self.host, self.port, self.timeout_s = host, port, timeout_s
+        self._c = None
+
+    def _connect(self):
+        c = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        c.connect()
+        # http.client sends headers and body as separate send() calls;
+        # without TCP_NODELAY, Nagle + the peer's delayed ACK turn every
+        # keep-alive POST into a ~40 ms stall — which would measure the
+        # kernel's timer, not the server
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return c
+
+    def request(self, path: str, body: bytes) -> int:
+        if self._c is None:
+            self._c = self._connect()
+        try:
+            self._c.request(
+                "POST", path, body,
+                headers={"Content-Type": "application/json"},
+            )
+            r = self._c.getresponse()
+            r.read()  # the response must be fully drained: closed loop
+            return r.status
+        except Exception:
+            # one reconnect attempt per request; a second failure is
+            # the caller's error to count
+            try:
+                self._c.close()
+            except Exception:
+                pass
+            self._c = self._connect()
+            self._c.request(
+                "POST", path, body,
+                headers={"Content-Type": "application/json"},
+            )
+            r = self._c.getresponse()
+            r.read()
+            return r.status
+
+    def close(self) -> None:
+        if self._c is not None:
+            try:
+                self._c.close()
+            except Exception:
+                pass
+            self._c = None
+
+
+def _worker(wid: int, url: str, payloads, duration_s: float,
+            reservoir_cap: int, timeout_s: float, barrier, outq) -> None:
+    """One closed-loop worker: warm once, rendezvous at the barrier,
+    then hammer until the window closes.  Runs as a top-level function
+    so spawn can pickle it.  A worker that dies still reports (a
+    ``fatal`` result) — a silent corpse would park every sibling at
+    the barrier until the parent's deadline."""
+    try:
+        _worker_inner(wid, url, payloads, duration_s, reservoir_cap,
+                      timeout_s, barrier, outq)
+    except Exception as e:
+        try:
+            barrier.abort()
+        except Exception:
+            pass
+        outq.put({
+            "worker": wid, "latencies": [], "errors": 1, "requests": 1,
+            "wall": 0.0, "truncated": False,
+            "fatal": f"{type(e).__name__}: {e}",
+        })
+
+
+def _worker_inner(wid: int, url: str, payloads, duration_s: float,
+                  reservoir_cap: int, timeout_s: float, barrier,
+                  outq) -> None:
+    host, port, path = _split_url(url)
+    conn = _Conn(host, port, timeout_s)
+    bodies = [
+        p if isinstance(p, (bytes, bytearray)) else str(p).encode()
+        for p in payloads
+    ]
+    # one warm request before the barrier: connection setup + any
+    # first-shape compile must not land inside the measured window
+    try:
+        conn.request(path, bodies[wid % len(bodies)])
+    except Exception:
+        pass
+    lats: list[float] = []
+    errors = 0
+    k = wid  # offset the payload rotation so workers don't march in step
+    barrier.wait(timeout=max(timeout_s, 30.0))
+    t_start = time.perf_counter()
+    t_end = t_start + duration_s
+    while True:
+        now = time.perf_counter()
+        if now >= t_end:
+            break
+        body = bodies[k % len(bodies)]
+        k += 1
+        t0 = time.perf_counter()
+        try:
+            status = conn.request(path, body)
+            dt = time.perf_counter() - t0
+            if status == 200:
+                if len(lats) < reservoir_cap:
+                    lats.append(dt)
+            else:
+                errors += 1
+        except Exception:
+            errors += 1
+    wall = time.perf_counter() - t_start
+    conn.close()
+    outq.put({
+        "worker": wid,
+        "latencies": lats,
+        "errors": errors,
+        "requests": len(lats) + errors,
+        "wall": wall,
+        "truncated": len(lats) >= reservoir_cap,
+    })
+
+
+def run_load(url: str, payloads, concurrency: int, duration_s: float,
+             timeout_s: float = 30.0, mode: str = "process",
+             reservoir_cap: int = DEFAULT_RESERVOIR_CAP) -> dict:
+    """Drive ``concurrency`` closed-loop workers against ``url`` for
+    ``duration_s`` seconds and return the exactly-merged result::
+
+        {"concurrency", "duration_s", "requests", "errors", "qps",
+         "p50_ms", "p90_ms", "p99_ms", "mean_ms", "max_ms",
+         "latencies", "truncated", "workers"}
+
+    ``latencies`` is the merged raw sample (seconds, sorted) so callers
+    can derive any further statistic exactly.  QPS is completed
+    requests over the slowest worker's wall (conservative: a straggler
+    worker lowers the claim, never inflates it).
+    """
+    if concurrency < 1:
+        raise ValueError("concurrency must be >= 1")
+    if not payloads:
+        raise ValueError("need at least one payload")
+    _split_url(url)  # fail fast in the parent, not in N workers
+    payloads = [
+        p if isinstance(p, (bytes, bytearray)) else
+        (p.encode() if isinstance(p, str) else json.dumps(p).encode())
+        for p in payloads
+    ]
+    if mode == "process":
+        ctx = multiprocessing.get_context("spawn")
+        barrier = ctx.Barrier(concurrency)
+        outq = ctx.Queue()
+        workers = [
+            ctx.Process(
+                target=_worker,
+                args=(w, url, payloads, duration_s, reservoir_cap,
+                      timeout_s, barrier, outq),
+                daemon=True,
+            )
+            for w in range(concurrency)
+        ]
+        for p in workers:
+            p.start()
+    elif mode == "thread":
+        barrier = threading.Barrier(concurrency)
+        outq = queue_mod.Queue()
+        workers = [
+            threading.Thread(
+                target=_worker,
+                args=(w, url, payloads, duration_s, reservoir_cap,
+                      timeout_s, barrier, outq),
+                daemon=True,
+            )
+            for w in range(concurrency)
+        ]
+        for t in workers:
+            t.start()
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    # workers ship results through the queue; drain BEFORE joining
+    # (a process blocked flushing a big queue payload never exits)
+    results = []
+    deadline = time.monotonic() + duration_s + timeout_s + 60.0
+    while len(results) < concurrency:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            raise RuntimeError(
+                f"loadgen: only {len(results)}/{concurrency} workers "
+                "reported before the deadline"
+            )
+        try:
+            results.append(outq.get(timeout=min(left, 5.0)))
+        except queue_mod.Empty:
+            continue
+    for w in workers:
+        w.join(timeout=10.0)
+
+    merged: list[float] = []
+    errors = 0
+    requests = 0
+    max_wall = 0.0
+    fatals = []
+    for r in results:
+        merged.extend(r["latencies"])
+        errors += r["errors"]
+        requests += r["requests"]
+        max_wall = max(max_wall, r["wall"])
+        if "fatal" in r:
+            fatals.append(f'worker {r["worker"]}: {r["fatal"]}')
+    merged.sort()
+    n = len(merged)
+    return {
+        "concurrency": concurrency,
+        "duration_s": duration_s,
+        "mode": mode,
+        "requests": requests,
+        "completed": n,
+        "errors": errors,
+        "qps": (n / max_wall) if max_wall > 0 else 0.0,
+        "p50_ms": percentile(merged, 50) * 1e3,
+        "p90_ms": percentile(merged, 90) * 1e3,
+        "p99_ms": percentile(merged, 99) * 1e3,
+        "mean_ms": (sum(merged) / n * 1e3) if n else float("nan"),
+        "max_ms": (merged[-1] * 1e3) if n else float("nan"),
+        "latencies": merged,
+        "truncated": any(r["truncated"] for r in results),
+        "fatals": fatals,
+        "workers": sorted(
+            (
+                {k: r[k] for k in
+                 ("worker", "requests", "errors", "wall")}
+                for r in results
+            ),
+            key=lambda r: r["worker"],
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--url", required=True)
+    ap.add_argument("--payload", action="append", default=[],
+                    help="JSON request body (repeatable; rotated "
+                    "round-robin per worker)")
+    ap.add_argument("--payload-file",
+                    help="JSONL file of request bodies")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--mode", choices=("process", "thread"),
+                    default="process")
+    args = ap.parse_args(argv)
+    payloads = list(args.payload)
+    if args.payload_file:
+        with open(args.payload_file, encoding="utf-8") as f:
+            payloads += [ln for ln in (ln.strip() for ln in f) if ln]
+    if not payloads:
+        ap.error("need --payload or --payload-file")
+    res = run_load(args.url, payloads, args.concurrency, args.duration,
+                   timeout_s=args.timeout, mode=args.mode)
+    res.pop("latencies")  # the raw sample is for library callers
+    print(json.dumps(res, indent=1))
+    return 0 if res["errors"] == 0 and res["completed"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -252,6 +252,17 @@ def _parse_args(argv=None):
         "canonical BENCH_HISTORY.jsonl records so tools/bench_gate.py "
         "gates the Gram phase; implies --inner semantics",
     )
+    ap.add_argument(
+        "--straggler-ab",
+        action="store_true",
+        help="fenced clean-vs-straggler A/B of the coded sharded "
+        "sweep (pio-armor): times one clean coded sweep and one with a "
+        "deterministically delayed shard per half (parity serve), and "
+        "appends the fenced als_sweep_straggler_overhead_ratio record "
+        "to BENCH_HISTORY.jsonl so tools/bench_gate.py gates parity "
+        "overhead like any other metric; needs a multi-device mesh "
+        "(re-execs onto virtual CPU devices when none is visible)",
+    )
     args = ap.parse_args(argv)
     if args.phase_probe and not args.breakdown:
         ap.error("--phase-probe requires --breakdown")
@@ -630,6 +641,148 @@ def run_fused_ab(args) -> None:
                 "kernel beats the wall it replaces",
         "platform": platform, "scale": args.scale,
     }), flush=True)
+
+
+def run_straggler_ab(args) -> None:
+    """Fenced clean-vs-straggler A/B of the coded sharded sweep.
+
+    Stages ONE dataset into a coded sharded trainer
+    (``factor_placement="sharded", coded_shards=True``), then times —
+    fenced, warm-first, identical staged data — (a) a clean coded sweep
+    and (b) the same sweep with ONE shard deterministically flagged
+    late on every half (``dist.shard_delay`` with zero injected lag, so
+    the measurement is the parity-serve COMPUTE overhead: the masked
+    gather, the reconstruction psum, and the frozen-write select — not
+    the straggler's wait, which the whole feature exists to avoid).
+    The ratio lands in BENCH_HISTORY.jsonl as the fenced
+    ``als_sweep_straggler_overhead_ratio`` record (direction: down),
+    so ``tools/bench_gate.py`` gates parity overhead like any other
+    trajectory metric.
+
+    Needs a multi-device mesh; with a single visible CPU device the
+    bench re-execs itself onto virtual devices
+    (``--xla_force_host_platform_device_count``), the same simulated
+    cluster tier-1 certifies.
+    """
+    import os
+    import subprocess
+
+    if (
+        os.environ.get("PIO_TPU_STRAGGLER_CHILD") != "1"
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    ):
+        # decide BEFORE importing jax whether this interpreter can see
+        # a multi-device mesh; a bare CPU box gets virtual devices via
+        # a re-exec (XLA flags only apply before backend init)
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            env={**os.environ, "JAX_PLATFORMS":
+                 os.environ.get("JAX_PLATFORMS", "cpu")},
+            capture_output=True, text=True, timeout=300,
+        )
+        n_dev = int(probe.stdout.strip() or 1) if probe.returncode == 0 \
+            else 1
+        if n_dev < 2:
+            print("# single device visible: re-exec onto 8 virtual CPU "
+                  "devices for the coded-sweep A/B", file=sys.stderr,
+                  flush=True)
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                              + " --xla_force_host_platform_device_count=8"
+                              ).strip(),
+                "PIO_TPU_STRAGGLER_CHILD": "1",
+            }
+            sys.exit(subprocess.run(
+                [sys.executable, __file__] + sys.argv[1:], env=env,
+            ).returncode)
+
+    jax, (u, i, v, n_users, n_items), mesh, cfg0 = _prepare(args)
+    import dataclasses
+
+    from predictionio_tpu.models.als import ALSConfig, ALSTrainer
+    from predictionio_tpu.parallel.mesh import fence
+    from predictionio_tpu.resilience import faults
+
+    if mesh is None:
+        print(json.dumps({
+            "metric": "als_sweep_straggler_overhead_ratio",
+            "value": None,
+            "error": "no multi-device mesh visible; cannot run the "
+                     "coded sweep A/B",
+        }), flush=True)
+        sys.exit(2)
+
+    base = {
+        f.name: getattr(cfg0, f.name) for f in dataclasses.fields(cfg0)
+    }
+    base.update(factor_placement="sharded", coded_shards=True)
+    # the measured sweep: short, repeated — the ratio is per-sweep and
+    # the staged data is identical across arms
+    sweep_iters = max(2, min(args.iters, 4))
+    base.update(num_iterations=sweep_iters)
+    cfg = ALSConfig(**base)
+    trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
+                         )
+    assert trainer.coded, "coded trainer did not engage"
+    U0, V0 = trainer.init_factors()
+    reps = 5
+    platform = str(jax.default_backend())
+    # one shard late on EVERY half: zero injected lag isolates the
+    # parity-serve compute overhead (reconstruction + frozen writes)
+    plan = "dist.shard_delay:shard=1,delay=0"
+
+    def sweep_s():
+        t0 = time.time()
+        U, V = trainer.run(U0, V0, sweep_iters)
+        fence(U, V)
+        return time.time() - t0
+
+    # warm: compile the coded halves (the degraded executable is the
+    # SAME program — the mask is a traced operand), then interleave the
+    # arms per rep so clock drift and cache state cancel instead of
+    # biasing whichever arm ran second
+    fence(*trainer.run(U0, V0, 1))
+    faults.arm(plan)
+    fence(*trainer.run(U0, V0, 1))
+    clean_t, strag_t = [], []
+    for _ in range(reps):
+        faults.disarm()
+        clean_t.append(sweep_s())
+        faults.arm(plan)
+        strag_t.append(sweep_s())
+    faults.disarm()
+    t_clean = float(np.median(clean_t))
+    t_strag = float(np.median(strag_t))
+
+    ratio = t_strag / t_clean if t_clean > 0 else None
+    rec = {
+        "metric": "als_sweep_straggler_overhead_ratio",
+        "value": round(ratio, 4) if ratio else None,
+        "unit": "ratio",
+        "platform": platform,
+        "scale": args.scale,
+        "fenced": True,
+        "direction": "down",
+        "rank": cfg.rank,
+        "sweep_iters": sweep_iters,
+        "mesh_devices": int(mesh.size),
+        "n_ratings": int(len(v)),
+        "clean_sweep_s": round(t_clean, 5),
+        "straggler_sweep_s": round(t_strag, 5),
+        "degraded_polls": trainer.shard_health.degraded_polls,
+    }
+    print(json.dumps(rec), flush=True)
+    try:
+        gate = _bench_gate()
+        gate.append_history(HISTORY_PATH, rec)
+        gate.write_pr_summary(rec, key="straggler_ab")
+    except Exception as e:  # noqa: BLE001 — the print already landed
+        print(f"# WARNING: could not record straggler A/B: {e}",
+              file=sys.stderr, flush=True)
 
 
 def run_inner(args) -> None:
@@ -1284,6 +1437,9 @@ def main() -> None:
         return
     if args.fused_ab:
         run_fused_ab(args)
+        return
+    if args.straggler_ab:
+        run_straggler_ab(args)
         return
     if args.breakdown:
         run_breakdown(args)

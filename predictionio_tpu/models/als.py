@@ -206,6 +206,23 @@ class ALSConfig:
                 f"factor_placement must be 'replicated' or 'sharded', "
                 f"got {self.factor_placement!r}"
             )
+        if self.coded_shards:
+            if self.factor_placement != "sharded":
+                raise ValueError(
+                    "coded_shards=True requires "
+                    "factor_placement='sharded' (parity is a property "
+                    "of the sharded table layout)"
+                )
+            if self.solver_mode == "subspace":
+                # the subspace sweep transiently all-gathers the
+                # UPDATING table too; serving that gather from parity as
+                # well is a second code word this PR does not maintain —
+                # refuse rather than silently run uncoded
+                raise ValueError(
+                    "coded_shards=True does not compose with "
+                    "solver_mode='subspace' (the warm-start gather of "
+                    "the updating table is not parity-protected)"
+                )
     # factor-table placement on the mesh: "replicated" keeps both tables
     # on every device (fastest when they fit one chip's HBM); "sharded"
     # block-shards both tables over the ``data`` axis (ALX-style, arXiv
@@ -213,6 +230,17 @@ class ALSConfig:
     # opposite table is all-gathered transiently per half-iteration and
     # updates are written shard-locally
     factor_placement: str = "replicated"
+    # coded-ALS straggler tolerance (arXiv 2105.03631; parallel/coded.py):
+    # maintain a rotating parity block alongside the d factor shards so
+    # a half-iteration whose shard is late/dead completes from the other
+    # d-1 plus parity instead of stalling the ring.  Sharded-only.
+    coded_shards: bool = False
+    # per-half shard wait budget when coded (seconds): a shard whose
+    # injected/observed lag stays within the budget is waited for; past
+    # it the shard is served from parity.  0 = no budget — any
+    # fault-flagged straggler degrades immediately (the deterministic
+    # default the chaos suite pins)
+    shard_hop_budget_s: float = 0.0
 
 
 @dataclass
@@ -921,6 +949,7 @@ def build_sharded_half(
     solver_mode: str = "full",
     subspace_size: int = 0,
     fused_gather: str = "taa",
+    coded: bool = False,
 ):
     """ALX-style half-iteration over block-sharded factor tables.
 
@@ -940,6 +969,23 @@ def build_sharded_half(
       scales with mesh HBM like MLlib's co-partitioned rating blocks,
       and the int32-offset ceiling applies per shard.
 
+    ``coded=True`` (coded-ALS, arXiv 2105.03631; `parallel/coded.py`)
+    builds the straggler-tolerant variant.  Signature grows two inputs
+    and one output::
+
+        fn(upd, opp, opp_parity, ok_mask, c, v, lam, alpha, *buckets)
+          -> (new_upd, new_upd_parity)
+
+    ``opp_parity`` is the replicated ``[M/d, R]`` f32 block sum of the
+    opposite table; ``ok_mask`` a replicated ``[d]`` 0/1 vector.  A
+    masked (late/dead) opposite block is reconstructed in-program as
+    ``parity - sum(alive blocks)`` — exact while parity is current —
+    and the masked shard's OWN rows are frozen at their previous values
+    (the dead worker wrote nothing this half).  The returned parity is
+    the block sum of the UPDATED table, so the next half's opposite
+    parity is already fresh.  With an all-ones mask the math reduces to
+    the plain path (reconstruction multiplies by zero).
+
     Requires row counts padded to a multiple of the mesh size; bucket
     padding rows carry ids >= the padded row count, so they drop out of
     every shard's scatter window.
@@ -957,31 +1003,14 @@ def build_sharded_half(
     shard_map = _ft.partial(raw, **{flag: False})
 
     axis = DATA_AXIS
+    d = mesh.shape[axis]
+    f32 = jnp.float32
 
-    def body(upd, opp, c_sorted, v_sorted, lam, alpha, *flat_buckets):
-        # upd/opp arrive as local shards [Np/d, R] / [Mp/d, R]
+    def solve_core(upd, opp_full, gram, c_sorted, v_sorted, lam, alpha,
+                   flat_buckets):
         me = jax.lax.axis_index(axis)
         shard_n = upd.shape[0]
         lo = (me * shard_n).astype(jnp.int32)
-        # cast BEFORE the all-gather so bf16 mode also halves ICI traffic
-        opp_send = (
-            opp.astype(jnp.bfloat16)
-            if gather_dtype == "bfloat16"
-            else opp
-        )
-        opp_full = jax.lax.all_gather(opp_send, axis, axis=0, tiled=True)
-        gram = None
-        if implicit:
-            # YtY from the LOCAL shard + psum: identical [R, R] result at
-            # 1/d the FLOPs of redoing the full einsum on every device
-            prec = jax.lax.Precision(
-                {"highest": "highest", "high": "high", "default": "default"}[
-                    precision
-                ]
-            )
-            gram = jax.lax.psum(
-                jnp.einsum("mr,ms->rs", opp, opp, precision=prec), axis
-            )
         bucket_args = tuple(
             tuple(flat_buckets[i : i + 3])
             for i in range(0, len(flat_buckets), 3)
@@ -1017,17 +1046,114 @@ def build_sharded_half(
         )
         return upd if out is None else out
 
+    def _prec():
+        return jax.lax.Precision(
+            {"highest": "highest", "high": "high", "default": "default"}[
+                precision
+            ]
+        )
+
     P_ = P
     sharded2 = P_(axis, None)
     rep = P_()
-    # factor tables + the COO arrive sharded; only the scalars replicate
+    bucket_specs = (P_(axis),) * (3 * len(ks))
+
+    if not coded:
+
+        def body(upd, opp, c_sorted, v_sorted, lam, alpha, *flat_buckets):
+            # upd/opp arrive as local shards [Np/d, R] / [Mp/d, R]
+            # cast BEFORE the all-gather so bf16 mode also halves ICI
+            # traffic
+            opp_send = (
+                opp.astype(jnp.bfloat16)
+                if gather_dtype == "bfloat16"
+                else opp
+            )
+            opp_full = jax.lax.all_gather(
+                opp_send, axis, axis=0, tiled=True
+            )
+            gram = None
+            if implicit:
+                # YtY from the LOCAL shard + psum: identical [R, R]
+                # result at 1/d the FLOPs of redoing the full einsum on
+                # every device
+                gram = jax.lax.psum(
+                    jnp.einsum("mr,ms->rs", opp, opp, precision=_prec()),
+                    axis,
+                )
+            return solve_core(
+                upd, opp_full, gram, c_sorted, v_sorted, lam, alpha,
+                flat_buckets,
+            )
+
+        in_specs = (
+            sharded2, sharded2, P_(axis), P_(axis), rep, rep,
+        ) + bucket_specs
+        mapped = shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=sharded2,
+        )
+        return xray.instrument("als.sharded_half")(
+            jax.jit(mapped, donate_argnums=(0,))
+        )
+
+    def coded_body(upd, opp, opp_parity, ok, c_sorted, v_sorted, lam,
+                   alpha, *flat_buckets):
+        me = jax.lax.axis_index(axis)
+        opp_send = (
+            opp.astype(jnp.bfloat16)
+            if gather_dtype == "bfloat16"
+            else opp
+        )
+        # mask the late/dead shard's block out of the gather, then put
+        # its reconstruction back: parity - sum(alive).  The alive sum
+        # rides f32 (the iterate's dtype) so bf16 gather mode does not
+        # erode the reconstruction; with all shards alive the recon
+        # block multiplies by zero and the math is the plain gather.
+        okm = ok[me]
+        masked_send = opp_send * okm.astype(opp_send.dtype)
+        gathered = jax.lax.all_gather(masked_send, axis, axis=0,
+                                      tiled=True)
+        alive_sum = jax.lax.psum(opp * okm.astype(opp.dtype), axis)
+        recon = (opp_parity - alive_sum.astype(f32))
+        blocks = gathered.reshape((d,) + opp.shape)
+        okb = ok.reshape((d,) + (1,) * opp.ndim).astype(opp_send.dtype)
+        opp_full = (
+            blocks * okb
+            + recon[None].astype(opp_send.dtype) * (1.0 - okb)
+        ).reshape(gathered.shape)
+        gram = None
+        if implicit:
+            # per-shard gram + psum would read the dead shard's data;
+            # fold the reconstructed block in explicitly instead
+            alive_gram = jax.lax.psum(
+                jnp.einsum(
+                    "mr,ms->rs", opp * okm.astype(opp.dtype),
+                    opp * okm.astype(opp.dtype), precision=_prec(),
+                ),
+                axis,
+            )
+            gram = alive_gram + jnp.einsum(
+                "mr,ms->rs", recon, recon, precision=_prec(),
+            ) * (1.0 - jnp.min(ok))
+        out = solve_core(
+            upd, opp_full, gram, c_sorted, v_sorted, lam, alpha,
+            flat_buckets,
+        )
+        # a degraded shard wrote nothing this half: freeze its rows
+        okw = okm.astype(out.dtype)
+        out = out * okw + upd.astype(out.dtype) * (1.0 - okw)
+        # parity of the UPDATED table — the next half's opposite parity
+        new_parity = jax.lax.psum(out.astype(f32), axis)
+        return out, new_parity
+
     in_specs = (
-        sharded2, sharded2, P_(axis), P_(axis), rep, rep,
-    ) + (P_(axis),) * (3 * len(ks))
+        sharded2, sharded2, rep, rep, P_(axis), P_(axis), rep, rep,
+    ) + bucket_specs
     mapped = shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=sharded2,
+        coded_body, mesh=mesh, in_specs=in_specs,
+        out_specs=(sharded2, rep),
     )
-    return xray.instrument("als.sharded_half")(
+    return xray.instrument("als.coded_half")(
         jax.jit(mapped, donate_argnums=(0,))
     )
 
@@ -1117,6 +1243,9 @@ class ALSTrainer:
         self.sharded = (
             cfg.factor_placement == "sharded" and self.mesh is not None
         )
+        # single-device "sharded" degenerates to replicated, and coded
+        # parity with it (there is no ring to straggle)
+        self.coded = False
         self._pad_users = pad_to_multiple(n_users, n_dev)
         self._pad_items = pad_to_multiple(n_items, n_dev)
         nu = self._pad_users if self.sharded else n_users
@@ -1186,6 +1315,7 @@ class ALSTrainer:
 
     def _build_sharded_halves(self) -> None:
         cfg = self.cfg
+        self.coded = bool(cfg.coded_shards) and self.sharded
         common = dict(
             implicit=cfg.implicit,
             weighted_lambda=cfg.weighted_lambda,
@@ -1196,6 +1326,7 @@ class ALSTrainer:
             solver_mode=cfg.solver_mode,
             subspace_size=cfg.subspace_size,
             fused_gather=self.fused_gather or "taa",
+            coded=self.coded,
         )
         self._sharded_user_half = build_sharded_half(
             self.mesh, ks=self._user_side["ks"], **common
@@ -1203,6 +1334,18 @@ class ALSTrainer:
         self._sharded_item_half = build_sharded_half(
             self.mesh, ks=self._item_side["ks"], **common
         )
+        if self.coded:
+            from ..parallel.coded import ShardHealth, build_parity_fn
+
+            self._parity_fn = build_parity_fn(self.mesh)
+            self._parity_state: dict[str, jax.Array] = {}
+            # one health tracker per trainer: a worker_kill is sticky
+            # across run() calls, the way a dead host stays dead
+            self.shard_health = ShardHealth(
+                self.mesh.size,
+                hop_budget_s=cfg.shard_hop_budget_s or None,
+                op="als.half",
+            )
 
     @classmethod
     def distributed(
@@ -1592,6 +1735,16 @@ class ALSTrainer:
             V = jax.device_put(V, replicated(self.mesh))
         return U, V
 
+    def _coded_parity(self, name: str, table: jax.Array) -> jax.Array:
+        """Replicated parity block of ``table``, cached under ``name``
+        ("user"/"item"); refreshed by each coded half's returned parity
+        and reset per :meth:`run` (fresh iterates mean fresh parity)."""
+        p = self._parity_state.get(name)
+        if p is None:
+            p = self._parity_fn(table)
+            self._parity_state[name] = p
+        return p
+
     def _half(self, upd, opp, side, lam: Optional[float] = None) -> jax.Array:
         cfg = self.cfg
         lam_t = jnp.asarray(cfg.lam if lam is None else lam, jnp.float32)
@@ -1602,6 +1755,26 @@ class ALSTrainer:
                 else self._sharded_item_half
             )
             flat = [a for b in side["buckets"] for a in b]
+            if self.coded:
+                upd_name = (
+                    "user" if side is self._user_side else "item"
+                )
+                opp_name = "item" if upd_name == "user" else "user"
+                # consult the dist.* fault points / hop budget BEFORE
+                # dispatch: a late shard is served from parity, a
+                # killed one stays frozen (parallel/coded.ShardHealth)
+                ok = self.shard_health.poll()
+                new, new_par = fn(
+                    upd, opp,
+                    self._coded_parity(opp_name, opp),
+                    jnp.asarray(ok, jnp.float32),
+                    side["c_sorted"], side["v_sorted"],
+                    lam_t,
+                    jnp.asarray(cfg.alpha, jnp.float32),
+                    *flat,
+                )
+                self._parity_state[upd_name] = new_par
+                return new
             return fn(
                 upd, opp, side["c_sorted"], side["v_sorted"],
                 lam_t,
@@ -1711,6 +1884,10 @@ class ALSTrainer:
         """
         U = jnp.array(U, copy=True)
         V = jnp.array(V, copy=True)
+        if self.coded:
+            # fresh iterates mean the cached parity blocks are stale:
+            # recompute lazily from THESE tables on first use
+            self._parity_state = {}
         trace_phases = _als_phase_trace_enabled()
         for it in range(num_iterations):
             if trace_phases:

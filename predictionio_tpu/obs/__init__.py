@@ -227,6 +227,24 @@ FOLDIN_WATERMARK_LAG = _registry.gauge(
     "Event-store rows written past the last applied fold-in watermark",
 )
 
+# pio-armor (straggler-tolerant distributed) families: the coded-shard
+# orchestration books every parity serve / frozen write, and the
+# per-shard lag histogram captures how long the host waited on a shard
+# before degrading (the straggler evidence a pod operator reads first).
+SHARD_DEGRADED_TOTAL = _registry.counter(
+    "pio_shard_degraded_total",
+    "Half-iterations / top-k hops where a shard was served from parity "
+    "instead of its owner (straggler or dead worker)",
+    labels=("shard",),
+)
+SHARD_LAG_SECONDS = _registry.histogram(
+    "pio_shard_lag_seconds",
+    "Host-observed wait on a late shard before serving it from parity "
+    "(op = als.half | topk.ring)",
+    labels=("op",),
+    buckets=log_buckets(1e-4, 100.0, per_decade=4),
+)
+
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every
 # process's first scrape already shows the full (zero-valued) shape

@@ -1,5 +1,11 @@
 """Device-mesh parallelism utilities (the Spark-substrate replacement)."""
 
+from .coded import (
+    ParityExhausted,
+    ShardHealth,
+    build_coded_gather,
+    build_parity_fn,
+)
 from .collectives import (
     all_gather_blocks,
     all_reduce_sum,
@@ -25,6 +31,10 @@ from .mesh import (
 )
 
 __all__ = [
+    "ParityExhausted",
+    "ShardHealth",
+    "build_coded_gather",
+    "build_parity_fn",
     "all_gather_blocks",
     "all_reduce_sum",
     "reduce_scatter_sum",

@@ -34,11 +34,14 @@ gathers are identity.
 from __future__ import annotations
 
 import hashlib
+import logging
 import time
 from pathlib import Path
 from typing import Optional, Sequence
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "entity_shard",
@@ -49,7 +52,17 @@ __all__ = [
     "exchange_ratings_by_owner",
     "read_ratings_distributed",
     "distributed_trainer",
+    "ExchangeTornError",
 ]
+
+
+class ExchangeTornError(RuntimeError):
+    """The sharded-COO file exchange failed past its retry budget.
+
+    Raised by :func:`exchange_ratings_by_owner` after the configured
+    retries; :func:`distributed_trainer` catches it and degrades to the
+    replicated gather path (correct, but rating memory no longer scales
+    with the cluster) instead of dying mid-train."""
 
 
 def entity_shard(entity_id: str, n_shards: int) -> int:
@@ -286,9 +299,15 @@ def _exchange_all_to_all(
     exchange_dir = Path(exchange_dir)
     exchange_dir.mkdir(parents=True, exist_ok=True)
     _sweep_stale(exchange_dir, age_s=max(_STALE_AGE_S, 2.0 * timeout))
+    from ..resilience import faults
+
     mine: list[Path] = []
     try:
         for dst in range(n):
+            # dist.exchange_torn: a publish tears mid-exchange — the
+            # except-path below withdraws the files already published,
+            # exactly what a crashed real peer forces survivors to do
+            faults.check("dist.exchange_torn")
             path = exchange_dir / f"{tag}-{pid}to{dst}.npz"
             tmp = exchange_dir / f"{tag}-{pid}to{dst}.tmp.npz"
             # uncompressed on purpose: this path exists for bulk numeric
@@ -330,6 +349,7 @@ def exchange_ratings_by_owner(
     exchange_dir,
     tag: str,
     timeout: float = 120.0,
+    retry=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Send each rating triple to the process owning its ROW and return
     the triples this process received (concatenated over sources).
@@ -343,27 +363,65 @@ def exchange_ratings_by_owner(
     being capped by one host's memory, the way the reference's
     region-sharded HBase scan never materialized the full event set in
     one JVM (`storage/hbase/HBPEvents.scala:99-105`).
+
+    A torn exchange (``dist.exchange_torn`` injection, a crashed peer's
+    half-published files, a flaky shared filesystem) is retried whole —
+    each attempt re-publishes under a fresh nonce, so a retry can never
+    merge a previous attempt's partial files.  Retries stay in lockstep
+    across processes for deterministic (plan-armed) faults because every
+    process consults the same ``PIO_FAULT_PLAN``; a genuinely one-sided
+    failure surfaces as a timeout on the survivors, which the same
+    retry covers.  Past the budget the call raises
+    :class:`ExchangeTornError` — callers with a fallback (see
+    :func:`distributed_trainer`) degrade instead of hanging.
     """
     import jax
 
+    from ..obs import RESILIENCE_TOTAL
+    from ..resilience import RetryPolicy, faults
+
     n = jax.process_count()
-    if n <= 1:
-        return row_ix, col_ix, rating
-    dest = np.asarray(owner_of_row)[row_ix]
-    payloads = {}
-    for dst in range(n):
-        sel = dest == dst
-        payloads[dst] = {
-            "r": np.ascontiguousarray(row_ix[sel]),
-            "c": np.ascontiguousarray(col_ix[sel]),
-            "v": np.ascontiguousarray(rating[sel]),
-        }
-    got = _exchange_all_to_all(exchange_dir, tag, payloads, timeout=timeout)
-    return (
-        np.concatenate([g["r"] for g in got]),
-        np.concatenate([g["c"] for g in got]),
-        np.concatenate([g["v"] for g in got]),
-    )
+    if retry is None:
+        retry = RetryPolicy(max_attempts=2, base_s=0.05, seed=0)
+
+    def attempt():
+        # consulted before the single-process short-circuit so the
+        # torn-exchange semantics are testable on the simulated cluster
+        faults.check("dist.exchange_torn")
+        if n <= 1:
+            return row_ix, col_ix, rating
+        dest = np.asarray(owner_of_row)[row_ix]
+        payloads = {}
+        for dst in range(n):
+            sel = dest == dst
+            payloads[dst] = {
+                "r": np.ascontiguousarray(row_ix[sel]),
+                "c": np.ascontiguousarray(col_ix[sel]),
+                "v": np.ascontiguousarray(rating[sel]),
+            }
+        got = _exchange_all_to_all(
+            exchange_dir, tag, payloads, timeout=timeout
+        )
+        return (
+            np.concatenate([g["r"] for g in got]),
+            np.concatenate([g["c"] for g in got]),
+            np.concatenate([g["v"] for g in got]),
+        )
+
+    def on_retry(attempt_no, exc):
+        RESILIENCE_TOTAL.labels(kind="dist.exchange_retry").inc()
+        logger.warning(
+            "COO exchange %s torn (attempt %d: %s); retrying under a "
+            "fresh nonce", tag, attempt_no, exc,
+        )
+
+    retriable = (faults.InjectedFault, OSError, TimeoutError)
+    try:
+        return retry.call(attempt, retry_on=retriable, on_retry=on_retry)
+    except retriable as e:
+        raise ExchangeTornError(
+            f"COO exchange {tag!r} failed past its retry budget: {e}"
+        ) from e
 
 
 def read_ratings_distributed(
@@ -455,7 +513,28 @@ def distributed_trainer(
         item_index=items,
         dedup=dedup,
     )
-    return ALSTrainer.distributed(
-        local, cfg=cfg, mesh=mesh, exchange_dir=exchange_dir,
-        tag=f"{tag}-coo", timeout=timeout,
-    )
+    try:
+        return ALSTrainer.distributed(
+            local, cfg=cfg, mesh=mesh, exchange_dir=exchange_dir,
+            tag=f"{tag}-coo", timeout=timeout,
+        )
+    except ExchangeTornError as e:
+        # retry budget spent: degrade LOUDLY to the replicated gather
+        # path — the train still completes and the model is identical,
+        # but rating memory no longer scales with the cluster, so the
+        # degradation is booked where operators look
+        import dataclasses
+
+        from ..obs import RESILIENCE_TOTAL
+
+        RESILIENCE_TOTAL.labels(kind="dist.exchange_degraded").inc()
+        logger.error(
+            "sharded-COO exchange failed past retries (%s); degrading "
+            "to the replicated gather path — rating memory will NOT "
+            "scale with the cluster this run", e,
+        )
+        gathered = gather_ratings(local)
+        cfg_rep = dataclasses.replace(
+            cfg, factor_placement="replicated", coded_shards=False
+        )
+        return ALSTrainer(gathered, cfg=cfg_rep, mesh=mesh)

@@ -30,7 +30,15 @@ from .policy import (
     current_deadline,
     deadline_scope,
 )
-from .faults import FaultPlan, InjectedFault, arm, armed, check, disarm
+from .faults import (
+    FaultPlan,
+    InjectedFault,
+    arm,
+    armed,
+    check,
+    disarm,
+    fired_shard,
+)
 from .delivery import DeliveryQueue
 
 __all__ = [
@@ -48,4 +56,5 @@ __all__ = [
     "current_deadline",
     "deadline_scope",
     "disarm",
+    "fired_shard",
 ]

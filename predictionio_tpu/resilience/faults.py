@@ -15,11 +15,21 @@ Injection points instrumented in this codebase::
     http.feedback      feedback-event delivery (delivery queue send)
     http.remote_log    remote error-log delivery (delivery queue send)
     reload.load_model  engine (re)load of trained components
+    dist.shard_delay   a factor/item shard is SLOW this half/hop
+                       (straggler; consulted via :func:`fired_shard`)
+    dist.shard_drop    a shard's data is unavailable for ONE half/hop
+                       (transient loss; consulted via :func:`fired_shard`)
+    dist.worker_kill   a worker dies; its shard is gone for the REST of
+                       the run (sticky — the coded orchestration in
+                       ``parallel/coded.py`` remembers the kill)
+    dist.exchange_torn the sharded-COO file exchange tears mid-publish
+                       (`parallel/ingest.exchange_ratings_by_owner`)
 
 Plan grammar (``;``-separated rules, ``,``-separated options)::
 
     PIO_FAULT_PLAN="storage.write:nth=1,times=2,exc=operational"
     PIO_FAULT_PLAN="seed=7;http.feedback:prob=0.5;device.dispatch:delay=0.05"
+    PIO_FAULT_PLAN="dist.shard_delay:shard=1,delay=0.2,times=1"
 
 Options per rule:
 
@@ -32,6 +42,18 @@ Options per rule:
 * ``exc=NAME`` — exception to raise: ``fault`` (default,
   :class:`InjectedFault`), ``operational`` (sqlite3.OperationalError),
   ``oserror``, ``timeout``, ``urlerror``
+* ``shard=I`` — the target shard of a ``dist.*`` rule (0-based mesh
+  shard index, default 0); returned by :func:`fired_shard` so the
+  distributed orchestration knows WHICH shard to degrade
+
+Two consultation styles:
+
+* :func:`check` — raise-or-sleep, for I/O boundaries whose degradation
+  is an exception path (the original six points; ``dist.exchange_torn``).
+* :func:`fired_shard` — ask-and-degrade, for the distributed
+  orchestration: counts the call, applies the rule's delay, and returns
+  the target shard id instead of raising — the caller's job is to serve
+  that shard from parity, not to unwind.
 """
 
 from __future__ import annotations
@@ -45,7 +67,7 @@ import urllib.error
 from typing import Optional
 
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "POINTS",
-           "arm", "disarm", "armed", "check"]
+           "arm", "disarm", "armed", "check", "fired_shard"]
 
 POINTS = (
     "storage.write",
@@ -54,6 +76,10 @@ POINTS = (
     "http.feedback",
     "http.remote_log",
     "reload.load_model",
+    "dist.shard_delay",
+    "dist.shard_drop",
+    "dist.worker_kill",
+    "dist.exchange_torn",
 )
 
 
@@ -79,15 +105,24 @@ class FaultRule:
     def __init__(self, point: str, nth: int = 1,
                  times: Optional[int] = None, prob: Optional[float] = None,
                  delay: Optional[float] = None, exc: Optional[str] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, shard: Optional[int] = None):
         if point not in POINTS:
             raise ValueError(
                 f"unknown injection point {point!r}; known: {POINTS}"
             )
+        if nth < 1:
+            # nth is 1-based ("first firing call"); 0 would silently mean
+            # the same as 1, and a negative value is always a typo
+            raise ValueError(f"nth must be >= 1 (1-based), got {nth}")
+        if times is not None and times < 1:
+            raise ValueError(f"times must be >= 1, got {times}")
+        if shard is not None and shard < 0:
+            raise ValueError(f"shard must be >= 0, got {shard}")
         if exc is not None:
             _make_exc(exc, "probe")  # validate the name at parse time
         self.point = point
         self.nth = nth
+        self.shard = shard
         self.times = times
         self.prob = prob
         self.delay = delay
@@ -123,6 +158,17 @@ class FaultPlan:
     """A set of rules, at most one per point, plus the firing log."""
 
     def __init__(self, rules: list[FaultRule]):
+        seen: set[str] = set()
+        for r in rules:
+            if r.point in seen:
+                # silently keeping the LAST rule (the old dict-build
+                # behavior) made a mistyped two-rule plan test only half
+                # of what the operator thought it armed
+                raise ValueError(
+                    f"duplicate rule for injection point {r.point!r}; "
+                    "a plan holds at most one rule per point"
+                )
+            seen.add(r.point)
         self._rules = {r.point: r for r in rules}
         self._lock = threading.Lock()
         # (point, call_index) per firing — the observable sequence a
@@ -137,6 +183,11 @@ class FaultPlan:
             if not part:
                 continue
             if ":" not in part:
+                if part in POINTS:
+                    # a bare point name is a rule with defaults (fires
+                    # every call with the point's default exception)
+                    rules.append(FaultRule(part, seed=seed))
+                    continue
                 k, _, v = part.partition("=")
                 if k.strip() != "seed":
                     raise ValueError(f"bad fault rule {part!r}")
@@ -149,7 +200,7 @@ class FaultPlan:
                     continue
                 k, _, v = opt.partition("=")
                 k = k.strip()
-                if k in ("nth", "times"):
+                if k in ("nth", "times", "shard"):
                     kw[k] = int(v)
                 elif k in ("prob", "delay"):
                     kw[k] = float(v)
@@ -177,6 +228,35 @@ class FaultPlan:
             time.sleep(rule.delay)  # outside the lock: other points flow
         if exc is not None:
             raise exc
+
+    def hit_shard(self, point: str,
+                  max_wait: Optional[float] = None
+                  ) -> Optional[tuple[int, float]]:
+        """Ask-and-degrade consultation: count one call; when the rule
+        fires, return ``(target shard, injected lag)`` instead of
+        raising.  The distributed caller degrades that shard (parity
+        serve / frozen writes) rather than unwinding — a straggler is
+        not an exception, it is a slower answer.
+
+        ``max_wait`` caps how long this host actually SLEEPS waiting on
+        the simulated straggler (the caller's hop budget); the returned
+        lag is the rule's FULL delay, so the caller can tell "answered
+        late but in budget" from "missed the budget — stop waiting and
+        serve parity".  ``None`` waits the delay out in full."""
+        rule = self._rules.get(point)
+        if rule is None:
+            return None
+        with self._lock:
+            fired, _ = rule.hit()
+            if fired:
+                self.log.append((point, rule.calls))
+        if not fired:
+            return None
+        lag = rule.delay or 0.0
+        wait = lag if max_wait is None else min(lag, max(max_wait, 0.0))
+        if wait:
+            time.sleep(wait)  # outside the lock: other points flow
+        return (rule.shard if rule.shard is not None else 0), lag
 
     def counters(self) -> dict:
         with self._lock:
@@ -213,6 +293,20 @@ def check(point: str) -> None:
     if plan is None:
         return
     plan.hit(point)
+
+
+def fired_shard(point: str,
+                max_wait: Optional[float] = None
+                ) -> Optional[tuple[int, float]]:
+    """Distributed instrumented boundary (``dist.shard_delay`` /
+    ``dist.shard_drop`` / ``dist.worker_kill``): returns ``(shard id,
+    injected lag)`` when the armed rule fires, else None.  The host
+    sleeps at most ``max_wait`` of the lag (its hop budget) — see
+    :meth:`FaultPlan.hit_shard`.  No plan armed => one global load."""
+    plan = _plan
+    if plan is None:
+        return None
+    return plan.hit_shard(point, max_wait=max_wait)
 
 
 # operator workflow: arm from the environment at import, so any entry

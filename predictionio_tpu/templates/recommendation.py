@@ -356,6 +356,18 @@ class ALSAlgorithmParams(Params):
     # "sharded" (tables AND rating COO block-sharded over the mesh —
     # model and data capacity scale with total HBM)
     factor_placement: str = "replicated"
+    # coded-ALS parity shards for sharded placement (engine.json key
+    # codedShards): a late/dead shard's half-iteration contribution is
+    # reconstructed from the other d-1 plus parity instead of stalling
+    # the ring (models/als.py ALSConfig.coded_shards)
+    coded_shards: bool = False
+    # serve queries through the ring top-k over a mesh-sharded item
+    # table (engine.json key distributedTopk) with parity-coded
+    # straggler tolerance: a shard missing its per-request hop budget
+    # (the serving Deadline, split per shard) is served from parity.
+    # Unfiltered queries only — category/white/blacklist queries keep
+    # the local scorer (per-query masks don't ride the ring)
+    distributed_topk: bool = False
 
 
 @dataclass
@@ -373,6 +385,21 @@ class ALSModel(DeviceTableMixin):
             raise ValueError("user factors contain non-finite values")
         if not np.isfinite(self.item_factors).all():
             raise ValueError("item factors contain non-finite values")
+
+    def sharded_topk_index(self):
+        """Lazy distributed top-k index (ops/distributed_topk.ShardedTopK):
+        item table sharded over the mesh + parity block + sticky shard
+        health, built once per model (re)load like the device caches.
+        The per-request deadline needs no plumbing — the index reads the
+        serving thread's deadline scope on every call."""
+        idx = getattr(self, "_sharded_topk", None)
+        if idx is None:
+            from ..ops.distributed_topk import ShardedTopK
+            from ..parallel import make_mesh
+
+            idx = ShardedTopK(self.item_factors, make_mesh())
+            self._sharded_topk = idx
+        return idx
 
 
 
@@ -400,6 +427,7 @@ class ALSAlgorithm(Algorithm):
             solver_mode=p.solver_mode,
             subspace_size=p.subspace_size,
             factor_placement=p.factor_placement,
+            coded_shards=p.coded_shards,
         )
 
     def _serve_dtype(self):
@@ -472,6 +500,15 @@ class ALSAlgorithm(Algorithm):
             topk_scores(vec, table, k, bias=bias)
         warm_batched_topk(table, rank, n, unmasked_too=True,
                           max_batch=max_batch)
+        if getattr(self.params, "distributed_topk", False):
+            # the ring index compiles BOTH variants (clean + parity-
+            # coded) per (batch, k): cover the common solo shapes so a
+            # first degradation never pays a mid-request compile; rarer
+            # batched shapes compile once under load like the local
+            # pow2 ladder
+            idx = model.sharded_topk_index()
+            for k in {min(pow2_ceil(k), n) for k in (1, 4, 10, 16, 20)}:
+                idx.warm(k, batch=1)
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         uix = model.users.get(query.user)
@@ -479,6 +516,21 @@ class ALSAlgorithm(Algorithm):
             return PredictedResult(item_scores=())
         k = min(query.num, len(model.items))
         mask = self._allowed_mask(model, query)
+        if (
+            mask is None
+            and getattr(self.params, "distributed_topk", False)
+        ):
+            # ring top-k over the mesh-sharded item table; the request
+            # Deadline in scope becomes the per-shard hop budget, and a
+            # late shard is served from parity (pio-armor)
+            vals2, ixs2 = model.sharded_topk_index()(
+                np.asarray(model.user_factors[uix])[None, :], k
+            )
+            return PredictedResult(
+                item_scores=decode_item_scores(
+                    model.items, np.asarray(vals2)[0], np.asarray(ixs2)[0]
+                )
+            )
         table = model.device_item_factors(self._serve_dtype())
         if mask is None:
             vals, ixs = topk_scores(
@@ -527,10 +579,18 @@ class ALSAlgorithm(Algorithm):
             mask = np.stack([zero if m is None else m for m in masks])
         else:
             mask = None
-        vals, ixs = batch_topk_scores(
-            uvecs, model.device_item_factors(self._serve_dtype()), k,
-            mask=mask,
-        )
+        if mask is None and getattr(self.params, "distributed_topk",
+                                    False):
+            # the micro-batched serving path rides the same parity-coded
+            # ring as solo predict (the ring takes a [B, R] query block
+            # natively); per-query masks keep the local scorer below
+            vals, ixs = model.sharded_topk_index()(uvecs, k)
+            vals, ixs = np.asarray(vals), np.asarray(ixs)
+        else:
+            vals, ixs = batch_topk_scores(
+                uvecs, model.device_item_factors(self._serve_dtype()), k,
+                mask=mask,
+            )
         decoded = decode_batch_item_scores(
             model.items, vals, ixs, [q.num for q in queries], valid, k
         )

@@ -1,7 +1,12 @@
-"""Worker for the 2-process multi-host ingest test.
+"""Worker for the multi-host ingest/train tests.
 
-Launched by tests/test_multihost.py as:
-    python _multihost_worker.py <pid> <nprocs> <coordinator> <db> <exch> <out>
+Launched by tools/multihost_harness.spawn_workers as:
+    python _multihost_worker.py <pid> <nprocs> <coord_dir> <db> <exch> <out>
+
+``coord_dir`` is the harness's coordination directory: worker 0 binds
+port 0 itself and publishes the bound address there
+(`tools/multihost_harness.resolve_coordinator`), so no parent-side
+free-port scan can race another concurrent run.
 
 Each process jax.distributed-inits into the cluster, reads ITS entity-hash
 shard of the shared sqlite event store, exchanges id dictionaries, gathers
@@ -20,8 +25,12 @@ import numpy as np
 
 def main() -> None:
     pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
-    coordinator, db, exch, out = sys.argv[3:7]
+    coord_dir, db, exch, out = sys.argv[3:7]
     home = sys.argv[7] if len(sys.argv) > 7 else ""
+
+    from tools.multihost_harness import resolve_coordinator
+
+    coordinator = resolve_coordinator(coord_dir, pid, nprocs)
 
     from predictionio_tpu.parallel.mesh import force_platform
 

@@ -61,6 +61,88 @@ def test_validation(mesh):
         ring_topk_scores(q, np.zeros((32, 4), np.float32), 64, mesh)
 
 
+def test_row_bias_excludes_rows(mesh):
+    """-inf-biased rows can never win — the padding contract
+    ShardedTopK relies on."""
+    rng = np.random.default_rng(3)
+    B, M, R, k = 4, 32, 6, 6
+    q = rng.normal(size=(B, R)).astype(np.float32)
+    v = rng.normal(size=(M, R)).astype(np.float32)
+    bias = np.zeros(M, np.float32)
+    bias[24:] = -np.inf  # last shard's rows masked out
+    vals, ixs = ring_topk_scores(
+        *_place(mesh, q, v), k=k, mesh=mesh,
+        row_bias=jax.device_put(
+            bias, data_sharding(mesh, 1)
+        ),
+    )
+    assert int(np.asarray(ixs).max()) < 24
+    dense = q @ v[:24].T
+    ref = np.sort(dense, axis=1)[:, ::-1][:, :k]
+    np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_parity_reconstruction_matches_dense(mesh):
+    """With a shard marked dead, its block is reconstructed from the
+    other d-1 plus parity inside the ring — the result is exactly the
+    clean top-k while parity is current."""
+    from predictionio_tpu.parallel.coded import (
+        ShardHealth, build_parity_fn,
+    )
+
+    rng = np.random.default_rng(4)
+    d = mesh.shape["data"]
+    B, M, R, k = 3, 8 * d, 5, 6
+    q = rng.normal(size=(B, R)).astype(np.float32)
+    v = rng.normal(size=(M, R)).astype(np.float32)
+    qd, vd = _place(mesh, q, v)
+    parity = build_parity_fn(mesh)(vd)
+    health = ShardHealth(d, op="topk.ring")
+    health.killed.add(1)  # pre-degraded: shard 1 is gone
+    vals, ixs = ring_topk_scores(
+        qd, vd, k=k, mesh=mesh, parity=parity, health=health,
+    )
+    dense = q @ v.T
+    ref_ix = np.argsort(-dense, axis=1)[:, :k]
+    ref_val = np.take_along_axis(dense, ref_ix, axis=1)
+    np.testing.assert_allclose(np.asarray(vals), ref_val, rtol=1e-5,
+                               atol=1e-5)
+    assert health.degraded_polls == 1
+
+
+def test_stale_parity_serves_last_published_rows(mesh):
+    """A stale parity (built before the table moved) serves the dead
+    shard's LAST PUBLISHED rows — degraded-but-bounded recall, never
+    garbage."""
+    from predictionio_tpu.parallel.coded import (
+        ShardHealth, build_parity_fn,
+    )
+
+    rng = np.random.default_rng(5)
+    d = mesh.shape["data"]
+    B, M, R, k = 2, 4 * d, 4, 5
+    q = rng.normal(size=(B, R)).astype(np.float32)
+    v_old = rng.normal(size=(M, R)).astype(np.float32)
+    v_new = v_old.copy()
+    rows = M // d
+    v_new[rows:2 * rows] += 0.25  # shard 1 moved after parity was built
+    qd, vd_new = _place(mesh, q, v_new)
+    parity_stale = build_parity_fn(mesh)(_place(mesh, q, v_old)[1])
+    health = ShardHealth(d, op="topk.ring")
+    health.killed.add(1)
+    vals, ixs = ring_topk_scores(
+        qd, vd_new, k=k, mesh=mesh, parity=parity_stale, health=health,
+    )
+    # the reconstruction equals the OLD shard-1 rows + the new rest
+    v_served = v_new.copy()
+    v_served[rows:2 * rows] = v_old[rows:2 * rows]
+    dense = q @ v_served.T
+    ref = np.sort(dense, axis=1)[:, ::-1][:, :k]
+    np.testing.assert_allclose(np.asarray(vals), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
 def test_works_under_jit(mesh):
     rng = np.random.default_rng(2)
     q = rng.normal(size=(4, 8)).astype(np.float32)

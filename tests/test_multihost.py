@@ -13,21 +13,23 @@ single-process read.
 """
 
 import datetime as dt
-import functools
-import os
-import socket
-import subprocess
 import sys
 from pathlib import Path
 
 import numpy as np
 import pytest
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.multihost_harness import (
+    collectives_unavailable_reason,
+    spawn_workers,
+)
+
 from predictionio_tpu.storage.event import DataMap, Event
 from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
 
 UTC = dt.timezone.utc
-WORKER = Path(__file__).parent / "_multihost_worker.py"
 
 
 # -- multiprocess-collectives capability gate --------------------------------
@@ -36,74 +38,18 @@ WORKER = Path(__file__).parent / "_multihost_worker.py"
 # REAL processes.  Some jaxlib builds' CPU backend refuses them
 # ("Multiprocess computations aren't implemented on the CPU backend"),
 # which made these 7 tests fail ENVIRONMENTALLY on every tier-1 run
-# since PR 3 — red noise that buried real regressions.  Detect the
-# capability once at collection time with a minimal 2-process
-# broadcast probe (the exact op the workers die on) and skip loudly
-# when it is absent; where collectives exist (a fixed jaxlib, a real
-# multihost runner) the suite runs in full.  PIO_TPU_RUN_MULTIHOST=1
-# skips the probe and forces the tests to run (e.g. to re-confirm the
-# failure mode or exercise a candidate jaxlib).
-
-_COLLECTIVES_PROBE = """
-import sys
-import jax
-jax.distributed.initialize(
-    sys.argv[1], num_processes=2, process_id=int(sys.argv[2])
-)
-import numpy as np
-from jax.experimental import multihost_utils
-multihost_utils.broadcast_one_to_all(np.ones(1))
-print("COLLECTIVES_OK")
-"""
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-@functools.lru_cache(maxsize=1)
-def _collectives_unavailable_reason():
-    """None when 2-process jax.distributed collectives work on this
-    backend; otherwise the specific failure (the skip reason)."""
-    if os.environ.get("PIO_TPU_RUN_MULTIHOST") == "1":
-        return None
-    coordinator = f"127.0.0.1:{_free_port()}"
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _COLLECTIVES_PROBE, coordinator,
-             str(p)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for p in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=120)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            return "2-process collectives probe timed out after 120s"
-        outs.append((p.returncode, out or ""))
-    if all(rc == 0 and "COLLECTIVES_OK" in out for rc, out in outs):
-        return None
-    bad = next((o for rc, o in outs if rc != 0), outs[0][1])
-    tail = bad.strip().splitlines()[-1][-300:] if bad.strip() else "?"
-    return (
-        "this jax backend cannot run multiprocess collectives "
-        f"(2-process broadcast probe failed: {tail}); the multihost "
-        "suite is environmental here — run it where collectives exist, "
-        "or force with PIO_TPU_RUN_MULTIHOST=1"
-    )
-
+# since PR 3 — red noise that buried real regressions.  The capability
+# probe, the coordinator rendezvous (worker 0 binds port 0 itself —
+# no parent-side free-port TOCTOU), and the worker launcher all live in
+# tools/multihost_harness.py now: the tests, the gate's verdict line,
+# and operators share ONE arbiter.  The probe verdict is cached on disk
+# per (interpreter, jaxlib), so collection stops spawning 2 processes
+# per pytest run; PIO_TPU_RUN_MULTIHOST=1 forces the tests to run and
+# PIO_TPU_REPROBE_MULTIHOST=1 refreshes the cached verdict.
 
 needs_collectives = pytest.mark.skipif(
-    _collectives_unavailable_reason() is not None,
-    reason=str(_collectives_unavailable_reason()),
+    collectives_unavailable_reason() is not None,
+    reason=str(collectives_unavailable_reason()),
 )
 
 
@@ -155,43 +101,19 @@ def test_shard_masks_partition_events(tmp_path):
 
 
 def _spawn_workers(nprocs, args_of, timeout=300, device_count=0):
-    """Launch nprocs worker processes; returns their loaded npz outputs.
-
-    ``device_count`` > 0 forces that many virtual CPU devices PER
-    process (mesh size = nprocs * device_count), exercising the
-    device→process mapping with more devices than processes."""
-    import os
-
-    env = {
-        **os.environ,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": (
-            f"--xla_force_host_platform_device_count={device_count}"
-            if device_count else ""
-        ),
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(WORKER)] + [str(a) for a in args_of(p)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True,
+    """Harness launch + the test-suite failure policy (pytest.fail on
+    timeout, hard assert on rc/marker)."""
+    results = spawn_workers(
+        nprocs, args_of, device_count=device_count, timeout=timeout,
+    )
+    for r in results:
+        if r.timed_out:
+            pytest.fail(f"worker {r.pid} timed out")
+        assert r.returncode == 0, (
+            f"worker {r.pid} rc={r.returncode}\n{r.stdout}\n{r.stderr}"
         )
-        for p in range(nprocs)
-    ]
-    results = []
-    for p, proc in enumerate(procs):
-        try:
-            stdout, stderr = proc.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"worker {p} timed out")
-        assert proc.returncode == 0, (
-            f"worker {p} rc={proc.returncode}\n{stdout}\n{stderr}"
-        )
-        assert f"WORKER_OK {p}" in stdout
-        results.append(stdout)
-    return results
+        assert f"WORKER_OK {r.pid}" in r.stdout
+    return [r.stdout for r in results]
 
 
 @needs_collectives
@@ -220,7 +142,7 @@ def test_multi_process_ingest_and_train(tmp_path, nprocs):
         expected, cfg=ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3)
     )
 
-    coordinator = f"127.0.0.1:{_free_port()}"
+    coordinator = tmp_path / "coord"
     exch = tmp_path / "exchange"
     outs = [tmp_path / f"out{p}.npz" for p in range(nprocs)]
     _spawn_workers(
@@ -268,35 +190,13 @@ def test_two_process_run_train_end_to_end(tmp_path):
         es.insert(e, app_id=app.id)
     st.close()
 
-    coordinator = f"127.0.0.1:{_free_port()}"
+    coordinator = tmp_path / "coord"
     outs = [tmp_path / f"train_out{p}.npz" for p in range(2)]
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, str(WORKER), str(p), "2", coordinator,
-                "-", "-", str(outs[p]), str(home),
-            ],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-        )
-        for p in range(2)
-    ]
-    results = []
-    for p, proc in enumerate(procs):
-        try:
-            stdout, stderr = proc.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail(f"worker {p} timed out")
-        assert proc.returncode == 0, (
-            f"worker {p} rc={proc.returncode}\n{stdout}\n{stderr}"
-        )
-        assert f"WORKER_OK {p}" in stdout
-        results.append(np.load(outs[p], allow_pickle=False))
+    _spawn_workers(
+        2,
+        lambda p: [p, 2, coordinator, "-", "-", outs[p], home],
+    )
+    results = [np.load(o, allow_pickle=False) for o in outs]
 
     # same instance, same model, same predictions on both processes
     assert results[0]["iid"][0] == results[1]["iid"][0]
@@ -353,7 +253,7 @@ def test_sharded_coo_distributed_trainer(tmp_path, nprocs, device_count):
     fresh = exch / "unrelated-fresh.npz"
     np.savez_compressed(fresh, ids=np.asarray(["KEEP"], dtype=str))
 
-    coordinator = f"127.0.0.1:{_free_port()}"
+    coordinator = tmp_path / "coord"
     outs = [tmp_path / f"sh{p}.npz" for p in range(nprocs)]
     _spawn_workers(
         nprocs,
@@ -423,7 +323,7 @@ def test_run_train_no_full_coo_end_to_end(tmp_path):
         expected, cfg=ALSConfig(rank=4, num_iterations=3, lam=0.1, seed=3)
     )
 
-    coordinator = f"127.0.0.1:{_free_port()}"
+    coordinator = tmp_path / "coord"
     outs = [tmp_path / f"local_out{p}.npz" for p in range(2)]
     _spawn_workers(
         2,
@@ -473,7 +373,7 @@ def test_sharded_distributed_trainer_fused_solver(tmp_path):
     )
     exch = tmp_path / "exchange"
     exch.mkdir()
-    coordinator = f"127.0.0.1:{_free_port()}"
+    coordinator = tmp_path / "coord"
     outs = [tmp_path / f"fu{p}.npz" for p in range(2)]
     _spawn_workers(
         2,

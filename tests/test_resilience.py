@@ -245,6 +245,81 @@ def test_fault_plan_rejects_garbage():
         faults.FaultPlan.parse("storage.write:exc=nope")
 
 
+def test_fault_plan_parse_rejects_duplicate_points():
+    """Two rules for one point silently kept only the LAST before the
+    pio-armor hardening; now the mistyped plan fails at parse."""
+    with pytest.raises(ValueError, match="duplicate"):
+        faults.FaultPlan.parse(
+            "storage.write:nth=1;storage.write:nth=3"
+        )
+
+
+def test_fault_plan_parse_rejects_nth_zero_and_negative():
+    """nth is 1-based ('first firing call'); 0 is always a typo that
+    would silently mean 1."""
+    with pytest.raises(ValueError, match="nth"):
+        faults.FaultPlan.parse("storage.write:nth=0")
+    with pytest.raises(ValueError, match="nth"):
+        faults.FaultPlan.parse("storage.write:nth=-2")
+    with pytest.raises(ValueError, match="times"):
+        faults.FaultPlan.parse("storage.write:times=0")
+    with pytest.raises(ValueError, match="shard"):
+        faults.FaultPlan.parse("dist.shard_drop:shard=-1")
+
+
+def test_fault_plan_parse_unknown_exception_name_fails_at_parse():
+    """An unknown exc name must fail when the plan is built, not when
+    the rule first fires mid-incident-reproduction."""
+    with pytest.raises(ValueError, match="unknown fault exception"):
+        faults.FaultPlan.parse("dist.exchange_torn:exc=segfault")
+
+
+def test_fault_plan_bare_point_is_default_rule():
+    """A bare point name ('dist.exchange_torn') arms an always-firing
+    default rule — the shorthand chaos recipes use."""
+    plan = faults.arm("dist.exchange_torn")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("dist.exchange_torn")
+    assert plan.counters()["dist.exchange_torn"]["fires"] == 1
+    faults.disarm()
+
+
+def test_fault_plan_counters_survive_disarm():
+    """counters() keeps answering on the plan OBJECT after disarm() —
+    the post-incident accounting a chaos test reads."""
+    plan = faults.arm("storage.write:times=1")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("storage.write")
+    faults.check("storage.write")
+    faults.disarm()
+    assert faults.armed() is None
+    assert plan.counters() == {
+        "storage.write": {"calls": 2, "fires": 1}
+    }
+    assert plan.log == [("storage.write", 1)]
+
+
+def test_fired_shard_returns_target_and_lag_with_wait_cap():
+    """fired_shard is the ask-and-degrade consultation: it returns
+    (shard, full lag) and sleeps at most the caller's hop budget."""
+    faults.arm("dist.shard_delay:shard=3,delay=5.0,times=1")
+    t0 = time.monotonic()
+    hit = faults.fired_shard("dist.shard_delay", max_wait=0.02)
+    waited = time.monotonic() - t0
+    assert hit == (3, 5.0)
+    assert waited < 1.0  # slept the cap, not the 5 s lag
+    assert faults.fired_shard("dist.shard_delay") is None  # exhausted
+    faults.disarm()
+    # no plan armed: one global load, no counting
+    assert faults.fired_shard("dist.shard_delay") is None
+
+
+def test_fired_shard_defaults_shard_zero():
+    faults.arm("dist.shard_drop:times=1")
+    assert faults.fired_shard("dist.shard_drop") == (0, 0.0)
+    faults.disarm()
+
+
 def test_no_plan_armed_is_noop():
     faults.disarm()
     for p in faults.POINTS:

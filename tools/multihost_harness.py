@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""Multi-host launch harness: real processes where collectives exist,
+a simulated in-process cluster where they don't.
+
+Three PRs of history motivated this file: the 7 ``tests/test_multihost.py``
+cases spawn real ``jax.distributed`` CPU processes, and on jaxlib builds
+whose CPU backend refuses multiprocess collectives they failed (PR 3-5)
+then skipped (PR 6+) ENVIRONMENTALLY — the distributed path was certified
+nowhere.  This harness is the single arbiter both the tests and operators
+use:
+
+* :func:`collectives_unavailable_reason` — the capability probe, run at
+  most once per (interpreter, jaxlib) and CACHED ON DISK, so repeated
+  pytest collections stop paying two process spawns each.  The verdict
+  (and the exact backend error when negative) is printable from the CLI
+  (``--probe``) and is surfaced by ``tools/gate.sh`` so skip-vs-run is
+  visible in CI logs instead of silent.
+* :func:`spawn_workers` — the one process launcher every multihost test
+  rides (replacing per-test private spawn code).  The coordinator port
+  is bound to **port 0 inside worker 0** and published through a
+  coordination directory (:func:`resolve_coordinator`) — the parent
+  never picks a port, which kills the ``_free_port()`` TOCTOU race two
+  concurrent collections used to lose.
+* ``--demo`` — the zero-to-aha run: where collectives exist it launches
+  N real processes through the same path the tests use; where they
+  don't it REPORTS THE REASON and runs the simulated cluster instead
+  (in-process virtual devices via ``XLA_FLAGS=
+  --xla_force_host_platform_device_count=N``), driving a coded-shard
+  chaos train (straggler + dead worker under a deterministic
+  ``PIO_FAULT_PLAN``) so the parity/deadline logic is exercised on
+  every box, not just on silicon.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+__all__ = [
+    "collectives_unavailable_reason",
+    "resolve_coordinator",
+    "spawn_workers",
+    "simulated_cluster_demo",
+    "WorkerResult",
+]
+
+
+# -- coordinator rendezvous -------------------------------------------------
+
+_COORD_FILE = "coordinator_addr"
+
+
+def resolve_coordinator(coord_dir, pid: int, nprocs: int,
+                        timeout: float = 60.0) -> str:
+    """The coordinator address for worker ``pid``, rendezvoused through
+    ``coord_dir``.
+
+    Worker 0 binds port 0 at the LAST moment (the kernel hands out a
+    port no one else holds), publishes ``host:port`` atomically, and
+    initializes the coordinator on it immediately; other workers poll
+    the file.  Unlike a parent-side free-port scan, two concurrent
+    harness runs can never be handed the same port — each run's worker 0
+    owns its own bind."""
+    coord_dir = Path(coord_dir)
+    coord_dir.mkdir(parents=True, exist_ok=True)
+    path = coord_dir / _COORD_FILE
+    if pid == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        addr = f"127.0.0.1:{port}"
+        tmp = coord_dir / f"{_COORD_FILE}.tmp"
+        tmp.write_text(addr)
+        tmp.rename(path)  # atomic publish
+        return addr
+    deadline = time.time() + timeout
+    while not path.exists():
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"coordinator address not published in {coord_dir} "
+                f"within {timeout}s"
+            )
+        time.sleep(0.05)
+    return path.read_text().strip()
+
+
+# -- capability probe -------------------------------------------------------
+
+# the minimal 2-process broadcast — the exact op the workers die on
+# when the CPU backend lacks multiprocess collectives
+_PROBE_SRC = """
+import sys
+sys.path.insert(0, {root!r})
+from tools.multihost_harness import resolve_coordinator
+pid = int(sys.argv[2])
+coordinator = resolve_coordinator(sys.argv[1], pid, 2)
+import jax
+jax.distributed.initialize(coordinator, num_processes=2, process_id=pid)
+import numpy as np
+from jax.experimental import multihost_utils
+multihost_utils.broadcast_one_to_all(np.ones(1))
+print("COLLECTIVES_OK")
+"""
+
+
+def _probe_cache_path() -> Path:
+    """Per-(interpreter, jaxlib) on-disk verdict so repeated pytest
+    collections in one environment stop re-spawning the probe."""
+    try:
+        import jaxlib
+
+        ver = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover — no jax at all
+        ver = "nojax"
+    key = hashlib.sha256(
+        f"{sys.executable}:{ver}".encode()
+    ).hexdigest()[:16]
+    return Path(tempfile.gettempdir()) / f"pio_tpu_collectives_{key}.json"
+
+
+def _run_probe(timeout: float = 120.0) -> Optional[str]:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""}
+    with tempfile.TemporaryDirectory(prefix="pio-coord-") as coord:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c",
+                 _PROBE_SRC.format(root=str(REPO_ROOT)), coord, str(p)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            for p in range(2)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                return (
+                    f"2-process collectives probe timed out after "
+                    f"{timeout:.0f}s"
+                )
+            outs.append((p.returncode, out or ""))
+    if all(rc == 0 and "COLLECTIVES_OK" in out for rc, out in outs):
+        return None
+    bad = next((o for rc, o in outs if rc != 0), outs[0][1])
+    tail = bad.strip().splitlines()[-1][-300:] if bad.strip() else "?"
+    return (
+        "this jax backend cannot run multiprocess collectives "
+        f"(2-process broadcast probe failed: {tail}); the multihost "
+        "suite is environmental here — run it where collectives exist, "
+        "or force with PIO_TPU_RUN_MULTIHOST=1"
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def collectives_unavailable_reason() -> Optional[str]:
+    """None when 2-process ``jax.distributed`` collectives work on this
+    backend; otherwise the specific failure (the skip reason).
+
+    Cached twice: in-process (lru_cache) AND on disk per
+    (interpreter, jaxlib) — a fresh pytest collection reads the disk
+    verdict in microseconds instead of spawning two probe processes.
+    ``PIO_TPU_RUN_MULTIHOST=1`` forces "available" (re-confirm a
+    failure mode / exercise a candidate jaxlib);
+    ``PIO_TPU_REPROBE_MULTIHOST=1`` drops the disk cache first."""
+    if os.environ.get("PIO_TPU_RUN_MULTIHOST") == "1":
+        return None
+    cache = _probe_cache_path()
+    if os.environ.get("PIO_TPU_REPROBE_MULTIHOST") == "1":
+        cache.unlink(missing_ok=True)
+    try:
+        verdict = json.loads(cache.read_text())
+        return verdict["reason"]
+    except (OSError, ValueError, KeyError):
+        pass
+    reason = _run_probe()
+    try:
+        tmp = cache.with_suffix(".tmp")
+        tmp.write_text(json.dumps({"reason": reason}))
+        tmp.rename(cache)
+    except OSError:  # pragma: no cover — read-only tmpdir
+        pass
+    return reason
+
+
+# -- worker launch ----------------------------------------------------------
+
+
+@dataclass
+class WorkerResult:
+    pid: int
+    returncode: Optional[int]
+    stdout: str
+    stderr: str
+    timed_out: bool = False
+
+    @property
+    def ok(self) -> bool:
+        return (
+            not self.timed_out
+            and self.returncode == 0
+            and f"WORKER_OK {self.pid}" in self.stdout
+        )
+
+
+def spawn_workers(
+    nprocs: int,
+    argv_of: Callable[[int], Sequence],
+    *,
+    worker: Optional[Path] = None,
+    device_count: int = 0,
+    timeout: float = 300.0,
+    env_extra: Optional[dict] = None,
+) -> list[WorkerResult]:
+    """Launch ``nprocs`` worker processes and collect their outcomes.
+
+    ``argv_of(pid)`` returns the worker's argv tail (stringified).
+    ``device_count`` > 0 forces that many virtual CPU devices PER
+    process (mesh size = nprocs * device_count), exercising the
+    device→process mapping with more devices than processes.  On a
+    timeout every worker is killed and the timed-out result marked —
+    callers decide whether that's a failure (tests) or a report
+    (operators).  Workers print ``WORKER_OK <pid>`` on success; the
+    :attr:`WorkerResult.ok` property checks rc + marker."""
+    worker = Path(worker) if worker else (
+        REPO_ROOT / "tests" / "_multihost_worker.py"
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            f"--xla_force_host_platform_device_count={device_count}"
+            if device_count else ""
+        ),
+        **(env_extra or {}),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker)] + [str(a) for a in argv_of(p)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        for p in range(nprocs)
+    ]
+    results: list[WorkerResult] = []
+    for p, proc in enumerate(procs):
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+            results.append(
+                WorkerResult(p, proc.returncode, stdout or "", stderr or "")
+            )
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            results.append(WorkerResult(p, None, "", "", timed_out=True))
+    return results
+
+
+# -- simulated-cluster fallback demo ---------------------------------------
+
+
+def simulated_cluster_demo(n_devices: int = 4) -> dict:
+    """The in-process fallback: a coded-shard chaos train on a virtual
+    CPU mesh — straggler then dead worker under a deterministic fault
+    plan, RMSE checked against the clean sweep.  Runs in a SUBPROCESS so
+    the virtual device count applies regardless of the caller's jax
+    state."""
+    src = f"""
+import json, sys
+sys.path.insert(0, {str(REPO_ROOT)!r})
+import numpy as np
+from predictionio_tpu.models.als import ALSConfig, ALSTrainer, rmse, train_als
+from predictionio_tpu.parallel import make_mesh
+from predictionio_tpu.resilience import faults
+
+rng = np.random.default_rng(0)
+n_u, n_i, nnz = 60, 40, 900
+u = rng.integers(0, n_u, nnz).astype(np.int32)
+i = rng.integers(0, n_i, nnz).astype(np.int32)
+v = rng.integers(1, 6, nnz).astype(np.float32)
+base = dict(rank=4, num_iterations=8, lam=0.1, seed=3)
+clean = rmse(train_als((u, i, v), n_u, n_i, ALSConfig(**base)), u, i, v)
+mesh = make_mesh()
+cfg = ALSConfig(**base, factor_placement="sharded", coded_shards=True)
+out = {{"devices": mesh.size, "clean_rmse": clean, "scenarios": {{}}}}
+for name, plan in (
+    ("straggler", "dist.shard_delay:nth=7,times=1,shard=2,delay=0.05"),
+    ("dead_worker", "dist.worker_kill:nth=15,shard=1"),
+):
+    faults.arm(plan)
+    tr = ALSTrainer((u, i, v), n_u, n_i, cfg, mesh=mesh)
+    r = rmse(tr.train(), u, i, v)
+    faults.disarm()
+    out["scenarios"][name] = {{
+        "plan": plan, "rmse": r, "rmse_ratio": r / clean,
+        "health": tr.shard_health.summary(),
+    }}
+print("SIM_DEMO " + json.dumps(out))
+"""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={n_devices}",
+    }
+    proc = subprocess.run(
+        [sys.executable, "-c", src], env=env, capture_output=True,
+        text=True, timeout=600,
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("SIM_DEMO "):
+            return json.loads(line[len("SIM_DEMO "):])
+    raise RuntimeError(
+        f"simulated-cluster demo failed (rc={proc.returncode}):\n"
+        f"{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def _make_demo_db(path: Path):
+    """Scratch sqlite event store for the real-process demo (the same
+    synthetic shape the multihost tests read)."""
+    import datetime as dt
+
+    import numpy as np
+
+    from predictionio_tpu.storage.event import DataMap, Event
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+
+    rng = np.random.default_rng(0)
+    es = SQLiteEventStore(path)
+    es.init_channel(1)
+    utc = dt.timezone.utc
+    for u in range(12):
+        for i in range(8):
+            if rng.random() < 0.5:
+                es.insert(
+                    Event(
+                        event="rate",
+                        entity_type="user",
+                        entity_id=f"u{u}",
+                        target_entity_type="item",
+                        target_entity_id=f"i{i}",
+                        properties=DataMap(
+                            {"rating": float(rng.integers(1, 6))}
+                        ),
+                        event_time=dt.datetime(2020, 1, 1, tzinfo=utc),
+                    ),
+                    app_id=1,
+                )
+    es.close()
+    return path
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--probe", action="store_true",
+                    help="print the collectives capability verdict")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the multi-process demo (real processes "
+                         "when collectives exist, simulated otherwise)")
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=4,
+                    help="virtual device count of the simulated fallback")
+    args = ap.parse_args(argv)
+
+    reason = collectives_unavailable_reason()
+    verdict = {
+        "collectives": reason is None,
+        "reason": reason,
+        "cache": str(_probe_cache_path()),
+    }
+    if args.probe or not args.demo:
+        print(json.dumps(verdict, indent=2))
+        return 0
+
+    if reason is None:
+        import tempfile as _tf
+
+        with _tf.TemporaryDirectory(prefix="pio-mh-demo-") as td:
+            td = Path(td)
+            coord = td / "coord"
+            # the ingest-and-train worker path over a scratch store
+            sys.path.insert(0, str(REPO_ROOT))
+            db = _make_demo_db(td / "events.db")
+            outs = [td / f"out{p}.npz" for p in range(args.nprocs)]
+            results = spawn_workers(
+                args.nprocs,
+                lambda p: [p, args.nprocs, coord, db, td / "exch",
+                           outs[p]],
+            )
+            ok = all(r.ok for r in results)
+            print(json.dumps({
+                **verdict, "mode": "real-processes",
+                "nprocs": args.nprocs, "ok": ok,
+                "workers": [
+                    {"pid": r.pid, "rc": r.returncode,
+                     "timed_out": r.timed_out}
+                    for r in results
+                ],
+            }, indent=2))
+            return 0 if ok else 1
+
+    print(f"# collectives unavailable -> simulated cluster "
+          f"({args.devices} virtual devices)\n# reason: {reason}",
+          file=sys.stderr)
+    demo = simulated_cluster_demo(args.devices)
+    bounded = all(
+        s["rmse_ratio"] <= 1.01 for s in demo["scenarios"].values()
+    )
+    print(json.dumps({
+        **verdict, "mode": "simulated-cluster", **demo,
+        "rmse_within_1pct": bounded,
+    }, indent=2))
+    return 0 if bounded else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -825,15 +825,19 @@ def run_inner(args) -> None:
     # can never masquerade as a fused measurement
     fused_gather_used = getattr(warm, "fused_gather", None)
     del warm, wU, wV
-    # the timed train is fence-free by design (per-step host round trips
-    # would pollute the measurement), so it is one long silent stretch:
-    # declare its budget instead of emitting heartbeats
+    # the timed train has no bench-side fences; since pio-tower the
+    # sweep loop itself fences once per half (always-on sweep
+    # telemetry — A/B'd within run noise on this bench), so dt is a
+    # sequence of device-complete sweeps, not one long dispatch.  It is
+    # still one long silent stretch host-side: declare its budget
+    # instead of emitting heartbeats
     print("# warm iteration done (compiles cached); timed train starts "
           "next-phase-budget=600", file=sys.stderr, flush=True)
 
     # timed: full train — staging + 20 iterations (compiles now cached).
-    # trainer.run() ends with a fence (tiny d2h), so dt includes the full
-    # device execution, not just dispatch — see parallel/mesh.py fence.
+    # trainer.run() fences per half and at the end (tiny d2h), so dt
+    # includes the full device execution, not just dispatch — see
+    # parallel/mesh.py fence.
     t0 = time.time()
     trainer = ALSTrainer((u, i, v), n_users, n_items, cfg, mesh=mesh,
                          staging=args.staging)

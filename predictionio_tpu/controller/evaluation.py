@@ -157,6 +157,15 @@ def _engine_params_json(ep: EngineParams) -> dict:
     }
 
 
+def _json_safe_score(score):
+    """Manifest records are JSON lines; scores are usually floats but
+    custom metrics may return anything comparable."""
+    try:
+        return float(score)
+    except (TypeError, ValueError):
+        return repr(score)
+
+
 class MetricEvaluator:
     """Scores every candidate, argmax by ``metric.compare``
     (reference `MetricEvaluator.scala:177-221`)."""
@@ -172,14 +181,26 @@ class MetricEvaluator:
         self.output_path = output_path
 
     def _score_one(self, ctx, engine, ep, workflow_params, ix, total):
-        from ..obs import phase_span
+        import time as _time
 
+        from ..obs import phase_span, tower
+
+        t0 = _time.perf_counter()
         with phase_span("eval.sweep", attrs={"candidate": ix}):
             eval_out = engine.eval(ctx, ep, workflow_params)
             score = self.metric.calculate(ctx, eval_out)
             other = [
                 m.calculate(ctx, eval_out) for m in self.other_metrics
             ]
+        # pio-tower: an eval run's manifest appends one candidate
+        # record per scored candidate — the sweep is replayable from
+        # disk the way a training run's sweeps are
+        tower.record_candidate(
+            ix,
+            score=_json_safe_score(score),
+            metric=self.metric.header,
+            seconds=round(_time.perf_counter() - t0, 6),
+        )
         # streamed from here so the parallel sweep shows live progress too
         logger.info(
             "MetricEvaluator: candidate %d/%d -> %s = %s",

@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import functools
 import logging
+import math
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -47,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..obs import xray
+from ..obs import TRAIN_PHASE_SECONDS, tower, xray
 from ..parallel.mesh import DATA_AXIS, fence, pad_to_multiple, replicated
 from ..storage.columnar import Ratings
 
@@ -206,6 +208,10 @@ class ALSConfig:
                 f"factor_placement must be 'replicated' or 'sharded', "
                 f"got {self.factor_placement!r}"
             )
+        if self.loss_every is not None and self.loss_every < 0:
+            raise ValueError(
+                f"loss_every must be >= 0, got {self.loss_every}"
+            )
         if self.coded_shards:
             if self.factor_placement != "sharded":
                 raise ValueError(
@@ -241,6 +247,14 @@ class ALSConfig:
     # fault-flagged straggler degrades immediately (the deterministic
     # default the chaos suite pins)
     shard_hop_budget_s: float = 0.0
+    # pio-tower sweep-loss cadence: compute training RMSE every N
+    # sweeps over a seeded subsample of at most
+    # ALSTrainer.LOSS_SAMPLE_MAX triples (one cached _sq_err_sum
+    # dispatch — cost bounded at any scale; exact RMSE when the
+    # dataset fits the cap).  0 disables; None = auto (every sweep).
+    # The convergence watchdog's divergence check needs the loss; its
+    # NaN check does not
+    loss_every: Optional[int] = None
 
 
 @dataclass
@@ -1312,6 +1326,66 @@ class ALSTrainer:
                 )
         if self.sharded:
             self._build_sharded_halves()
+        self._init_loss(u, i, v)
+
+    # per-sweep loss sample cap: the watchdog needs a *trajectory*, not
+    # the exact training RMSE, so the loss pass runs over a fixed
+    # seeded subsample of at most this many triples — its cost is
+    # BOUNDED regardless of dataset scale (a full-COO pass was a
+    # measured ~11% tax on the scale-0.02 CPU train; 64Ki samples put
+    # the same trajectory at ~2%).  nnz <= the cap means the loss is
+    # the exact training RMSE.
+    LOSS_SAMPLE_MAX = 1 << 16
+
+    def _init_loss(self, u, i, v) -> None:
+        """Stage the (sub)sampled COO triples for the per-sweep loss
+        pass (pio-tower).  ``cfg.loss_every`` None = auto: every sweep
+        (the sample cap keeps it cheap at any scale)."""
+        every = self.cfg.loss_every
+        if every is None:
+            every = 1
+        self.loss_every = every
+        if not every or len(v) == 0:
+            self._loss_coo = None
+            self.loss_sample_n = 0
+        elif len(v) > self.LOSS_SAMPLE_MAX:
+            pick = np.random.default_rng(self.cfg.seed).choice(
+                len(v), size=self.LOSS_SAMPLE_MAX, replace=False,
+            )
+            pick.sort()
+            self._loss_coo = (
+                np.ascontiguousarray(np.asarray(u)[pick]),
+                np.ascontiguousarray(np.asarray(i)[pick]),
+                np.ascontiguousarray(
+                    np.asarray(v)[pick].astype(np.float32)),
+            )
+            self.loss_sample_n = int(self.LOSS_SAMPLE_MAX)
+        else:
+            self._loss_coo = (
+                np.asarray(u), np.asarray(i),
+                np.asarray(v).astype(np.float32),
+            )
+            self.loss_sample_n = int(len(v))
+        self._loss_dev = None  # device copies, staged on first use
+
+    def sweep_loss(self, U, V) -> Optional[float]:
+        """Per-sweep training RMSE over the retained (sub)sampled COO
+        (``_sq_err_sum`` — same math as :func:`rmse`; exact when the
+        dataset fits :attr:`LOSS_SAMPLE_MAX`).  The sample is staged to
+        the device ONCE and reused every sweep."""
+        if self._loss_coo is None:
+            return None
+        if self._loss_dev is None:
+            u, i, v = self._loss_coo
+            if self.mesh is not None:
+                put = lambda x: jax.device_put(  # noqa: E731
+                    x, replicated(self.mesh))
+            else:
+                put = jnp.asarray
+            self._loss_dev = (put(u), put(i), put(v))
+        ud, idv, vd = self._loss_dev
+        n = self.loss_sample_n
+        return math.sqrt(float(_sq_err_sum(U, V, ud, idv, vd)) / n)
 
     def _build_sharded_halves(self) -> None:
         cfg = self.cfg
@@ -1433,6 +1507,12 @@ class ALSTrainer:
             n_dev, device_proc, exchange_dir, f"{tag}-item", timeout,
         )
         self._build_sharded_halves()
+        # distributed staging holds only LOCAL triples; a global
+        # training loss is not computable from one process
+        self.loss_every = 0
+        self._loss_coo = None
+        self._loss_dev = None
+        self.loss_sample_n = 0
         return self
 
     def _stage_side_distributed(
@@ -1798,7 +1878,8 @@ class ALSTrainer:
         )
 
     def _traced_half(self, upd, opp, side, side_name: str, it: int,
-                     lam: Optional[float]) -> jax.Array:
+                     lam: Optional[float],
+                     collect: Optional[dict] = None) -> jax.Array:
         """One half-iteration with pio-obs phase spans (als.gather /
         als.gram / als.solve), attributed by the fence-probe subtraction
         idiom: time the gather-only truncation, the gather+Gram
@@ -1806,10 +1887,12 @@ class ALSTrainer:
         per-phase device times (ALX §5: per-phase timing is what makes
         TPU factorization tunable).  Sharded placement has no probe
         entry point — it records the fenced full half as ``als.half``.
-        """
-        import time
 
-        from ..obs import TRAIN_PHASE_SECONDS, get_tracer
+        ``collect`` (pio-tower) accumulates the emitted phase times as
+        side-qualified keys (``user.gather`` ...) for the run
+        manifest's sweep record.
+        """
+        from ..obs import get_tracer
 
         tracer = get_tracer()
         attrs = {"side": side_name, "iteration": it}
@@ -1825,6 +1908,9 @@ class ALSTrainer:
         def emit(phase: str, dt: float) -> None:
             tracer.record(phase, dt, attrs=attrs)
             TRAIN_PHASE_SECONDS.labels(phase=phase).observe(dt)
+            if collect is not None:
+                key = f"{side_name}.{phase.rsplit('.', 1)[-1]}"
+                collect[key] = collect.get(key, 0.0) + dt
 
         if self.sharded:
             new, t_full = timed(
@@ -1882,6 +1968,8 @@ class ALSTrainer:
         and the staged (possibly sharded) COO — the sweep path for
         problems too big for the vmapped ``sweep_train_als``.
         """
+        from ..resilience import faults
+
         U = jnp.array(U, copy=True)
         V = jnp.array(V, copy=True)
         if self.coded:
@@ -1889,16 +1977,64 @@ class ALSTrainer:
             # recompute lazily from THESE tables on first use
             self._parity_state = {}
         trace_phases = _als_phase_trace_enabled()
+        session = tower.active_session()
+        if session is not None:
+            # the workflow layer opened the session without knowing the
+            # algorithm's iteration budget; declare it for the ETA
+            session.set_sweeps_planned(self.cfg.num_iterations)
         for it in range(num_iterations):
+            t_sweep = time.perf_counter()
+            phases: dict[str, float] = {}
             if trace_phases:
+                # half-iteration granularity (fence-probe subtraction):
+                # opt-in via PIO_TPU_TRACE_ALS=1 — the probes re-run
+                # truncated halves, overhead the always-on path refuses
                 U = self._traced_half(U, V, self._user_side, "user", it,
-                                      lam)
+                                      lam, collect=phases)
                 V = self._traced_half(V, U, self._item_side, "item", it,
-                                      lam)
+                                      lam, collect=phases)
             else:
+                # always-on sweep telemetry: one fence per half gives
+                # the user/item split with zero extra device work (the
+                # halves are data-dependent, so the device pipeline
+                # loses nothing; only host dispatch-ahead is traded)
+                t0 = time.perf_counter()
                 U = self._half(U, V, self._user_side, lam=lam)
+                fence(U)
+                phases["user_half"] = time.perf_counter() - t0
+                t0 = time.perf_counter()
                 V = self._half(V, U, self._item_side, lam=lam)
-            logger.debug("ALS iteration %d/%d dispatched", it + 1,
+                fence(V)
+                phases["item_half"] = time.perf_counter() - t0
+                TRAIN_PHASE_SECONDS.labels(phase="als.user_half").observe(
+                    phases["user_half"]
+                )
+                TRAIN_PHASE_SECONDS.labels(phase="als.item_half").observe(
+                    phases["item_half"]
+                )
+            if faults.fired("train.nan"):
+                # poison the iterates the way an exploding sweep would;
+                # the convergence watchdog must catch it THIS sweep
+                U = U * jnp.asarray(float("nan"), U.dtype)
+            loss = None
+            if self.loss_every and (it + 1) % self.loss_every == 0:
+                t0 = time.perf_counter()
+                loss = self.sweep_loss(U, V)
+                if loss is not None:
+                    phases["loss"] = time.perf_counter() - t0
+            finite = True
+            if session is not None and session.wants_finite_check():
+                t0 = time.perf_counter()
+                finite = bool(_finite_all(U, V))
+                phases["check"] = time.perf_counter() - t0
+            # may raise tower.ConvergenceError — the typed watchdog
+            # abort propagates out of the training run with the
+            # manifest already finalized
+            tower.record_sweep(
+                time.perf_counter() - t_sweep, phases,
+                loss=loss, factors_finite=finite, source=id(self),
+            )
+            logger.debug("ALS iteration %d/%d complete", it + 1,
                          num_iterations)
         # fence, not block_until_ready: the latter is a no-op on some
         # remote-tunnel backends (parallel/mesh.py fence docstring), which
@@ -2060,6 +2196,13 @@ def sweep_train_als(
 # --------------------------------------------------------------------------
 # Quality metrics
 # --------------------------------------------------------------------------
+
+
+@jax.jit
+def _finite_all(U, V):
+    """Watchdog NaN/Inf sentinel: one bandwidth-bound reduction over
+    both factor tables (noise next to a half-iteration's Gram work)."""
+    return jnp.isfinite(U).all() & jnp.isfinite(V).all()
 
 
 @xray.instrument("als.sq_err_sum")

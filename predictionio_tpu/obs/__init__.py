@@ -126,7 +126,26 @@ def configure(journal_dir: Optional[os.PathLike | str] = None,
         set_metrics_enabled(metrics)
 
 
+_cluster_renderer = None
+
+
+def set_cluster_renderer(fn) -> None:
+    """Install (or clear, with ``None``) a callable that renders the
+    CLUSTER-merged exposition in place of the local registry's.  Set by
+    a pio-tower chief session during a multi-worker training run so
+    worker 0's ``/metrics`` shows cluster-wide sums while the run is
+    live; local recording is untouched."""
+    global _cluster_renderer
+    _cluster_renderer = fn
+
+
 def render_prometheus() -> str:
+    fn = _cluster_renderer
+    if fn is not None:
+        try:
+            return fn()
+        except Exception:
+            pass  # a broken merge must not 500 /metrics
     return _registry.render_prometheus()
 
 
@@ -275,7 +294,15 @@ def phase_span(name: str, attrs: Optional[dict] = None) -> Iterator[dict]:
 # ``from . import ...`` and register their metric families at import,
 # so every process's first scrape carries the full schema.  None
 # imports jax at module level — obs stays jax-free.
-from . import timeline, xray  # noqa: E402
+from . import runlog, timeline, tower, xray  # noqa: E402
 from .flight import FlightRecorder, get_flight_recorder  # noqa: E402
 
-__all__ += ["FlightRecorder", "get_flight_recorder", "timeline", "xray"]
+__all__ += [
+    "FlightRecorder",
+    "get_flight_recorder",
+    "runlog",
+    "set_cluster_renderer",
+    "timeline",
+    "tower",
+    "xray",
+]

@@ -43,6 +43,8 @@ __all__ = [
     "MetricsRegistry",
     "default_latency_buckets",
     "log_buckets",
+    "merge_states",
+    "render_state",
 ]
 
 _N_SHARDS = 8  # power of two; thread-ident hash distributes across these
@@ -118,6 +120,10 @@ class Counter:
     def samples(self, name: str, labels: tuple) -> list:
         return [(name, labels, self.value())]
 
+    def state(self) -> dict:
+        """Serializable snapshot for the cluster aggregator."""
+        return {"value": self.value()}
+
 
 class Gauge:
     """Set-anywhere value, or a callback read at scrape time.
@@ -163,6 +169,9 @@ class Gauge:
 
     def samples(self, name: str, labels: tuple) -> list:
         return [(name, labels, self.value())]
+
+    def state(self) -> dict:
+        return {"value": self.value()}
 
 
 class Histogram:
@@ -288,6 +297,16 @@ class Histogram:
         out.append((name + "_sum", labels, snap["sum"]))
         out.append((name + "_count", labels, snap["count"]))
         return out
+
+    def state(self) -> dict:
+        snap = self.snapshot()
+        return {"hist": {
+            "bounds": list(self.bounds),
+            "counts": list(snap["counts"]),
+            "sum": snap["sum"],
+            "count": snap["count"],
+            "exemplars": [list(t) for t in self.exemplar_items()],
+        }}
 
 
 def _fmt_float(v: float) -> str:
@@ -425,46 +444,204 @@ class MetricsRegistry:
             out += fam.collect()
         return out
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
-        lines = []
+    def dump_state(self) -> dict:
+        """JSON-serializable snapshot of the WHOLE registry — the
+        per-worker delta the pio-tower cluster aggregator ships through
+        the coordination dir each sweep (values are cumulative, so a
+        re-read of the newest file always supersedes older ones and a
+        worker that dies mid-run leaves its last snapshot standing)."""
+        fams = []
         for fam in self.families():
-            if fam.help_text:
-                lines.append(f"# HELP {fam.name} "
-                             + fam.help_text.replace("\n", " "))
-            lines.append(f"# TYPE {fam.name} {fam.kind}")
-            for name, label_items, value in fam.collect():
-                if label_items:
-                    lbl = ",".join(
-                        f'{k}="{_escape_label(v)}"' for k, v in label_items
+            fams.append({
+                "name": fam.name,
+                "help": fam.help_text,
+                "kind": fam.kind,
+                "labelNames": list(fam.label_names),
+                "children": [
+                    {"labels": [list(kv) for kv in key], **child.state()}
+                    for key, child in fam.children()
+                ],
+            })
+        return {"families": fams}
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4.
+
+        Rendering goes through :func:`render_state` so a merged
+        multi-worker state (pio-tower) and a live registry produce
+        byte-identical text for identical contents — the golden-merge
+        test depends on there being exactly ONE renderer."""
+        return render_state(self.dump_state())
+
+
+# -- state rendering + cluster merge (pio-tower) ----------------------------
+
+
+def render_state(state: dict) -> str:
+    """Prometheus text format 0.0.4 for a :meth:`MetricsRegistry.
+    dump_state` snapshot (or a :func:`merge_states` result)."""
+    lines = []
+    for fam in sorted(state["families"], key=lambda f: f["name"]):
+        if fam["help"]:
+            lines.append(f"# HELP {fam['name']} "
+                         + fam["help"].replace("\n", " "))
+        lines.append(f"# TYPE {fam['name']} {fam['kind']}")
+        children = sorted(
+            fam["children"], key=lambda c: [tuple(kv) for kv in c["labels"]]
+        )
+        for child in children:
+            labels = tuple(tuple(kv) for kv in child["labels"])
+            hist = child.get("hist")
+            if hist is None:
+                lines.append(_sample_line(
+                    fam["name"], labels, child["value"]
+                ))
+                continue
+            cum = 0
+            for bound, c in zip(hist["bounds"], hist["counts"]):
+                cum += c
+                lines.append(_sample_line(
+                    fam["name"] + "_bucket",
+                    labels + (("le", _fmt_float(bound)),), cum,
+                ))
+            lines.append(_sample_line(
+                fam["name"] + "_bucket", labels + (("le", "+Inf"),),
+                hist["count"],
+            ))
+            lines.append(_sample_line(
+                fam["name"] + "_sum", labels, hist["sum"]
+            ))
+            lines.append(_sample_line(
+                fam["name"] + "_count", labels, hist["count"]
+            ))
+        if fam["kind"] == "histogram":
+            for child in children:
+                hist = child.get("hist") or {}
+                base = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in (tuple(kv) for kv in child["labels"])
+                )
+                # ``# EXEMPLAR`` comment lines: legal-by-construction
+                # in text format 0.0.4 (parsers skip comments), yet a
+                # ``grep t-xxxx`` on a scrape finds the trace id a slow
+                # bucket points at
+                for le, ex, v, _ts in hist.get("exemplars", ()):
+                    lbl = (base + "," if base else "") + f'le="{le}"'
+                    lines.append(
+                        f"# EXEMPLAR {fam['name']}_bucket{{{lbl}}} "
+                        f'trace_id="{_escape_label(str(ex))}" '
+                        f"value={_fmt_value(v)}"
                     )
-                    lines.append(f"{name}{{{lbl}}} {_fmt_value(value)}")
-                else:
-                    lines.append(f"{name} {_fmt_value(value)}")
-            if fam.kind == "histogram":
-                lines += _exemplar_lines(fam)
-        return "\n".join(lines) + "\n"
+    return "\n".join(lines) + "\n"
 
 
-def _exemplar_lines(fam: _Family) -> list:
-    """``# EXEMPLAR`` comment lines for a histogram family's bucket
-    exemplars: legal-by-construction in text format 0.0.4 (parsers skip
-    comments), yet a ``grep t-xxxx /metrics-scrape`` finds the trace id
-    that a slow bucket points at — the /metrics -> journal -> flight
-    record walk is one grep."""
-    out = []
-    for label_items, child in fam.children():
-        items = getattr(child, "exemplar_items", None)
-        if items is None:
-            continue
-        base = ",".join(
+def _sample_line(name: str, label_items: tuple, value) -> str:
+    if label_items:
+        lbl = ",".join(
             f'{k}="{_escape_label(v)}"' for k, v in label_items
         )
-        for le, ex, v, _ts in items():
-            lbl = (base + "," if base else "") + f'le="{le}"'
-            out.append(
-                f"# EXEMPLAR {fam.name}_bucket{{{lbl}}} "
-                f'trace_id="{_escape_label(str(ex))}" '
-                f"value={_fmt_value(v)}"
-            )
-    return out
+        return f"{name}{{{lbl}}} {_fmt_value(value)}"
+    return f"{name} {_fmt_value(value)}"
+
+
+def merge_states(tagged: Sequence[tuple]) -> dict:
+    """Merge per-worker registry snapshots into one cluster state.
+
+    ``tagged`` is ``[(worker_id, state), ...]``.  Merge semantics (the
+    table in docs/ARCHITECTURE.md "Tower"):
+
+    * **counters** sum exactly across workers (same labels = one
+      child);
+    * **histograms** add bucket-wise: identical bucket ladders are
+      required (the eager family catalog guarantees it), counts merge
+      elementwise, sum/count add — so percentile re-derivation on the
+      merged exposition is exact bucket arithmetic over the union of
+      observations, byte-identical to a single process that saw them
+      all; per-bucket exemplars keep the newest timestamp;
+    * **gauges** are NOT summable (a per-worker queue depth summed is
+      a lie); every gauge child instead gains a ``worker`` label so
+      the cluster view shows each worker's value side by side.
+
+    A kind/label/bucket mismatch raises ``ValueError`` — that is a
+    schema drift bug, not a collision to paper over.
+    """
+    fams: dict[str, dict] = {}
+    for worker, state in tagged:
+        for fam in state["families"]:
+            name = fam["name"]
+            mine = fams.get(name)
+            if mine is None:
+                mine = {
+                    "name": name,
+                    "help": fam["help"],
+                    "kind": fam["kind"],
+                    "labelNames": list(fam["labelNames"]),
+                    "children": {},
+                }
+                if fam["kind"] == "gauge":
+                    mine["labelNames"] = mine["labelNames"] + ["worker"]
+                fams[name] = mine
+            elif mine["kind"] != fam["kind"]:
+                raise ValueError(
+                    f"metric {name!r}: kind mismatch across workers "
+                    f"({mine['kind']} vs {fam['kind']})"
+                )
+            for child in fam["children"]:
+                labels = tuple(tuple(kv) for kv in child["labels"])
+                if fam["kind"] == "gauge":
+                    labels = labels + (("worker", str(worker)),)
+                    mine["children"][labels] = {
+                        "labels": [list(kv) for kv in labels],
+                        "value": child["value"],
+                    }
+                    continue
+                have = mine["children"].get(labels)
+                if have is None:
+                    merged = {
+                        "labels": [list(kv) for kv in labels],
+                    }
+                    if "hist" in child:
+                        h = child["hist"]
+                        merged["hist"] = {
+                            "bounds": list(h["bounds"]),
+                            "counts": list(h["counts"]),
+                            "sum": h["sum"],
+                            "count": h["count"],
+                            "exemplars": [list(t) for t in
+                                          h.get("exemplars", ())],
+                        }
+                    else:
+                        merged["value"] = child["value"]
+                    mine["children"][labels] = merged
+                    continue
+                if "hist" in child:
+                    h, hv = child["hist"], have["hist"]
+                    if list(h["bounds"]) != list(hv["bounds"]):
+                        raise ValueError(
+                            f"metric {name!r}: bucket ladder mismatch "
+                            "across workers"
+                        )
+                    hv["counts"] = [
+                        a + b for a, b in zip(hv["counts"], h["counts"])
+                    ]
+                    hv["sum"] += h["sum"]
+                    hv["count"] += h["count"]
+                    by_le = {e[0]: e for e in hv.get("exemplars", ())}
+                    for e in h.get("exemplars", ()):
+                        cur = by_le.get(e[0])
+                        if cur is None or e[3] >= cur[3]:
+                            by_le[e[0]] = list(e)
+                    hv["exemplars"] = [
+                        by_le[le] for le in sorted(
+                            by_le,
+                            key=lambda s: (
+                                float("inf") if s == "+Inf" else float(s)
+                            ),
+                        )
+                    ]
+                else:
+                    have["value"] += child["value"]
+    return {"families": [
+        {**f, "children": list(f["children"].values())}
+        for f in sorted(fams.values(), key=lambda f: f["name"])
+    ]}

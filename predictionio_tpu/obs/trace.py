@@ -111,6 +111,20 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_worker() -> Optional[int]:
+    """The process's cluster worker index, when launched as one
+    (``PIO_TPU_PROCESS_INDEX`` — the multihost harness stamps it per
+    spawned worker; ``run_train`` also sets it from
+    ``jax.process_index()``).  ``None`` in single-process land."""
+    v = os.environ.get("PIO_TPU_PROCESS_INDEX")
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
 class Tracer:
     """Bounded span ring + optional JSONL journal.
 
@@ -146,6 +160,7 @@ class Tracer:
             keep_segments if keep_segments is not None
             else _env_int("PIO_TPU_TELEMETRY_KEEP", 3)
         )
+        self._worker = _env_worker()
         self.dropped_journal_writes = 0
 
     # -- configuration -----------------------------------------------------
@@ -169,10 +184,32 @@ class Tracer:
             if keep_segments is not None:
                 self._keep = keep_segments
 
+    def set_process_index(self, worker: Optional[int]) -> None:
+        """Stamp this process's cluster worker index into the journal
+        filename (``spans-w<k>-<pid>.jsonl``) and every span record —
+        a cluster run's journals merge and grep by worker instead of
+        by opaque pid.  An open journal is closed so the next write
+        reopens under the stamped name."""
+        with self._lock:
+            self._worker = worker
+            if self._journal is not None:
+                try:
+                    self._journal.close()
+                except OSError:
+                    pass
+                self._journal = None
+                self._journal_bytes = 0
+
+    def _journal_name(self) -> str:
+        if self._worker is not None:
+            return f"spans-w{self._worker}-{os.getpid()}.jsonl"
+        return f"spans-{os.getpid()}.jsonl"
+
     def journal_path(self) -> Optional[Path]:
         with self._lock:
             d = self._journal_dir
-        return d / f"spans-{os.getpid()}.jsonl" if d else None
+            name = self._journal_name()
+        return d / name if d else None
 
     def _journal_write(self, span: Span) -> None:
         # lock held by the caller (record); failures disable the
@@ -182,7 +219,7 @@ class Tracer:
         if self._journal is None:
             try:
                 self._journal_dir.mkdir(parents=True, exist_ok=True)
-                path = self._journal_dir / f"spans-{os.getpid()}.jsonl"
+                path = self._journal_dir / self._journal_name()
                 self._journal = open(path, "a", encoding="utf-8")
                 try:
                     self._journal_bytes = path.stat().st_size
@@ -193,7 +230,10 @@ class Tracer:
                 self.dropped_journal_writes += 1
                 return
         try:
-            line = json.dumps(span.to_json()) + "\n"
+            doc = span.to_json()
+            if self._worker is not None:
+                doc["worker"] = self._worker
+            line = json.dumps(doc) + "\n"
             self._journal.write(line)
             self._journal.flush()
             self._journal_bytes += len(line)
@@ -214,7 +254,7 @@ class Tracer:
             pass
         self._journal = None
         self._journal_bytes = 0
-        base = self._journal_dir / f"spans-{os.getpid()}.jsonl"
+        base = self._journal_dir / self._journal_name()
         try:
             oldest = base.with_name(base.name + f".{self._keep}")
             if self._keep <= 0:

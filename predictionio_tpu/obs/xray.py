@@ -58,6 +58,7 @@ from . import get_registry, log_buckets
 from .trace import current_trace_id
 
 __all__ = [
+    "device_high_water",
     "install",
     "instrument",
     "jit_stats",
@@ -67,6 +68,7 @@ __all__ = [
     "set_sample_period",
     "start_sampler",
     "stop_sampler",
+    "total_backend_compiles",
     "xray_payload",
 ]
 
@@ -628,6 +630,32 @@ def note_compilation_cache(cache_dir: Optional[str]) -> None:
 
 def jit_stats() -> dict:
     return _STATE.snapshot()["fns"]
+
+
+def total_backend_compiles() -> int:
+    """Backend compiles booked so far, all fns + untracked — pio-tower
+    diffs this per sweep to surface mid-train recompile churn (a sweep
+    that recompiled is a sweep whose wall time lies about steady
+    state)."""
+    snap = _STATE.snapshot()
+    return sum(st["backendCompiles"] for st in snap["fns"].values())
+
+
+def device_high_water() -> Optional[int]:
+    """Max bytes across devices from the most recent sample:
+    ``peak_bytes_in_use`` where the allocator reports it, else the
+    current in-use/live figure — the single high-water number a run
+    manifest records per sweep."""
+    snap = _STATE.snapshot()
+    best: Optional[int] = None
+    for s in snap["devices"]:
+        stats = s.get("stats") or {}
+        v = stats.get("peak_bytes_in_use")
+        if v is None:
+            v = stats.get("bytes_in_use", stats.get("live_bytes"))
+        if v is not None and (best is None or v > best):
+            best = int(v)
+    return best
 
 
 def recompile_events() -> list:

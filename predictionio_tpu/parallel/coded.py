@@ -55,7 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..obs import SHARD_DEGRADED_TOTAL, SHARD_LAG_SECONDS, get_tracer
+from ..obs import SHARD_DEGRADED_TOTAL, SHARD_LAG_SECONDS, get_tracer, tower
 from ..resilience import faults
 from .collectives import shard_map
 from .mesh import DATA_AXIS
@@ -240,6 +240,13 @@ class ShardHealth:
                 attrs={"shard": shard, "op": self.op,
                        "sticky": shard in self.killed},
             )
+            # pio-tower sink: a degradation during a tracked training
+            # run lands in the run manifest (event record + next sweep
+            # record), not just in process-local metrics
+            tower.note_shard_event({
+                "shard": shard, "lagSeconds": round(shard_lag, 6),
+                "op": self.op, "sticky": shard in self.killed,
+            })
         return ok
 
     def summary(self) -> dict:

@@ -24,6 +24,10 @@ Injection points instrumented in this codebase::
                        ``parallel/coded.py`` remembers the kill)
     dist.exchange_torn the sharded-COO file exchange tears mid-publish
                        (`parallel/ingest.exchange_ratings_by_owner`)
+    train.nan          the ALS sweep loop poisons the factor tables
+                       with NaN after the targeted sweep (consulted via
+                       :func:`fired` — the pio-tower convergence
+                       watchdog must turn it into a typed abort)
 
 Plan grammar (``;``-separated rules, ``,``-separated options)::
 
@@ -67,7 +71,7 @@ import urllib.error
 from typing import Optional
 
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "POINTS",
-           "arm", "disarm", "armed", "check", "fired_shard"]
+           "arm", "disarm", "armed", "check", "fired", "fired_shard"]
 
 POINTS = (
     "storage.write",
@@ -80,6 +84,7 @@ POINTS = (
     "dist.shard_drop",
     "dist.worker_kill",
     "dist.exchange_torn",
+    "train.nan",
 )
 
 
@@ -307,6 +312,18 @@ def fired_shard(point: str,
     if plan is None:
         return None
     return plan.hit_shard(point, max_wait=max_wait)
+
+
+def fired(point: str) -> bool:
+    """Ask-style boolean consultation for points whose degradation is
+    an in-band state change rather than an exception or a shard id
+    (``train.nan``: the sweep loop poisons its own factors when the
+    rule fires).  Counts the call and applies any rule delay; no plan
+    armed => one global load."""
+    plan = _plan
+    if plan is None:
+        return False
+    return plan.hit_shard(point) is not None
 
 
 # operator workflow: arm from the environment at import, so any entry

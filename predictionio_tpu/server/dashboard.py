@@ -67,6 +67,7 @@ class DashboardServer(HTTPServerBase):
             "<p><a href='/metrics.html'>live metrics</a> &middot; "
             "<a href='/xray.html'>x-ray</a> &middot; "
             "<a href='/pulse.html'>pulse</a> &middot; "
+            "<a href='/train.html'>training console</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
         )
@@ -357,6 +358,97 @@ class DashboardServer(HTTPServerBase):
             "</body></html>"
         )
 
+    def train_html(self) -> str:
+        """pio-tower training console: the live run (if any — this
+        process, or another process's manifest still growing on disk)
+        plus manifest history with phase totals and loss trajectory
+        endpoints.  Machines read ``/debug/train``; ``tools/runlog.py
+        diff`` answers "why did sweep 7 take 3x" from the same files."""
+        from ..obs.tower import train_payload
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        p = train_payload()
+        active = p["active"]
+        if active:
+            last = active.get("lastSweep") or {}
+            seg = "; ".join(
+                f"{k} {v * 1e3:.1f}ms"
+                for k, v in sorted((last.get("phases") or {}).items())
+            )
+            planned = active.get("sweepsPlanned")
+            eta = active.get("etaSeconds")
+            active_html = (
+                "<p><b>live:</b> {iid} ({kind}) — sweep {i}{of}, "
+                "last {ls:.3f}s [{seg}], ETA {eta}</p>".format(
+                    iid=esc(active["instanceId"]),
+                    kind=esc(active["runKind"]),
+                    i=active["sweep"],
+                    of=f"/{planned}" if planned else "",
+                    ls=(last.get("seconds") or 0.0),
+                    seg=esc(seg),
+                    eta=f"{eta:.0f}s" if eta is not None else "?",
+                )
+            )
+        else:
+            active_html = "<p>(no run live in this process)</p>"
+        rows = []
+        for r in p["runs"]:
+            phases = "; ".join(
+                f"{k} {v:.2f}s" for k, v in sorted(
+                    (r.get("phaseTotals") or {}).items(),
+                    key=lambda kv: -kv[1],
+                )[:4]
+            )
+            loss = (
+                f"{r['firstLoss']:.4g} &rarr; {r['lastLoss']:.4g}"
+                if r.get("firstLoss") is not None
+                and r.get("lastLoss") is not None else "-"
+            )
+            status = r.get("status", "?")
+            if r.get("live"):
+                status = "<b>live</b>"
+            elif r.get("reason"):
+                status += f" ({esc(r['reason'])})"
+            rows.append(
+                "<tr><td>{iid}</td><td>{kind}</td><td>{st}</td>"
+                "<td>{n}{of}</td><td>{mean}</td><td>{ph}</td>"
+                "<td>{loss}</td><td>{ev}</td></tr>".format(
+                    iid=esc(r.get("instanceId")),
+                    kind=esc(r.get("runKind")),
+                    st=status,
+                    n=r.get("sweeps"),
+                    of=(
+                        f"/{r['sweepsPlanned']}"
+                        if r.get("sweepsPlanned") else ""
+                    ),
+                    mean=(
+                        f"{r['sweepSecondsMean']:.3f}s"
+                        if r.get("sweepSecondsMean") is not None else "-"
+                    ),
+                    ph=esc(phases) or "-",
+                    loss=loss,
+                    ev=r.get("events", 0),
+                )
+            )
+        return (
+            "<html><head><title>training console</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace;padding:2px 8px}</style></head>"
+            "<body><h1>Tower: training console</h1>"
+            "<p>JSON at <a href='/debug/train'>/debug/train</a>; "
+            "compare two runs with <code>python tools/runlog.py diff "
+            "A B</code>.</p>"
+            + active_html +
+            "<h2>Run manifests (newest first)</h2>"
+            "<table border='1'><tr><th>instance</th><th>kind</th>"
+            "<th>status</th><th>sweeps</th><th>mean sweep</th>"
+            "<th>top phases (total)</th><th>loss first&rarr;last</th>"
+            "<th>events</th></tr>" + "\n".join(rows) + "</table>"
+            "</body></html>"
+        )
+
     def _make_handler(server: "DashboardServer"):
         class Handler(JsonRequestHandler):
             server_logger = logger
@@ -397,6 +489,10 @@ class DashboardServer(HTTPServerBase):
                     return
                 if path == "/pulse.html":
                     self._reply(200, server.pulse_html().encode(),
+                                "text/html")
+                    return
+                if path == "/train.html":
+                    self._reply(200, server.train_html().encode(),
                                 "text/html")
                     return
                 parts = [x for x in path.split("/") if x]

@@ -35,13 +35,16 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
     def _serve_metrics(self) -> bool:
         """Answer the common observability mounts — ``GET /metrics``
         (Prometheus exposition), ``GET /debug/xray`` (compiler/device/
-        flight-recorder JSON, pio-xray) and ``GET /debug/profile``
-        (blocking on-demand jax.profiler capture, pio-pulse) — from the
-        process-wide registry.  Every server's ``do_GET`` tries this
-        first, so all four HTTP surfaces expose the same set without
-        per-server code.  Returns True when the request was handled."""
+        flight-recorder JSON, pio-xray), ``GET /debug/train`` (training
+        run progress + manifest history, pio-tower) and ``GET
+        /debug/profile`` (blocking on-demand jax.profiler capture,
+        pio-pulse) — from the process-wide registry.  Every server's
+        ``do_GET`` tries this first, so all four HTTP surfaces expose
+        the same set without per-server code.  Returns True when the
+        request was handled."""
         path = urllib.parse.urlparse(self.path).path
-        if path not in ("/metrics", "/debug/xray", "/debug/profile"):
+        if path not in ("/metrics", "/debug/xray", "/debug/train",
+                        "/debug/profile"):
             return False
         if not metrics_enabled():
             self._reply(404, {"message": "metrics disabled (--no-metrics)"})
@@ -50,6 +53,11 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
             from ..obs.xray import xray_payload
 
             self._reply(200, xray_payload())
+            return True
+        if path == "/debug/train":
+            from ..obs.tower import train_payload
+
+            self._reply(200, train_payload())
             return True
         if path == "/debug/profile":
             self._serve_profile()

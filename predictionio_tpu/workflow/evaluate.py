@@ -97,12 +97,31 @@ def run_evaluation(
                 engine, evaluation.metric, evaluation.metrics,
                 evaluation.output_path,
             )
-        with phase_span("eval.run", attrs={
-            "instance": eval_id, "candidates": len(engine_params_list),
-        }):
-            result = evaluation.run(
-                ctx, engine_params_list, wp, parallelism=parallelism
-            )
+        # pio-tower: an eval run gets a manifest too — one candidate
+        # record per scored sweep (MetricEvaluator._score_one appends
+        # them), so "which candidate ate the wall time" outlives the log
+        from ..obs import tower
+
+        session = tower.TowerSession(
+            eval_id,
+            kind="eval",
+            meta={
+                "evaluationClass": rec.evaluation_class,
+                "candidates": len(engine_params_list),
+                "batch": wp.batch,
+            },
+        ).start()
+        try:
+            with phase_span("eval.run", attrs={
+                "instance": eval_id, "candidates": len(engine_params_list),
+            }):
+                result = evaluation.run(
+                    ctx, engine_params_list, wp, parallelism=parallelism
+                )
+            session.finalize("completed")
+        except BaseException as e:
+            session.finalize_error(e)
+            raise
         rec.status = "EVALCOMPLETED"
         rec.end_time = format_time(now_utc())
         rec.evaluator_results = result.to_one_liner()

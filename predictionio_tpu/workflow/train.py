@@ -115,9 +115,12 @@ def run_train(
     reference's single Spark driver owns those writes; here every process
     is a "driver", so writes are explicitly gated).
     """
+    import os
+    import time
+
     import jax
 
-    from ..obs import xray
+    from ..obs import get_tracer, tower, xray
 
     # compile/device observability for the whole training run: every
     # half-iteration compile books into pio_jit_compiles_total{fn} and
@@ -129,8 +132,29 @@ def run_train(
     wp = workflow_params or WorkflowParams()
     md = ctx.storage.get_metadata()
     chief = jax.process_index() == 0
+    if jax.process_count() > 1:
+        # stamp worker identity into span journals (pio-tower: a
+        # cluster run's journals merge and grep by worker)
+        get_tracer().set_process_index(jax.process_index())
 
     instance_id = _shared_instance_id()
+    # pio-tower run session: chief writes the persistent run manifest;
+    # every worker publishes registry snapshots into the coordination
+    # dir (PIO_TPU_COORD_DIR — the multihost harness's rendezvous dir)
+    # and the chief merges them into its /metrics and the manifest
+    session = tower.TowerSession(
+        instance_id,
+        kind="train",
+        meta={
+            "engineId": engine_id,
+            "engineVariant": engine_variant,
+            "batch": wp.batch,
+            "nDevices": ctx.n_devices,
+        },
+        worker=jax.process_index(),
+        n_workers=jax.process_count(),
+        coord_dir=os.environ.get("PIO_TPU_COORD_DIR"),
+    ).start()
     ei = EngineInstance(
         id=instance_id,
         status="INIT",
@@ -154,8 +178,10 @@ def run_train(
             md.engine_instance_update(ei)
         # keep the trained instances: persistence hooks may rely on state
         # the algorithm built during train
+        t_run = time.perf_counter()
         with phase_span("train.run", attrs={"instance": instance_id}):
             algos, models = engine.train_components(ctx, engine_params, wp)
+        session.note_train_run(time.perf_counter() - t_run)
         if wp.save_model:
             names = [n for n, _ in engine_params.algorithms]
             with phase_span("train.save_models",
@@ -168,19 +194,24 @@ def run_train(
         if chief:
             md.engine_instance_update(ei)
         completed = True
+        session.finalize("completed")
         logger.info("training finished: instance %s", instance_id)
         return instance_id
-    except TrainingInterrupted:
+    except TrainingInterrupted as e:
         ei.status = "INTERRUPTED"
         ei.end_time = format_time(now_utc())
         if chief:
             md.engine_instance_update(ei)
+        session.finalize("interrupted", error=str(e))
         raise
-    except Exception:
+    except Exception as e:
         ei.status = "FAILED"
         ei.end_time = format_time(now_utc())
         if chief:
             md.engine_instance_update(ei)
+        # a ConvergenceError was already finalized as "aborted" by the
+        # watchdog (finalize is idempotent); anything else is "failed"
+        session.finalize_error(e)
         raise
     finally:
         if jax.process_count() > 1 and not chief and completed:

@@ -1,0 +1,54 @@
+"""Worker for the pio-tower registry-aggregation tests.
+
+Launched by ``tools/multihost_harness.spawn_workers`` as::
+
+    python _tower_worker.py <pid> <nprocs> <coord_dir> <cycles> <die_pid> <die_after>
+
+No ``jax.distributed`` required: the aggregation plane is the
+coordination DIRECTORY (atomic snapshot files), so this worker runs on
+any backend — exactly why a dead worker's counts survive.  Each cycle
+the worker books deterministic registry traffic and publishes its
+snapshot; worker ``die_pid`` exits HARD (``os._exit``) after
+``die_after`` cycles, simulating a mid-run crash with its last
+snapshot already on disk.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    pid, _nprocs = int(sys.argv[1]), int(sys.argv[2])
+    coord_dir = sys.argv[3]
+    cycles = int(sys.argv[4])
+    die_pid = int(sys.argv[5]) if len(sys.argv) > 5 else -1
+    die_after = int(sys.argv[6]) if len(sys.argv) > 6 else -1
+
+    from predictionio_tpu.obs import get_registry
+    from predictionio_tpu.obs.tower import RegistryPublisher
+
+    reg = get_registry()
+    ops = reg.counter("tower_test_ops_total", "tower merge test")
+    lat = reg.histogram(
+        "tower_test_lat_seconds", "tower merge test",
+        buckets=(0.001, 0.01, 0.1, 1.0),
+    )
+    depth = reg.gauge("tower_test_depth", "tower merge test")
+    pub = RegistryPublisher(coord_dir, pid)
+
+    for cycle in range(1, cycles + 1):
+        ops.child().inc(pid + 1)            # worker k adds k+1 per cycle
+        lat.child().observe(0.005 * (pid + 1))
+        depth.child().set(pid * 100 + cycle)
+        pub.publish()
+        if pid == die_pid and cycle == die_after:
+            os._exit(0)  # hard death: no final publish, no marker
+
+    print("WORKER_OK", pid, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -252,7 +252,11 @@ def spawn_workers(
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker)] + [str(a) for a in argv_of(p)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            # PIO_TPU_PROCESS_INDEX stamps worker identity into every
+            # span-journal filename/record (pio-tower): a cluster run's
+            # journals merge and grep by worker, not by opaque pid
+            env={**env, "PIO_TPU_PROCESS_INDEX": str(p)},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True,
         )
         for p in range(nprocs)

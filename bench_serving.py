@@ -72,6 +72,17 @@ def main() -> None:
                     default="process",
                     help="loadgen worker kind (process = no client "
                     "GIL, the honest default)")
+    ap.add_argument("--edge", choices=("eventloop", "threads"),
+                    default="eventloop",
+                    help="serving front end for --sweep/--concurrency "
+                    "(pio-surge A/B: eventloop = selector loop, "
+                    "threads = the pre-surge stdlib edge)")
+    ap.add_argument("--arrival-rate", type=float, default=0.0,
+                    metavar="QPS",
+                    help="with --concurrency: open-loop Poisson "
+                    "arrivals at this aggregate rate instead of "
+                    "closed-loop (coordinated-omission-free "
+                    "latencies; see tools/loadgen.py)")
     ap.add_argument("--append-history", action="store_true",
                     help="append the sweep's fenced records to "
                     "BENCH_HISTORY.jsonl (the canonical trajectory "
@@ -340,12 +351,12 @@ def _prebuilt_engine(model):
     return engine, ep, iid, ctx
 
 
-def _boot_server(engine, ep, iid, ctx, microbatch):
+def _boot_server(engine, ep, iid, ctx, microbatch, edge="eventloop"):
     from predictionio_tpu.server.serving import EngineServer, ServerConfig
 
     srv = EngineServer(
         engine, ep, iid, ctx=ctx,
-        config=ServerConfig(port=0, microbatch=microbatch),
+        config=ServerConfig(port=0, microbatch=microbatch, edge=edge),
         engine_variant="bench.json",
     )
     srv.start_background()
@@ -470,7 +481,8 @@ def _bench_sweep(args, model, rng) -> None:
         else [args.concurrency]
     )
     engine, ep, iid, ctx = _prebuilt_engine(model)
-    srv = _boot_server(engine, ep, iid, ctx, microbatch="auto")
+    srv = _boot_server(engine, ep, iid, ctx, microbatch="auto",
+                       edge=args.edge)
     base = f"http://127.0.0.1:{srv.config.port}"
     _warm_batch_ladder(srv, args.num, max(points_c) * 2)
     payloads = [
@@ -490,7 +502,7 @@ def _bench_sweep(args, model, rng) -> None:
         before = seg_snapshot()
         res = loadgen.run_load(
             f"{base}/queries.json", payloads, c, args.duration_s,
-            mode=args.loadgen_mode,
+            mode=args.loadgen_mode, arrival_rate=args.arrival_rate,
         )
         after = seg_snapshot()
         # mean per-segment share of this window's requests: the server
@@ -524,10 +536,14 @@ def _bench_sweep(args, model, rng) -> None:
             "p50_ms": point["p50_ms"],
             "duration_s": args.duration_s,
             "loadgen_mode": args.loadgen_mode,
+            "edge": args.edge,
             "errors": res["errors"],
             "items": args.items,
             "rank": args.rank,
             "segments_ms": segments_ms,
+            **({"arrival_rate": args.arrival_rate,
+                "service_p99_ms": round(res["service_p99_ms"], 3)}
+               if args.arrival_rate else {}),
         }
         print(json.dumps(rec), flush=True)
         if args.append_history:
@@ -541,6 +557,7 @@ def _bench_sweep(args, model, rng) -> None:
         ),
         "slo_ms": args.slo_ms,
         "platform": platform,
+        "edge": args.edge,
         "items": args.items,
         "rank": args.rank,
         "points": points,
@@ -568,6 +585,7 @@ def _bench_sweep(args, model, rng) -> None:
             "sweep": [p["concurrency"] for p in points],
             "duration_s": args.duration_s,
             "loadgen_mode": args.loadgen_mode,
+            "edge": args.edge,
             "items": args.items,
             "rank": args.rank,
         }

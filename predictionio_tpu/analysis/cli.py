@@ -15,6 +15,7 @@ import subprocess
 from pathlib import Path
 from typing import Optional
 
+from .asynclint import AsyncEngine
 from .core import RULES, Baseline, Finding, SourceFile, load_baseline
 from .jaxlint import JaxEngine
 from .locklint import LockEngine
@@ -105,6 +106,7 @@ def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
         src, bench_scope=_is_bench_scope(path, root)
     ).run()
     findings += LockEngine(src).run()
+    findings += AsyncEngine(src).run()
     if _is_pkg_scope(path, root):
         findings += TimeEngine(src).run()
     return findings

@@ -45,6 +45,9 @@ RULES: dict[str, str] = {
               "fence/block_until_ready (bench*/tools only)",
     "PIO109": "wall-clock duration: time.time() t0/dt subtraction — "
               "use monotonic()/perf_counter() (predictionio_tpu/ only)",
+    "PIO110": "event-loop stall: blocking call (time.sleep, blocking "
+              "socket I/O, untimed queue get/put) inside a coroutine "
+              "or @callback_scope loop-thread function",
     "PIO201": "lock discipline: write to a lock-guarded attribute "
               "without holding the lock",
     "PIO202": "lock discipline: read of a lock-guarded attribute "

@@ -377,6 +377,9 @@ def cmd_deploy(args, storage: Storage) -> int:
     from ..server.serving import EngineServer, ServerConfig
     from ..tools.template_gallery import verify_template_min_version
 
+    if getattr(args, "replicas", 0) and args.replicas > 1:
+        # pio-surge fleet mode: N replica processes + one router
+        return _deploy_fleet(args)
     enable_compilation_cache()
     if getattr(args, "scan_cache", False):
         import os
@@ -417,6 +420,8 @@ def cmd_deploy(args, storage: Storage) -> int:
             breaker_failures=args.breaker_failures,
             breaker_reset_s=args.breaker_reset,
             foldin_poll_s=args.foldin_poll,
+            edge=args.edge,
+            max_connections=args.max_connections,
         ),
         engine_id=engine_id,
         engine_variant=str(args.engine_json),
@@ -439,8 +444,92 @@ def cmd_deploy(args, storage: Storage) -> int:
             time.sleep(0.5)
     except (urllib.error.URLError, OSError):
         pass
-    _out(f"Deploying engine instance {iid} on {args.ip}:{args.port}")
+    if args.port_file:
+        # bind now so the announced port is real (--port 0 = ephemeral);
+        # the replica spawner (deploy --replicas) reads this file
+        server._bind()
+        pf = Path(args.port_file)
+        pf.parent.mkdir(parents=True, exist_ok=True)
+        pf.write_text(f"{server.port}\n")
+    _out(f"Deploying engine instance {iid} on {args.ip}:{server.port}")
     server.serve_forever()
+    return 0
+
+
+def _deploy_fleet(args) -> int:
+    """``deploy --replicas N``: spawn N single-replica deploy
+    subprocesses on ephemeral ports, then run the router in THIS
+    process on the requested port.  Ctrl-C / POST /stop tears the
+    whole fleet down."""
+    import atexit
+    import tempfile
+
+    from ..server.router import (
+        Replica, RouterConfig, RouterServer, spawn_replica,
+        wait_for_port_file,
+    )
+
+    coord_dir = Path(tempfile.mkdtemp(prefix="pio-surge-fleet-"))
+    extra = []
+    for flag, val in (
+        ("--engine-factory", args.engine_factory),
+        ("--engine-instance-id", args.engine_instance_id),
+        ("--microbatch", args.microbatch),
+        ("--edge", args.edge),
+    ):
+        if val:
+            extra += [flag, str(val)]
+    for flag, val in (
+        ("--query-timeout", args.query_timeout),
+        ("--foldin-poll", args.foldin_poll),
+        ("--max-connections", args.max_connections),
+    ):
+        if val is not None:
+            extra += [flag, str(val)]
+    if getattr(args, "scan_cache", False):
+        extra.append("--scan-cache")
+    spawned = [
+        spawn_replica(args.engine_json, i, coord_dir, extra_args=extra)
+        for i in range(args.replicas)
+    ]
+
+    def reap():
+        for s in spawned:
+            if s["proc"].poll() is None:
+                s["proc"].terminate()
+        for s in spawned:
+            try:
+                s["proc"].wait(timeout=10)
+            except Exception:
+                s["proc"].kill()
+
+    atexit.register(reap)
+    replicas = []
+    for s in spawned:
+        port = wait_for_port_file(s)
+        _out(f"Replica {s['index']} up on 127.0.0.1:{port} "
+             f"(log: {s['log_path']})")
+        replicas.append(Replica(
+            f"replica-{s['index']}", "127.0.0.1", port,
+            breaker_failures=args.breaker_failures,
+        ))
+    router = RouterServer(replicas, RouterConfig(
+        host=args.ip, port=args.port,
+        health_interval_s=args.health_interval,
+        max_connections=args.max_connections,
+        push_foldin_s=args.push_foldin,
+    ))
+    if args.port_file:
+        router._bind()
+        pf = Path(args.port_file)
+        pf.parent.mkdir(parents=True, exist_ok=True)
+        pf.write_text(f"{router.port}\n")
+    _out(f"Router fronting {len(replicas)} replicas on "
+         f"{args.ip}:{args.port}")
+    try:
+        router.serve_forever()
+    finally:
+        reap()
     return 0
 
 
@@ -548,7 +637,8 @@ def cmd_eventserver(args, storage: Storage) -> int:
         storage, EventServerConfig(host=args.ip, port=args.port,
                                    stats=args.stats,
                                    write_retries=args.write_retries,
-                                   write_backoff_s=args.write_backoff)
+                                   write_backoff_s=args.write_backoff,
+                                   max_connections=args.max_connections)
     )
     _out(f"Event server running on {args.ip}:{args.port}")
     server.serve_forever()
@@ -935,6 +1025,34 @@ def build_parser() -> argparse.ArgumentParser:
                    "in place (factor rows + top-k index, no "
                    "stop-the-world reload); pair with a `pio-tpu "
                    "foldin --watch` daemon")
+    d.add_argument("--edge", choices=("eventloop", "threads"),
+                   default="eventloop",
+                   help="serving front end (pio-surge): eventloop = "
+                   "one selector loop, no thread per connection "
+                   "(default); threads = the stdlib "
+                   "ThreadingHTTPServer edge")
+    d.add_argument("--max-connections", type=int, default=512,
+                   help="concurrent-connection cap; connection "
+                   "attempts past it get a structured 503 and are "
+                   "closed (slow-loris guard)")
+    d.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="pio-surge fleet mode: spawn N replica "
+                   "processes on ephemeral ports and run a router on "
+                   "--port fanning out over them with health checks, "
+                   "failover masking, and rolling fold-in delta push")
+    d.add_argument("--health-interval", type=float, default=1.0,
+                   metavar="SEC",
+                   help="fleet mode: router health-check period")
+    d.add_argument("--push-foldin", type=float, default=None,
+                   metavar="SEC",
+                   help="fleet mode: run a rolling fold-in delta push "
+                   "across the replicas every SEC seconds (each "
+                   "replica applies in place, one at a time — "
+                   "availability never drops below N-1)")
+    d.add_argument("--port-file", metavar="PATH",
+                   help="announce the BOUND port (after --port 0 "
+                   "resolution) by writing it to PATH — how fleet "
+                   "replicas report in")
 
     fi = sub.add_parser(
         "foldin",
@@ -990,6 +1108,9 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SEC",
                     help="base backoff between storage retries "
                     "(decorrelated jitter grows it toward a 10x cap)")
+    ev.add_argument("--max-connections", type=int, default=512,
+                    help="concurrent-connection cap; attempts past it "
+                    "get a structured 503 and are closed")
 
     ad = sub.add_parser("adminserver", help="run the admin API server")
     _add_obs_args(ad)

@@ -264,6 +264,41 @@ SHARD_LAG_SECONDS = _registry.histogram(
     buckets=log_buckets(1e-4, 100.0, per_decade=4),
 )
 
+# pio-surge (event-loop serving edge + replica fleet) families: the
+# connection-cap guard books refusals per server edge, and the router
+# process keeps per-replica health/freshness gauges + forward counters
+# (each replica's own registry still exports the unlabeled
+# pio_model_freshness_seconds; the router's labeled view is what an
+# operator alerts on fleet-wide).
+HTTP_OPEN_CONNECTIONS = _registry.gauge(
+    "pio_http_open_connections",
+    "Open client connections per HTTP server edge",
+    labels=("server",),
+)
+HTTP_CONN_REJECTED = _registry.counter(
+    "pio_http_connections_rejected_total",
+    "Connections refused with a structured 503 because the per-server "
+    "concurrent-connection cap was reached (slow-loris guard)",
+    labels=("server",),
+)
+REPLICA_UP = _registry.gauge(
+    "pio_replica_up",
+    "Router view of replica health (1=healthy, 0=down)",
+    labels=("replica",),
+)
+REPLICA_MODEL_FRESHNESS = _registry.gauge(
+    "pio_replica_model_freshness_seconds",
+    "Router-observed per-replica model freshness (seconds since that "
+    "replica's model last advanced, read off its health-check status)",
+    labels=("replica",),
+)
+REPLICA_REQUESTS_TOTAL = _registry.counter(
+    "pio_replica_requests_total",
+    "Requests the router forwarded per replica by outcome "
+    "(ok/error/failover)",
+    labels=("replica", "outcome"),
+)
+
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every
 # process's first scrape already shows the full (zero-valued) shape

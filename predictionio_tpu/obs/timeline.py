@@ -121,8 +121,19 @@ MICROBATCH_WAIT_SECONDS = _registry.histogram(
 MICROBATCH_ROLE_TOTAL = _registry.counter(
     "pio_microbatch_role_total",
     "Requests by batcher role: the leader ran the device call on its "
-    "own thread, a follower's result came from another thread's batch",
+    "own thread, a follower's result came from another thread's batch, "
+    "a dispatched request rode the continuous dispatcher (pio-surge "
+    "event-loop edge — no request thread involved)",
     labels=("role",),
+)
+MICROBATCH_ADMISSION_TOTAL = _registry.counter(
+    "pio_microbatch_admission_total",
+    "Deadline-aware admission outcomes (pio-surge): rejected = the "
+    "edge answered a structured 503 up front because the estimated "
+    "queue+service time exceeded the request deadline; expired = "
+    "claimed from the queue already past its deadline and completed "
+    "without ever reaching the device",
+    labels=("outcome",),
 )
 
 # children cached at import: .labels() is a dict build + lock per call
@@ -143,6 +154,9 @@ MICROBATCH_BATCH_SIZE.child()
 MICROBATCH_WAIT_SECONDS.child()
 MICROBATCH_ROLE_TOTAL.labels(role="leader")
 MICROBATCH_ROLE_TOTAL.labels(role="follower")
+MICROBATCH_ROLE_TOTAL.labels(role="dispatched")
+MICROBATCH_ADMISSION_TOTAL.labels(outcome="rejected")
+MICROBATCH_ADMISSION_TOTAL.labels(outcome="expired")
 
 
 class Timeline:

@@ -16,7 +16,8 @@ import jax.numpy as jnp
 
 from ..obs import xray
 
-__all__ = ["topk_scores", "batch_topk_scores", "cosine_topk", "pow2_ceil"]
+__all__ = ["topk_scores", "batch_topk_scores", "batch_topk_scores_t",
+           "cosine_topk", "pow2_ceil"]
 
 
 def pow2_ceil(x: int) -> int:
@@ -49,6 +50,26 @@ def batch_topk_scores(query_vecs: jax.Array, table: jax.Array, k: int,
     """[B, R] x [M, R] -> top-k per row; ``mask`` (additive, [B, M] or [M])
     suppresses entries (use -inf)."""
     scores = query_vecs @ table.T
+    if mask is not None:
+        scores = scores + mask
+    return jax.lax.top_k(scores, k)
+
+
+@xray.instrument("topk.batch_topk_scores_t")
+@functools.partial(jax.jit, static_argnames=("k",))
+def batch_topk_scores_t(query_vecs: jax.Array, table_t: jax.Array, k: int,
+                        mask: jax.Array | None = None):
+    """[B, R] x [R, M] (PRE-TRANSPOSED table) -> top-k per row.
+
+    Identical math to :func:`batch_topk_scores`, radically different
+    lowering on CPU: with the contraction dim contiguous on BOTH
+    operands the batched matmul vectorizes along the M output axis —
+    measured 10.6 ms -> 2.1 ms for [16, 64] x [64, 100k] f32 on one
+    core (XLA's Eigen path pays a strided-RHS penalty ``@ table.T``
+    that the MXU never showed).  Serving keeps a transposed device
+    cache (``DeviceTableMixin.device_item_factors_t``) so the hot path
+    pays the transpose once per model advance, not per batch."""
+    scores = query_vecs @ table_t
     if mask is not None:
         scores = scores + mask
     return jax.lax.top_k(scores, k)

@@ -1,9 +1,11 @@
 """HTTP servers: engine deployment (serving), event ingestion, admin,
-dashboard (reference L3/L8/L9 surfaces)."""
+dashboard (reference L3/L8/L9 surfaces), plus the pio-surge
+event-loop edge and the replica-fleet router."""
 
 from .admin import AdminServer
 from .dashboard import DashboardServer
 from .event_server import EventServer, EventServerConfig
+from .router import Replica, RouterConfig, RouterServer
 from .serving import EngineServer, ServerConfig
 from .stats import StatsCollector
 
@@ -13,6 +15,9 @@ __all__ = [
     "EventServer",
     "EventServerConfig",
     "EngineServer",
+    "Replica",
+    "RouterConfig",
+    "RouterServer",
     "ServerConfig",
     "StatsCollector",
 ]

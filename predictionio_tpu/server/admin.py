@@ -27,6 +27,7 @@ __all__ = ["AdminServer"]
 
 
 class AdminServer(HTTPServerBase):
+    server_name = "admin"
     def __init__(self, storage: Storage, host: str = "127.0.0.1",
                  port: int = 7071):
         self.storage = storage

@@ -25,6 +25,7 @@ __all__ = ["DashboardServer"]
 
 
 class DashboardServer(HTTPServerBase):
+    server_name = "dashboard"
     def __init__(self, storage: Storage, host: str = "127.0.0.1",
                  port: int = 9000):
         self.storage = storage

@@ -52,10 +52,14 @@ class EventServerConfig:
     def __init__(self, host: str = "127.0.0.1", port: int = 7070,
                  stats: bool = True, write_retries: int = 3,
                  write_backoff_s: float = 0.05,
-                 retry_seed: Optional[int] = None):
+                 retry_seed: Optional[int] = None,
+                 max_connections: int = 512):
         self.host = host
         self.port = port
         self.stats = stats
+        # concurrent-connection cap (pio-surge): attempts past it get a
+        # structured 503 + close instead of one pinned thread each
+        self.max_connections = max_connections
         # transient-storage-failure policy: a busy WAL / locked sqlite
         # write is retried with backoff before the route answers
         # 503 + Retry-After (write_retries counts the first try)
@@ -75,6 +79,7 @@ TRANSIENT_STORAGE_ERRORS = (sqlite3.OperationalError,)
 
 
 class EventServer(HTTPServerBase):
+    server_name = "events"
     def __init__(self, storage: Optional[Storage] = None,
                  config: Optional[EventServerConfig] = None):
         self.storage = storage or get_storage()
@@ -101,6 +106,10 @@ class EventServer(HTTPServerBase):
     @property
     def port(self) -> int:
         return self.config.port
+
+    @property
+    def max_connections(self) -> int:
+        return self.config.max_connections
 
     @port.setter
     def port(self, v: int) -> None:

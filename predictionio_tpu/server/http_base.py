@@ -10,9 +10,111 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
-from ..obs import TRACE_HEADER, metrics_enabled, render_prometheus
+from ..obs import (
+    HTTP_CONN_REJECTED,
+    TRACE_HEADER,
+    metrics_enabled,
+    render_prometheus,
+)
 
 PROMETHEUS_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# per-server default for the concurrent-connection cap (pio-surge): a
+# slow-loris client opening sockets used to pin one thread EACH on the
+# threading edge, unbounded; both edges now shed connection attempts
+# past the cap with a structured 503 + Connection: close
+DEFAULT_MAX_CONNECTIONS = 512
+
+
+class CappedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a bound on concurrent connections.
+
+    Each accepted connection (keep-alive included) holds one handler
+    thread until it closes; past ``max_connections`` of them, further
+    connections are answered with a minimal structured 503 and closed
+    instead of spawning thread number cap+1.  The refusal is written
+    inline on the listener thread — a few hundred bytes into a fresh
+    socket's send buffer never blocks.
+    """
+
+    def __init__(self, server_address, handler_class,
+                 max_connections: int = DEFAULT_MAX_CONNECTIONS,
+                 server_name: str = "serving"):
+        self.max_connections = max_connections
+        self._conn_sema = threading.BoundedSemaphore(max_connections)
+        self._m_rejected = HTTP_CONN_REJECTED.labels(server=server_name)
+        super().__init__(server_address, handler_class)
+
+    def process_request(self, request, client_address):
+        if not self._conn_sema.acquire(blocking=False):
+            self._m_rejected.inc()
+            self._refuse(request)
+            return
+        try:
+            super().process_request(request, client_address)
+        except BaseException:
+            self._conn_sema.release()
+            raise
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            self._conn_sema.release()
+
+    def _refuse(self, request) -> None:
+        body = json.dumps({
+            "message": "connection limit reached",
+            "error": "TooManyConnections",
+        }).encode()
+        try:
+            request.sendall(
+                b"HTTP/1.1 503 Service Unavailable\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Retry-After: 1\r\nConnection: close\r\n\r\n" + body
+            )
+        except OSError:
+            pass
+        self.shutdown_request(request)
+
+
+OBS_PATHS = ("/metrics", "/debug/xray", "/debug/train", "/debug/profile")
+
+
+def observability_response(path: str, query: str = ""):
+    """Answer the common observability mounts shared by every server
+    (both edges): returns ``(code, payload, ctype)`` or ``None`` when
+    ``path`` is not an observability mount.  ``/debug/profile`` BLOCKS
+    for the capture duration — event-loop callers must run this off
+    the loop (the serving edge routes all GETs through its aux pool)."""
+    if path not in OBS_PATHS:
+        return None
+    if not metrics_enabled():
+        return 404, {"message": "metrics disabled (--no-metrics)"}, None
+    if path == "/debug/xray":
+        from ..obs.xray import xray_payload
+
+        return 200, xray_payload(), None
+    if path == "/debug/train":
+        from ..obs.tower import train_payload
+
+        return 200, train_payload(), None
+    if path == "/debug/profile":
+        from ..obs import timeline
+
+        qs = urllib.parse.parse_qs(query)
+        try:
+            seconds = float(qs.get("seconds", ["2"])[0])
+        except ValueError:
+            return 400, {"message": f"bad seconds: {qs['seconds'][0]!r}"}, None
+        try:
+            return 200, timeline.capture_profile(seconds), None
+        except timeline.ProfileBusy as e:
+            return 409, {"message": str(e)}, None
+        except Exception as e:
+            return 500, {"message": f"profile capture failed: {e}"}, None
+    return 200, render_prometheus().encode(), PROMETHEUS_CTYPE
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -42,55 +144,13 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         ``do_GET`` tries this first, so all four HTTP surfaces expose
         the same set without per-server code.  Returns True when the
         request was handled."""
-        path = urllib.parse.urlparse(self.path).path
-        if path not in ("/metrics", "/debug/xray", "/debug/train",
-                        "/debug/profile"):
+        u = urllib.parse.urlparse(self.path)
+        ans = observability_response(u.path, u.query)
+        if ans is None:
             return False
-        if not metrics_enabled():
-            self._reply(404, {"message": "metrics disabled (--no-metrics)"})
-            return True
-        if path == "/debug/xray":
-            from ..obs.xray import xray_payload
-
-            self._reply(200, xray_payload())
-            return True
-        if path == "/debug/train":
-            from ..obs.tower import train_payload
-
-            self._reply(200, train_payload())
-            return True
-        if path == "/debug/profile":
-            self._serve_profile()
-            return True
-        self._reply(200, render_prometheus().encode(),
-                    ctype=PROMETHEUS_CTYPE)
+        code, payload, ctype = ans
+        self._reply(code, payload, ctype=ctype or "application/json")
         return True
-
-    def _serve_profile(self) -> None:
-        """``GET /debug/profile?seconds=S``: capture a jax.profiler
-        trace into ``$PIO_TPU_HOME/telemetry/profiles/`` with pulse
-        segments bridged as TraceAnnotations, and answer the artifact
-        manifest.  Blocks this handler thread for S (clamped) seconds —
-        the other ThreadingHTTPServer threads keep serving, which is
-        exactly what a live capture wants to observe."""
-        from ..obs import timeline
-
-        qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
-        try:
-            seconds = float(qs.get("seconds", ["2"])[0])
-        except ValueError:
-            self._reply(400, {
-                "message": f"bad seconds: {qs['seconds'][0]!r}"
-            })
-            return
-        try:
-            self._reply(200, timeline.capture_profile(seconds))
-        except timeline.ProfileBusy as e:
-            self._reply(409, {"message": str(e)})
-        except Exception as e:
-            self._reply(500, {
-                "message": f"profile capture failed: {e}"
-            })
 
     def _trace_id(self) -> Optional[str]:
         """The request's propagated trace id (``X-PIO-Trace``), if any."""
@@ -128,12 +188,28 @@ class HTTPServerBase:
 
     host: str
     port: int
-    _httpd: Optional[ThreadingHTTPServer] = None
+    _httpd = None  # CappedThreadingHTTPServer | EventLoopHTTPServer
 
     def _make_handler(self):
         raise NotImplementedError
 
     bind_retries = 3  # MasterActor retries the spray bind 3x in the reference
+    # per-server connection bound + metric label; subclasses override
+    # (EngineServer reads them from its ServerConfig)
+    max_connections: int = DEFAULT_MAX_CONNECTIONS
+    server_name: str = "serving"
+
+    def _build_httpd(self):
+        """Construct the bound server object.  Default: the capped
+        threading edge.  EngineServer/RouterServer override this to
+        return an ``eventloop.EventLoopHTTPServer`` — same
+        ``server_address``/``serve_forever``/``shutdown``/
+        ``server_close`` surface, one lifecycle here."""
+        return CappedThreadingHTTPServer(
+            (self.host, self.port), self._make_handler(),
+            max_connections=self.max_connections,
+            server_name=self.server_name,
+        )
 
     def _bind(self) -> None:
         import errno
@@ -142,9 +218,7 @@ class HTTPServerBase:
         retries = max(1, self.bind_retries)
         for attempt in range(retries):
             try:
-                self._httpd = ThreadingHTTPServer(
-                    (self.host, self.port), self._make_handler()
-                )
+                self._httpd = self._build_httpd()
                 break
             except OSError as e:
                 # only a busy port is transient (a stale server shutting

@@ -12,18 +12,32 @@ unchanged QPS, bench_serving.py --threads).
 The TPU-shaped fix is to make concurrency *wider, not deeper*: coalesce
 the queries that arrive while a device call is in flight into ONE
 batched call (`Algorithm.batch_predict` — a [B, R] x [R, M] matmul
-costs barely more than the [R] x [R, M] one).  This is the
-leader/follower "continuous batching" pattern:
+costs barely more than the [R] x [R, M] one).  Two submission paths
+share one pending queue and one claim/run core:
 
-* a request appends its query to the pending list; if no batch is
-  executing, it becomes the LEADER: it takes everything pending (up to
-  ``max_batch``) and runs the batch function *on its own thread*;
-* requests arriving meanwhile park as FOLLOWERS; the leader's
-  completion wakes them — their results are already set, or one of
-  them becomes the next leader with the batch that accumulated;
-* under no concurrency the pending list always has exactly one entry
-  and the batcher degenerates to a direct call: no dispatcher thread,
-  no timer, zero added latency at QPS where batching can't help.
+* **Blocking** ``submit(x)`` — the original leader/follower pattern:
+  a request appends its query; if no batch is executing (and no
+  dispatcher owns the queue), it becomes the LEADER and runs the batch
+  on its own thread; requests arriving meanwhile park as FOLLOWERS.
+  Under no concurrency this degenerates to a direct call — no extra
+  thread, no timer, zero added latency.
+* **Continuous** ``submit_nowait(x, on_done, ...)`` (pio-surge) — the
+  event-loop edge admits requests *into the in-flight queue as they
+  arrive* and returns immediately; a lazily-started dispatcher thread
+  claims whatever is pending the moment the device frees up and fires
+  per-entry completion callbacks.  No thread ever parks per request:
+  the edge stays one loop thread + one dispatcher regardless of
+  concurrency.
+
+Deadline-aware admission (pio-surge): entries may carry a
+``resilience.policy.Deadline``.  A claimed entry already past its
+deadline is completed with ``DeadlineExceeded`` WITHOUT ever reaching
+the device (the device queue is the one resource concurrency shares —
+work for a client that gave up is pure stolen capacity), and
+:meth:`MicroBatcher.estimate_wait_s` exposes an EWMA-based estimate of
+queue+service time so the serving edge can reject a request that
+cannot make its SLO *up front* as a structured 503
+(:class:`AdmissionRejected`) rather than queue it to die.
 
 Batch size therefore adapts to the arrival rate with no tuning knob
 doing latency/throughput trades behind the operator's back
@@ -38,11 +52,13 @@ set ``ServerConfig(microbatch="off")``.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from ..obs.timeline import (
+    MICROBATCH_ADMISSION_TOTAL,
     MICROBATCH_BATCH_SIZE,
     MICROBATCH_QUEUE_DEPTH,
     MICROBATCH_ROLE_TOTAL,
@@ -50,8 +66,15 @@ from ..obs.timeline import (
     annotate,
     current_timeline,
 )
+from ..resilience.policy import Deadline, DeadlineExceeded
 
-__all__ = ["MicroBatcher", "dispatchable_sizes"]
+__all__ = [
+    "AdmissionRejected",
+    "MicroBatcher",
+    "dispatchable_sizes",
+]
+
+logger = logging.getLogger(__name__)
 
 # pulse saturation metrics, children cached at import (labels() is too
 # hot for the per-submit path); process-wide like pio_query_latency —
@@ -61,11 +84,22 @@ _m_batch_size = MICROBATCH_BATCH_SIZE.child()
 _m_batch_wait = MICROBATCH_WAIT_SECONDS.child()
 _m_leader = MICROBATCH_ROLE_TOTAL.labels(role="leader")
 _m_follower = MICROBATCH_ROLE_TOTAL.labels(role="follower")
+_m_dispatched = MICROBATCH_ROLE_TOTAL.labels(role="dispatched")
+_m_adm_rejected = MICROBATCH_ADMISSION_TOTAL.labels(outcome="rejected")
+_m_adm_expired = MICROBATCH_ADMISSION_TOTAL.labels(outcome="expired")
 
 # distinguishes "no result produced" from a legitimate None result —
 # batch_fns whose valid outputs include None must not have them
 # clobbered by the leader-abort guard
 _UNSET = object()
+
+
+class AdmissionRejected(DeadlineExceeded):
+    """The serving edge refused to queue a request that could not make
+    its deadline (estimated queue+service time exceeds the remaining
+    budget).  A subclass of :class:`DeadlineExceeded` so every existing
+    503 path handles it; kept distinct so the edge can count sheds
+    separately from in-flight expiries."""
 
 
 def _pad_size(n: int) -> int:
@@ -98,17 +132,22 @@ def dispatchable_sizes(max_batch: int) -> list[int]:
 class _Entry:
     # t_enq/t_claim/t_run0/t_run1 are the pulse timeline stamps: set by
     # whichever thread performs the transition (enqueue by the caller,
-    # claim by the leader, run bracketing by the executing thread) and
-    # read by the caller AFTER ``done`` — the condition variable's
-    # release/acquire orders the writes before the read
-    __slots__ = ("item", "done", "value", "error",
-                 "t_enq", "t_claim", "t_run0", "t_run1")
+    # claim by the leader/dispatcher, run bracketing by the executing
+    # thread) and read AFTER ``done`` — the condition variable's
+    # release/acquire (blocking path) or the dispatcher's post-batch
+    # callback (continuous path) orders the writes before the read
+    __slots__ = ("item", "done", "value", "error", "deadline", "tl",
+                 "on_done", "t_enq", "t_claim", "t_run0", "t_run1")
 
-    def __init__(self, item):
+    def __init__(self, item, deadline: Optional[Deadline] = None,
+                 tl=None, on_done: Optional[Callable] = None):
         self.item = item
         self.done = False
         self.value = _UNSET
         self.error: Exception | None = None
+        self.deadline = deadline
+        self.tl = tl
+        self.on_done = on_done
         self.t_enq = time.perf_counter()
         self.t_claim = None
         self.t_run0 = None
@@ -116,7 +155,8 @@ class _Entry:
 
 
 class MicroBatcher:
-    """Coalesce concurrent ``submit(x)`` calls into ``batch_fn([x...])``.
+    """Coalesce concurrent ``submit(x)`` / ``submit_nowait(x, cb)``
+    calls into ``batch_fn([x...])``.
 
     ``batch_fn`` receives a list of items and must return a list of
     results of the same length and order.  An exception from
@@ -149,6 +189,12 @@ class MicroBatcher:
         self._cond = threading.Condition()
         self._pending: list[_Entry] = []
         self._running = False
+        self._closed = False
+        self._dispatcher_alive = False
+        # EWMA of recent device-batch service time: the admission
+        # estimator's input.  Seeded 0 (= "no evidence, admit"), so a
+        # cold batcher never sheds; mutated only under _cond.
+        self._ewma_batch_s = 0.0
         # observability: how the batcher is actually coalescing.
         # Mutated only under _cond; read through stats() (bare reads
         # tore under concurrency — serving status JSON and the benches
@@ -158,11 +204,14 @@ class MicroBatcher:
         self.max_seen = 0
         self.leaders = 0
         self.followers = 0
+        self.dispatched = 0
+        self.expired = 0
 
     def reset_stats(self) -> None:
         with self._cond:
             self.batches = self.requests = self.max_seen = 0
             self.leaders = self.followers = 0
+            self.dispatched = self.expired = 0
 
     def stats(self) -> dict:
         """Locked snapshot of the coalescing counters plus the live
@@ -175,30 +224,73 @@ class MicroBatcher:
                 "maxBatchSeen": self.max_seen,
                 "leaders": self.leaders,
                 "followers": self.followers,
+                "dispatched": self.dispatched,
+                "expired": self.expired,
                 "queueDepth": len(self._pending),
+                "dispatcher": self._dispatcher_alive,
+                "ewmaBatchSec": self._ewma_batch_s,
             }
 
-    def submit(self, item: Any) -> Any:
-        entry = _Entry(item)
+    # -- admission (pio-surge) ---------------------------------------------
+    def estimate_wait_s(self) -> float:
+        """Estimated queue + service time a request admitted NOW would
+        experience: (in-flight batch + queued batches ahead + its own
+        batch) x the EWMA batch service time.  0.0 until the first
+        batch completes — no evidence means admit, never shed."""
+        with self._cond:
+            ew = self._ewma_batch_s
+            if ew <= 0.0:
+                return 0.0
+            ahead = 1.0 if self._running else 0.0
+            ahead += len(self._pending) / float(self.max_batch)
+            return (ahead + 1.0) * ew
+
+    def check_admission(self, deadline: Optional[Deadline]) -> None:
+        """Raise :class:`AdmissionRejected` when ``deadline`` cannot be
+        met even optimistically.  The up-front half of deadline-aware
+        admission: a request the estimator already knows will die in
+        the queue is answered a structured 503 NOW, costing the client
+        one RTT instead of its full timeout."""
+        if deadline is None:
+            return
+        remaining = deadline.remaining()
+        if remaining <= 0.0:
+            _m_adm_rejected.inc()
+            raise AdmissionRejected(
+                f"query deadline already exceeded its "
+                f"{deadline.budget_s:.3f}s budget at admission"
+            )
+        est = self.estimate_wait_s()
+        if est > remaining:
+            _m_adm_rejected.inc()
+            raise AdmissionRejected(
+                f"estimated queue+service time {est * 1e3:.1f}ms exceeds "
+                f"the {remaining * 1e3:.1f}ms remaining of the "
+                f"{deadline.budget_s:.3f}s deadline"
+            )
+
+    # -- submission paths --------------------------------------------------
+    def submit(self, item: Any,
+               deadline: Optional[Deadline] = None) -> Any:
+        """Blocking submit: returns the result (or raises) on the
+        calling thread.  With no dispatcher running, the classic
+        leader/follower flow; with one, the caller parks as a follower
+        of the dispatcher's batches."""
+        entry = _Entry(item, deadline=deadline)
         led_own = False
         with self._cond:
             self._pending.append(entry)
             _m_queue_depth.set(float(len(self._pending)))
-            # wake a leader sitting in its accumulation window (no-op
-            # for followers: they re-check state and wait again)
+            # wake a leader/dispatcher sitting in its accumulation
+            # window (no-op for followers: they re-check and wait)
             self._cond.notify_all()
             while True:
                 if entry.done:
                     break
-                if not self._running:
+                if not self._running and not self._dispatcher_alive:
                     # become the leader for everything pending now
                     self._running = True
-                    batch = self._pending[: self.max_batch]
-                    del self._pending[: len(batch)]
-                    now = time.perf_counter()
-                    for e in batch:
-                        e.t_claim = now
-                    _m_queue_depth.set(float(len(self._pending)))
+                    batch = self._claim_locked()
                     # role bookkeeping: with > max_batch entries ahead,
                     # the claimed batch may not include our own entry —
                     # then we led for OTHERS and our request is still a
@@ -220,14 +312,86 @@ class MicroBatcher:
             raise entry.error
         return entry.value if entry.value is not _UNSET else None
 
-    @staticmethod
-    def _book_timeline(entry: _Entry) -> None:
+    def submit_nowait(self, item: Any, on_done: Callable[["_Entry"], None],
+                      deadline: Optional[Deadline] = None,
+                      timeline=None) -> None:
+        """Continuous (callback) submit: the entry is admitted into the
+        pending queue immediately and ``on_done(entry)`` fires — on the
+        dispatcher thread, after the entry's timeline is booked — once
+        ``entry.value``/``entry.error`` is set.  The lazily-started
+        dispatcher claims the next batch the moment the device frees
+        up, so arrivals ride the NEXT device call rather than waiting
+        out a batch boundary."""
+        entry = _Entry(item, deadline=deadline, tl=timeline,
+                       on_done=on_done)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if not self._dispatcher_alive:
+                self._dispatcher_alive = True
+                threading.Thread(
+                    target=self._dispatch_loop, daemon=True,
+                    name="microbatch-dispatch",
+                ).start()
+            self._pending.append(entry)
+            _m_queue_depth.set(float(len(self._pending)))
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop accepting ``submit_nowait`` work and let the dispatcher
+        drain what is pending, then exit.  Blocking ``submit`` keeps
+        working (self-led) — a reload swaps batchers while in-flight
+        queries still hold the old one."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- claim/run core (shared by leaders and the dispatcher) -------------
+    def _claim_locked(self) -> list[_Entry]:
+        batch = self._pending[: self.max_batch]
+        del self._pending[: len(batch)]
+        now = time.perf_counter()
+        for e in batch:
+            e.t_claim = now
+        _m_queue_depth.set(float(len(self._pending)))
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        """Standing leader for the continuous path: claims pending
+        entries whenever the device is free.  Blocking submitters
+        coalesce into its batches as followers."""
+        with self._cond:
+            try:
+                while True:
+                    while not self._pending and not self._closed:
+                        self._cond.wait()
+                    if not self._pending and self._closed:
+                        break
+                    if self._running:
+                        # a blocking leader beat us to the claim
+                        self._cond.wait()
+                        continue
+                    self._running = True
+                    batch = self._claim_locked()
+                    try:
+                        self._lead(batch)
+                    except Exception:
+                        # _lead's finally already completed the batch;
+                        # the dispatcher itself must survive (a dead
+                        # dispatcher would wedge every future submit)
+                        logger.exception("microbatch dispatcher error")
+            finally:
+                self._dispatcher_alive = False
+                self._cond.notify_all()
+
+    def _book_timeline(self, entry: _Entry) -> None:
         """Book queue_wait/batch_wait/device from the entry stamps onto
-        the thread's current timeline.  Residual time inside the submit
-        region (condition wake latency, a solo retry after a failed
-        batch) is attributed to ``device`` by add_block, so the
+        the entry's attached timeline (continuous path) or the calling
+        thread's current one (blocking path).  Residual time inside the
+        covered region (condition wake latency, a solo retry after a
+        failed batch) is attributed to ``device`` by add_block, so the
         timeline's segment sum still equals wall time."""
-        tl = current_timeline()
+        tl = entry.tl if entry.tl is not None else current_timeline()
         if tl is None:
             return
         parts = []
@@ -240,8 +404,13 @@ class MicroBatcher:
         tl.add_block(parts, residual_to="device")
 
     def _lead(self, batch: list[_Entry]) -> None:
-        """Run one batch on the calling thread.  Called with the lock
-        HELD; releases it around the device call and re-acquires.
+        """Run one claimed batch on the calling thread.  Called with
+        the lock HELD; releases it around the device call (and around
+        continuous-path callbacks) and re-acquires.
+
+        Claim-time deadline enforcement happens here: entries already
+        past their deadline are completed with ``DeadlineExceeded`` and
+        never reach the device.
 
         The ENTIRE leader turn — accumulation window included — sits
         inside one try/finally: a BaseException landing anywhere in it
@@ -250,33 +419,49 @@ class MicroBatcher:
         done and clear ``_running``, or the followers block forever and
         every future ``submit`` hangs behind a leaderless batcher."""
         completed = False
+        live: list[_Entry] = []
+        n_expired = 0
+        for e in batch:
+            if e.deadline is not None and e.deadline.expired:
+                e.error = DeadlineExceeded(
+                    f"query expired in the batch queue after "
+                    f"{time.perf_counter() - e.t_enq:.3f}s (budget "
+                    f"{e.deadline.budget_s:.3f}s); never dispatched"
+                )
+                n_expired += 1
+            else:
+                live.append(e)
+        if n_expired:
+            _m_adm_expired.inc(n_expired)
         try:
-            if self.max_wait_s > 0 and len(batch) < self.max_batch:
+            if self.max_wait_s > 0 and live and len(live) < self.max_batch:
                 # optional accumulation window (off by default): give
                 # near-simultaneous arrivals a chance to join this batch.
                 # Arrivals notify; absorb after EVERY wake (timeout
                 # included) so nothing queued during the window is left
                 # behind for the next leader.
                 deadline = time.monotonic() + self.max_wait_s
-                while len(batch) < self.max_batch:
+                while len(live) < self.max_batch:
                     left = deadline - time.monotonic()
                     if left <= 0:
                         break
                     self._cond.wait(left)
-                    take = self.max_batch - len(batch)
+                    take = self.max_batch - len(live)
                     absorbed = self._pending[:take]
                     del self._pending[:take]
                     if absorbed:
                         now = time.perf_counter()
                         for e in absorbed:
                             e.t_claim = now
+                        live += absorbed
                         batch += absorbed
                         _m_queue_depth.set(float(len(self._pending)))
-            self._cond.release()
-            try:
-                self._run_batch(batch)
-            finally:
-                self._cond.acquire()
+            if live:
+                self._cond.release()
+                try:
+                    self._run_batch(live)
+                finally:
+                    self._cond.acquire()
             completed = True
         finally:
             for e in batch:
@@ -292,10 +477,46 @@ class MicroBatcher:
                     )
                 e.done = True
             self._running = False
-            self.batches += 1
+            if live:
+                self.batches += 1
+                self.max_seen = max(self.max_seen, len(live))
+                e0 = live[0]
+                if e0.t_run0 is not None and e0.t_run1 is not None:
+                    dt = max(e0.t_run1 - e0.t_run0, 0.0)
+                    self._ewma_batch_s = (
+                        dt if self._ewma_batch_s <= 0.0
+                        else 0.25 * dt + 0.75 * self._ewma_batch_s
+                    )
             self.requests += len(batch)
-            self.max_seen = max(self.max_seen, len(batch))
+            self.expired += n_expired
+            # continuous entries get the third role: the dispatcher ran
+            # the device call for them, no request thread led anything
+            n_disp = sum(1 for e in batch if e.on_done is not None)
+            if n_disp:
+                self.dispatched += n_disp
+                _m_dispatched.inc(n_disp)
             self._cond.notify_all()
+            # continuous-path completions: book timelines and fire the
+            # callbacks OUTSIDE the lock (a callback enqueues response
+            # bytes to the event loop / runs serving.serve — neither
+            # may hold the batcher's condition).  Inside the finally so
+            # even a BaseException tearing through the leader still
+            # answers every event-loop request (their entries carry the
+            # leader-abort error by this point).
+            cbs = [e for e in batch if e.on_done is not None]
+            if cbs:
+                self._cond.release()
+                try:
+                    for e in cbs:
+                        self._book_timeline(e)
+                        try:
+                            e.on_done(e)
+                        except Exception:
+                            logger.exception(
+                                "microbatch completion callback failed"
+                            )
+                finally:
+                    self._cond.acquire()
 
     def _run_batch(self, batch: list[_Entry]) -> None:
         """Execute one batch; on failure, isolate the blast radius.

@@ -1,0 +1,585 @@
+"""pio-surge: serving replica fleet router.
+
+One serving process is one core's worth of QPS; "millions of users"
+means going horizontal.  ``pio-tpu deploy --replicas N`` boots N
+single-replica EngineServer *processes* (each its own interpreter —
+no shared GIL, its own device queue, its own ``/metrics``) and ONE
+router process in front:
+
+* **Routing**: ``POST /queries.json`` round-robins over healthy
+  replicas on pooled keep-alive connections.  A transport failure
+  (replica killed, connection refused, read timeout) marks the replica
+  down, books a ``failover``, and retries the SAME request on the next
+  replica — predicts are idempotent, so the client sees one 200 and no
+  evidence a replica died.  Only when every replica is unreachable
+  does the router answer a structured 503.
+* **Health**: a daemon thread polls each replica's ``GET /`` status
+  every ``health_interval_s``, maintaining per-replica health, breaker
+  state, and the fleet gauges ``pio_replica_up{replica}`` /
+  ``pio_replica_model_freshness_seconds{replica}`` (the labeled
+  fleet-wide view of each replica's own
+  ``pio_model_freshness_seconds``).
+* **Rolling delta push (pio-live x fleet)**: ``POST
+  /admin/push-foldin`` walks the replicas ONE AT A TIME, POSTing
+  ``/foldin/apply`` so each patches any pending fold-in delta links in
+  place (no reload, no warmup).  Strictly sequential by construction:
+  fleet availability never drops below N-1 replicas during a push, and
+  a replica that fails to apply keeps serving its stale model while
+  the rest of the fleet advances.  ``--push-foldin SEC`` runs the same
+  rolling push on a timer.
+
+The router itself rides the event-loop edge (`server/eventloop.py`):
+the loop parses and routes, a bounded worker pool does the blocking
+upstream HTTP, so router threads are O(pool), not O(connections).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.parse
+from pathlib import Path
+from typing import Optional
+
+from ..obs import (
+    REPLICA_MODEL_FRESHNESS,
+    REPLICA_REQUESTS_TOTAL,
+    REPLICA_UP,
+    TRACE_HEADER,
+)
+from ..resilience.policy import CircuitBreaker
+from .eventloop import EventLoopHTTPServer, callback_scope
+from .http_base import HTTPServerBase, observability_response
+
+__all__ = [
+    "Replica",
+    "RouterConfig",
+    "RouterServer",
+    "spawn_replica",
+    "wait_for_port_file",
+]
+
+logger = logging.getLogger(__name__)
+
+
+class RouterConfig:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 health_interval_s: float = 1.0,
+                 health_timeout_s: float = 2.0,
+                 forward_timeout_s: float = 30.0,
+                 breaker_failures: int = 3,
+                 breaker_reset_s: float = 2.0,
+                 max_connections: int = 1024,
+                 workers: int = 16,
+                 push_foldin_s: Optional[float] = None):
+        self.host = host
+        self.port = port
+        self.health_interval_s = health_interval_s
+        self.health_timeout_s = health_timeout_s
+        self.forward_timeout_s = forward_timeout_s
+        self.breaker_failures = breaker_failures
+        self.breaker_reset_s = breaker_reset_s
+        self.max_connections = max_connections
+        # blocking upstream forwards run on this many pool threads;
+        # the loop thread itself never blocks on a replica
+        self.workers = workers
+        # optional timer driving the rolling fold-in push (the same
+        # walk POST /admin/push-foldin triggers on demand)
+        self.push_foldin_s = push_foldin_s
+
+
+class Replica:
+    """Router-side state for one replica: address, pooled keep-alive
+    connections, breaker, health + last-seen status fields."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 breaker_failures: int = 3, breaker_reset_s: float = 2.0,
+                 timeout_s: float = 30.0):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_failures,
+            reset_timeout_s=breaker_reset_s,
+        )
+        self._lock = threading.Lock()
+        self._pool: list[http.client.HTTPConnection] = []
+        # healthy starts True: a fresh fleet serves immediately and the
+        # first failed forward/health-check flips it (optimistic start
+        # beats rejecting the first second of traffic)
+        self.healthy = True
+        self.last_status: dict = {}
+        self.last_error: Optional[str] = None
+        self.forwarded = 0
+        self.errors = 0
+        self.failovers = 0
+        self._m_up = REPLICA_UP.labels(replica=name)
+        self._m_fresh = REPLICA_MODEL_FRESHNESS.labels(replica=name)
+        self._m_ok = REPLICA_REQUESTS_TOTAL.labels(
+            replica=name, outcome="ok")
+        self._m_err = REPLICA_REQUESTS_TOTAL.labels(
+            replica=name, outcome="error")
+        self._m_fail = REPLICA_REQUESTS_TOTAL.labels(
+            replica=name, outcome="failover")
+        self._m_up.set(1.0)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _connect(self) -> http.client.HTTPConnection:
+        c = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        c.connect()
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return c
+
+    def request(self, method: str, path: str, body: Optional[bytes],
+                headers: Optional[dict] = None,
+                timeout_s: Optional[float] = None) -> tuple[int, bytes, str]:
+        """One upstream round trip on a pooled keep-alive connection.
+        Transport trouble raises OSError/http.client exceptions — the
+        router's failover signal; HTTP error statuses return normally
+        (an application 4xx/5xx is the replica's answer, not a death)."""
+        with self._lock:
+            conn = self._pool.pop() if self._pool else None
+        if conn is None:
+            conn = self._connect()
+        elif timeout_s is not None and conn.sock is not None:
+            conn.sock.settimeout(timeout_s)
+        try:
+            hdrs = {"Content-Type": "application/json"}
+            if headers:
+                hdrs.update(headers)
+            conn.request(method, path, body, headers=hdrs)
+            r = conn.getresponse()
+            data = r.read()
+            ctype = r.getheader("Content-Type",
+                                "application/json") or "application/json"
+            status = r.status
+        except BaseException:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            if len(self._pool) < 32:
+                self._pool.append(conn)
+            else:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        return status, data, ctype
+
+    def mark_down(self, err: str) -> None:
+        self.healthy = False
+        self.last_error = err
+        self.breaker.record_failure()
+        self._m_up.set(0.0)
+        # drop pooled connections: they point at a corpse
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for c in pool:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def mark_up(self, status: dict) -> None:
+        self.healthy = True
+        self.last_error = None
+        self.last_status = status
+        self.breaker.record_success()
+        self._m_up.set(1.0)
+        fresh = status.get("modelFreshnessSec")
+        if fresh is not None:
+            self._m_fresh.set(float(fresh))
+
+    def snapshot(self) -> dict:
+        out = {
+            "name": self.name,
+            "url": self.url,
+            "healthy": self.healthy,
+            "breaker": self.breaker.state,
+            "forwarded": self.forwarded,
+            "errors": self.errors,
+            "failovers": self.failovers,
+        }
+        if self.last_error:
+            out["lastError"] = self.last_error
+        st = self.last_status
+        for src_key, dst_key in (
+            ("engineInstanceId", "engineInstanceId"),
+            ("requestCount", "requestCount"),
+            ("modelFreshnessSec", "modelFreshnessSec"),
+            ("foldinDeltasApplied", "foldinDeltasApplied"),
+        ):
+            if src_key in st:
+                out[dst_key] = st[src_key]
+        return out
+
+
+class RouterServer(HTTPServerBase):
+    """The fleet front door; see module docstring."""
+
+    server_name = "router"
+
+    def __init__(self, replicas: list[Replica],
+                 config: Optional[RouterConfig] = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = replicas
+        self.config = config or RouterConfig()
+        self._pool = None
+        self._rr_lock = threading.Lock()
+        self._rr = 0
+        self._push_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self.start_time = time.time()  # wall clock: a TIMESTAMP
+        self.request_count = 0
+        self.unroutable = 0
+        self._health_thread: Optional[threading.Thread] = None
+        self._push_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def host(self) -> str:
+        return self.config.host
+
+    @property
+    def port(self) -> int:
+        return self.config.port
+
+    @port.setter
+    def port(self, v: int) -> None:
+        self.config.port = v
+
+    @property
+    def max_connections(self) -> int:
+        return self.config.max_connections
+
+    def _build_httpd(self):
+        import concurrent.futures
+
+        if self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.config.workers,
+                thread_name_prefix="router-fwd",
+            )
+        self._start_daemons()
+        return EventLoopHTTPServer(
+            (self.host, self.port), self._el_handle,
+            max_connections=self.config.max_connections,
+            name="router",
+        )
+
+    def _start_daemons(self) -> None:
+        if self._health_thread is None:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True, name="router-health"
+            )
+            self._health_thread.start()
+        if self.config.push_foldin_s and self._push_thread is None:
+            self._push_thread = threading.Thread(
+                target=self._push_loop, daemon=True, name="router-push"
+            )
+            self._push_thread.start()
+
+    def stop(self) -> None:
+        super().stop()
+        self._stop_event.set()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    # -- health ------------------------------------------------------------
+    def check_replica(self, replica: Replica) -> bool:
+        try:
+            status, data, _ = replica.request(
+                "GET", "/", None,
+                timeout_s=self.config.health_timeout_s,
+            )
+            if status != 200:
+                replica.mark_down(f"status {status}")
+                return False
+            replica.mark_up(json.loads(data.decode()))
+            return True
+        except Exception as e:
+            replica.mark_down(f"{type(e).__name__}: {e}")
+            return False
+
+    def check_all(self) -> None:
+        for r in self.replicas:
+            self.check_replica(r)
+
+    def _health_loop(self) -> None:
+        while not self._stop_event.wait(self.config.health_interval_s):
+            try:
+                self.check_all()
+            except Exception:
+                logger.exception("router health sweep failed")
+
+    # -- rolling fold-in push ---------------------------------------------
+    def push_foldin(self) -> dict:
+        """Walk the fleet ONE replica at a time, telling each to apply
+        any pending fold-in delta links now (``POST /foldin/apply``).
+        Sequential by construction — mid-push, at most the one replica
+        currently applying is busy (and the apply is in-place anyway),
+        so availability never drops below N-1."""
+        results = []
+        with self._push_lock:  # one rolling push at a time
+            for r in self.replicas:
+                if not r.healthy:
+                    results.append({
+                        "replica": r.name, "skipped": "unhealthy",
+                    })
+                    continue
+                try:
+                    status, data, _ = r.request(
+                        "POST", "/foldin/apply", b"{}",
+                        timeout_s=self.config.forward_timeout_s,
+                    )
+                    body = json.loads(data.decode())
+                    entry = {"replica": r.name, "status": status}
+                    entry.update({
+                        k: body[k] for k in
+                        ("applied", "modelFreshnessSec",
+                         "foldinDeltasApplied")
+                        if k in body
+                    })
+                    results.append(entry)
+                    fresh = body.get("modelFreshnessSec")
+                    if fresh is not None:
+                        r._m_fresh.set(float(fresh))
+                except Exception as e:
+                    r.mark_down(f"{type(e).__name__}: {e}")
+                    results.append({
+                        "replica": r.name,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+        return {"pushed": results}
+
+    def _push_loop(self) -> None:
+        while not self._stop_event.wait(self.config.push_foldin_s):
+            try:
+                self.push_foldin()
+            except Exception:
+                logger.exception("rolling fold-in push failed")
+
+    # -- forwarding --------------------------------------------------------
+    def _candidates(self) -> list[Replica]:
+        with self._rr_lock:
+            self._rr += 1
+            start = self._rr
+        n = len(self.replicas)
+        order = [self.replicas[(start + i) % n] for i in range(n)]
+        healthy = [r for r in order if r.healthy]
+        # last resort: unhealthy replicas whose breaker grants a probe
+        # (a recovered replica starts taking traffic before the next
+        # health tick)
+        probes = [r for r in order
+                  if not r.healthy and r.breaker.allow()]
+        return healthy + probes
+
+    def _forward_query(self, path_qs: str, body: bytes,
+                       trace_id: Optional[str], respond) -> None:
+        """Worker-pool half of the hot path: try candidates in order
+        until one answers; transport failures fail over with the
+        replica marked down."""
+        headers = {TRACE_HEADER: trace_id} if trace_id else None
+        candidates = self._candidates()
+        last_err = "no replicas configured"
+        for i, replica in enumerate(candidates):
+            try:
+                status, data, ctype = replica.request(
+                    "POST", path_qs, body, headers=headers,
+                    timeout_s=self.config.forward_timeout_s,
+                )
+            except Exception as e:
+                last_err = f"{replica.name}: {type(e).__name__}: {e}"
+                replica.errors += 1
+                replica._m_fail.inc()
+                replica.failovers += 1
+                replica.mark_down(last_err)
+                continue
+            if not replica.healthy:
+                replica.mark_up(replica.last_status)
+            replica.forwarded += 1
+            (replica._m_ok if status < 500 else replica._m_err).inc()
+            try:
+                respond(status, data, ctype=ctype)
+            except RuntimeError:
+                pass
+            return
+        self.unroutable += 1
+        try:
+            respond(503, {
+                "message": f"no replica available ({last_err})",
+                "error": "NoReplicaAvailable",
+            }, extra_headers=[("Retry-After", "1")])
+        except RuntimeError:
+            pass
+
+    # -- http --------------------------------------------------------------
+    def status_json(self) -> dict:
+        return {
+            "status": "alive",
+            "role": "router",
+            "replicas": [r.snapshot() for r in self.replicas],
+            "healthyReplicas": sum(r.healthy for r in self.replicas),
+            "requestCount": self.request_count,
+            "unroutable": self.unroutable,
+            "startTime": self.start_time,
+            "maxConnections": self.config.max_connections,
+        }
+
+    @callback_scope
+    def _el_handle(self, req, respond) -> None:
+        u = urllib.parse.urlparse(req.path)
+        path = u.path
+        if req.method == "POST" and path == "/queries.json":
+            self.request_count += 1  # loop-thread only: no lock needed
+            tid = (req.header(TRACE_HEADER) or "").strip() or None
+            body = req.body
+            pool = self._pool
+            if pool is None:
+                respond(503, {"message": "router is stopping"})
+                return
+            try:
+                pool.submit(
+                    self._forward_query, req.path, body, tid, respond
+                )
+            except RuntimeError:
+                respond(503, {"message": "router is stopping"})
+            return
+        if req.method == "POST" and path == "/admin/push-foldin":
+            pool = self._pool
+            if pool is None:
+                respond(503, {"message": "router is stopping"})
+                return
+
+            def push():
+                try:
+                    respond(200, self.push_foldin())
+                except RuntimeError:
+                    pass
+                except Exception as e:
+                    logger.exception("push-foldin failed")
+                    try:
+                        respond(500, {"message": str(e)})
+                    except RuntimeError:
+                        pass
+
+            try:
+                pool.submit(push)
+            except RuntimeError:
+                respond(503, {"message": "router is stopping"})
+            return
+        if req.method == "POST" and path == "/stop":
+            respond(200, {"message": "stopping"})
+            threading.Thread(target=self.stop, daemon=True).start()
+            return
+        if req.method == "GET":
+            ans = observability_response(path, u.query)
+            if ans is not None:
+                # /debug/profile can block for seconds — pool, not loop
+                pool = self._pool
+
+                def obs():
+                    code, payload, ctype = observability_response(
+                        path, u.query
+                    )
+                    try:
+                        respond(code, payload,
+                                ctype=ctype or "application/json")
+                    except RuntimeError:
+                        pass
+
+                if path == "/debug/profile" and pool is not None:
+                    pool.submit(obs)
+                else:
+                    code, payload, ctype = ans
+                    respond(code, payload,
+                            ctype=ctype or "application/json")
+                return
+            if path == "/":
+                respond(200, self.status_json())
+                return
+        respond(404, {"message": "not found"})
+
+
+# -- replica process spawning ----------------------------------------------
+
+
+def spawn_replica(engine_json, index: int, coord_dir,
+                  extra_args=(), env=None,
+                  python: str = sys.executable) -> dict:
+    """Launch one replica as a real subprocess (`pio-tpu deploy` on an
+    ephemeral port, announcing it through a port file in
+    ``coord_dir``).  Returns ``{"proc", "port_file", "log_path",
+    "index"}``; pair with :func:`wait_for_port_file`."""
+    coord_dir = Path(coord_dir)
+    coord_dir.mkdir(parents=True, exist_ok=True)
+    port_file = coord_dir / f"replica-{index}.port"
+    log_path = coord_dir / f"replica-{index}.log"
+    # the child must resolve predictionio_tpu regardless of caller cwd
+    import os as _os
+
+    pkg_root = str(Path(__file__).resolve().parent.parent.parent)
+    env = dict(env if env is not None else _os.environ)
+    pp = env.get("PYTHONPATH", "")
+    if pkg_root not in pp.split(_os.pathsep):
+        env["PYTHONPATH"] = (
+            pkg_root + (_os.pathsep + pp if pp else "")
+        )
+    cmd = [
+        python, "-m", "predictionio_tpu.cli.main", "deploy",
+        "--engine-json", str(engine_json),
+        "--ip", "127.0.0.1", "--port", "0",
+        "--port-file", str(port_file),
+        *extra_args,
+    ]
+    log_f = open(log_path, "w")
+    proc = subprocess.Popen(
+        cmd, stdout=log_f, stderr=subprocess.STDOUT, env=env,
+    )
+    log_f.close()
+    return {"proc": proc, "port_file": port_file,
+            "log_path": log_path, "index": index}
+
+
+def wait_for_port_file(spawned: dict, timeout_s: float = 180.0) -> int:
+    """Block until the replica announces its bound port (or dies)."""
+    port_file = spawned["port_file"]
+    proc = spawned["proc"]
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if port_file.exists():
+            text = port_file.read_text().strip()
+            if text:
+                return int(text)
+        if proc.poll() is not None:
+            tail = ""
+            try:
+                tail = Path(spawned["log_path"]).read_text()[-2000:]
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"replica {spawned['index']} exited rc={proc.returncode} "
+                f"before announcing a port; log tail:\n{tail}"
+            )
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"replica {spawned['index']} did not announce a port within "
+        f"{timeout_s}s"
+    )

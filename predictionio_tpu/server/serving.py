@@ -32,7 +32,13 @@ from http.server import ThreadingHTTPServer
 from typing import Any, Callable, Optional
 
 from ..controller.base import WorkflowContext
-from .http_base import HTTPServerBase, JsonRequestHandler
+from .http_base import (
+    HTTPServerBase,
+    JsonRequestHandler,
+    observability_response,
+)
+from .eventloop import callback_scope
+from .microbatch import AdmissionRejected
 from ..controller.engine import Engine, EngineParams
 from ..obs import (
     FOLDIN_APPLIES_TOTAL,
@@ -88,9 +94,25 @@ class ServerConfig:
                  breaker_failures: int = 5,
                  breaker_reset_s: float = 10.0,
                  retry_seed: Optional[int] = None,
-                 foldin_poll_s: Optional[float] = None):
+                 foldin_poll_s: Optional[float] = None,
+                 edge: str = "eventloop",
+                 max_connections: int = 512):
         self.host = host
         self.port = port
+        # pio-surge: which HTTP front end answers the port.
+        # "eventloop" (default) = ONE selector loop thread parses and
+        # routes every connection, device work rides the micro-batcher
+        # dispatcher, blocking routes ride a small aux pool — no thread
+        # per connection.  "threads" = the pre-surge stdlib
+        # ThreadingHTTPServer edge (kept for bitwise-compatible A/B
+        # benchmarking and as a fallback).
+        if edge not in ("eventloop", "threads"):
+            raise ValueError(f"edge must be eventloop|threads, got {edge!r}")
+        self.edge = edge
+        # concurrent-connection cap (both edges): connection attempts
+        # past it are answered a structured 503 and closed, so a
+        # slow-loris client can't pin unbounded threads/sockets
+        self.max_connections = max_connections
         self.feedback = feedback
         self.event_server_url = event_server_url
         self.access_key = access_key
@@ -121,6 +143,26 @@ class ServerConfig:
         # stop-the-world reload).  None = off; deltas already on disk
         # at (re)load time are still caught up once.
         self.foldin_poll_s = foldin_poll_s
+
+
+class _QueryCtx:
+    """Per-query snapshot shared by the blocking and event-loop paths:
+    decoded query, deadline, the components captured under the state
+    lock, and the pio-live attribution fields."""
+
+    __slots__ = ("query", "deadline", "algorithms", "models", "serving",
+                 "batcher", "freshness", "foldin_seq")
+
+    def __init__(self, query, deadline, algorithms, models, serving,
+                 batcher, freshness, foldin_seq):
+        self.query = query
+        self.deadline = deadline
+        self.algorithms = algorithms
+        self.models = models
+        self.serving = serving
+        self.batcher = batcher
+        self.freshness = freshness
+        self.foldin_seq = foldin_seq
 
 
 def _takes_max_batch(fn: Callable) -> bool:
@@ -239,6 +281,18 @@ class EngineServer(HTTPServerBase):
             failure_threshold=self.config.breaker_failures,
             reset_timeout_s=self.config.breaker_reset_s,
         )
+        # pio-surge admission breaker: consecutive deadline-admission
+        # rejects open it, and while open every deadlined request is
+        # shed immediately (no estimator math) — the cheap-shed mode an
+        # overloaded edge needs; any completed query closes it again
+        self._admission_breaker = CircuitBreaker(
+            failure_threshold=max(self.config.breaker_failures * 4, 8),
+            reset_timeout_s=min(self.config.breaker_reset_s, 1.0),
+        )
+        # aux pool for the event-loop edge's blocking routes (status,
+        # reload, /debug/profile, fold-in apply, unbatched predicts);
+        # built lazily at first bind of the eventloop edge
+        self._aux_pool = None
         self._foldin_stop = threading.Event()
         self._load(instance_id)
         if self.config.foldin_poll_s:
@@ -257,6 +311,12 @@ class EngineServer(HTTPServerBase):
         self.start_time = time.time()  # wall clock: a TIMESTAMP, not a span
         self._latency = Histogram()
         self._m_latency = QUERY_LATENCY.child()
+        # per-outcome query counters resolved once (.labels() is too
+        # hot for per-request use); shared by both edges
+        self._m_queries = {
+            s: QUERIES_TOTAL.labels(status=s)
+            for s in ("ok", "bad_request", "timeout", "error", "rejected")
+        }
         self._httpd: Optional[ThreadingHTTPServer] = None
         # pio-xray: compile/cache events during warmup+serving book into
         # /metrics, and the daemon device sampler keeps the per-device
@@ -325,6 +385,7 @@ class EngineServer(HTTPServerBase):
                     logger.info("%s warmed up in %.2fs",
                                 type(algo).__name__, dt)
         with self._lock:
+            old_batcher = getattr(self, "batcher", None)
             self.engine_params = engine_params
             self.models = models
             self.algorithms = algorithms
@@ -339,6 +400,10 @@ class EngineServer(HTTPServerBase):
             self.foldin_deltas_applied = 0
             self.last_foldin_error = None
             self.model_advanced_mono = time.monotonic()
+        # the old batcher's dispatcher thread (continuous path) drains
+        # and exits; in-flight queries still holding it complete fine
+        if old_batcher is not None and old_batcher is not batcher:
+            old_batcher.close()
         # catch up on delta links already published for this instance
         # (a redeploy/reload must not serve staler than the chain)
         self._apply_available_deltas()
@@ -371,6 +436,18 @@ class EngineServer(HTTPServerBase):
             return None
 
         def batch_fn(queries):
+            if len(queries) == 1:
+                # solo batches ride the scalar predict path: the [1, M]
+                # batched executable is measurably SLOWER than the [M]
+                # matvec one (CPU: 5.2 ms vs 1.5 ms at M=100k, R=64 —
+                # a batched row top-k pays layout overhead a vector
+                # top-k doesn't), and under no concurrency every batch
+                # is solo
+                q = queries[0]
+                return [[
+                    algo.predict(model, q)
+                    for algo, model in zip(algorithms, models)
+                ]]
             per_algo = [
                 algo.batch_predict(model, queries)
                 for algo, model in zip(algorithms, models)
@@ -545,72 +622,70 @@ class EngineServer(HTTPServerBase):
         self._foldin_status()  # computing the fields also sets the gauges
 
     # -- query path -------------------------------------------------------
-    def predict_json(self, query_json: dict,
-                     timeout_s: Optional[float] = None) -> Any:
-        # pulse timeline: adopt the HTTP handler's (its t0 covers body
-        # read + JSON decode, and it adds the socket-write segment
-        # after the reply) or own a fresh one for direct callers
-        # (benches, tests) — either way the batcher finds it via the
-        # thread-local scope and credits queue/batch/device waits
-        tl = timeline.current_timeline()
-        owned = tl is None
-        if owned:
-            tl = timeline.Timeline("serve")
-        t0 = time.perf_counter()
-        _m_inflight.inc()
-        try:
-            with timeline.timeline_scope(tl), annotate("pio.serve.query"):
-                # the request's time budget: per-request override, else
-                # the configured default, else unbounded (None costs
-                # nothing)
-                budget = timeout_s if timeout_s is not None \
-                    else self.config.query_timeout_s
-                deadline = Deadline.after(budget) \
-                    if budget is not None else None
-                query = self.query_decoder(query_json)
-                tl.mark("parse")
-                with self._lock:
-                    algorithms, models, serving, batcher = (
-                        self.algorithms, self.models, self.serving,
-                        self.batcher,
-                    )
-                    # pio-live attribution, captured with the snapshot:
-                    # a slow query concurrent with a fold-in apply is
-                    # explicable from its flight record alone
-                    freshness = (
-                        time.monotonic() - self.model_advanced_mono
-                    )
-                    foldin_seq = max(
-                        self.foldin_applied_seq.values(), default=0
-                    )
-                faults.check("device.dispatch")
-                tl.mark("auth")
-                with deadline_scope(deadline):
-                    if deadline is not None:
-                        # checked at the device boundary: dispatching a
-                        # batched XLA call for a request whose client
-                        # gave up wastes the one resource concurrency
-                        # shares — the device queue
-                        deadline.check("query device dispatch")
-                    if batcher is not None:
-                        # concurrent requests coalesce into one batched
-                        # device call (serve() stays per-request on the
-                        # caller's thread); the batcher books the
-                        # queue_wait/batch_wait/device segments
-                        predictions = batcher.submit(query)
-                    else:
-                        predictions = [
-                            algo.predict(model, query)
-                            for algo, model in zip(algorithms, models)
-                        ]
-                        tl.mark("device")
-                    if deadline is not None:
-                        deadline.check("query serving")
-                    result = serving.serve(query, predictions)
-                out = _result_to_json(result)
-                tl.mark("serialize")
-        finally:
-            _m_inflight.dec()
+    def _query_setup(self, query_json: dict, timeout_s: Optional[float],
+                     tl) -> "_QueryCtx":
+        """Shared front half of a query on ANY edge: budget, decode,
+        state snapshot, fault point, deadline-aware admission.  Marks
+        the ``parse``/``auth`` timeline boundaries.  Runs on the
+        calling thread (event-loop thread or HTTP handler thread) and
+        never blocks."""
+        # the request's time budget: per-request override, else the
+        # configured default, else unbounded (None costs nothing)
+        budget = timeout_s if timeout_s is not None \
+            else self.config.query_timeout_s
+        deadline = Deadline.after(budget) if budget is not None else None
+        query = self.query_decoder(query_json)
+        tl.mark("parse")
+        with self._lock:
+            ctx = _QueryCtx(
+                query=query,
+                deadline=deadline,
+                algorithms=self.algorithms,
+                models=self.models,
+                serving=self.serving,
+                batcher=self.batcher,
+                # pio-live attribution, captured with the snapshot: a
+                # slow query concurrent with a fold-in apply is
+                # explicable from its flight record alone
+                freshness=time.monotonic() - self.model_advanced_mono,
+                foldin_seq=max(
+                    self.foldin_applied_seq.values(), default=0
+                ),
+            )
+        faults.check("device.dispatch")
+        tl.mark("auth")
+        if deadline is not None:
+            # deadline-aware admission (pio-surge): a request that
+            # cannot make its SLO is answered a structured 503 NOW
+            # instead of queued to die.  The breaker is the cheap-shed
+            # mode: after repeated rejects it opens and deadlined
+            # requests shed without estimator math until a success.
+            if not self._admission_breaker.allow():
+                raise AdmissionRejected(
+                    "admission breaker open: the edge is shedding "
+                    "deadlined requests (overload)"
+                )
+            try:
+                if ctx.batcher is not None:
+                    ctx.batcher.check_admission(deadline)
+                else:
+                    deadline.check("query admission")
+            except AdmissionRejected:
+                self._admission_breaker.record_failure()
+                raise
+        return ctx
+
+    def _query_finish(self, ctx: "_QueryCtx", predictions, tl, t0: float,
+                      query_json: dict) -> Any:
+        """Shared back half: serving.serve, JSON encode, stats/
+        histogram/trace/flight bookkeeping, feedback injection.  Runs
+        on whatever thread completed the device work."""
+        if ctx.deadline is not None:
+            ctx.deadline.check("query serving")
+        result = ctx.serving.serve(ctx.query, predictions)
+        out = _result_to_json(result)
+        tl.mark("serialize")
+        self._admission_breaker.record_success()
         dt = time.perf_counter() - t0
         with self._lock:
             self.request_count += 1
@@ -629,20 +704,303 @@ class EngineServer(HTTPServerBase):
         self._m_latency.observe(dt, exemplar=tid)
         attrs = {
             "instance": instance_id,
-            "modelFreshnessSec": round(max(freshness, 0.0), 3),
+            "modelFreshnessSec": round(max(ctx.freshness, 0.0), 3),
             "segmentsMs": tl.snapshot_ms(),
         }
-        if foldin_seq:
-            attrs["foldinSeq"] = foldin_seq
+        if ctx.foldin_seq:
+            attrs["foldinSeq"] = ctx.foldin_seq
         get_tracer().record("serve.query", dt, attrs=attrs)
         get_flight_recorder().offer(
             tid, dt, name="serve.query", attrs=attrs
         )
-        if owned:
-            tl.finish()
         if self.config.feedback and self.config.event_server_url:
             out = self._send_feedback(query_json, out)
         return out
+
+    def predict_json(self, query_json: dict,
+                     timeout_s: Optional[float] = None) -> Any:
+        """Blocking query path (threading edge, direct library callers,
+        benches).  The event-loop edge uses the same setup/finish
+        halves around a continuous ``submit_nowait`` instead."""
+        # pulse timeline: adopt the HTTP handler's (its t0 covers body
+        # read + JSON decode, and it adds the socket-write segment
+        # after the reply) or own a fresh one for direct callers
+        # (benches, tests) — either way the batcher finds it via the
+        # thread-local scope and credits queue/batch/device waits
+        tl = timeline.current_timeline()
+        owned = tl is None
+        if owned:
+            tl = timeline.Timeline("serve")
+        t0 = time.perf_counter()
+        _m_inflight.inc()
+        try:
+            with timeline.timeline_scope(tl), annotate("pio.serve.query"):
+                ctx = self._query_setup(query_json, timeout_s, tl)
+                with deadline_scope(ctx.deadline):
+                    if ctx.deadline is not None:
+                        # checked at the device boundary: dispatching a
+                        # batched XLA call for a request whose client
+                        # gave up wastes the one resource concurrency
+                        # shares — the device queue
+                        ctx.deadline.check("query device dispatch")
+                    if ctx.batcher is not None:
+                        # concurrent requests coalesce into one batched
+                        # device call (serve() stays per-request on the
+                        # caller's thread); the batcher books the
+                        # queue_wait/batch_wait/device segments
+                        predictions = ctx.batcher.submit(
+                            ctx.query, deadline=ctx.deadline
+                        )
+                    else:
+                        predictions = [
+                            algo.predict(model, ctx.query)
+                            for algo, model in zip(ctx.algorithms,
+                                                   ctx.models)
+                        ]
+                        tl.mark("device")
+                    out = self._query_finish(
+                        ctx, predictions, tl, t0, query_json
+                    )
+        finally:
+            _m_inflight.dec()
+        if owned:
+            tl.finish()
+        return out
+
+    # -- event-loop edge (pio-surge) ---------------------------------------
+    def _build_httpd(self):
+        if self.config.edge != "eventloop":
+            return super()._build_httpd()
+        from .eventloop import EventLoopHTTPServer
+
+        if self._aux_pool is None:
+            import concurrent.futures
+
+            # blocking routes only (status/reload/profile/fold-in and
+            # unbatched predicts) — the query hot path never lands here
+            self._aux_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="serve-aux"
+            )
+        return EventLoopHTTPServer(
+            (self.host, self.port), self._el_handle,
+            max_connections=self.config.max_connections,
+            name="serving",
+        )
+
+    def _aux_submit(self, respond, fn) -> None:
+        """Hand a blocking route to the aux pool; if the pool is gone
+        (server stopping) answer 503 instead of crashing the loop."""
+        try:
+            self._aux_pool.submit(fn)
+        except RuntimeError:
+            try:
+                respond(503, {"message": "server is stopping"})
+            except RuntimeError:
+                pass
+
+    def _aux(self, respond, fn, *args) -> None:
+        """Run ``fn(*args) -> (code, payload, ctype, extra_headers)``
+        on the aux pool and answer from there."""
+        def run():
+            try:
+                code, payload, ctype, extra = fn(*args)
+                respond(code, payload, ctype=ctype, extra_headers=extra)
+            except Exception as e:
+                logger.exception("aux route failed")
+                try:
+                    respond(500, {"message": str(e)})
+                except RuntimeError:
+                    pass  # route answered before raising
+
+        self._aux_submit(respond, run)
+
+    @callback_scope
+    def _el_handle(self, req, respond) -> None:
+        """Event-loop request router: runs ON the loop thread — every
+        branch either answers inline from in-memory state or hands off
+        (batcher dispatcher / aux pool) without blocking."""
+        u = urllib.parse.urlparse(req.path)
+        path = u.path
+        if req.method == "POST":
+            if path == "/queries.json":
+                self._el_query(req, u.query, respond)
+            elif path == "/stop":
+                respond(200, {"message": "stopping"})
+                threading.Thread(target=self.stop, daemon=True).start()
+            elif path == "/foldin/apply":
+                self._aux(respond, self._blocking_foldin_apply)
+            else:
+                respond(404, {"message": "not found"})
+            return
+        if req.method == "GET":
+            accept = req.header("accept", "") or ""
+            self._aux(respond, self._blocking_get, path, u.query, accept)
+            return
+        respond(405, {"message": f"method {req.method} not allowed"})
+
+    def _blocking_get(self, path: str, query: str, accept: str):
+        ans = observability_response(path, query)
+        if ans is not None:
+            code, payload, ctype = ans
+            return code, payload, ctype or "application/json", ()
+        if path == "/":
+            if "text/html" in accept:
+                return (200, self.status_html().encode(),
+                        "text/html; charset=utf-8", ())
+            return 200, self.status_json(), "application/json", ()
+        if path == "/reload":
+            try:
+                iid = self.reload()
+                return 200, {"reloaded": iid}, "application/json", ()
+            except LookupError as e:
+                return 404, {"message": str(e)}, "application/json", ()
+            except Exception as e:
+                logger.exception("reload failed")
+                return (500, {"message": f"reload failed: {e}"},
+                        "application/json", ())
+        return 404, {"message": "not found"}, "application/json", ()
+
+    def _blocking_foldin_apply(self):
+        """POST /foldin/apply: apply any pending fold-in delta links
+        NOW (the router's rolling delta push calls this per replica —
+        push semantics on top of the poll machinery)."""
+        n = self._apply_available_deltas()
+        out = {"applied": n}
+        out.update(self._foldin_status())
+        return 200, out, "application/json", ()
+
+    @callback_scope
+    def _el_query(self, req, query_str: str, respond) -> None:
+        """The continuous hot path: parse + admission on the loop
+        thread, device work on the batcher dispatcher, completion
+        (serve/encode/bookkeeping) on the dispatcher's callback, socket
+        write back on the loop.  One request never parks a thread."""
+        tid = (req.header(TRACE_HEADER) or "").strip() or new_trace_id()
+        hdrs = [(TRACE_HEADER, tid)]
+        tl = timeline.Timeline("serve")
+        try:
+            query_json = json.loads(req.body.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            self._m_queries["bad_request"].inc()
+            respond(400, {"message": f"invalid JSON: {e}"},
+                    extra_headers=hdrs)
+            return
+        timeout_s = None
+        tv = urllib.parse.parse_qs(query_str).get("timeout")
+        if tv:
+            try:
+                timeout_s = float(tv[0])
+            except ValueError:
+                self._m_queries["bad_request"].inc()
+                respond(400, {"message": f"bad timeout: {tv[0]!r}"},
+                        extra_headers=hdrs)
+                return
+        _m_inflight.inc()
+        try:
+            with trace_scope(tid), timeline.timeline_scope(tl):
+                ctx = self._query_setup(query_json, timeout_s, tl)
+        except Exception as e:
+            _m_inflight.dec()
+            self._el_reply_error(e, respond, hdrs)
+            return
+
+        if ctx.batcher is None:
+            # no batched path for this engine: the per-query predict is
+            # blocking device work — aux pool, not the loop
+            def run_direct():
+                try:
+                    with trace_scope(tid), timeline.timeline_scope(tl), \
+                            deadline_scope(ctx.deadline), \
+                            annotate("pio.serve.query"):
+                        if ctx.deadline is not None:
+                            ctx.deadline.check("query device dispatch")
+                        predictions = [
+                            algo.predict(model, ctx.query)
+                            for algo, model in zip(ctx.algorithms,
+                                                   ctx.models)
+                        ]
+                        tl.mark("device")
+                        out = self._query_finish(
+                            ctx, predictions, tl, tl.t0, query_json
+                        )
+                except Exception as e:
+                    _m_inflight.dec()
+                    self._el_reply_error(e, respond, hdrs)
+                    return
+                _m_inflight.dec()
+                self._m_queries["ok"].inc()
+                respond(200, out, extra_headers=hdrs, tl=tl)
+
+            self._aux_submit(respond, run_direct)
+            return
+
+        def done(entry):
+            # batcher dispatcher thread: the entry's timeline is booked
+            # (queue_wait/batch_wait/device) before this fires
+            err = entry.error
+            out = None
+            if err is None:
+                try:
+                    with trace_scope(tid), deadline_scope(ctx.deadline):
+                        out = self._query_finish(
+                            ctx, entry.value, tl, tl.t0, query_json
+                        )
+                except Exception as e:
+                    err = e
+            _m_inflight.dec()
+            if err is not None:
+                self._el_reply_error(err, respond, hdrs)
+                return
+            self._m_queries["ok"].inc()
+            respond(200, out, extra_headers=hdrs, tl=tl)
+
+        try:
+            ctx.batcher.submit_nowait(
+                ctx.query, done, deadline=ctx.deadline, timeline=tl
+            )
+        except RuntimeError:
+            # the snapshot raced a reload that closed this batcher:
+            # retry once on the current one
+            with self._lock:
+                batcher = self.batcher
+            if batcher is not None and batcher is not ctx.batcher:
+                ctx.batcher = batcher
+                batcher.submit_nowait(
+                    ctx.query, done, deadline=ctx.deadline, timeline=tl
+                )
+            else:
+                _m_inflight.dec()
+                self._el_reply_error(
+                    RuntimeError("batcher unavailable during reload"),
+                    respond, hdrs,
+                )
+
+    def _el_reply_error(self, e: BaseException, respond, hdrs) -> None:
+        """Map a query-path exception to the same structured replies
+        the threading edge produces (and the same counters)."""
+        try:
+            if isinstance(e, AdmissionRejected):
+                self._m_queries["rejected"].inc()
+                respond(503, {"message": str(e),
+                              "error": "AdmissionRejected"},
+                        extra_headers=hdrs + [("Retry-After", "1")])
+            elif isinstance(e, DeadlineExceeded):
+                self._m_queries["timeout"].inc()
+                respond(503, {"message": str(e),
+                              "error": "DeadlineExceeded"},
+                        extra_headers=hdrs + [("Retry-After", "1")])
+            elif isinstance(e, (KeyError, ValueError, TypeError)):
+                self._m_queries["bad_request"].inc()
+                respond(400, {"message": f"bad query: {e}"},
+                        extra_headers=hdrs)
+                self.remote_log(f"Query is invalid: {e}")
+            else:
+                self._m_queries["error"].inc()
+                logger.error("query failed: %s", e)
+                respond(500, {"message": str(e)}, extra_headers=hdrs)
+                self.remote_log(f"Query failed: {e}")
+        except RuntimeError:
+            pass  # request already answered
 
     def _send_feedback(self, query_json: dict, result_json: Any) -> Any:
         """Enqueue a pio_pr feedback event with prId injection, off the
@@ -862,9 +1220,17 @@ class EngineServer(HTTPServerBase):
 
     def stop(self) -> None:
         super().stop()
-        # release the delta-poll and delivery drain threads (pending
-        # entries are abandoned — the process is going away)
+        # release the delta-poll, batcher-dispatcher, aux and delivery
+        # drain threads (pending entries are abandoned — the process is
+        # going away)
         self._foldin_stop.set()
+        with self._lock:
+            batcher = getattr(self, "batcher", None)
+        if batcher is not None:
+            batcher.close()
+        if self._aux_pool is not None:
+            self._aux_pool.shutdown(wait=False)
+            self._aux_pool = None
         self._feedback_queue.close()
         self._log_queue.close()
 
@@ -881,6 +1247,10 @@ class EngineServer(HTTPServerBase):
     def port(self, v: int) -> None:
         self.config.port = v
 
+    @property
+    def max_connections(self) -> int:
+        return self.config.max_connections
+
     def _make_handler(server: "EngineServer"):
         # labeled counter children resolved ONCE: .labels() is a dict
         # build + lock per call (~1.5 us), too hot for per-request use
@@ -888,6 +1258,7 @@ class EngineServer(HTTPServerBase):
         m_bad = QUERIES_TOTAL.labels(status="bad_request")
         m_timeout = QUERIES_TOTAL.labels(status="timeout")
         m_err = QUERIES_TOTAL.labels(status="error")
+        m_rejected = QUERIES_TOTAL.labels(status="rejected")
 
         class Handler(JsonRequestHandler):
             server_logger = logger
@@ -935,6 +1306,13 @@ class EngineServer(HTTPServerBase):
                     tl = timeline.Timeline("serve")
                     with trace_scope(tid), timeline.timeline_scope(tl):
                         self._post_query(raw, tl)
+                elif self.path.startswith("/foldin/apply"):
+                    try:
+                        code, payload, _, _ = server._blocking_foldin_apply()
+                        self._reply(code, payload)
+                    except Exception as e:
+                        logger.exception("foldin apply failed")
+                        self._reply(500, {"message": str(e)})
                 elif self.path.startswith("/stop"):
                     self._reply(200, {"message": "stopping"})
                     threading.Thread(target=server.stop, daemon=True).start()
@@ -971,6 +1349,16 @@ class EngineServer(HTTPServerBase):
                     tl.mark("write")
                     tl.finish()
                     m_ok.inc()
+                except AdmissionRejected as e:
+                    # deadline-aware admission shed the request before
+                    # it queued (pio-surge): same structured 503, its
+                    # own counter
+                    m_rejected.inc()
+                    self.extra_headers.append(("Retry-After", "1"))
+                    self._reply(503, {
+                        "message": str(e),
+                        "error": "AdmissionRejected",
+                    })
                 except DeadlineExceeded as e:
                     # structured overload answer, not a hang: the
                     # client can back off and retry

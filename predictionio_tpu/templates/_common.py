@@ -74,25 +74,59 @@ class DeviceTableMixin:
             )
 
         for attr in list(vars(self)):
-            plain = attr.startswith("_dev_item_factors_")
-            normed = attr.startswith("_dev_item_factors_norm_")
-            if not plain:
+            if not attr.startswith("_dev_item_factors_"):
                 continue
+            normed = attr.startswith("_dev_item_factors_norm_")
+            transposed = attr.startswith("_dev_item_factors_t_")
             dev = getattr(self, attr)
             src_rows = norm(rows_np) if normed else rows_np
             src_app = (
                 None if app_np is None
                 else (norm(app_np) if normed else app_np)
             )
-            if src_app is not None:
-                dev = jnp.concatenate(
-                    [dev, jnp.asarray(src_app).astype(dev.dtype)], axis=0
-                )
-            if len(rows_np):
-                dev = dev.at[ixs_d].set(
-                    jnp.asarray(src_rows).astype(dev.dtype)
-                )
+            if transposed:
+                # the [R, M] serving layout: appended rows become
+                # appended COLUMNS, patched rows become column writes
+                if src_app is not None:
+                    dev = jnp.concatenate(
+                        [dev, jnp.asarray(src_app.T).astype(dev.dtype)],
+                        axis=1,
+                    )
+                if len(rows_np):
+                    dev = dev.at[:, ixs_d].set(
+                        jnp.asarray(src_rows.T).astype(dev.dtype)
+                    )
+            else:
+                if src_app is not None:
+                    dev = jnp.concatenate(
+                        [dev, jnp.asarray(src_app).astype(dev.dtype)],
+                        axis=0,
+                    )
+                if len(rows_np):
+                    dev = dev.at[ixs_d].set(
+                        jnp.asarray(src_rows).astype(dev.dtype)
+                    )
             setattr(self, attr, dev)
+
+    def device_item_factors_t(self, dtype: Optional[str] = None):
+        """The item table PRE-TRANSPOSED to ``[R, M]`` (contiguous) —
+        the layout the batched serving matmul wants on CPU backends
+        (``ops.topk.batch_topk_scores_t``: contraction dim contiguous
+        on both operands, ~5x the GFLOPS of ``@ table.T`` through
+        XLA's Eigen path).  Cached per dtype; pio-live delta applies
+        patch it column-wise in place."""
+        import jax.numpy as jnp
+
+        key = f"_dev_item_factors_t_{dtype or 'native'}"
+        dev = getattr(self, key, None)
+        if dev is None:
+            dev = jnp.asarray(np.ascontiguousarray(
+                np.asarray(self.item_factors).T
+            ))
+            if dtype:
+                dev = dev.astype(jnp.dtype(dtype))
+            setattr(self, key, dev)
+        return dev
 
     def device_item_factors_normalized(self, dtype: Optional[str] = None):
         """Row-normalized table for cosine scoring — normalized once (in
@@ -169,7 +203,8 @@ def pow2_ladder(max_batch: int) -> list[int]:
 
 def warm_batched_topk(table, rank: int, n: int,
                       unmasked_too: bool = False,
-                      max_batch: int = 64) -> None:
+                      max_batch: int = 64,
+                      table_t=None) -> None:
     """Pre-compile the pow2 batched top-k shapes the serving
     micro-batcher dispatches (server/microbatch.py pads batches to
     powers of two; templates round k to pow2): EVERY B in
@@ -180,21 +215,29 @@ def warm_batched_topk(table, rank: int, n: int,
     exists to avoid (ADVICE r4).  ``max_batch <= 0`` (no batcher: the
     per-query predict path serves everything) skips the batched warms
     entirely — they would compile executables nothing dispatches."""
-    from ..ops.topk import batch_topk_scores, pow2_ceil
+    from ..ops.topk import batch_topk_scores, batch_topk_scores_t, pow2_ceil
 
     ladder = pow2_ladder(max_batch)
     if not ladder:
         return
+
+    def warm(vecs, k, mask=None):
+        # warm the scorer the caller's batch path actually dispatches:
+        # the transposed [R, M] one when a transposed table is given
+        # (recommendation), the classic [M, R] one otherwise
+        if table_t is not None:
+            batch_topk_scores_t(vecs, table_t, k, mask=mask)
+        else:
+            batch_topk_scores(vecs, table, k, mask=mask)
+
     k_default = min(pow2_ceil(10), n)
     for b in ladder:
         vecs = np.zeros((b, rank), np.float32)
-        batch_topk_scores(vecs, table, k_default,
-                          mask=np.zeros((b, n), np.float32))
+        warm(vecs, k_default, mask=np.zeros((b, n), np.float32))
         if unmasked_too:
-            batch_topk_scores(vecs, table, k_default)
+            warm(vecs, k_default)
     for k in {min(pow2_ceil(k), n) for k in (1, 4)}:
         vecs = np.zeros((1, rank), np.float32)
-        batch_topk_scores(vecs, table, k,
-                          mask=np.zeros((1, n), np.float32))
+        warm(vecs, k, mask=np.zeros((1, n), np.float32))
         if unmasked_too:
-            batch_topk_scores(vecs, table, k)
+            warm(vecs, k)

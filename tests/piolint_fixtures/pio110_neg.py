@@ -1,0 +1,58 @@
+"""PIO110 negative fixture: the compliant twin — bounded waits,
+non-blocking alternatives, unmarked (non-loop) functions, and nested
+deferred work all stay quiet."""
+
+import asyncio
+import queue
+import socket
+import time
+from queue import Queue
+
+
+def callback_scope(fn):  # stand-in for server.eventloop.callback_scope
+    return fn
+
+
+_events = queue.Queue()
+_sock = socket.socket()
+
+
+async def poll_politely():
+    await asyncio.sleep(0.1)  # the non-blocking sleep
+    try:
+        return _events.get(timeout=0.5)  # bounded wait: legal
+    except queue.Empty:
+        return _events.get(block=False)  # non-blocking get: legal
+
+
+@callback_scope
+def on_request(req, respond):
+    # dict .get is not a queue .get — receiver taint keeps this quiet
+    timeout = req.headers.get("x-timeout")
+    # nested defs are DEFERRED work (aux pool / dispatcher), where
+    # blocking is fine — the loop never runs them
+    def later():
+        time.sleep(0.01)
+        return _sock.recv(1)
+
+    respond(200, {"t": timeout, "cb": later})
+
+
+def plain_worker_thread():
+    # unmarked plain function: worker-thread code may block freely
+    time.sleep(0.2)
+    data = _sock.recv(4096)
+    q = Queue()
+    q.put(data)
+    return q.get()
+
+
+class Edge:
+    def __init__(self):
+        self._q = queue.Queue()
+
+    @callback_scope
+    def on_readable(self):
+        # bounded queue ops inside the callback scope are legal
+        self._q.put("x", timeout=0.1)
+        return self._q.get(timeout=0.1)

@@ -1,0 +1,45 @@
+"""PIO110 positive fixture: blocking calls inside loop-thread scopes
+(coroutines and @callback_scope handlers) must be flagged."""
+
+import queue
+import socket
+import time
+from queue import Queue
+
+
+def callback_scope(fn):  # stand-in for server.eventloop.callback_scope
+    return fn
+
+
+_events = queue.Queue()
+_sock = socket.socket()
+
+
+async def poll_for_result():
+    time.sleep(0.1)  # EXPECT: PIO110
+    return _events.get()  # EXPECT: PIO110
+
+
+@callback_scope
+def on_request(req, respond):
+    data = _sock.recv(4096)  # EXPECT: PIO110
+    respond(200, {"data": len(data)})
+
+
+@callback_scope
+def drain_one():
+    q = Queue()
+    return q.get()  # EXPECT: PIO110
+
+
+class Edge:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._conn = socket.create_connection(("127.0.0.1", 80))
+
+    @callback_scope
+    def on_readable(self):
+        item = self._q.put("x")  # EXPECT: PIO110
+        self._conn.sendall(b"hi")  # EXPECT: PIO110
+        time.sleep(1)  # EXPECT: PIO110
+        return item

@@ -329,3 +329,205 @@ def test_engine_server_auto_gating(storage_memory):
                               config=ServerConfig(port=0, microbatch="on"))
         assert srv_on.batcher is not None
         assert srv_on.predict_json({"x": 5}) == {"y": 10}
+
+
+# -- pio-surge: continuous admission (submit_nowait + deadlines) -----------
+
+
+def test_mid_batch_admission_rides_next_device_call():
+    """A request admitted WHILE a batch is executing must ride the
+    very next device call (continuous admission), not wait out some
+    batch-boundary barrier."""
+    first_entered = threading.Event()
+    release = threading.Event()
+    sizes = []
+    done = []
+
+    def batch_fn(xs):
+        sizes.append(len(xs))
+        if len(sizes) == 1:
+            first_entered.set()
+            assert release.wait(10)
+        return [x * 10 for x in xs]
+
+    b = MicroBatcher(batch_fn, max_batch=64)
+    b.submit_nowait(1, lambda e: done.append(("a", e.value)))
+    assert first_entered.wait(10)  # dispatcher is mid-device-call
+    # admitted mid-batch: these queue continuously behind the in-flight
+    # batch and form the NEXT one together
+    b.submit_nowait(2, lambda e: done.append(("b", e.value)))
+    b.submit_nowait(3, lambda e: done.append(("c", e.value)))
+    deadline = time.time() + 10
+    while True:
+        with b._cond:
+            if len(b._pending) == 2:
+                break
+        assert time.time() < deadline, "arrivals never queued"
+        time.sleep(0.002)
+    release.set()
+    deadline = time.time() + 10
+    while len(done) < 3 and time.time() < deadline:
+        time.sleep(0.005)
+    assert sorted(done) == [("a", 10), ("b", 20), ("c", 30)]
+    assert sizes == [1, 2]  # the two arrivals coalesced into ONE next call
+    stats = b.stats()
+    assert stats["dispatched"] == 3
+    assert stats["dispatcher"] is True
+    b.close()
+
+
+def test_deadline_expired_request_never_reaches_device():
+    """Claim-time enforcement: an entry whose deadline lapsed in the
+    queue completes with DeadlineExceeded and the device NEVER sees its
+    item."""
+    from predictionio_tpu.resilience.policy import (
+        Deadline, DeadlineExceeded,
+    )
+
+    first_entered = threading.Event()
+    release = threading.Event()
+    seen_items = []
+    done = {}
+
+    def batch_fn(xs):
+        seen_items.append(list(xs))
+        if len(seen_items) == 1:
+            first_entered.set()
+            assert release.wait(10)
+        return list(xs)
+
+    b = MicroBatcher(batch_fn, max_batch=64)
+    b.submit_nowait("warm", lambda e: done.setdefault("warm", e))
+    assert first_entered.wait(10)
+    # queued behind the in-flight batch with an already-tiny budget
+    b.submit_nowait("doomed", lambda e: done.setdefault("doomed", e),
+                    deadline=Deadline.after(0.01))
+    b.submit_nowait("fine", lambda e: done.setdefault("fine", e))
+    time.sleep(0.1)  # let the doomed deadline lapse while queued
+    release.set()
+    deadline = time.time() + 10
+    while len(done) < 3 and time.time() < deadline:
+        time.sleep(0.005)
+    assert isinstance(done["doomed"].error, DeadlineExceeded)
+    assert done["fine"].value == "fine"
+    # the device saw the warm batch and the fine item — never "doomed"
+    flat = [x for batch in seen_items for x in batch]
+    assert "doomed" not in flat
+    assert b.stats()["expired"] == 1
+    b.close()
+
+
+def test_continuous_path_timeline_identity():
+    """The accounting identity survives the new admission path: an
+    async entry's timeline segments still sum EXACTLY to the covered
+    wall time (queue_wait/batch_wait/device booked from entry stamps,
+    residual credited to device)."""
+    from predictionio_tpu.obs.timeline import Timeline
+
+    def batch_fn(xs):
+        time.sleep(0.02)
+        return list(xs)
+
+    b = MicroBatcher(batch_fn)
+    tl = Timeline("serve")
+    tl.mark("parse")
+    finished = threading.Event()
+
+    def on_done(entry):
+        finished.set()
+
+    b.submit_nowait(5, on_done, timeline=tl)
+    assert finished.wait(10)
+    segs = tl.segments
+    assert {"queue_wait", "batch_wait", "device"} <= set(segs)
+    assert segs["device"] >= 0.015  # the sleep lands in device
+    assert sum(segs.values()) == pytest.approx(tl._last - tl.t0, abs=1e-6)
+    b.close()
+
+
+def test_admission_estimate_and_rejection():
+    """check_admission: silent while there is no service-time evidence;
+    once the EWMA knows a batch costs ~50 ms, a 1 ms deadline is
+    rejected up front (AdmissionRejected ⊂ DeadlineExceeded) and a
+    roomy one admits."""
+    from predictionio_tpu.resilience.policy import (
+        Deadline, DeadlineExceeded,
+    )
+    from predictionio_tpu.server.microbatch import AdmissionRejected
+
+    def batch_fn(xs):
+        time.sleep(0.05)
+        return list(xs)
+
+    b = MicroBatcher(batch_fn)
+    # no evidence yet: even a tight (unexpired) deadline admits
+    assert b.estimate_wait_s() == 0.0
+    b.check_admission(Deadline.after(0.001))
+    assert b.submit(1) == 1  # teaches the EWMA
+    assert b.estimate_wait_s() > 0.04
+    with pytest.raises(AdmissionRejected):
+        b.check_admission(Deadline.after(0.001))
+    assert issubclass(AdmissionRejected, DeadlineExceeded)
+    b.check_admission(Deadline.after(10.0))  # roomy budget admits
+    b.check_admission(None)  # no deadline: never sheds
+    # an already-expired deadline rejects regardless of evidence
+    d = Deadline.after(0.0005)
+    time.sleep(0.002)
+    with pytest.raises(AdmissionRejected):
+        b.check_admission(d)
+
+
+def test_submit_nowait_after_close_raises_and_blocking_still_works():
+    b = MicroBatcher(lambda xs: [x + 1 for x in xs])
+    done = []
+    b.submit_nowait(1, lambda e: done.append(e.value))
+    deadline = time.time() + 10
+    while not done and time.time() < deadline:
+        time.sleep(0.005)
+    assert done == [2]
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit_nowait(3, lambda e: None)
+    # blocking submit degrades to self-led batches after close
+    deadline = time.time() + 10
+    while b.stats()["dispatcher"] and time.time() < deadline:
+        time.sleep(0.005)
+    assert b.submit(9) == 10
+
+
+def test_mixed_blocking_and_continuous_coalesce():
+    """Blocking submitters coalesce into the dispatcher's batches as
+    followers once a dispatcher owns the queue."""
+    first_entered = threading.Event()
+    release = threading.Event()
+    sizes = []
+    async_done = []
+
+    def batch_fn(xs):
+        sizes.append(len(xs))
+        if len(sizes) == 1:
+            first_entered.set()
+            assert release.wait(10)
+        return [x * 2 for x in xs]
+
+    b = MicroBatcher(batch_fn, max_batch=64)
+    b.submit_nowait(1, lambda e: async_done.append(e.value))
+    assert first_entered.wait(10)
+    with concurrent.futures.ThreadPoolExecutor(2) as ex:
+        blocking = [ex.submit(b.submit, x) for x in (2, 3)]
+        deadline = time.time() + 10
+        while True:
+            with b._cond:
+                if len(b._pending) == 2:
+                    break
+            assert time.time() < deadline
+            time.sleep(0.002)
+        release.set()
+        assert sorted(f.result(10) for f in blocking) == [4, 6]
+    assert async_done == [2]
+    stats = b.stats()
+    assert stats["requests"] == 3
+    # the two blocking entries ran inside the dispatcher's second batch
+    assert sizes == [1, 2]
+    assert stats["followers"] == 2
+    b.close()

@@ -34,6 +34,7 @@ from predictionio_tpu.analysis.cli import (
     main,
     repo_root,
 )
+from predictionio_tpu.analysis.asynclint import AsyncEngine
 from predictionio_tpu.analysis.jaxlint import JaxEngine
 from predictionio_tpu.analysis.locklint import LockEngine
 from predictionio_tpu.analysis.timelint import TimeEngine
@@ -54,7 +55,8 @@ def run_fixture(path: Path):
     src = SourceFile.load(path, path.parent)
     return (JaxEngine(src, bench_scope=True).run()
             + LockEngine(src).run()
-            + TimeEngine(src).run())
+            + TimeEngine(src).run()
+            + AsyncEngine(src).run())
 
 
 def expected_findings(path: Path) -> set[tuple[str, int]]:

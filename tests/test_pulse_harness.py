@@ -228,3 +228,85 @@ def test_qps_records_separate_from_latency_keys(tmp_path):
     lat_bad = bench_gate.check_candidate(history, _lat_rec(30.0))
     assert qps_bad["status"] == lat_bad["status"] == "regression"
     assert qps_bad["nSamples"] == lat_bad["nSamples"] == 3
+
+
+# -- open-loop Poisson mode (pio-surge) ------------------------------------
+
+
+def test_open_loop_poisson_offers_scheduled_load():
+    """--arrival-rate: open-loop workers fire on schedule; the result
+    carries the offered rate, coordinated-omission-free percentiles,
+    and the separate service-time view; achieved QPS lands near the
+    offered rate against a fast server."""
+    with _StubHandler() as stub:
+        res = loadgen.run_load(
+            f"http://127.0.0.1:{stub.port}/queries.json", ['{"q": 1}'],
+            concurrency=2, duration_s=1.5, mode="thread",
+            arrival_rate=100.0, seed=7,
+        )
+    assert res["errors"] == 0
+    assert res["arrival_rate"] == 100.0
+    assert res["missed"] == 0
+    # Poisson(100/s) over 1.5s across 2 workers: ~150 arrivals; allow
+    # wide slack for scheduling noise but prove the SCHEDULE drove it
+    # (closed-loop at c2 against this stub would do thousands)
+    assert 90 <= res["completed"] <= 230
+    assert 60.0 <= res["qps"] <= 160.0
+    assert res["service_p50_ms"] <= res["p50_ms"] + 1e-9
+    assert len(res["latencies"]) == res["completed"]
+
+
+def test_open_loop_books_stall_per_scheduled_arrival():
+    """The coordinated-omission proof: a mid-window server stall books
+    schedule lag into EVERY arrival queued behind it (latency measured
+    from scheduled time), so open-loop p99 >> service p99 — exactly
+    the signal closed-loop measurement hides (a closed-loop worker
+    politely stops offering load during the stall, booking it once)."""
+    import time as _time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    stalled = threading.Event()
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True
+        hits = 0
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            H.hits += 1
+            if H.hits == 10 and not stalled.is_set():
+                stalled.set()
+                _time.sleep(0.4)  # one 400 ms stall mid-window
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        res = loadgen.run_load(
+            f"http://127.0.0.1:{srv.server_address[1]}/queries.json",
+            ['{"q": 1}'],
+            concurrency=1, duration_s=1.5, mode="thread",
+            arrival_rate=150.0, seed=3,
+        )
+    finally:
+        srv.shutdown()
+        srv.server_close()
+    assert stalled.is_set()
+    assert res["errors"] == 0
+    assert res["completed"] > 50
+    # ~60 arrivals were scheduled during the 400 ms stall; each booked
+    # its own share of it, so the open-loop p90 carries the stall while
+    # the service-time p50 stays tiny (requests themselves were fast)
+    assert res["p90_ms"] > 50.0
+    assert res["service_p50_ms"] < 20.0
+    assert res["p99_ms"] + 1e-9 >= res["service_p99_ms"]

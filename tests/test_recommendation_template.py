@@ -170,13 +170,13 @@ def test_batch_predict_shape_stable_under_invalid_queries(ctx,
     models = e.train(ctx, ep)
     algo = e._algorithms(ep)[0]
     shapes = []
-    real = rmod.batch_topk_scores
+    real = rmod.batch_topk_scores_t
 
-    def spy(vecs, table, k, mask=None):
+    def spy(vecs, table_t, k, mask=None):
         shapes.append((vecs.shape[0], k))
-        return real(vecs, table, k, mask=mask)
+        return real(vecs, table_t, k, mask=mask)
 
-    monkeypatch.setattr(rmod, "batch_topk_scores", spy)
+    monkeypatch.setattr(rmod, "batch_topk_scores_t", spy)
     queries = [Query(user="u0", num=3), Query(user="ghost", num=3),
                Query(user="u1", num=0), Query(user="u2", num=3)]
     out = algo.batch_predict(models[0], queries)
@@ -461,3 +461,45 @@ def test_read_training_fused_path_matches_general(tmp_path):
     assert np.array_equal(a.ratings.item_ix[ka], b.ratings.item_ix[kb])
     assert np.allclose(a.ratings.rating[ka], b.ratings.rating[kb])
     assert a.items == b.items
+
+
+def test_transposed_device_cache_patches_with_deltas():
+    """pio-surge x pio-live: the pre-transposed [R, M] serving table
+    (the fast batched-matmul layout) must patch column-wise under a
+    fold-in delta — patched rows, appended rows, every dtype cache —
+    and stay bitwise-equal to a fresh transpose of the patched host
+    table."""
+    import numpy as np
+
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.recommendation import ALSModel
+
+    rng = np.random.default_rng(11)
+    model = ALSModel(
+        user_factors=rng.normal(size=(4, 8)).astype(np.float32),
+        item_factors=rng.normal(size=(6, 8)).astype(np.float32),
+        users=StringIndex([f"u{i}" for i in range(4)]),
+        items=StringIndex([f"i{i}" for i in range(6)]),
+        item_props={},
+    )
+    t0 = np.asarray(model.device_item_factors_t())
+    assert t0.shape == (8, 6)
+    np.testing.assert_array_equal(t0, model.item_factors.T)
+    # patch rows 1 and 4, append two new rows
+    new_rows = rng.normal(size=(2, 8)).astype(np.float32)
+    appended = rng.normal(size=(2, 8)).astype(np.float32)
+    host = np.concatenate([model.item_factors, appended], axis=0)
+    host[[1, 4]] = new_rows
+    model.item_factors = host
+    model.patch_device_item_rows([1, 4], new_rows, appended)
+    t1 = np.asarray(model.device_item_factors_t())
+    assert t1.shape == (8, 8)
+    np.testing.assert_array_equal(t1, host.T)
+    # the batched scorer over the patched transposed cache agrees with
+    # a dense numpy argmax ranking
+    from predictionio_tpu.ops.topk import batch_topk_scores_t
+
+    q = rng.normal(size=(2, 8)).astype(np.float32)
+    vals, ixs = batch_topk_scores_t(q, model.device_item_factors_t(), 3)
+    ref = np.argsort(-(q @ host.T), axis=1)[:, :3]
+    np.testing.assert_array_equal(np.asarray(ixs), ref)

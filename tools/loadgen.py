@@ -7,12 +7,21 @@ GIL serializes the measurement and the reported p99 is the client's,
 not the server's.  This module is the honest load edge for the
 QPS@SLO gate:
 
-* **Closed-loop workers**: each worker issues its next request only
-  after the previous response is fully read, so offered load always
-  equals ``concurrency`` in-flight requests — the classic closed-loop
-  model whose measured throughput at a latency SLO is well-defined
-  (open-loop generators conflate queueing delay with service time the
-  moment the server saturates).
+* **Closed-loop workers** (default): each worker issues its next
+  request only after the previous response is fully read, so offered
+  load always equals ``concurrency`` in-flight requests — the classic
+  closed-loop model whose measured throughput at a latency SLO is
+  well-defined.
+* **Open-loop Poisson mode** (``--arrival-rate R``, pio-surge): each
+  worker draws exponential inter-arrival gaps (aggregate rate R/s
+  split across workers) and fires on SCHEDULE, server ready or not.
+  Closed-loop measurement hides *coordinated omission*: when the
+  server stalls, a closed-loop worker politely stops offering load, so
+  the stall shows up once instead of once per would-have-been request.
+  Open-loop latencies here are measured **from the scheduled arrival
+  time** (never the actual send), so queue-behind-a-stall time counts
+  — exactly where an event-loop edge should beat a thread-per-request
+  one.  ``service_*`` fields report the send->drain time separately.
 * **Process workers by default** (``mode="process"``, spawn context):
   N real interpreters, zero shared GIL, persistent keep-alive
   connections (one per worker — closed-loop semantics need exactly
@@ -40,7 +49,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
-import http.client
+
 import json
 import multiprocessing
 import queue as queue_mod
@@ -85,85 +94,120 @@ def _split_url(url: str) -> tuple:
 
 class _Conn:
     """One persistent keep-alive connection; reconnects on error (the
-    server may have closed an idle connection between windows)."""
+    server may have closed an idle connection between windows).
+
+    Raw-socket HTTP/1.1, NOT ``http.client``: the stdlib client parses
+    every response through the email package — measured at several
+    hundred µs of client CPU per request, which on a one-core bench
+    box serializes with the server under test and pollutes every
+    latency sample.  The generator's job is to measure the server, so
+    its own per-request cost must be as close to zero as stdlib
+    sockets allow: one ``sendall``, a find on ``\\r\\n\\r\\n``, one
+    ``Content-Length`` parse, drain.  No chunked support (the servers
+    under test always send Content-Length)."""
 
     def __init__(self, host: str, port: int, timeout_s: float):
         self.host, self.port, self.timeout_s = host, port, timeout_s
-        self._c = None
+        self._s: socket.socket | None = None
+        self._buf = bytearray()
 
     def _connect(self):
-        c = http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout_s
+        s = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
         )
-        c.connect()
-        # http.client sends headers and body as separate send() calls;
-        # without TCP_NODELAY, Nagle + the peer's delayed ACK turn every
-        # keep-alive POST into a ~40 ms stall — which would measure the
-        # kernel's timer, not the server
-        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return c
+        # one sendall per request, but the server's reply still races
+        # delayed ACKs — keep NODELAY on both ends
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _roundtrip(self, req: bytes) -> int:
+        s = self._s
+        s.sendall(req)
+        buf = self._buf
+        del buf[:]
+        while True:
+            end = buf.find(b"\r\n\r\n")
+            if end >= 0:
+                break
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed mid-response")
+            buf += chunk
+        head = bytes(buf[:end]).split(b"\r\n")
+        status = int(head[0].split(None, 2)[1])
+        clen = 0
+        for ln in head[1:]:
+            if ln[:15].lower() == b"content-length:":
+                clen = int(ln[15:])
+                break
+        need = end + 4 + clen
+        while len(buf) < need:
+            chunk = s.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed mid-body")
+            buf += chunk
+        # the body must be fully drained before the next request:
+        # closed-loop semantics (and keep-alive framing) require it
+        del buf[:need]
+        return status
 
     def request(self, path: str, body: bytes) -> int:
-        if self._c is None:
-            self._c = self._connect()
+        req = (
+            b"POST " + path.encode() + b" HTTP/1.1\r\n"
+            b"Host: " + self.host.encode() + b"\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+            + body
+        )
+        if self._s is None:
+            self._s = self._connect()
         try:
-            self._c.request(
-                "POST", path, body,
-                headers={"Content-Type": "application/json"},
-            )
-            r = self._c.getresponse()
-            r.read()  # the response must be fully drained: closed loop
-            return r.status
+            return self._roundtrip(req)
         except Exception:
             # one reconnect attempt per request; a second failure is
             # the caller's error to count
-            try:
-                self._c.close()
-            except Exception:
-                pass
-            self._c = self._connect()
-            self._c.request(
-                "POST", path, body,
-                headers={"Content-Type": "application/json"},
-            )
-            r = self._c.getresponse()
-            r.read()
-            return r.status
+            self.close()
+            self._s = self._connect()
+            return self._roundtrip(req)
 
     def close(self) -> None:
-        if self._c is not None:
+        if self._s is not None:
             try:
-                self._c.close()
+                self._s.close()
             except Exception:
                 pass
-            self._c = None
+            self._s = None
 
 
 def _worker(wid: int, url: str, payloads, duration_s: float,
-            reservoir_cap: int, timeout_s: float, barrier, outq) -> None:
-    """One closed-loop worker: warm once, rendezvous at the barrier,
-    then hammer until the window closes.  Runs as a top-level function
-    so spawn can pickle it.  A worker that dies still reports (a
-    ``fatal`` result) — a silent corpse would park every sibling at
-    the barrier until the parent's deadline."""
+            reservoir_cap: int, timeout_s: float, barrier, outq,
+            arrival_rate: float = 0.0, seed: int = 0) -> None:
+    """One loadgen worker: warm once, rendezvous at the barrier, then
+    hammer (closed-loop) or fire on a Poisson schedule (open-loop)
+    until the window closes.  Runs as a top-level function so spawn can
+    pickle it.  A worker that dies still reports (a ``fatal`` result)
+    — a silent corpse would park every sibling at the barrier until
+    the parent's deadline."""
     try:
         _worker_inner(wid, url, payloads, duration_s, reservoir_cap,
-                      timeout_s, barrier, outq)
+                      timeout_s, barrier, outq, arrival_rate, seed)
     except Exception as e:
         try:
             barrier.abort()
         except Exception:
             pass
         outq.put({
-            "worker": wid, "latencies": [], "errors": 1, "requests": 1,
-            "wall": 0.0, "truncated": False,
+            "worker": wid, "latencies": [], "service": [], "errors": 1,
+            "requests": 1, "wall": 0.0, "truncated": False, "missed": 0,
             "fatal": f"{type(e).__name__}: {e}",
         })
 
 
 def _worker_inner(wid: int, url: str, payloads, duration_s: float,
                   reservoir_cap: int, timeout_s: float, barrier,
-                  outq) -> None:
+                  outq, arrival_rate: float, seed: int) -> None:
+    import random
+
     host, port, path = _split_url(url)
     conn = _Conn(host, port, timeout_s)
     bodies = [
@@ -176,50 +220,97 @@ def _worker_inner(wid: int, url: str, payloads, duration_s: float,
         conn.request(path, bodies[wid % len(bodies)])
     except Exception:
         pass
-    lats: list[float] = []
+    lats: list[float] = []     # what the result's percentiles judge
+    service: list[float] = []  # open-loop only: send -> drained
     errors = 0
+    missed = 0  # open-loop arrivals never attempted (window closed)
+    rng = random.Random((seed << 16) ^ wid)
     k = wid  # offset the payload rotation so workers don't march in step
     barrier.wait(timeout=max(timeout_s, 30.0))
     t_start = time.perf_counter()
     t_end = t_start + duration_s
-    while True:
-        now = time.perf_counter()
-        if now >= t_end:
-            break
-        body = bodies[k % len(bodies)]
-        k += 1
-        t0 = time.perf_counter()
-        try:
-            status = conn.request(path, body)
-            dt = time.perf_counter() - t0
-            if status == 200:
-                if len(lats) < reservoir_cap:
-                    lats.append(dt)
-            else:
+    if arrival_rate > 0:
+        # open-loop Poisson: latency is measured FROM THE SCHEDULED
+        # arrival — a stalled server keeps accumulating scheduled
+        # arrivals, and every one of them books the stall it sat
+        # through (no coordinated omission).  One connection per
+        # worker: a behind-schedule worker fires immediately,
+        # back-to-back, until it catches up.
+        next_t = t_start + rng.expovariate(arrival_rate)
+        while next_t < t_end:
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            elif now - next_t > timeout_s:
+                # hopelessly behind schedule (server dead/stalled past
+                # the client timeout): booking the skip honestly beats
+                # letting the measured window overrun unboundedly
+                missed += 1
+                next_t += rng.expovariate(arrival_rate)
+                continue
+            body = bodies[k % len(bodies)]
+            k += 1
+            t0 = time.perf_counter()
+            try:
+                status = conn.request(path, body)
+                done = time.perf_counter()
+                if status == 200:
+                    if len(lats) < reservoir_cap:
+                        lats.append(done - next_t)
+                        service.append(done - t0)
+                else:
+                    errors += 1
+            except Exception:
                 errors += 1
-        except Exception:
-            errors += 1
+            next_t += rng.expovariate(arrival_rate)
+    else:
+        while True:
+            now = time.perf_counter()
+            if now >= t_end:
+                break
+            body = bodies[k % len(bodies)]
+            k += 1
+            t0 = time.perf_counter()
+            try:
+                status = conn.request(path, body)
+                dt = time.perf_counter() - t0
+                if status == 200:
+                    if len(lats) < reservoir_cap:
+                        lats.append(dt)
+                else:
+                    errors += 1
+            except Exception:
+                errors += 1
     wall = time.perf_counter() - t_start
     conn.close()
     outq.put({
         "worker": wid,
         "latencies": lats,
+        "service": service,
         "errors": errors,
         "requests": len(lats) + errors,
         "wall": wall,
+        "missed": missed,
         "truncated": len(lats) >= reservoir_cap,
     })
 
 
 def run_load(url: str, payloads, concurrency: int, duration_s: float,
              timeout_s: float = 30.0, mode: str = "process",
-             reservoir_cap: int = DEFAULT_RESERVOIR_CAP) -> dict:
-    """Drive ``concurrency`` closed-loop workers against ``url`` for
-    ``duration_s`` seconds and return the exactly-merged result::
+             reservoir_cap: int = DEFAULT_RESERVOIR_CAP,
+             arrival_rate: float = 0.0, seed: int = 0) -> dict:
+    """Drive ``concurrency`` workers against ``url`` for ``duration_s``
+    seconds and return the exactly-merged result::
 
         {"concurrency", "duration_s", "requests", "errors", "qps",
          "p50_ms", "p90_ms", "p99_ms", "mean_ms", "max_ms",
          "latencies", "truncated", "workers"}
+
+    ``arrival_rate`` > 0 switches to open-loop Poisson arrivals at that
+    aggregate rate (split evenly across workers): latencies are then
+    measured from the SCHEDULED arrival (coordinated-omission-free) and
+    the result grows ``arrival_rate``/``service_p50_ms``/
+    ``service_p99_ms``/``missed``.
 
     ``latencies`` is the merged raw sample (seconds, sorted) so callers
     can derive any further statistic exactly.  QPS is completed
@@ -230,12 +321,15 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
         raise ValueError("concurrency must be >= 1")
     if not payloads:
         raise ValueError("need at least one payload")
+    if arrival_rate < 0:
+        raise ValueError("arrival_rate must be >= 0")
     _split_url(url)  # fail fast in the parent, not in N workers
     payloads = [
         p if isinstance(p, (bytes, bytearray)) else
         (p.encode() if isinstance(p, str) else json.dumps(p).encode())
         for p in payloads
     ]
+    per_worker_rate = arrival_rate / concurrency if arrival_rate else 0.0
     if mode == "process":
         ctx = multiprocessing.get_context("spawn")
         barrier = ctx.Barrier(concurrency)
@@ -244,7 +338,7 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
             ctx.Process(
                 target=_worker,
                 args=(w, url, payloads, duration_s, reservoir_cap,
-                      timeout_s, barrier, outq),
+                      timeout_s, barrier, outq, per_worker_rate, seed),
                 daemon=True,
             )
             for w in range(concurrency)
@@ -258,7 +352,7 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
             threading.Thread(
                 target=_worker,
                 args=(w, url, payloads, duration_s, reservoir_cap,
-                      timeout_s, barrier, outq),
+                      timeout_s, barrier, outq, per_worker_rate, seed),
                 daemon=True,
             )
             for w in range(concurrency)
@@ -287,20 +381,25 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
         w.join(timeout=10.0)
 
     merged: list[float] = []
+    merged_service: list[float] = []
     errors = 0
     requests = 0
+    missed = 0
     max_wall = 0.0
     fatals = []
     for r in results:
         merged.extend(r["latencies"])
+        merged_service.extend(r.get("service", ()))
         errors += r["errors"]
         requests += r["requests"]
+        missed += r.get("missed", 0)
         max_wall = max(max_wall, r["wall"])
         if "fatal" in r:
             fatals.append(f'worker {r["worker"]}: {r["fatal"]}')
     merged.sort()
+    merged_service.sort()
     n = len(merged)
-    return {
+    out = {
         "concurrency": concurrency,
         "duration_s": duration_s,
         "mode": mode,
@@ -325,6 +424,15 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
             key=lambda r: r["worker"],
         ),
     }
+    if arrival_rate:
+        # open-loop extras: the offered rate, the coordinated-omission-
+        # free percentiles already sit in p50/p99 above (measured from
+        # scheduled arrivals); service_* isolates pure send->drain time
+        out["arrival_rate"] = arrival_rate
+        out["missed"] = missed
+        out["service_p50_ms"] = percentile(merged_service, 50) * 1e3
+        out["service_p99_ms"] = percentile(merged_service, 99) * 1e3
+    return out
 
 
 def main(argv=None) -> int:
@@ -343,6 +451,14 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=30.0)
     ap.add_argument("--mode", choices=("process", "thread"),
                     default="process")
+    ap.add_argument("--arrival-rate", type=float, default=0.0,
+                    metavar="QPS",
+                    help="open-loop mode: offer Poisson arrivals at "
+                    "this aggregate rate instead of closed-loop "
+                    "hammering; latencies measure from the SCHEDULED "
+                    "arrival (no coordinated omission)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="open-loop arrival-schedule RNG seed")
     args = ap.parse_args(argv)
     payloads = list(args.payload)
     if args.payload_file:
@@ -351,7 +467,8 @@ def main(argv=None) -> int:
     if not payloads:
         ap.error("need --payload or --payload-file")
     res = run_load(args.url, payloads, args.concurrency, args.duration,
-                   timeout_s=args.timeout, mode=args.mode)
+                   timeout_s=args.timeout, mode=args.mode,
+                   arrival_rate=args.arrival_rate, seed=args.seed)
     res.pop("latencies")  # the raw sample is for library callers
     print(json.dumps(res, indent=1))
     return 0 if res["errors"] == 0 and res["completed"] > 0 else 1

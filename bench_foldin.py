@@ -64,6 +64,13 @@ def main() -> int:
                     help="append the canonical record to "
                     "BENCH_HISTORY.jsonl")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--retrieval", choices=("exact", "int8", "ivf"),
+                    default="exact",
+                    help="pio-scout serving retrieval mode: non-exact "
+                    "puts the quantized-index delta patch INSIDE the "
+                    "measured event->fresh-prediction path (the "
+                    "freshness gate must hold with the ANN index "
+                    "patching in place)")
     args = ap.parse_args()
 
     import jax
@@ -119,7 +126,7 @@ def main() -> int:
         "datasource": {"params": {"appName": "benchfoldin"}},
         "algorithms": [{"name": "als", "params": {
             "rank": args.rank, "numIterations": args.iterations,
-            "lambda": 0.05}}],
+            "lambda": 0.05, "retrieval": args.retrieval}}],
     })
     ctx = WorkflowContext(storage=storage)
     t0 = time.perf_counter()
@@ -208,6 +215,7 @@ def main() -> int:
         "items": args.items,
         "rank": args.rank,
         "poll_s": args.poll,
+        "retrieval": args.retrieval,
         "foldin_cycles": runner.cycles,
     }
     print(json.dumps(rec))

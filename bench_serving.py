@@ -87,6 +87,23 @@ def main() -> None:
                     help="append the sweep's fenced records to "
                     "BENCH_HISTORY.jsonl (the canonical trajectory "
                     "tools/bench_gate.py gates on)")
+    ap.add_argument("--retrieval", choices=("exact", "int8", "ivf"),
+                    default="exact",
+                    help="pio-scout serving retrieval mode for the "
+                    "measured algorithm (two-stage quantized candidate "
+                    "+ exact rerank); non-exact modes suffix the "
+                    "fenced metric keys so exact and ANN trajectories "
+                    "never share a baseline")
+    ap.add_argument("--candidate-factor", type=int, default=10,
+                    help="ANN shortlist width in units of k")
+    ap.add_argument("--nprobe", type=int, default=8,
+                    help="ivf: coarse clusters scanned per query")
+    ap.add_argument("--clustered-catalog", action="store_true",
+                    help="draw item factors from a mixture of "
+                    "Gaussians (tools/bench_ann.py's generator — the "
+                    "shape trained ALS tables have) instead of pure "
+                    "noise; what makes an IVF recall/latency trade "
+                    "representative")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
@@ -108,18 +125,31 @@ def main() -> None:
     )
 
     rng = np.random.default_rng(0)
+    if args.clustered_catalog:
+        sys.path.insert(0, str(Path(__file__).parent / "tools"))
+        from bench_ann import clustered_factors
+
+        item_f = clustered_factors(args.items, args.rank, rng)
+    else:
+        item_f = rng.normal(size=(args.items, args.rank)).astype(
+            np.float32
+        )
     model = ALSModel(
         user_factors=rng.normal(size=(args.users, args.rank)).astype(
             np.float32
         ),
-        item_factors=rng.normal(size=(args.items, args.rank)).astype(
-            np.float32
-        ),
+        item_factors=item_f,
         users=StringIndex([f"u{i}" for i in range(args.users)]),
         items=StringIndex([f"i{i}" for i in range(args.items)]),
         item_props={},
     )
     algo = ALSAlgorithm()
+    if args.retrieval != "exact":
+        algo.params = algo.params_class(
+            retrieval=args.retrieval,
+            candidate_factor=args.candidate_factor,
+            nprobe=args.nprobe,
+        )
     algo.warmup(model)
 
     from predictionio_tpu.obs import Histogram
@@ -151,6 +181,7 @@ def main() -> None:
         "value": round(p50 * 1e3, 3),
         "unit": "ms",
         "exact_p50_ms": round(exact_p50 * 1e3, 3),
+        "retrieval": args.retrieval,
         "vs_baseline": round(100.0 / (p50 * 1e3), 3),
     }
     print(json.dumps(serving_rec))
@@ -306,10 +337,13 @@ def main() -> None:
         _bench_sweep(args, model, rng)
 
 
-def _prebuilt_engine(model):
+def _prebuilt_engine(model, algo_params=None):
     """A deployable engine whose 'training' hands back the prebuilt
     synthetic model (what the serving benches measure is the serving
-    path, never training)."""
+    path, never training).  ``algo_params`` (an engine.json-style
+    params dict, e.g. ``{"retrieval": "ivf", "nprobe": 16}``) rides
+    the variant so sweep A/Bs measure the product's own param
+    threading, not a bench-only side channel."""
     from predictionio_tpu.controller.base import DataSource, WorkflowContext
     from predictionio_tpu.controller.engine import SimpleEngine
     from predictionio_tpu.storage.registry import Storage
@@ -342,7 +376,11 @@ def _prebuilt_engine(model):
     })
     ctx = WorkflowContext(storage=storage)
     engine = SimpleEngine(DS, PrebuiltALS)
-    ep = engine.params_from_variant({})
+    variant = (
+        {"algorithms": [{"name": "", "params": dict(algo_params)}]}
+        if algo_params else {}
+    )
+    ep = engine.params_from_variant(variant)
     # save_model=False: deploy "retrains" via PrebuiltALS.train, which
     # hands back the in-memory model — no orphaned ~28 MB pickle in the
     # user's model dir per bench run
@@ -480,9 +518,25 @@ def _bench_sweep(args, model, rng) -> None:
         [int(x) for x in args.sweep.split(",")] if args.sweep
         else [args.concurrency]
     )
-    engine, ep, iid, ctx = _prebuilt_engine(model)
+    algo_params = None
+    if args.retrieval != "exact":
+        algo_params = {
+            "retrieval": args.retrieval,
+            "candidateFactor": args.candidate_factor,
+            "nprobe": args.nprobe,
+        }
+    engine, ep, iid, ctx = _prebuilt_engine(model, algo_params)
     srv = _boot_server(engine, ep, iid, ctx, microbatch="auto",
                        edge=args.edge)
+    # fenced-record keying (pio-scout satellite): the catalog size
+    # rides the record's ``scale`` field — part of bench_gate's
+    # baseline key — so a 1M-item sweep never shares a rolling
+    # baseline with the 100k default (which keeps scale None for
+    # continuity with the pre-scout history).  Non-exact retrieval
+    # additionally suffixes the metric name: exact and ANN
+    # trajectories are separate lines, judged separately.
+    rec_scale = float(args.items) if args.items != 100_000 else None
+    suffix = f"_{args.retrieval}" if args.retrieval != "exact" else ""
     base = f"http://127.0.0.1:{srv.config.port}"
     _warm_batch_ladder(srv, args.num, max(points_c) * 2)
     payloads = [
@@ -525,13 +579,14 @@ def _bench_sweep(args, model, rng) -> None:
         }
         points.append(point)
         rec = {
-            "metric": f"serving_p99_ms_c{c}",
+            "metric": f"serving_p99_ms_c{c}{suffix}",
             "value": point["p99_ms"],
             "unit": "ms",
             "direction": "down",
             "platform": platform,
-            "scale": None,
+            "scale": rec_scale,
             "fenced": True,
+            "retrieval": args.retrieval,
             "qps": point["qps"],
             "p50_ms": point["p50_ms"],
             "duration_s": args.duration_s,
@@ -560,6 +615,7 @@ def _bench_sweep(args, model, rng) -> None:
         "edge": args.edge,
         "items": args.items,
         "rank": args.rank,
+        "retrieval": args.retrieval,
         "points": points,
         **({"microbatch": mb} if mb else {}),
     }
@@ -572,13 +628,14 @@ def _bench_sweep(args, model, rng) -> None:
         sweep_doc["qps_at_slo"] = best["qps"]
         sweep_doc["concurrency_at_slo"] = best["concurrency"]
         rec = {
-            "metric": "serving_qps_at_slo",
+            "metric": f"serving_qps_at_slo{suffix}",
             "value": best["qps"],
             "unit": "qps",
             "direction": "up",
             "platform": platform,
-            "scale": None,
+            "scale": rec_scale,
             "fenced": True,
+            "retrieval": args.retrieval,
             "slo_ms": args.slo_ms,
             "concurrency": best["concurrency"],
             "p99_ms": best["p99_ms"],

@@ -465,8 +465,8 @@ def _deploy_fleet(args) -> int:
     import tempfile
 
     from ..server.router import (
-        Replica, RouterConfig, RouterServer, spawn_replica,
-        wait_for_port_file,
+        Replica, ReplicaSupervisor, RouterConfig, RouterServer,
+        spawn_replica, wait_for_port_file,
     )
 
     coord_dir = Path(tempfile.mkdtemp(prefix="pio-surge-fleet-"))
@@ -488,20 +488,31 @@ def _deploy_fleet(args) -> int:
             extra += [flag, str(val)]
     if getattr(args, "scan_cache", False):
         extra.append("--scan-cache")
-    spawned = [
-        spawn_replica(args.engine_json, i, coord_dir, extra_args=extra)
-        for i in range(args.replicas)
-    ]
+    def spawner(i):
+        return spawn_replica(args.engine_json, i, coord_dir,
+                             extra_args=extra)
+
+    spawned = [spawner(i) for i in range(args.replicas)]
+    supervisor = (
+        ReplicaSupervisor(spawner)
+        if not getattr(args, "no_respawn", False) else None
+    )
 
     def reap():
-        for s in spawned:
-            if s["proc"].poll() is None:
-                s["proc"].terminate()
-        for s in spawned:
+        # the supervisor may have replaced boot-time processes with
+        # respawns — reap whatever is CURRENTLY tracked, plus the boot
+        # list (dead originals reap as no-ops)
+        procs = [s["proc"] for s in spawned]
+        if supervisor is not None:
+            procs += supervisor.live_procs()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
             try:
-                s["proc"].wait(timeout=10)
+                p.wait(timeout=10)
             except Exception:
-                s["proc"].kill()
+                p.kill()
 
     atexit.register(reap)
     replicas = []
@@ -509,16 +520,19 @@ def _deploy_fleet(args) -> int:
         port = wait_for_port_file(s)
         _out(f"Replica {s['index']} up on 127.0.0.1:{port} "
              f"(log: {s['log_path']})")
-        replicas.append(Replica(
+        replica = Replica(
             f"replica-{s['index']}", "127.0.0.1", port,
             breaker_failures=args.breaker_failures,
-        ))
+        )
+        if supervisor is not None:
+            supervisor.attach(replica, s)
+        replicas.append(replica)
     router = RouterServer(replicas, RouterConfig(
         host=args.ip, port=args.port,
         health_interval_s=args.health_interval,
         max_connections=args.max_connections,
         push_foldin_s=args.push_foldin,
-    ))
+    ), supervisor=supervisor)
     if args.port_file:
         router._bind()
         pf = Path(args.port_file)
@@ -1053,6 +1067,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="announce the BOUND port (after --port 0 "
                    "resolution) by writing it to PATH — how fleet "
                    "replicas report in")
+    d.add_argument("--no-respawn", action="store_true",
+                   help="fleet mode: disable the replica-respawn "
+                   "supervisor (default: a dead replica process is "
+                   "respawned with capped exponential backoff and "
+                   "booked in pio_replica_respawns_total)")
 
     fi = sub.add_parser(
         "foldin",

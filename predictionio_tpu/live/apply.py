@@ -86,9 +86,20 @@ def apply_model_delta(model, delta: ModelDelta) -> dict:
     model.item_factors = new_if
     # the device-resident top-k index: patch cached tables row-wise
     patch = getattr(model, "patch_device_item_rows", None)
+    item_ixs = np.asarray(delta.item_rows_ix, np.int32)
     if patch is not None:
-        item_ixs = np.asarray(delta.item_rows_ix, np.int32)
         patch(item_ixs, delta.item_rows, delta.new_item_rows)
+    # pio-scout: the quantized ANN index is serve-time state exactly
+    # like the device tables — re-quantize ONLY the delta's rows and
+    # append new items to their nearest coarse cluster, in place.  No
+    # rebuild, so the fold-in freshness gate holds at catalog scale
+    # (re-clustering 10M rows would blow the budget a delta apply has).
+    patch_ann = getattr(model, "patch_ann_indexes", None)
+    counts = delta.counts()
+    if patch_ann is not None:
+        counts["annIndexesPatched"] = patch_ann(
+            item_ixs, delta.item_rows, delta.new_item_rows
+        )
     model.users.append([str(s) for s in delta.new_user_ids])
     model.items.append([str(s) for s in delta.new_item_ids])
-    return delta.counts()
+    return counts

@@ -139,6 +139,17 @@ class ALSConfig:
     # tiles usefully.  Exact (same rows, same math) — the A/B is pure
     # gather bandwidth, measured on-chip by bench.py --gather-mode.
     gather_mode: str = "row"
+    # -- pio-scout serve-time retrieval defaults ------------------------
+    # Training never reads these; they ride the config object so one
+    # ALSConfig describes a full train+serve deployment (bench.py and
+    # programmatic servers configure one place; the templates map the
+    # engine.json keys retrieval/candidateFactor/nprobe onto them).
+    # "exact" = brute-force scan; "int8" = flat quantized candidate
+    # stage + exact f32 rerank; "ivf" = candidates restricted to the
+    # nprobe nearest coarse clusters (predictionio_tpu/retrieval/).
+    retrieval: str = "exact"
+    candidate_factor: int = 10
+    nprobe: int = 8
 
     def __post_init__(self) -> None:
         # checked here, not at use sites: the use sites test exact
@@ -212,6 +223,18 @@ class ALSConfig:
             raise ValueError(
                 f"loss_every must be >= 0, got {self.loss_every}"
             )
+        if self.retrieval not in ("exact", "int8", "ivf"):
+            raise ValueError(
+                f"retrieval must be 'exact', 'int8' or 'ivf', "
+                f"got {self.retrieval!r}"
+            )
+        if self.candidate_factor < 1:
+            raise ValueError(
+                f"candidate_factor must be >= 1, "
+                f"got {self.candidate_factor}"
+            )
+        if self.nprobe < 1:
+            raise ValueError(f"nprobe must be >= 1, got {self.nprobe}")
         if self.coded_shards:
             if self.factor_placement != "sharded":
                 raise ValueError(

@@ -298,6 +298,32 @@ REPLICA_REQUESTS_TOTAL = _registry.counter(
     "(ok/error/failover)",
     labels=("replica", "outcome"),
 )
+ROUTER_ADMISSION_TOTAL = _registry.counter(
+    "pio_router_admission_total",
+    "Router-level deadline admission decisions (admitted / rejected = "
+    "a structured 503 answered WITHOUT burning a replica round trip)",
+    labels=("outcome",),
+)
+REPLICA_RESPAWNS_TOTAL = _registry.counter(
+    "pio_replica_respawns_total",
+    "Dead replica processes the router's supervisor respawned "
+    "(capped exponential backoff between attempts)",
+    labels=("replica",),
+)
+
+# pio-scout (two-stage quantized ANN retrieval) family: the retrieval
+# layer books per-stage device time so pulse timelines decompose the
+# new path — candidate = quantized shortlist scan (int8 flat or IVF),
+# rerank = exact f32 top-k over the gathered shortlist.  Without
+# PIO_TPU_TRACE_RETRIEVAL=1 the split is dispatch-attributed (stages
+# pipeline on the device queue); with it, each stage is fenced.
+RETRIEVAL_STAGE_SECONDS = _registry.histogram(
+    "pio_retrieval_stage_seconds",
+    "Two-stage ANN retrieval time per stage (candidate|rerank); fenced "
+    "per stage only under PIO_TPU_TRACE_RETRIEVAL=1",
+    labels=("stage",),
+    buckets=log_buckets(1e-5, 10.0, per_decade=4),
+)
 
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every

@@ -116,7 +116,8 @@ def ring_topk_scores(
 
 
 @functools.lru_cache(maxsize=128)
-def _ring_callable(mesh: Mesh, axis: str, k: int, coded: bool):
+def _ring_callable(mesh: Mesh, axis: str, k: int, coded: bool,
+                   candidate_k: int = 0):
     """The jitted ring program per (mesh, axis, k, variant).
 
     Cached so the serving hot path never re-traces: a per-call closure
@@ -125,22 +126,42 @@ def _ring_callable(mesh: Mesh, axis: str, k: int, coded: bool):
     honor).  The ok-mask is a traced operand, so one coded executable
     serves every degradation pattern; batch-size/table-shape variants
     compile once inside the jit cache.
+
+    ``candidate_k > 0`` is the pio-scout variant: each hop scores the
+    passing shard's int8-quantized rows first, shortlists the top
+    ``candidate_k`` LOCAL candidates, and reranks only those rows from
+    the f32 shard before folding — per-hop f32 work drops from
+    O(B·M/d·R) to O(B·candidate_k·R) while the int8 scan reads a
+    table a quarter the size.  The quantized variant does not compose
+    with the coded one (parity reconstructs f32 rows, which have no
+    quantized counterpart): :class:`ShardedTopK` routes degraded calls
+    to the coded EXACT program instead — correctness over candidate
+    savings while a shard is being served from parity.
     """
+    if coded and candidate_k:
+        raise ValueError(
+            "coded and quantized ring variants do not compose; "
+            "degraded calls ride the coded exact program"
+        )
     d = mesh.shape[axis]
     extra_specs = (P(), P()) if coded else ()
+    if candidate_k:
+        # int8 shard + its per-row scales rotate with the f32 shard
+        extra_specs = (P(axis, None), P(axis))
 
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(), P(axis, None), P(axis)) + extra_specs,
         out_specs=(P(), P()),
     )
-    def _ring(q, v_shard, b_shard, *coded_args):
+    def _ring(q, v_shard, b_shard, *extra):
         # q: [B, R]; v_shard: [M/d, R]; b_shard: [M/d]
         my = jax.lax.axis_index(axis)
         shard_rows = v_shard.shape[0]
         fwd = [(i, (i + 1) % d) for i in range(d)]
-        if coded_args:
-            par, ok_m = coded_args
+        qv0 = qs0 = None
+        if coded:
+            par, ok_m = extra
             # the late shard's rows, reconstructed from the survivors:
             # exact while parity is current with the table
             masked = v_shard * ok_m[my].astype(v_shard.dtype)
@@ -152,19 +173,39 @@ def _ring_callable(mesh: Mesh, axis: str, k: int, coded: bool):
         else:
             ok_m = recon = None
             v0 = v_shard
+            if candidate_k:
+                qv0, qs0 = extra   # [M/d, R] int8, [M/d] f32
 
         def step(carry, _):
-            v, b, owner, best_val, best_ix = carry
+            # the carry only holds the quantized shard when the
+            # variant uses it (a scan carry cannot hold None leaves)
+            if candidate_k:
+                v, b, qv, qs, owner, best_val, best_ix = carry
+            else:
+                v, b, owner, best_val, best_ix = carry
+                qv = qs = None
             if recon is not None:
                 v_use = jnp.where(ok_m[owner] > 0, v, recon)
             else:
                 v_use = v
-            scores = q @ v_use.T + b[None, :]   # [B, M/d] on the MXU
             base = owner * shard_rows
-            ix = base + jax.lax.broadcasted_iota(
-                jnp.int32, scores.shape, 1
-            )
-            # fold into the running top-k: concat + re-topk (k + M/d wide)
+            if candidate_k:
+                # per-shard candidate stage: int8 scan (+bias so -inf
+                # padding rows can't shortlist), then exact rerank of
+                # the survivors from the f32 shard
+                cscores = (
+                    q @ qv.T.astype(jnp.float32)
+                ) * qs[None, :] + b[None, :]
+                _, cix = jax.lax.top_k(cscores, candidate_k)  # [B, kc]
+                rows = v_use[cix]                    # [B, kc, R]
+                scores = jnp.einsum("bkr,br->bk", rows, q) + b[cix]
+                ix = base + cix.astype(jnp.int32)
+            else:
+                scores = q @ v_use.T + b[None, :]   # [B, M/d] on the MXU
+                ix = base + jax.lax.broadcasted_iota(
+                    jnp.int32, scores.shape, 1
+                )
+            # fold into the running top-k: concat + re-topk
             cat_val = jnp.concatenate([best_val, scores], axis=1)
             cat_ix = jnp.concatenate([best_ix, ix], axis=1)
             new_val, pos = jax.lax.top_k(cat_val, k)
@@ -172,14 +213,22 @@ def _ring_callable(mesh: Mesh, axis: str, k: int, coded: bool):
             # pass the shard to the next device; track whose shard we hold
             v = jax.lax.ppermute(v, axis, fwd)
             b = jax.lax.ppermute(b, axis, fwd)
+            if candidate_k:
+                qv = jax.lax.ppermute(qv, axis, fwd)
+                qs = jax.lax.ppermute(qs, axis, fwd)
             owner = jax.lax.ppermute(owner, axis, fwd)
-            return (v, b, owner, new_val, new_ix), None
+            out = (v, b) + ((qv, qs) if candidate_k else ()) + (
+                owner, new_val, new_ix,
+            )
+            return out, None
 
         init_val = jnp.full((q.shape[0], k), -jnp.inf, q.dtype)
         init_ix = jnp.zeros((q.shape[0], k), jnp.int32)
-        (v, b, owner, best_val, best_ix), _ = jax.lax.scan(
-            step, (v0, b_shard, my, init_val, init_ix), None, length=d
-        )
+        init = (v0, b_shard) + (
+            (qv0, qs0) if candidate_k else ()
+        ) + (my, init_val, init_ix)
+        final, _ = jax.lax.scan(step, init, None, length=d)
+        best_val, best_ix = final[-2], final[-1]
         # after d steps every device has folded every shard, so the
         # result is replicated by construction
         return best_val, best_ix
@@ -207,7 +256,8 @@ class ShardedTopK:
     """
 
     def __init__(self, item_factors, mesh: Mesh, axis: str = DATA_AXIS,
-                 hop_budget_s: Optional[float] = None):
+                 hop_budget_s: Optional[float] = None,
+                 retrieval: str = "exact", candidate_factor: int = 10):
         from ..parallel.coded import ShardHealth, build_parity_fn
         from ..parallel.mesh import pad_to_multiple
 
@@ -229,10 +279,57 @@ class ShardedTopK:
             ShardHealth(d, hop_budget_s=hop_budget_s, op="topk.ring")
             if d >= 2 else None
         )
+        # pio-scout per-shard candidate stage: int8 shards + per-row
+        # scales, sharded like the table, rotated with it.  "ivf" maps
+        # to "int8" here — coarse clusters are a whole-catalog
+        # structure and don't shard; the flat int8 scan per hop is the
+        # ring's candidate stage.
+        self.candidate_factor = candidate_factor
+        if retrieval not in ("exact", "int8", "ivf"):
+            raise ValueError(
+                f"retrieval must be 'exact', 'int8' or 'ivf', "
+                f"got {retrieval!r}"
+            )
+        self.retrieval = "int8" if retrieval == "ivf" else retrieval
+        if self.retrieval == "int8":
+            from .ann import quantize_rows
+
+            q8, scale = quantize_rows(padded)
+            self.q_table = jax.device_put(q8, sh)
+            self.q_scale = jax.device_put(
+                scale, NamedSharding(mesh, P(axis))
+            )
+        else:
+            self.q_table = self.q_scale = None
+
+    def _candidate_k(self, k: int) -> int:
+        """Per-hop shortlist width: candidate_factor*k, at least k
+        (d hops each contribute this many exact-reranked rows), capped
+        at the shard height (a shortlist covering the whole shard IS
+        the exact scan)."""
+        shard_rows = self.table.shape[0] // self.mesh.shape[self.axis]
+        return min(max(self.candidate_factor * k, k), shard_rows)
 
     def __call__(self, queries, k: int, deadline=None):
         q = jnp.atleast_2d(jnp.asarray(queries, jnp.float32))
         k = min(k, self.n_items)
+        if self.q_table is not None:
+            ok = None
+            if self.health is not None:
+                ok = self.health.poll(
+                    deadline=deadline or current_deadline()
+                )
+            if ok is None or ok.min() >= 1.0:
+                fn = _ring_callable(self.mesh, self.axis, k, False,
+                                    self._candidate_k(k))
+                return fn(q, self.table, self.row_bias,
+                          self.q_table, self.q_scale)
+            # degraded: parity reconstruction has no quantized
+            # counterpart, so the hop rides the coded EXACT program —
+            # correctness over candidate savings while a shard is down
+            fn = _ring_callable(self.mesh, self.axis, k, True)
+            return fn(q, self.table, self.row_bias, self.parity,
+                      jnp.asarray(ok, jnp.float32))
         return ring_topk_scores(
             q, self.table, k, self.mesh, self.axis,
             parity=self.parity if self.health is not None else None,
@@ -242,15 +339,22 @@ class ShardedTopK:
         )
 
     def warm(self, k: int, batch: int = 1) -> None:
-        """Pre-compile BOTH ring variants (clean + coded) for this
-        (batch, k) shape, bypassing the health poll — a first
-        degradation must not pay a mid-request XLA compile on top of
-        the straggler it is already absorbing (the compile would blow
-        the very deadline the coded path exists to honor)."""
+        """Pre-compile EVERY ring variant this index can dispatch
+        (clean + coded + the quantized candidate one under
+        retrieval != exact) for this (batch, k) shape, bypassing the
+        health poll — a first degradation must not pay a mid-request
+        XLA compile on top of the straggler it is already absorbing
+        (the compile would blow the very deadline the coded path
+        exists to honor)."""
         k = min(k, self.n_items)
         q = jnp.zeros((batch, self.table.shape[1]), jnp.float32)
         clean = _ring_callable(self.mesh, self.axis, k, False)
         clean(q, self.table, self.row_bias)
+        if self.q_table is not None:
+            quant = _ring_callable(self.mesh, self.axis, k, False,
+                                   self._candidate_k(k))
+            quant(q, self.table, self.row_bias, self.q_table,
+                  self.q_scale)
         if self.health is not None:
             coded = _ring_callable(self.mesh, self.axis, k, True)
             d = self.mesh.shape[self.axis]
@@ -262,7 +366,10 @@ class ShardedTopK:
         out = {
             "items": self.n_items,
             "shards": int(self.mesh.shape[self.axis]),
+            "retrieval": self.retrieval,
         }
+        if self.retrieval == "int8":
+            out["candidateFactor"] = self.candidate_factor
         if self.health is not None:
             out.update(self.health.summary())
         return out

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from ..obs import xray
 
 __all__ = ["topk_scores", "batch_topk_scores", "batch_topk_scores_t",
-           "cosine_topk", "pow2_ceil"]
+           "cosine_topk", "rerank_topk", "pow2_ceil"]
 
 
 def pow2_ceil(x: int) -> int:
@@ -73,6 +73,29 @@ def batch_topk_scores_t(query_vecs: jax.Array, table_t: jax.Array, k: int,
     if mask is not None:
         scores = scores + mask
     return jax.lax.top_k(scores, k)
+
+
+@xray.instrument("topk.rerank_topk")
+@functools.partial(jax.jit, static_argnames=("k",))
+def rerank_topk(query_vecs: jax.Array, table: jax.Array,
+                cand_ix: jax.Array, k: int):
+    """Exact rerank stage of two-stage ANN retrieval (pio-scout):
+    gather the ``[B, P]`` candidate rows from the UNQUANTIZED serving
+    table and top-k them with the same full-precision dot products the
+    exact scan computes — restricted to the shortlist, the scores are
+    the exact scan's scores, so the candidate stage can only lose
+    recall, never corrupt a kept candidate's score or rank.
+
+    ``cand_ix`` entries of ``-1`` (IVF padding / candidate shortfall)
+    score ``-inf`` and are dropped by the template decode like any
+    masked row.  Returns ``([B, k] values, [B, k] int32 global ids)``.
+    """
+    safe = jnp.maximum(cand_ix, 0)
+    rows = table[safe]                                    # [B, P, R]
+    scores = jnp.einsum("bpr,br->bp", rows, query_vecs)
+    scores = jnp.where(cand_ix >= 0, scores, -jnp.inf)
+    vals, pos = jax.lax.top_k(scores, k)
+    return vals, jnp.take_along_axis(cand_ix, pos, axis=1)
 
 
 @xray.instrument("topk.cosine_topk")

@@ -1,0 +1,351 @@
+"""pio-scout: the serving-side two-stage retrieval layer.
+
+`ops/ann.py` holds the math (quantization, coarse clustering, the
+jitted candidate kernels); this package holds the *lifecycle* a serving
+process needs around it:
+
+* :class:`RetrievalConfig` — the operator surface (engine.json keys
+  ``retrieval`` / ``candidateFactor`` / ``nprobe`` / ``annClusters``,
+  CLI + bench knobs), validated once at config time.
+* :class:`TwoStageRetriever` — device-cached quantized artifacts
+  (int8 table + per-row scale, plus centroids + padded member matrix
+  for IVF), a ``search()`` that runs candidate -> exact-rerank and
+  books ``pio_retrieval_stage_seconds{stage=candidate|rerank}``, a
+  ``warm()`` for the serving compile ladder, and — the pio-live
+  contract — an in-place :meth:`TwoStageRetriever.patch` that
+  re-quantizes ONLY the rows a fold-in delta touched and appends new
+  items to their nearest coarse cluster, no index rebuild.
+
+Tear-freedom follows the repo's delta-apply idiom (`live/apply.py`):
+every mutation lands as ONE attribute rebind of the state tuple, so a
+concurrent ``search`` sees the old artifact set or the new one, never
+a mixed (q_table, scale) pair mis-scaling a row.
+
+The rerank table is deliberately NOT owned here: callers pass the
+model's current f32/bf16 device cache per call, because pio-live
+rebinds those caches on every delta apply — a retriever-held reference
+would serve stale rows after the first fold-in.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..obs import RETRIEVAL_STAGE_SECONDS
+from ..ops import ann
+from ..ops.topk import pow2_ceil, rerank_topk
+
+__all__ = ["RetrievalConfig", "TwoStageRetriever", "RETRIEVAL_MODES"]
+
+RETRIEVAL_MODES = ("exact", "int8", "ivf")
+
+# stage-histogram children cached at import: labels() is too hot for
+# the per-query path (same idiom as serving's cached children)
+_m_candidate = RETRIEVAL_STAGE_SECONDS.labels(stage="candidate")
+_m_rerank = RETRIEVAL_STAGE_SECONDS.labels(stage="rerank")
+
+
+def _trace_fenced() -> bool:
+    return os.environ.get("PIO_TPU_TRACE_RETRIEVAL", "") == "1"
+
+
+@dataclass(frozen=True)
+class RetrievalConfig:
+    """How a serving path retrieves top-k.
+
+    ``mode='exact'`` is the pre-scout brute-force scan (the default —
+    retrieval stays opt-in per engine.json).  ``'int8'`` adds the flat
+    quantized candidate stage; ``'ivf'`` additionally restricts the
+    candidate scan to the ``nprobe`` nearest of ``clusters`` coarse
+    clusters (``clusters=0`` auto-sizes to ~sqrt(M), pow2-rounded).
+    ``candidate_factor`` is the shortlist width in units of k —
+    ``candidate_factor * k`` rows survive to the exact rerank."""
+
+    mode: str = "exact"
+    candidate_factor: int = 10
+    nprobe: int = 8
+    clusters: int = 0
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        # validated here, not at use sites: these strings arrive
+        # straight from user engine.json files, and the use sites
+        # dispatch on exact equality with an exact-scan fallthrough —
+        # a typo'd mode would silently serve brute force
+        if self.mode not in RETRIEVAL_MODES:
+            raise ValueError(
+                f"retrieval must be one of {RETRIEVAL_MODES}, "
+                f"got {self.mode!r}"
+            )
+        if self.candidate_factor < 1:
+            raise ValueError(
+                f"candidate_factor must be >= 1, got {self.candidate_factor}"
+            )
+        if self.nprobe < 1:
+            raise ValueError(f"nprobe must be >= 1, got {self.nprobe}")
+        if self.clusters < 0:
+            raise ValueError(f"clusters must be >= 0, got {self.clusters}")
+
+    @property
+    def active(self) -> bool:
+        return self.mode != "exact"
+
+    def cache_key(self) -> str:
+        """Keys the per-model retriever cache (DeviceTableMixin) the
+        way dtype keys the device-table caches."""
+        return (
+            f"{self.mode}_cf{self.candidate_factor}_np{self.nprobe}"
+            f"_c{self.clusters}_s{self.seed}"
+        )
+
+    def resolve_clusters(self, n_items: int) -> int:
+        if self.clusters > 0:
+            return min(self.clusters, max(n_items, 1))
+        # ~sqrt(M) balances centroid-scan cost (O(C)) against
+        # per-cluster member-scan cost (O(M/C)); pow2 keeps the
+        # executable space tidy alongside the pow2 B/k ladders
+        return max(min(pow2_ceil(int(np.sqrt(max(n_items, 1)))),
+                       max(n_items, 1)), 1)
+
+
+class TwoStageRetriever:
+    """Quantized candidate artifacts + the two-stage search for ONE
+    item table (built at model (re)load like the device caches)."""
+
+    def __init__(self, cfg: RetrievalConfig, n_items: int, rank: int,
+                 state: dict):
+        self.cfg = cfg
+        self.n_items = n_items
+        self.rank = rank
+        # ONE attribute carries every mutable artifact (device arrays
+        # + host-side IVF bookkeeping): patch() builds a full
+        # replacement dict and rebinds — the tear-freedom contract
+        self._state = state
+        self.patches = 0
+
+    # -- build -------------------------------------------------------------
+    @classmethod
+    def build(cls, item_factors: np.ndarray,
+              cfg: RetrievalConfig) -> "TwoStageRetriever":
+        import jax.numpy as jnp
+
+        table = np.asarray(item_factors, np.float32)
+        n_items, rank = table.shape
+        q, scale = ann.quantize_rows(table)
+        state: dict = {}
+        if cfg.mode == "ivf":
+            n_clusters = cfg.resolve_clusters(n_items)
+            centroids, assign = ann.build_clusters(
+                table, n_clusters, seed=cfg.seed
+            )
+            # skewed catalogs split oversized clusters, so the built
+            # count can exceed the requested one — the layout follows
+            # the centroids actually produced
+            layout = ann.build_cluster_layout(
+                q, scale, assign, len(centroids)
+            )
+            state.update(
+                centroids=centroids,           # host: append assignment
+                centroids_t=jnp.asarray(
+                    np.ascontiguousarray(centroids.T)
+                ),
+                # the cluster-contiguous slab layout the kernel scans
+                q_slabs=jnp.asarray(layout["q_slabs"]),
+                slab_scale=jnp.asarray(layout["slab_scale"]),
+                slab_ids=jnp.asarray(layout["slab_ids"]),
+                # host-side patch addressing: item -> (cluster, slot)
+                assign=np.asarray(assign, np.int64),
+                slot=layout["slot"],
+                fill=layout["fill"],
+            )
+        else:
+            # flat int8 scans: the pre-transposed [R, M] layout the
+            # batched serving matmul already established on CPU
+            state["scale"] = jnp.asarray(scale)
+            state["q_table_t"] = jnp.asarray(
+                np.ascontiguousarray(q.T)
+            )
+        return cls(cfg, n_items, rank, state)
+
+    # -- search ------------------------------------------------------------
+    def shortlist_width(self, k: int) -> int:
+        """Static candidate count per (k): pow2-rounded so the
+        executable key space stays bounded like the B/k ladders."""
+        return min(pow2_ceil(self.cfg.candidate_factor * k), self.n_items)
+
+    def search(self, query_vecs, k: int, table):
+        """Two-stage top-k: quantized shortlist -> exact rerank against
+        ``table`` (the caller's CURRENT unquantized device cache — see
+        module docstring for why it is an argument).  Returns
+        ``([B, k] values, [B, k] int32 ids)`` with non-finite values
+        for shortfall rows, matching the exact scorers' mask contract.
+        """
+        import jax.numpy as jnp
+
+        st = self._state
+        q = jnp.atleast_2d(jnp.asarray(query_vecs, jnp.float32))
+        kc = self.shortlist_width(k)
+        fence = _trace_fenced()
+        t0 = time.perf_counter()
+        if self.cfg.mode == "ivf":
+            cand = ann.ivf_candidate_topk(
+                q, st["centroids_t"], st["q_slabs"], st["slab_scale"],
+                st["slab_ids"],
+                min(self.cfg.nprobe, st["q_slabs"].shape[0]), kc,
+            )
+        else:
+            cand = ann.int8_candidate_topk(
+                q, st["q_table_t"], st["scale"], kc
+            )
+        if fence:
+            cand.block_until_ready()
+        t1 = time.perf_counter()
+        _m_candidate.observe(t1 - t0)
+        vals, ixs = rerank_topk(q, table, cand, min(k, kc))
+        if fence:
+            vals.block_until_ready()
+        _m_rerank.observe(time.perf_counter() - t1)
+        return vals, ixs
+
+    def warm(self, k: int, batches, table) -> None:
+        """Pre-compile the candidate + rerank executables for every
+        batch size in ``batches`` at this k — the two-stage path joins
+        the serving warmup ladder so a first real query (or the first
+        query after a fold-in) never pays a mid-traffic compile."""
+        import jax.numpy as jnp
+
+        for b in batches:
+            self.search(
+                jnp.zeros((b, self.rank), jnp.float32), k, table
+            )
+
+    # -- pio-live delta patch ---------------------------------------------
+    def patch(self, ixs, rows, appended=None,
+              appended_factors=None) -> dict:
+        """Fold one model delta into the quantized index IN PLACE:
+        re-quantize only the touched rows, append new items to their
+        nearest coarse cluster — never a rebuild (the fold-in
+        freshness gate budget has no room for re-clustering a 10M
+        catalog).  ``appended_factors`` are the appended rows' f32
+        factors (same as ``appended``; the separate name mirrors the
+        device-table patch signature).  Returns patch counts."""
+        import jax.numpy as jnp
+
+        ixs = np.asarray(ixs, np.int64)
+        rows = np.asarray(rows, np.float32) if len(ixs) else \
+            np.zeros((0, self.rank), np.float32)
+        app = appended if appended is not None else appended_factors
+        app = (
+            np.asarray(app, np.float32)
+            if app is not None and len(app) else None
+        )
+        if len(ixs) == 0 and app is None:
+            return {"patched": 0, "appended": 0}
+        st = dict(self._state)
+        q_rows, s_rows = (
+            ann.quantize_rows(rows) if len(ixs)
+            else (np.zeros((0, self.rank), np.int8),
+                  np.zeros((0,), np.float32))
+        )
+        q_app, s_app = (
+            ann.quantize_rows(app) if app is not None else (None, None)
+        )
+        if self.cfg.mode == "ivf":
+            self._patch_ivf(st, ixs, q_rows, s_rows, app, q_app, s_app)
+        else:
+            scale = st["scale"]
+            qtt = st["q_table_t"]
+            if q_app is not None:
+                scale = jnp.concatenate([scale, jnp.asarray(s_app)])
+                qtt = jnp.concatenate(
+                    [qtt, jnp.asarray(np.ascontiguousarray(q_app.T))],
+                    axis=1,
+                )
+            if len(ixs):
+                scale = scale.at[jnp.asarray(ixs)].set(
+                    jnp.asarray(s_rows)
+                )
+                qtt = qtt.at[:, jnp.asarray(ixs)].set(
+                    jnp.asarray(q_rows.T)
+                )
+            st["scale"] = scale
+            st["q_table_t"] = qtt
+        n_app = 0 if app is None else len(app)
+        self.n_items += n_app
+        self._state = st
+        self.patches += 1
+        return {"patched": int(len(ixs)), "appended": n_app}
+
+    def _patch_ivf(self, st, ixs, q_rows, s_rows, app, q_app,
+                   s_app) -> None:
+        """Patched rows write their (cluster, slot) cells directly
+        (the host-side ``slot`` map addresses the slab layout);
+        appended rows take the next free slot of their NEAREST
+        centroid, growing the padded capacity (one device pad, no
+        re-quantization of anything existing) only when a cluster
+        fills."""
+        import jax.numpy as jnp
+
+        q_slabs = st["q_slabs"]
+        slab_scale = st["slab_scale"]
+        slab_ids = st["slab_ids"]
+        assign = st["assign"]
+        slot = st["slot"]
+        fill = st["fill"].copy()
+        if len(ixs):
+            c = jnp.asarray(assign[ixs])
+            sl = jnp.asarray(slot[ixs])
+            q_slabs = q_slabs.at[c, sl].set(jnp.asarray(q_rows))
+            slab_scale = slab_scale.at[c, sl].set(jnp.asarray(s_rows))
+        if app is not None:
+            clusters = np.asarray(
+                ann.nearest_cluster(app, st["centroids"]), np.int64
+            )
+            new_slots = np.empty(len(app), np.int32)
+            for j, c in enumerate(clusters):
+                new_slots[j] = fill[c]
+                fill[c] += 1
+            need = int(fill.max(initial=0))
+            cap = q_slabs.shape[1]
+            if need > cap:
+                grow = int(need * 1.25) + 1 - cap
+                q_slabs = jnp.pad(q_slabs, ((0, 0), (0, grow), (0, 0)))
+                slab_scale = jnp.pad(slab_scale, ((0, 0), (0, grow)))
+                slab_ids = jnp.pad(slab_ids, ((0, 0), (0, grow)),
+                                   constant_values=-1)
+            c = jnp.asarray(clusters)
+            sl = jnp.asarray(new_slots)
+            q_slabs = q_slabs.at[c, sl].set(jnp.asarray(q_app))
+            slab_scale = slab_scale.at[c, sl].set(jnp.asarray(s_app))
+            slab_ids = slab_ids.at[c, sl].set(
+                jnp.arange(self.n_items, self.n_items + len(app),
+                           dtype=jnp.int32)
+            )
+            st["assign"] = np.concatenate([assign, clusters])
+            st["slot"] = np.concatenate([slot, new_slots])
+        st["q_slabs"] = q_slabs
+        st["slab_scale"] = slab_scale
+        st["slab_ids"] = slab_ids
+        st["fill"] = fill
+
+    # -- observability -----------------------------------------------------
+    def summary(self) -> dict:
+        """Status-JSON block (serving surfaces it as ``retrieval``)."""
+        out = {
+            "mode": self.cfg.mode,
+            "items": self.n_items,
+            "candidateFactor": self.cfg.candidate_factor,
+            "patches": self.patches,
+        }
+        if self.cfg.mode == "ivf":
+            st = self._state
+            out.update(
+                clusters=int(st["q_slabs"].shape[0]),
+                clusterCapacity=int(st["q_slabs"].shape[1]),
+                nprobe=self.cfg.nprobe,
+            )
+        return out

@@ -70,6 +70,7 @@ from ..resilience.policy import Deadline, DeadlineExceeded
 
 __all__ = [
     "AdmissionRejected",
+    "EwmaEstimator",
     "MicroBatcher",
     "dispatchable_sizes",
 ]
@@ -100,6 +101,32 @@ class AdmissionRejected(DeadlineExceeded):
     budget).  A subclass of :class:`DeadlineExceeded` so every existing
     503 path handles it; kept distinct so the edge can count sheds
     separately from in-flight expiries."""
+
+
+class EwmaEstimator:
+    """Exponentially-weighted moving average of observed durations —
+    the memory behind deadline-aware admission, shared by the
+    micro-batcher (device-batch service time) and the fleet router
+    (replica round-trip time; pio-scout satellite).  ``0.0`` until the
+    first observation, so a cold estimator never sheds: no evidence
+    means admit.  Not synchronized itself — callers serialize
+    observations (the batcher under its condition variable, the router
+    under its round-robin lock)."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float = 0.25):
+        self.alpha = alpha
+        self.value = 0.0
+
+    def observe(self, dt: float) -> None:
+        self.value = (
+            dt if self.value <= 0.0
+            else self.alpha * dt + (1.0 - self.alpha) * self.value
+        )
+
+    def estimate(self) -> float:
+        return self.value
 
 
 def _pad_size(n: int) -> int:
@@ -194,7 +221,7 @@ class MicroBatcher:
         # EWMA of recent device-batch service time: the admission
         # estimator's input.  Seeded 0 (= "no evidence, admit"), so a
         # cold batcher never sheds; mutated only under _cond.
-        self._ewma_batch_s = 0.0
+        self._ewma = EwmaEstimator()
         # observability: how the batcher is actually coalescing.
         # Mutated only under _cond; read through stats() (bare reads
         # tore under concurrency — serving status JSON and the benches
@@ -228,7 +255,7 @@ class MicroBatcher:
                 "expired": self.expired,
                 "queueDepth": len(self._pending),
                 "dispatcher": self._dispatcher_alive,
-                "ewmaBatchSec": self._ewma_batch_s,
+                "ewmaBatchSec": self._ewma.value,
             }
 
     # -- admission (pio-surge) ---------------------------------------------
@@ -238,7 +265,7 @@ class MicroBatcher:
         batch) x the EWMA batch service time.  0.0 until the first
         batch completes — no evidence means admit, never shed."""
         with self._cond:
-            ew = self._ewma_batch_s
+            ew = self._ewma.value
             if ew <= 0.0:
                 return 0.0
             ahead = 1.0 if self._running else 0.0
@@ -482,11 +509,7 @@ class MicroBatcher:
                 self.max_seen = max(self.max_seen, len(live))
                 e0 = live[0]
                 if e0.t_run0 is not None and e0.t_run1 is not None:
-                    dt = max(e0.t_run1 - e0.t_run0, 0.0)
-                    self._ewma_batch_s = (
-                        dt if self._ewma_batch_s <= 0.0
-                        else 0.25 * dt + 0.75 * self._ewma_batch_s
-                    )
+                    self._ewma.observe(max(e0.t_run1 - e0.t_run0, 0.0))
             self.requests += len(batch)
             self.expired += n_expired
             # continuous entries get the third role: the dispatcher ran

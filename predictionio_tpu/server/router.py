@@ -50,15 +50,19 @@ from typing import Optional
 from ..obs import (
     REPLICA_MODEL_FRESHNESS,
     REPLICA_REQUESTS_TOTAL,
+    REPLICA_RESPAWNS_TOTAL,
     REPLICA_UP,
+    ROUTER_ADMISSION_TOTAL,
     TRACE_HEADER,
 )
 from ..resilience.policy import CircuitBreaker
 from .eventloop import EventLoopHTTPServer, callback_scope
 from .http_base import HTTPServerBase, observability_response
+from .microbatch import EwmaEstimator
 
 __all__ = [
     "Replica",
+    "ReplicaSupervisor",
     "RouterConfig",
     "RouterServer",
     "spawn_replica",
@@ -229,17 +233,137 @@ class Replica:
         return out
 
 
+class ReplicaSupervisor:
+    """Respawn-on-death for the replica fleet (pio-scout satellite;
+    ROADMAP item 1b): before this, a SIGKILLed replica stayed dead —
+    masked by failover, but the fleet ran at N-1 until an operator
+    acted.  The router's health loop ticks the supervisor every sweep;
+    a replica whose *process* has exited is respawned through the same
+    spawner ``deploy --replicas`` used, with capped exponential backoff
+    between attempts so a crash-looping engine (bad model, OOM) cannot
+    melt the box, and ``pio_replica_respawns_total{replica}`` books
+    every successful respawn.
+
+    The respawn itself (subprocess boot + port-file wait — seconds to
+    minutes) runs on a per-replica background thread so one slow boot
+    never stalls health sweeps for the rest of the fleet.
+    """
+
+    def __init__(self, spawner, waiter=None, backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 30.0,
+                 spawn_timeout_s: float = 180.0):
+        # spawner(index) -> spawned dict (router.spawn_replica shape);
+        # waiter(spawned) -> bound port (defaults to wait_for_port_file)
+        self.spawner = spawner
+        self.waiter = waiter or wait_for_port_file
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.spawn_timeout_s = spawn_timeout_s
+        self._lock = threading.Lock()
+        # replica name -> {"spawned", "index", "attempts", "next_try",
+        #                  "busy"}
+        self._procs: dict[str, dict] = {}
+        self.respawns = 0
+
+    def attach(self, replica: Replica, spawned: dict) -> None:
+        with self._lock:
+            self._procs[replica.name] = {
+                "spawned": spawned,
+                "index": spawned["index"],
+                "attempts": 0,
+                "next_try": 0.0,
+                "busy": False,
+            }
+
+    def live_procs(self) -> list:
+        """Every currently-tracked subprocess (fleet teardown reaps
+        these, not the boot-time list — respawns replace entries)."""
+        with self._lock:
+            return [st["spawned"]["proc"] for st in self._procs.values()]
+
+    def tick(self, replicas: list[Replica]) -> None:
+        """One health-loop sweep: respawn any replica whose process
+        has exited (past its backoff), reset backoff for replicas that
+        are alive AND healthy again."""
+        now = time.monotonic()
+        for replica in replicas:
+            with self._lock:
+                st = self._procs.get(replica.name)
+                if st is None or st["busy"]:
+                    continue
+                proc = st["spawned"]["proc"]
+                if proc.poll() is None:
+                    if replica.healthy:
+                        st["attempts"] = 0
+                    continue
+                if now < st["next_try"]:
+                    continue
+                st["busy"] = True
+            threading.Thread(
+                target=self._respawn, args=(replica,),
+                daemon=True, name=f"respawn-{replica.name}",
+            ).start()
+
+    def _respawn(self, replica: Replica) -> None:
+        name = replica.name
+        with self._lock:
+            st = self._procs[name]
+            index = st["index"]
+            attempt = st["attempts"]
+        try:
+            spawned = self.spawner(index)
+            port = self.waiter(spawned, timeout_s=self.spawn_timeout_s)
+        except Exception as e:
+            logger.warning("respawn of %s failed: %s", name, e)
+            with self._lock:
+                st["attempts"] += 1
+                st["next_try"] = time.monotonic() + min(
+                    self.backoff_cap_s,
+                    self.backoff_base_s * (2.0 ** st["attempts"]),
+                )
+                st["busy"] = False
+            return
+        # point the router at the new process: update the port, drop
+        # pooled connections to the corpse (mark_down does), and let
+        # the next health tick flip it healthy
+        replica.port = port
+        replica.mark_down(f"respawned on port {port}; awaiting health")
+        REPLICA_RESPAWNS_TOTAL.labels(replica=name).inc()
+        with self._lock:
+            st["spawned"] = spawned
+            self.respawns += 1
+            # successful respawns back off too: a crash-looping engine
+            # respawns at the capped cadence, not as fast as it dies
+            st["attempts"] += 1
+            st["next_try"] = time.monotonic() + min(
+                self.backoff_cap_s,
+                self.backoff_base_s * (2.0 ** st["attempts"]),
+            )
+            st["busy"] = False
+        logger.info("respawned %s on port %d", name, port)
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "respawns": self.respawns,
+                "tracked": len(self._procs),
+                "backoffCapSec": self.backoff_cap_s,
+            }
+
+
 class RouterServer(HTTPServerBase):
     """The fleet front door; see module docstring."""
 
     server_name = "router"
 
     def __init__(self, replicas: list[Replica],
-                 config: Optional[RouterConfig] = None):
+                 config: Optional[RouterConfig] = None,
+                 supervisor: Optional[ReplicaSupervisor] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = replicas
         self.config = config or RouterConfig()
+        self.supervisor = supervisor
         self._pool = None
         self._rr_lock = threading.Lock()
         self._rr = 0
@@ -248,6 +372,19 @@ class RouterServer(HTTPServerBase):
         self.start_time = time.time()  # wall clock: a TIMESTAMP
         self.request_count = 0
         self.unroutable = 0
+        # router-level deadline admission (pio-scout satellite; ROADMAP
+        # item 1b): the same EWMA estimator shape the micro-batcher
+        # uses for device batches, fed with replica round-trip times —
+        # a request whose ?timeout= budget the fleet demonstrably
+        # cannot meet is answered a structured 503 HERE, without
+        # burning a replica round trip on a doomed forward (today only
+        # replicas shed).  Seeded 0: a cold router never sheds.
+        self._ewma_forward = EwmaEstimator()
+        self._ewma_lock = threading.Lock()
+        self.admission_rejected = 0
+        self._m_adm_ok = ROUTER_ADMISSION_TOTAL.labels(outcome="admitted")
+        self._m_adm_rej = ROUTER_ADMISSION_TOTAL.labels(
+            outcome="rejected")
         self._health_thread: Optional[threading.Thread] = None
         self._push_thread: Optional[threading.Thread] = None
 
@@ -328,6 +465,11 @@ class RouterServer(HTTPServerBase):
                 self.check_all()
             except Exception:
                 logger.exception("router health sweep failed")
+            if self.supervisor is not None:
+                try:
+                    self.supervisor.tick(self.replicas)
+                except Exception:
+                    logger.exception("replica supervisor tick failed")
 
     # -- rolling fold-in push ---------------------------------------------
     def push_foldin(self) -> dict:
@@ -400,6 +542,7 @@ class RouterServer(HTTPServerBase):
         candidates = self._candidates()
         last_err = "no replicas configured"
         for i, replica in enumerate(candidates):
+            t0 = time.perf_counter()
             try:
                 status, data, ctype = replica.request(
                     "POST", path_qs, body, headers=headers,
@@ -415,6 +558,11 @@ class RouterServer(HTTPServerBase):
             if not replica.healthy:
                 replica.mark_up(replica.last_status)
             replica.forwarded += 1
+            # feed the admission estimator with the fleet's actual
+            # round-trip time (success paths only: a failover's
+            # timeout would teach the estimator to shed everything)
+            with self._ewma_lock:
+                self._ewma_forward.observe(time.perf_counter() - t0)
             (replica._m_ok if status < 500 else replica._m_err).inc()
             try:
                 respond(status, data, ctype=ctype)
@@ -432,16 +580,21 @@ class RouterServer(HTTPServerBase):
 
     # -- http --------------------------------------------------------------
     def status_json(self) -> dict:
-        return {
+        out = {
             "status": "alive",
             "role": "router",
             "replicas": [r.snapshot() for r in self.replicas],
             "healthyReplicas": sum(r.healthy for r in self.replicas),
             "requestCount": self.request_count,
             "unroutable": self.unroutable,
+            "admissionRejected": self.admission_rejected,
+            "ewmaForwardSec": self._ewma_forward.value,
             "startTime": self.start_time,
             "maxConnections": self.config.max_connections,
         }
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.summary()
+        return out
 
     @callback_scope
     def _el_handle(self, req, respond) -> None:
@@ -451,6 +604,33 @@ class RouterServer(HTTPServerBase):
             self.request_count += 1  # loop-thread only: no lock needed
             tid = (req.header(TRACE_HEADER) or "").strip() or None
             body = req.body
+            # router-level deadline admission: a ?timeout= request the
+            # EWMA forward estimate already exceeds is a doomed
+            # round-trip — answer the structured 503 the replica edge
+            # would have, one hop earlier and without spending a
+            # replica on it.  No timeout (or a cold estimator) admits.
+            tv = urllib.parse.parse_qs(u.query).get("timeout")
+            if tv:
+                try:
+                    budget = float(tv[0])
+                except ValueError:
+                    budget = None
+                est = self._ewma_forward.value
+                if budget is not None and est > 0.0 and (
+                    budget <= 0.0 or est > budget
+                ):
+                    self.admission_rejected += 1  # loop-thread only
+                    self._m_adm_rej.inc()
+                    respond(503, {
+                        "message": (
+                            f"estimated fleet round-trip "
+                            f"{est * 1e3:.1f}ms exceeds the "
+                            f"{budget * 1e3:.1f}ms request budget"
+                        ),
+                        "error": "AdmissionRejected",
+                    }, extra_headers=[("Retry-After", "1")])
+                    return
+                self._m_adm_ok.inc()
             pool = self._pool
             if pool is None:
                 respond(503, {"message": "router is stopping"})

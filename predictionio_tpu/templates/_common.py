@@ -128,6 +128,39 @@ class DeviceTableMixin:
             setattr(self, key, dev)
         return dev
 
+    def device_ann_index(self, cfg):
+        """Lazy per-config two-stage ANN retriever (pio-scout), cached
+        on the model like the device tables: int8 table + scale (+
+        IVF centroids/members) are serve-time artifacts built once per
+        model (re)load and delta-PATCHED in place thereafter
+        (:meth:`patch_ann_indexes`).  ``cfg`` is a
+        ``retrieval.RetrievalConfig``; each distinct config caches its
+        own index (mirrors the per-dtype device-table caches)."""
+        from ..retrieval import TwoStageRetriever
+
+        key = f"_ann_index_{cfg.cache_key()}"
+        idx = getattr(self, key, None)
+        if idx is None:
+            idx = TwoStageRetriever.build(self.item_factors, cfg)
+            setattr(self, key, idx)
+        return idx
+
+    def patch_ann_indexes(self, ixs, rows, appended=None) -> int:
+        """pio-live delta apply: fold the touched/appended item rows
+        into every CACHED quantized index in place (re-quantize only
+        those rows, append new items to their nearest coarse cluster)
+        — the quantized artifacts are part of the serve-time index
+        exactly like the device tables, so a fold-in must patch them
+        or ANN-served predictions would go stale while exact-served
+        ones advance.  No rebuild: patch cost scales with the delta,
+        not the catalog.  Returns the number of indexes patched."""
+        n = 0
+        for attr in list(vars(self)):
+            if attr.startswith("_ann_index_"):
+                getattr(self, attr).patch(ixs, rows, appended)
+                n += 1
+        return n
+
     def device_item_factors_normalized(self, dtype: Optional[str] = None):
         """Row-normalized table for cosine scoring — normalized once (in
         f32, then cast), not per request."""

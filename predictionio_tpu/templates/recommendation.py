@@ -40,7 +40,12 @@ from ..ops.topk import (
     topk_scores,
 )
 from ..storage.columnar import Ratings
-from ._common import DeviceTableMixin, filter_bias_mask, warm_batched_topk
+from ._common import (
+    DeviceTableMixin,
+    filter_bias_mask,
+    pow2_ladder,
+    warm_batched_topk,
+)
 from ..storage.levents import EventStore
 
 
@@ -373,6 +378,47 @@ class ALSAlgorithmParams(Params):
     # Unfiltered queries only — category/white/blacklist queries keep
     # the local scorer (per-query masks don't ride the ring)
     distributed_topk: bool = False
+    # pio-scout two-stage retrieval (engine.json key retrieval):
+    # "exact" (default — brute-force scan, the pre-scout behavior),
+    # "int8" (flat quantized candidate stage + exact f32 rerank), or
+    # "ivf" (int8 candidates restricted to the nprobe nearest coarse
+    # clusters — the catalog-scale mode).  Unfiltered queries only;
+    # category/white/blacklist queries keep the exact scorer (a
+    # per-query mask over a shortlist can starve it below num).  With
+    # distributedTopk, the ring runs the int8 candidate stage
+    # per shard ("ivf" maps to "int8" there — coarse clusters don't
+    # shard).
+    retrieval: str = "exact"
+    # shortlist width in units of k: candidateFactor*k quantized
+    # candidates survive to the exact rerank (recall@k rises with it;
+    # candidateFactor covering the catalog is exact by construction)
+    candidate_factor: int = 10
+    # "ivf" only: clusters scanned per query (recall/latency dial)
+    nprobe: int = 8
+    # "ivf" only: coarse cluster count (engine.json annClusters;
+    # 0 = auto ~sqrt(catalog), pow2-rounded)
+    ann_clusters: int = 0
+
+    def __post_init__(self) -> None:
+        # serve-time knobs validated at CONFIG time (the ALSConfig
+        # convention): a typo'd engine.json value must fail at
+        # params_from_variant, not as a 500 on the first query
+        if self.retrieval not in ("exact", "int8", "ivf"):
+            raise ValueError(
+                f"retrieval must be 'exact', 'int8' or 'ivf', "
+                f"got {self.retrieval!r}"
+            )
+        if self.candidate_factor < 1:
+            raise ValueError(
+                f"candidateFactor must be >= 1, "
+                f"got {self.candidate_factor}"
+            )
+        if self.nprobe < 1:
+            raise ValueError(f"nprobe must be >= 1, got {self.nprobe}")
+        if self.ann_clusters < 0:
+            raise ValueError(
+                f"annClusters must be >= 0, got {self.ann_clusters}"
+            )
 
 
 @dataclass
@@ -391,18 +437,25 @@ class ALSModel(DeviceTableMixin):
         if not np.isfinite(self.item_factors).all():
             raise ValueError("item factors contain non-finite values")
 
-    def sharded_topk_index(self):
+    def sharded_topk_index(self, retrieval: str = "exact",
+                           candidate_factor: int = 10):
         """Lazy distributed top-k index (ops/distributed_topk.ShardedTopK):
         item table sharded over the mesh + parity block + sticky shard
         health, built once per model (re)load like the device caches.
         The per-request deadline needs no plumbing — the index reads the
-        serving thread's deadline scope on every call."""
+        serving thread's deadline scope on every call.  ``retrieval``
+        != "exact" builds per-shard int8 candidate artifacts so each
+        ring hop shortlists before the exact fold (pio-scout); the
+        first caller's config wins for this model's lifetime (params
+        are fixed per deployed algorithm)."""
         idx = getattr(self, "_sharded_topk", None)
         if idx is None:
             from ..ops.distributed_topk import ShardedTopK
             from ..parallel import make_mesh
 
-            idx = ShardedTopK(self.item_factors, make_mesh())
+            idx = ShardedTopK(self.item_factors, make_mesh(),
+                              retrieval=retrieval,
+                              candidate_factor=candidate_factor)
             self._sharded_topk = idx
         return idx
 
@@ -433,11 +486,38 @@ class ALSAlgorithm(Algorithm):
             subspace_size=p.subspace_size,
             factor_placement=p.factor_placement,
             coded_shards=p.coded_shards,
+            retrieval=p.retrieval,
+            candidate_factor=p.candidate_factor,
+            nprobe=p.nprobe,
         )
 
     def _serve_dtype(self):
         dt = getattr(self.params, "serving_dtype", "float32")
         return None if dt in ("float32", "", None) else dt
+
+    def _retrieval_config(self):
+        """The pio-scout two-stage config, or None when this algorithm
+        serves exact (the default) — call sites dispatch on None so
+        the exact hot path pays nothing for the feature existing."""
+        p = self.params
+        mode = getattr(p, "retrieval", "exact")
+        if mode in ("exact", "", None):
+            return None
+        from ..retrieval import RetrievalConfig
+
+        return RetrievalConfig(
+            mode=mode,
+            candidate_factor=getattr(p, "candidate_factor", 10),
+            nprobe=getattr(p, "nprobe", 8),
+            clusters=getattr(p, "ann_clusters", 0),
+        )
+
+    def _sharded_index(self, model: "ALSModel"):
+        p = self.params
+        return model.sharded_topk_index(
+            retrieval=getattr(p, "retrieval", "exact"),
+            candidate_factor=getattr(p, "candidate_factor", 10),
+        )
 
     def train(self, ctx: WorkflowContext, data: TrainingData) -> ALSModel:
         cfg = self._config()
@@ -507,13 +587,29 @@ class ALSAlgorithm(Algorithm):
             table, rank, n, unmasked_too=True, max_batch=max_batch,
             table_t=model.device_item_factors_t(self._serve_dtype()),
         )
+        rcfg = self._retrieval_config()
+        if rcfg is not None and not getattr(self.params,
+                                            "distributed_topk", False):
+            # pio-scout: the two-stage path joins the warmup ladder —
+            # candidate + rerank executables for every pow2 batch the
+            # padded batcher can dispatch, plus the solo small-k
+            # shapes (same contract as warm_batched_topk: a size the
+            # padding can produce but the warmup skipped compiles
+            # mid-traffic, which is the p99 spike the ladder prevents)
+            idx = model.device_ann_index(rcfg)
+            ladder = pow2_ladder(max_batch) or []
+            k_default = min(pow2_ceil(10), n)
+            idx.warm(k_default, ladder + [1], table)
+            for k in {min(pow2_ceil(kk), n) for kk in (1, 4)}:
+                idx.warm(k, [1], table)
         if getattr(self.params, "distributed_topk", False):
             # the ring index compiles BOTH variants (clean + parity-
-            # coded) per (batch, k): cover the common solo shapes so a
-            # first degradation never pays a mid-request compile; rarer
-            # batched shapes compile once under load like the local
-            # pow2 ladder
-            idx = model.sharded_topk_index()
+            # coded; + the quantized candidate variant under
+            # retrieval != exact) per (batch, k): cover the common
+            # solo shapes so a first degradation never pays a
+            # mid-request compile; rarer batched shapes compile once
+            # under load like the local pow2 ladder
+            idx = self._sharded_index(model)
             for k in {min(pow2_ceil(k), n) for k in (1, 4, 10, 16, 20)}:
                 idx.warm(k, batch=1)
 
@@ -530,8 +626,23 @@ class ALSAlgorithm(Algorithm):
             # ring top-k over the mesh-sharded item table; the request
             # Deadline in scope becomes the per-shard hop budget, and a
             # late shard is served from parity (pio-armor)
-            vals2, ixs2 = model.sharded_topk_index()(
+            vals2, ixs2 = self._sharded_index(model)(
                 np.asarray(model.user_factors[uix])[None, :], k
+            )
+            return PredictedResult(
+                item_scores=decode_item_scores(
+                    model.items, np.asarray(vals2)[0], np.asarray(ixs2)[0]
+                )
+            )
+        rcfg = self._retrieval_config()
+        if mask is None and rcfg is not None:
+            # pio-scout: quantized candidate shortlist -> exact f32
+            # rerank.  Filtered queries stay on the exact scorer above
+            # (a -inf mask over a shortlist can starve results below
+            # num; the exact path's mask contract is already right).
+            vals2, ixs2 = model.device_ann_index(rcfg).search(
+                np.asarray(model.user_factors[uix])[None, :], k,
+                model.device_item_factors(self._serve_dtype()),
             )
             return PredictedResult(
                 item_scores=decode_item_scores(
@@ -586,12 +697,22 @@ class ALSAlgorithm(Algorithm):
             mask = np.stack([zero if m is None else m for m in masks])
         else:
             mask = None
+        rcfg = self._retrieval_config()
         if mask is None and getattr(self.params, "distributed_topk",
                                     False):
             # the micro-batched serving path rides the same parity-coded
             # ring as solo predict (the ring takes a [B, R] query block
             # natively); per-query masks keep the local scorer below
-            vals, ixs = model.sharded_topk_index()(uvecs, k)
+            vals, ixs = self._sharded_index(model)(uvecs, k)
+            vals, ixs = np.asarray(vals), np.asarray(ixs)
+        elif mask is None and rcfg is not None:
+            # pio-scout two-stage: the batched serving path is exactly
+            # where the candidate stage pays — per-batch device work
+            # drops from O(M*R) f32 to a quantized shortlist scan +
+            # O(candidate_factor*k*R) exact rerank
+            vals, ixs = model.device_ann_index(rcfg).search(
+                uvecs, k, model.device_item_factors(self._serve_dtype())
+            )
             vals, ixs = np.asarray(vals), np.asarray(ixs)
         else:
             # the pre-transposed [R, M] table: same math, ~5x the

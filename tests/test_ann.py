@@ -1,0 +1,465 @@
+"""pio-scout: two-stage quantized ANN retrieval (`ops/ann.py`,
+`predictionio_tpu/retrieval/`, template threading, delta patching,
+and the per-shard candidate stage of the ring top-k)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops import ann
+from predictionio_tpu.ops.topk import batch_topk_scores, rerank_topk
+from predictionio_tpu.retrieval import RetrievalConfig, TwoStageRetriever
+from predictionio_tpu.storage.bimap import StringIndex
+from predictionio_tpu.templates.recommendation import (
+    ALSAlgorithm,
+    ALSModel,
+    Query,
+)
+
+RNG = np.random.default_rng(42)
+
+
+def _table(m=2000, r=16):
+    return RNG.normal(size=(m, r)).astype(np.float32)
+
+
+def _exact(table, q, k):
+    vals, ixs = batch_topk_scores(
+        jnp.asarray(q), jnp.asarray(table), k
+    )
+    return np.asarray(vals), np.asarray(ixs)
+
+
+# -- quantization ----------------------------------------------------------
+
+
+def test_quantize_rows_roundtrip_error_bounded():
+    t = _table(500, 24)
+    q, scale = quantized = ann.quantize_rows(t)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    deq = q.astype(np.float32) * scale[:, None]
+    # symmetric int8: error <= scale/2 per element = amax/254
+    amax = np.abs(t).max(axis=1)
+    assert np.all(np.abs(deq - t) <= amax[:, None] / 254.0 + 1e-7)
+    del quantized
+
+
+def test_quantize_rows_zero_row_and_validation():
+    t = np.zeros((3, 8), np.float32)
+    t[1] = 2.0
+    q, scale = ann.quantize_rows(t)
+    assert scale[0] == 1.0 and np.all(q[0] == 0)
+    assert q[1].max() == 127
+    with pytest.raises(ValueError, match="rows"):
+        ann.quantize_rows(np.zeros(5, np.float32))
+
+
+# -- candidate + rerank kernels --------------------------------------------
+
+
+def test_int8_covering_shortlist_is_exact():
+    t = _table(300, 16)
+    qv = RNG.normal(size=(4, 16)).astype(np.float32)
+    q8, scale = ann.quantize_rows(t)
+    cand = ann.int8_candidate_topk(
+        jnp.asarray(qv), jnp.asarray(np.ascontiguousarray(q8.T)),
+        jnp.asarray(scale), 300,
+    )
+    vals, ixs = rerank_topk(
+        jnp.asarray(qv), jnp.asarray(t), cand, 7
+    )
+    ev, ei = _exact(t, qv, 7)
+    assert np.array_equal(np.asarray(ixs), ei)
+    np.testing.assert_allclose(np.asarray(vals), ev, rtol=1e-6)
+
+
+def test_rerank_masks_negative_ids():
+    t = _table(50, 8)
+    qv = RNG.normal(size=(2, 8)).astype(np.float32)
+    cand = jnp.asarray(np.array([[3, -1, 7, -1], [1, 2, -1, -1]],
+                                np.int32))
+    vals, ixs = rerank_topk(jnp.asarray(qv), jnp.asarray(t), cand, 4)
+    vals = np.asarray(vals)
+    # exactly the live candidates are finite
+    assert np.isfinite(vals[0]).sum() == 2
+    assert np.isfinite(vals[1]).sum() == 2
+
+
+def test_ivf_kernel_never_returns_padding():
+    t = _table(100, 8)
+    q8, scale = ann.quantize_rows(t)
+    cent, assign = ann.build_clusters(t, 8, seed=0)
+    lay = ann.build_cluster_layout(q8, scale, assign, 8)
+    cand = ann.ivf_candidate_topk(
+        jnp.asarray(RNG.normal(size=(3, 8)).astype(np.float32)),
+        jnp.asarray(np.ascontiguousarray(cent.T)),
+        jnp.asarray(lay["q_slabs"]), jnp.asarray(lay["slab_scale"]),
+        jnp.asarray(lay["slab_ids"]), 2, 64,
+    )
+    cand = np.asarray(cand)
+    # ids are either valid rows or the -1 shortfall marker
+    assert cand.max() < 100
+    assert np.all((cand >= 0) | (cand == -1))
+
+
+# -- clustering ------------------------------------------------------------
+
+
+def test_build_clusters_splits_oversized():
+    # heavily skewed data: everything near one center — splitting
+    # must bound the max cluster (the slab capacity) while keeping
+    # every item in a cluster whose centroid represents it
+    t = np.concatenate([
+        RNG.normal(size=(900, 8)).astype(np.float32) * 0.01 + 5.0,
+        RNG.normal(size=(100, 8)).astype(np.float32),
+    ])
+    cent, assign = ann.build_clusters(t, 10, seed=0, balance=1.3)
+    counts = np.bincount(assign, minlength=len(cent))
+    assert counts.max() <= int(np.ceil(1.3 * 1000 / 10))
+    assert counts.sum() == 1000
+    assert len(cent) >= 10  # skew grows the cluster count, not cap
+
+
+def test_cluster_layout_partitions_catalog():
+    t = _table(321, 8)
+    q8, scale = ann.quantize_rows(t)
+    cent, assign = ann.build_clusters(t, 6, seed=1)
+    lay = ann.build_cluster_layout(q8, scale, assign, 6)
+    ids = lay["slab_ids"]
+    live = ids[ids >= 0]
+    assert sorted(live.tolist()) == list(range(321))
+    # slot map addresses each item's cell
+    for i in (0, 5, 320):
+        c, s = assign[i], lay["slot"][i]
+        assert ids[c, s] == i
+        np.testing.assert_array_equal(lay["q_slabs"][c, s], q8[i])
+        assert lay["slab_scale"][c, s] == scale[i]
+    assert lay["fill"].sum() == 321
+
+
+def test_recall_at_k_helper():
+    assert ann.recall_at_k([[1, 2, 3]], [[3, 2, 9]]) == pytest.approx(
+        2 / 3
+    )
+    with pytest.raises(ValueError, match="differ"):
+        ann.recall_at_k(np.zeros((2, 3)), np.zeros((3, 3)))
+
+
+# -- RetrievalConfig -------------------------------------------------------
+
+
+def test_retrieval_config_validation():
+    with pytest.raises(ValueError, match="retrieval"):
+        RetrievalConfig(mode="typo")
+    with pytest.raises(ValueError, match="candidate_factor"):
+        RetrievalConfig(mode="int8", candidate_factor=0)
+    with pytest.raises(ValueError, match="nprobe"):
+        RetrievalConfig(mode="ivf", nprobe=0)
+    assert not RetrievalConfig().active
+    assert RetrievalConfig(mode="int8").active
+    # auto cluster count: pow2 near sqrt(M), never above M
+    assert RetrievalConfig(mode="ivf").resolve_clusters(10_000) == 128
+    assert RetrievalConfig(mode="ivf").resolve_clusters(3) <= 3
+    assert RetrievalConfig(
+        mode="ivf", clusters=64
+    ).resolve_clusters(10_000) == 64
+
+
+def test_als_config_carries_retrieval_knobs():
+    from predictionio_tpu.models.als import ALSConfig
+
+    cfg = ALSConfig(retrieval="ivf", candidate_factor=4, nprobe=2)
+    assert cfg.retrieval == "ivf"
+    with pytest.raises(ValueError, match="retrieval"):
+        ALSConfig(retrieval="bogus")
+    with pytest.raises(ValueError, match="candidate_factor"):
+        ALSConfig(candidate_factor=0)
+    with pytest.raises(ValueError, match="nprobe"):
+        ALSConfig(nprobe=0)
+
+
+# -- TwoStageRetriever -----------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["int8", "ivf"])
+def test_covering_search_matches_exact(mode):
+    t = _table(600, 16)
+    qv = RNG.normal(size=(5, 16)).astype(np.float32)
+    cfg = RetrievalConfig(mode=mode, candidate_factor=600,
+                          nprobe=10**6, clusters=8)
+    idx = TwoStageRetriever.build(t, cfg)
+    vals, ixs = idx.search(qv, 9, jnp.asarray(t))
+    ev, ei = _exact(t, qv, 9)
+    assert np.array_equal(np.asarray(ixs), ei)
+    np.testing.assert_allclose(np.asarray(vals), ev, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["int8", "ivf"])
+def test_patch_equals_rebuild(mode):
+    """THE delta contract: patching rows + appending items in place
+    answers exactly like an index rebuilt from the patched table."""
+    t = _table(400, 8)
+    cfg = RetrievalConfig(mode=mode, candidate_factor=400,
+                          nprobe=10**6, clusters=4)
+    patched = TwoStageRetriever.build(t, cfg)
+    rows = RNG.normal(size=(3, 8)).astype(np.float32)
+    app = RNG.normal(size=(5, 8)).astype(np.float32)
+    counts = patched.patch([7, 0, 399], rows, app)
+    assert counts == {"patched": 3, "appended": 5}
+    assert patched.n_items == 405
+
+    t2 = np.concatenate([t, app])
+    t2[[7, 0, 399]] = rows
+    rebuilt = TwoStageRetriever.build(t2, cfg)
+    qv = RNG.normal(size=(4, 8)).astype(np.float32)
+    va, ia = patched.search(qv, 11, jnp.asarray(t2))
+    vb, ib = rebuilt.search(qv, 11, jnp.asarray(t2))
+    assert np.array_equal(np.asarray(ia), np.asarray(ib))
+    np.testing.assert_allclose(
+        np.asarray(va), np.asarray(vb), rtol=1e-6
+    )
+
+
+def test_ivf_append_grows_capacity_in_place():
+    t = _table(64, 8)
+    cfg = RetrievalConfig(mode="ivf", candidate_factor=64,
+                          nprobe=10**6, clusters=4)
+    idx = TwoStageRetriever.build(t, cfg)
+    cap0 = idx.summary()["clusterCapacity"]
+    # append enough rows to overflow any cluster's headroom
+    app = RNG.normal(size=(4 * cap0, 8)).astype(np.float32)
+    idx.patch([], np.zeros((0, 8), np.float32), app)
+    assert idx.summary()["clusterCapacity"] > cap0
+    t2 = np.concatenate([t, app])
+    qv = RNG.normal(size=(2, 8)).astype(np.float32)
+    _, ixs = idx.search(qv, 5, jnp.asarray(t2))
+    ev, ei = _exact(t2, qv, 5)
+    assert np.array_equal(np.asarray(ixs), ei)
+
+
+def test_empty_patch_is_noop():
+    t = _table(50, 8)
+    idx = TwoStageRetriever.build(
+        t, RetrievalConfig(mode="int8", candidate_factor=2)
+    )
+    st = idx._state
+    assert idx.patch([], np.zeros((0, 8), np.float32)) == {
+        "patched": 0, "appended": 0,
+    }
+    assert idx._state is st and idx.patches == 0
+
+
+def test_stage_metrics_observed():
+    from predictionio_tpu.obs import RETRIEVAL_STAGE_SECONDS
+
+    before_c = RETRIEVAL_STAGE_SECONDS.labels(
+        stage="candidate").snapshot()["count"]
+    before_r = RETRIEVAL_STAGE_SECONDS.labels(
+        stage="rerank").snapshot()["count"]
+    t = _table(100, 8)
+    idx = TwoStageRetriever.build(
+        t, RetrievalConfig(mode="int8", candidate_factor=4)
+    )
+    idx.search(RNG.normal(size=(2, 8)).astype(np.float32), 3,
+               jnp.asarray(t))
+    assert RETRIEVAL_STAGE_SECONDS.labels(
+        stage="candidate").snapshot()["count"] == before_c + 1
+    assert RETRIEVAL_STAGE_SECONDS.labels(
+        stage="rerank").snapshot()["count"] == before_r + 1
+
+
+# -- template threading ----------------------------------------------------
+
+
+def _model(m=800, r=12, users=30):
+    return ALSModel(
+        user_factors=RNG.normal(size=(users, r)).astype(np.float32),
+        item_factors=_table(m, r),
+        users=StringIndex([f"u{i}" for i in range(users)]),
+        items=StringIndex([f"i{i}" for i in range(m)]),
+        item_props={},
+    )
+
+
+def _ann_algo(mode="int8", cf=800, **kw):
+    algo = ALSAlgorithm()
+    algo.params = algo.params_class(
+        retrieval=mode, candidate_factor=cf,
+        nprobe=kw.pop("nprobe", 10**6),
+        ann_clusters=kw.pop("ann_clusters", 8), **kw,
+    )
+    return algo
+
+
+@pytest.mark.parametrize("mode", ["int8", "ivf"])
+def test_template_predict_matches_exact_at_coverage(mode):
+    model = _model()
+    exact = ALSAlgorithm()
+    algo = _ann_algo(mode)
+    q = Query(user="u2", num=10)
+    a, b = algo.predict(model, q), exact.predict(model, q)
+    assert [s.item for s in a.item_scores] == [
+        s.item for s in b.item_scores
+    ]
+    for sa, sb in zip(a.item_scores, b.item_scores):
+        assert sa.score == pytest.approx(sb.score, rel=1e-6)
+
+
+def test_template_batch_predict_routes_ann_and_respects_invalid():
+    model = _model()
+    algo = _ann_algo("int8")
+    exact = ALSAlgorithm()
+    qs = [Query(user="u1", num=5), Query(user="nope", num=5),
+          Query(user="u3", num=0), Query(user="u4", num=7)]
+    res = algo.batch_predict(model, qs)
+    ref = exact.batch_predict(model, qs)
+    assert res[1].item_scores == () and res[2].item_scores == ()
+    for ra, rb in zip(res, ref):
+        assert [s.item for s in ra.item_scores] == [
+            s.item for s in rb.item_scores
+        ]
+
+
+def test_template_filtered_query_stays_exact():
+    """Masked queries must keep the exact scorer (shortlist + -inf
+    mask can starve below num) — and therefore honor the filter."""
+    model = _model()
+    algo = _ann_algo("int8")
+    exact = ALSAlgorithm()
+    top = exact.predict(model, Query(user="u5", num=3)).item_scores
+    banned = top[0].item
+    r = algo.predict(
+        model, Query(user="u5", num=3, blacklist=(banned,))
+    )
+    assert banned not in [s.item for s in r.item_scores]
+    assert len(r.item_scores) == 3
+
+
+def test_template_params_from_engine_json_variant():
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+
+    engine = recommendation_engine()
+    ep = engine.params_from_variant({
+        "algorithms": [{
+            "name": "als",
+            "params": {"rank": 4, "retrieval": "ivf",
+                       "candidateFactor": 5, "nprobe": 3,
+                       "annClusters": 32},
+        }],
+    })
+    p = ep.algorithms[0][1]
+    assert p.retrieval == "ivf"
+    assert p.candidate_factor == 5
+    assert p.nprobe == 3
+    assert p.ann_clusters == 32
+
+
+def test_warmup_covers_batched_ann_shapes():
+    """After warmup, serving-ladder searches must not add compile
+    entries for the candidate kernels (the p99-spike contract)."""
+    model = _model(m=300)
+    algo = _ann_algo("int8", cf=10)
+    algo.warmup(model, max_batch=4)
+    idx = model.device_ann_index(algo._retrieval_config())
+    from predictionio_tpu.ops.ann import int8_candidate_topk
+
+    sizes_before = int8_candidate_topk._cache_size()
+    table = model.device_item_factors(None)
+    for b in (1, 2, 4):
+        idx.search(np.zeros((b, 12), np.float32), 16, table)
+    assert int8_candidate_topk._cache_size() == sizes_before
+
+
+# -- pio-live integration --------------------------------------------------
+
+
+def test_apply_model_delta_patches_ann_index():
+    from predictionio_tpu.live.apply import apply_model_delta
+    from predictionio_tpu.workflow.model_io import ModelDelta
+
+    model = _model(m=200, r=8, users=10)
+    algo = _ann_algo("ivf", cf=200, ann_clusters=4)
+    algo.warmup(model, max_batch=2)
+    cfg = algo._retrieval_config()
+    idx = model.device_ann_index(cfg)
+    uf = model.user_factors
+    best = (uf[4] / np.linalg.norm(uf[4]) * 30).astype(np.float32)
+    z = np.zeros((0, 8), np.float32)
+    delta = ModelDelta(
+        seq=1, user_rows_ix=[], user_rows=z, new_user_ids=[],
+        new_user_rows=z, item_rows_ix=[2],
+        item_rows=(best * 0.5)[None, :], new_item_ids=["fresh"],
+        new_item_rows=best[None, :],
+        meta={"baseUsers": 10, "baseItems": 200},
+    )
+    counts = apply_model_delta(model, delta)
+    assert counts["annIndexesPatched"] == 1
+    assert model.device_ann_index(cfg) is idx  # no rebuild
+    assert idx.patches == 1 and idx.n_items == 201
+    r = algo.predict(model, Query(user="u4", num=2))
+    assert [s.item for s in r.item_scores] == ["fresh", "i2"]
+
+
+# -- distributed: per-shard candidate stage --------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from predictionio_tpu.parallel import make_mesh
+
+    return make_mesh()
+
+
+def test_quantized_ring_covering_matches_exact(mesh):
+    from predictionio_tpu.ops.distributed_topk import ShardedTopK
+
+    t = _table(512, 8)
+    qv = RNG.normal(size=(3, 8)).astype(np.float32)
+    idx = ShardedTopK(t, mesh, retrieval="int8", candidate_factor=512)
+    idx.warm(6, batch=3)
+    vals, ixs = idx(qv, 6)
+    ev, ei = _exact(t, qv, 6)
+    assert np.array_equal(np.asarray(ixs), ei)
+    np.testing.assert_allclose(np.asarray(vals), ev, rtol=1e-5)
+    assert idx.summary()["retrieval"] == "int8"
+
+
+def test_quantized_ring_shortlist_recall(mesh):
+    """A narrow per-shard shortlist still recalls the global top-k
+    well (every hop contributes its local best)."""
+    from predictionio_tpu.ops.distributed_topk import ShardedTopK
+
+    t = _table(1024, 16)
+    qv = RNG.normal(size=(4, 16)).astype(np.float32)
+    idx = ShardedTopK(t, mesh, retrieval="ivf",  # maps to int8
+                      candidate_factor=10)
+    vals, ixs = idx(qv, 8)
+    _, ei = _exact(t, qv, 8)
+    assert ann.recall_at_k(ei, np.asarray(ixs)) >= 0.9
+
+
+def test_quantized_ring_degraded_falls_back_to_coded(mesh, monkeypatch):
+    """With a shard degraded, the quantized index rides the coded
+    EXACT ring (parity has no quantized counterpart) — answers stay
+    correct, just without candidate savings."""
+    from predictionio_tpu.ops.distributed_topk import ShardedTopK
+
+    t = _table(256, 8)
+    qv = RNG.normal(size=(2, 8)).astype(np.float32)
+    idx = ShardedTopK(t, mesh, retrieval="int8", candidate_factor=4)
+    if idx.health is None:
+        pytest.skip("single-device mesh: no health tracking")
+    idx.warm(5, batch=2)
+    d = mesh.shape["data"]
+    monkeypatch.setattr(
+        idx.health, "poll",
+        lambda deadline=None: np.array(
+            [0.0] + [1.0] * (d - 1), np.float32
+        ),
+    )
+    vals, ixs = idx(qv, 5)
+    ev, ei = _exact(t, qv, 5)
+    assert np.array_equal(np.asarray(ixs), ei)
+    np.testing.assert_allclose(np.asarray(vals), ev, rtol=1e-5)

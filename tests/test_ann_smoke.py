@@ -1,0 +1,47 @@
+"""tools/ann_smoke.py drives the pio-scout contract end to end
+through the real template serving path (two-stage quantized retrieval
+exact at covering candidate_factor, stage metrics booked, one fold-in
+delta patching the quantized index in place with no rebuild): a
+regression in candidate/rerank math or the delta re-quantization path
+fails here in CI, not as silently degraded recall in production."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent.parent
+
+
+def test_ann_smoke_runs_and_all_checks_hold(tmp_path):
+    out = tmp_path / "ann.json"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PIO_TPU_HOME": str(tmp_path / "home"),
+    })
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "ann_smoke.py"),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    rec = json.loads(out.read_text())
+    assert rec["ok"] is True
+    names = {c["check"] for c in rec["checks"]}
+    # the contract's headline invariants all ran
+    for required in (
+        "int8_covering_recall_is_1",
+        "ivf_covering_recall_is_1",
+        "int8_rerank_scores_exact",
+        "stage_metrics_booked",
+        "patch_in_place_no_rebuild",
+        "appended_item_served",
+        "patched_row_served",
+        "patched_ann_matches_exact",
+    ):
+        assert required in names, f"missing check {required}"
+    for c in rec["checks"]:
+        assert c["ok"], f"check {c['check']} failed: {c}"

@@ -503,3 +503,77 @@ def test_transposed_device_cache_patches_with_deltas():
     vals, ixs = batch_topk_scores_t(q, model.device_item_factors_t(), 3)
     ref = np.argsort(-(q @ host.T), axis=1)[:, :3]
     np.testing.assert_array_equal(np.asarray(ixs), ref)
+
+
+@pytest.mark.parametrize("m,r", [
+    (8, 8),       # exactly one (8, 128)-class tile row block
+    (127, 8),     # one short of the f32 sublane boundary
+    (128, 16),    # exactly on it
+    (129, 16),    # one past it (tail row)
+    (261, 32),    # multi-tile with a ragged tail
+])
+def test_device_cache_patch_tile_boundary_shapes(m, r):
+    """pio-scout satellite: the PR 11 parity test covered ONE shape;
+    the column-wise transposed patch (and now the quantized-table
+    patch) must hold at tile-boundary and tail sizes too — patched
+    rows at the edges, appends crossing the boundary, every cached
+    layout bitwise-consistent with a rebuild from the patched host
+    table."""
+    import numpy as np
+
+    from predictionio_tpu.ops.ann import quantize_rows
+    from predictionio_tpu.retrieval import RetrievalConfig
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.recommendation import ALSModel
+
+    rng = np.random.default_rng(m * 1000 + r)
+    model = ALSModel(
+        user_factors=rng.normal(size=(3, r)).astype(np.float32),
+        item_factors=rng.normal(size=(m, r)).astype(np.float32),
+        users=StringIndex([f"u{i}" for i in range(3)]),
+        items=StringIndex([f"i{i}" for i in range(m)]),
+        item_props={},
+    )
+    # build every cache the serving path can hold: plain, transposed,
+    # normalized, and the quantized ANN index
+    model.device_item_factors()
+    model.device_item_factors_t()
+    model.device_item_factors_normalized()
+    cfg = RetrievalConfig(mode="int8", candidate_factor=max(m, 1))
+    model.device_ann_index(cfg)
+
+    # patch the first row, a tile-edge row, and the last row; append
+    # enough rows to cross the next boundary
+    ixs = sorted({0, m // 2, m - 1})
+    new_rows = rng.normal(size=(len(ixs), r)).astype(np.float32)
+    appended = rng.normal(size=(9, r)).astype(np.float32)
+    host = np.concatenate([model.item_factors, appended], axis=0)
+    host[ixs] = new_rows
+    model.item_factors = host
+    model.patch_device_item_rows(ixs, new_rows, appended)
+    model.patch_ann_indexes(ixs, new_rows, appended)
+
+    np.testing.assert_array_equal(
+        np.asarray(model.device_item_factors()), host
+    )
+    np.testing.assert_array_equal(
+        np.asarray(model.device_item_factors_t()), host.T
+    )
+    norm = host / (
+        np.linalg.norm(host, axis=-1, keepdims=True) + 1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.device_item_factors_normalized()), norm,
+        rtol=1e-6,
+    )
+    # the quantized table patched in place == quantizing the patched
+    # host table from scratch (bitwise: same rounding, same scales)
+    idx = model.device_ann_index(cfg)
+    assert idx.n_items == m + 9
+    q_ref, s_ref = quantize_rows(host)
+    np.testing.assert_array_equal(
+        np.asarray(idx._state["q_table_t"]), q_ref.T
+    )
+    np.testing.assert_array_equal(
+        np.asarray(idx._state["scale"]), s_ref
+    )

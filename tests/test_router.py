@@ -262,3 +262,149 @@ def test_trace_header_forwarded(fleet):
     assert c.getresponse().status == 200
     c.close()
     assert seen.get("trace") == "t-route-1"
+
+
+# -- pio-scout satellites: router admission + respawn supervisor ----------
+
+
+def test_router_deadline_admission_sheds_doomed_requests():
+    """A ?timeout= request the EWMA forward estimate already exceeds
+    is 503'd AT THE ROUTER — the replica never sees it (no burned
+    round trip); a generous budget still admits."""
+
+    class SlowReplica(FakeReplica):
+        def _handle(self, req, respond):
+            if req.method == "POST" and req.path.startswith(
+                    "/queries.json"):
+                time.sleep(0.15)
+            super()._handle(req, respond)
+
+    fake = SlowReplica("slow")
+    router = _router_for([fake])
+    try:
+        # train the estimator with real (slow) round trips
+        for _ in range(3):
+            status, _ = _post(router.port, "/queries.json")
+            assert status == 200
+        served = fake.queries
+        assert router._ewma_forward.value > 0.1
+        status, body = _post(
+            router.port, "/queries.json?timeout=0.01"
+        )
+        assert status == 503
+        assert body["error"] == "AdmissionRejected"
+        assert fake.queries == served  # replica never saw it
+        assert router.admission_rejected == 1
+        # a budget the fleet can meet is admitted and served
+        status, _ = _post(router.port, "/queries.json?timeout=30")
+        assert status == 200
+        assert fake.queries == served + 1
+        assert router.status_json()["admissionRejected"] == 1
+    finally:
+        router.stop()
+        fake.kill()
+
+
+class _FakeProc:
+    def __init__(self):
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+
+def test_supervisor_respawns_dead_replica_with_backoff():
+    """Kill a replica's PROCESS: the supervisor respawns it (new
+    port), the router routes to the respawn, the respawn counter
+    books it, and repeated deaths back off exponentially."""
+    from predictionio_tpu.obs import REPLICA_RESPAWNS_TOTAL
+    from predictionio_tpu.server.router import ReplicaSupervisor
+
+    fakes = {0: FakeReplica("r0")}
+    procs = {0: _FakeProc()}
+
+    def spawner(index):
+        fakes[index] = FakeReplica(f"r0-respawn{len(fakes)}")
+        procs[index] = _FakeProc()
+        return {"proc": procs[index], "index": index,
+                "port_file": None, "log_path": None,
+                "_fake": fakes[index]}
+
+    def waiter(spawned, timeout_s=0.0):
+        return spawned["_fake"].port
+
+    sup = ReplicaSupervisor(spawner, waiter=waiter,
+                            backoff_base_s=0.05, backoff_cap_s=0.4)
+    replica = Replica("r0", "127.0.0.1", fakes[0].port,
+                      breaker_reset_s=0.2)
+    sup.attach(replica, {"proc": procs[0], "index": 0,
+                         "port_file": None, "log_path": None})
+    cfg = RouterConfig(host="127.0.0.1", port=0,
+                       health_interval_s=0.05, forward_timeout_s=5.0)
+    router = RouterServer([replica], cfg, supervisor=sup)
+    router.start_background()
+    try:
+        status, _ = _post(router.port, "/queries.json")
+        assert status == 200
+        before = REPLICA_RESPAWNS_TOTAL.labels(replica="r0").value()
+        # kill the process AND the listener
+        first = fakes[0]
+        procs[0].rc = 137
+        first.kill()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sup.respawns >= 1 and replica.healthy:
+                break
+            time.sleep(0.05)
+        assert sup.respawns >= 1
+        assert REPLICA_RESPAWNS_TOTAL.labels(
+            replica="r0").value() == before + 1
+        # the router now reaches the RESPAWNED listener
+        status, _ = _post(router.port, "/queries.json")
+        assert status == 200
+        assert replica.port != first.port
+        assert router.status_json()["supervisor"]["respawns"] >= 1
+        # backoff was scheduled at respawn time; once the respawn
+        # turned HEALTHY the counter reset (a recovered replica's next
+        # death starts the ladder over — only crash LOOPS climb it)
+        st = sup._procs["r0"]
+        assert st["next_try"] > 0.0
+        assert st["attempts"] == 0
+    finally:
+        router.stop()
+        for f in fakes.values():
+            try:
+                f.kill()
+            except Exception:
+                pass
+
+
+def test_supervisor_failed_respawn_backs_off():
+    from predictionio_tpu.server.router import ReplicaSupervisor
+
+    calls = []
+
+    def spawner(index):
+        calls.append(time.monotonic())
+        raise RuntimeError("spawn exploded")
+
+    sup = ReplicaSupervisor(spawner, waiter=lambda s, timeout_s=0: 0,
+                            backoff_base_s=0.05, backoff_cap_s=0.2)
+    fake = FakeReplica("rX")
+    replica = Replica("rX", "127.0.0.1", fake.port)
+    dead = _FakeProc()
+    dead.rc = 1
+    sup.attach(replica, {"proc": dead, "index": 0,
+                         "port_file": None, "log_path": None})
+    try:
+        for _ in range(50):
+            sup.tick([replica])
+            time.sleep(0.02)
+        # backoff throttled the attempts: a 1s window at 20ms ticks
+        # would try 50 times unthrottled; capped-backoff allows ~7
+        assert 1 <= len(calls) <= 12
+        assert sup.respawns == 0
+        st = sup._procs["rX"]
+        assert st["attempts"] >= 2
+    finally:
+        fake.kill()

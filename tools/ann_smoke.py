@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""pio-scout smoke: the two-stage ANN retrieval contract on a tiny
+catalog, cheap enough for every gate run (~10 s on CPU).
+
+Asserts, end to end through the REAL template serving path
+(`templates.recommendation.ALSAlgorithm` predict/batch_predict):
+
+1. **Exactness at full coverage** — with ``candidate_factor`` covering
+   the catalog, both quantized modes (int8 flat, IVF probing every
+   cluster) return the exact scan's top-10 ids WITH the exact scan's
+   scores (recall@10 == 1.0): the rerank stage really is the exact
+   math restricted to the shortlist, and a covering shortlist is the
+   whole catalog.
+2. **Stage decomposition** — ``pio_retrieval_stage_seconds`` booked
+   one candidate + one rerank observation per two-stage search.
+3. **Delta patch without rebuild** — one fold-in delta (a patched item
+   row + an appended item) applied through `live.apply.
+   apply_model_delta` patches the SAME retriever object in place
+   (object identity + patch counter; re-quantizing only the touched
+   rows), and the patched index immediately serves both the appended
+   item and the patched row's new score — the pio-live freshness
+   contract extended to the quantized index.
+
+Writes a JSON verdict to ``--out`` and exits nonzero on any failed
+invariant (tools/gate.sh step).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/ann_smoke.json")
+    ap.add_argument("--items", type=int, default=512)
+    ap.add_argument("--rank", type=int, default=16)
+    args = ap.parse_args()
+
+    from predictionio_tpu.live.apply import apply_model_delta
+    from predictionio_tpu.obs import RETRIEVAL_STAGE_SECONDS
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm, ALSModel, Query,
+    )
+    from predictionio_tpu.workflow.model_io import ModelDelta
+
+    checks: list[dict] = []
+
+    def check(name: str, ok: bool, **detail):
+        checks.append({"check": name, "ok": bool(ok), **detail})
+        print(f"  [{'ok' if ok else 'FAIL'}] {name} "
+              f"{json.dumps(detail) if detail else ''}")
+
+    rng = np.random.default_rng(7)
+    m, rank, users = args.items, args.rank, 40
+    uf = rng.normal(size=(users, rank)).astype(np.float32)
+    model = ALSModel(
+        user_factors=uf,
+        item_factors=rng.normal(size=(m, rank)).astype(np.float32),
+        users=StringIndex([f"u{i}" for i in range(users)]),
+        items=StringIndex([f"i{i}" for i in range(m)]),
+        item_props={},
+    )
+    exact = ALSAlgorithm()
+    queries = [Query(user=f"u{i}", num=10) for i in range(8)]
+    exact_res = exact.batch_predict(model, queries)
+    exact_ids = [[s.item for s in r.item_scores] for r in exact_res]
+    exact_scores = [[s.score for s in r.item_scores] for r in exact_res]
+
+    def covering_algo(mode):
+        algo = ALSAlgorithm()
+        algo.params = algo.params_class(
+            retrieval=mode, candidate_factor=m,
+            # probe EVERY cluster: coverage must not depend on k-means
+            nprobe=10**6, ann_clusters=16,
+        )
+        return algo
+
+    # 1) exactness at full coverage, both modes, solo + batched
+    for mode in ("int8", "ivf"):
+        algo = covering_algo(mode)
+        algo.warmup(model, max_batch=8)
+        res = algo.batch_predict(model, queries)
+        ids = [[s.item for s in r.item_scores] for r in res]
+        scores = [[s.score for s in r.item_scores] for r in res]
+        recall = float(np.mean([
+            len(set(e) & set(a)) / 10.0
+            for e, a in zip(exact_ids, ids)
+        ]))
+        check(f"{mode}_covering_recall_is_1", recall == 1.0,
+              recall=recall)
+        score_gap = float(max(
+            abs(a - b)
+            for ea, aa in zip(exact_scores, scores)
+            for a, b in zip(sorted(ea), sorted(aa))
+        ))
+        check(f"{mode}_rerank_scores_exact", score_gap < 1e-4,
+              max_gap=score_gap)
+        solo = algo.predict(model, Query(user="u0", num=10))
+        check(f"{mode}_solo_matches_exact",
+              [s.item for s in solo.item_scores] == exact_ids[0])
+
+    # 2) stage metrics booked for both stages
+    cand = RETRIEVAL_STAGE_SECONDS.labels(stage="candidate").snapshot()
+    rer = RETRIEVAL_STAGE_SECONDS.labels(stage="rerank").snapshot()
+    check("stage_metrics_booked",
+          cand["count"] > 0 and cand["count"] == rer["count"],
+          candidate=cand["count"], rerank=rer["count"])
+
+    # 3) fold-in delta patches the index in place, no rebuild
+    algo = covering_algo("ivf")
+    cfg = algo._retrieval_config()
+    idx_before = model.device_ann_index(cfg)
+    patches_before = idx_before.patches
+    # the appended item is u5's ideal item; the patched row becomes
+    # u6's — both must serve IMMEDIATELY after the apply
+    target5 = (uf[5] / np.linalg.norm(uf[5]) * 25).astype(np.float32)
+    target6 = (uf[6] / np.linalg.norm(uf[6]) * 25).astype(np.float32)
+    z = np.zeros((0, rank), np.float32)
+    delta = ModelDelta(
+        seq=1,
+        user_rows_ix=[], user_rows=z, new_user_ids=[], new_user_rows=z,
+        item_rows_ix=[3], item_rows=target6[None, :],
+        new_item_ids=["i-new"], new_item_rows=target5[None, :],
+        meta={"baseUsers": users, "baseItems": m},
+    )
+    counts = apply_model_delta(model, delta)
+    idx_after = model.device_ann_index(cfg)
+    check("patch_in_place_no_rebuild",
+          idx_after is idx_before
+          and idx_after.patches == patches_before + 1
+          and counts.get("annIndexesPatched", 0) >= 1,
+          counts=counts)
+    r5 = algo.predict(model, Query(user="u5", num=5))
+    check("appended_item_served",
+          r5.item_scores and r5.item_scores[0].item == "i-new",
+          top=[s.item for s in r5.item_scores[:3]])
+    r6 = algo.predict(model, Query(user="u6", num=5))
+    check("patched_row_served",
+          r6.item_scores and r6.item_scores[0].item == "i3",
+          top=[s.item for s in r6.item_scores[:3]])
+    # and the exact path agrees with the patched model (shared decode)
+    r6_exact = exact.predict(model, Query(user="u6", num=5))
+    check("patched_ann_matches_exact",
+          [s.item for s in r6.item_scores]
+          == [s.item for s in r6_exact.item_scores])
+
+    ok = all(c["ok"] for c in checks)
+    out = {"ok": ok, "checks": checks, "items": m, "rank": rank}
+    Path(args.out).write_text(json.dumps(out, indent=1) + "\n")
+    print(f"ann smoke: {'OK' if ok else 'FAILED'} "
+          f"({sum(c['ok'] for c in checks)}/{len(checks)}) -> {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,14 @@ def main() -> None:
                     "shape trained ALS tables have) instead of pure "
                     "noise; what makes an IVF recall/latency trade "
                     "representative")
+    ap.add_argument("--tenants", type=int, default=0, metavar="N",
+                    help="pio-hive: stage N independent tenant models "
+                    "in ONE multi-tenant server and drive the "
+                    "--sweep/--concurrency load round-robin across "
+                    "them; fenced records get the _mt suffix and are "
+                    "keyed by tenant count (scale=N — the same "
+                    "record-keying convention --items uses for "
+                    "catalog size)")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
@@ -389,16 +397,61 @@ def _prebuilt_engine(model, algo_params=None):
     return engine, ep, iid, ctx
 
 
-def _boot_server(engine, ep, iid, ctx, microbatch, edge="eventloop"):
+def _boot_server(engine, ep, iid, ctx, microbatch, edge="eventloop",
+                 tenants=None):
     from predictionio_tpu.server.serving import EngineServer, ServerConfig
 
     srv = EngineServer(
         engine, ep, iid, ctx=ctx,
         config=ServerConfig(port=0, microbatch=microbatch, edge=edge),
         engine_variant="bench.json",
+        tenants=tenants,
     )
     srv.start_background()
     return srv
+
+
+def _prebuilt_tenant_registry(args, model, rng, n, algo_params):
+    """N independent prebuilt tenants (tenant 0 reuses the already-
+    staged model; the rest draw fresh factor tables) in one
+    TenantRegistry — the mixed-tenant serving surface the --tenants
+    sweep measures.  Returns (anchor components, registry)."""
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.recommendation import ALSModel
+    from predictionio_tpu.tenancy import TenantRegistry, TenantSpec
+
+    specs = []
+    anchor = None
+    for i in range(n):
+        if i == 0:
+            m = model
+        else:
+            trng = np.random.default_rng(1000 + i)
+            m = ALSModel(
+                user_factors=trng.normal(
+                    size=(args.users, args.rank)
+                ).astype(np.float32),
+                item_factors=trng.normal(
+                    size=(args.items, args.rank)
+                ).astype(np.float32),
+                users=StringIndex(
+                    [f"u{j}" for j in range(args.users)]
+                ),
+                items=StringIndex(
+                    [f"i{j}" for j in range(args.items)]
+                ),
+                item_props={},
+            )
+        engine, ep, iid, ctx = _prebuilt_engine(m, algo_params)
+        specs.append(TenantSpec(
+            f"app{i}", "main", engine=engine, engine_params=ep,
+            instance_id=iid, ctx=ctx,
+        ))
+        if i == 0:
+            anchor = (engine, ep, iid, ctx)
+    registry = TenantRegistry(specs, memory_budget_bytes=0,
+                              salt="bench")
+    return anchor, registry
 
 
 def _warm_batch_ladder(srv, num: int, top: int) -> None:
@@ -525,23 +578,52 @@ def _bench_sweep(args, model, rng) -> None:
             "candidateFactor": args.candidate_factor,
             "nprobe": args.nprobe,
         }
-    engine, ep, iid, ctx = _prebuilt_engine(model, algo_params)
+    tenants_n = max(getattr(args, "tenants", 0) or 0, 0)
+    registry = None
+    if tenants_n > 1:
+        (engine, ep, iid, ctx), registry = _prebuilt_tenant_registry(
+            args, model, rng, tenants_n, algo_params
+        )
+    else:
+        engine, ep, iid, ctx = _prebuilt_engine(model, algo_params)
     srv = _boot_server(engine, ep, iid, ctx, microbatch="auto",
-                       edge=args.edge)
+                       edge=args.edge, tenants=registry)
     # fenced-record keying (pio-scout satellite): the catalog size
     # rides the record's ``scale`` field — part of bench_gate's
     # baseline key — so a 1M-item sweep never shares a rolling
     # baseline with the 100k default (which keeps scale None for
     # continuity with the pre-scout history).  Non-exact retrieval
     # additionally suffixes the metric name: exact and ANN
-    # trajectories are separate lines, judged separately.
+    # trajectories are separate lines, judged separately.  Multi-
+    # tenant sweeps (pio-hive) get the _mt suffix AND scale = tenant
+    # count — a 4-tenant QPS@SLO never shares a baseline with the
+    # single-tenant line.
     rec_scale = float(args.items) if args.items != 100_000 else None
     suffix = f"_{args.retrieval}" if args.retrieval != "exact" else ""
+    if tenants_n > 1:
+        suffix += "_mt"
+        rec_scale = float(tenants_n)
     base = f"http://127.0.0.1:{srv.config.port}"
     _warm_batch_ladder(srv, args.num, max(points_c) * 2)
+    if registry is not None:
+        # force-load + warm every tenant BEFORE the measured window: a
+        # lazy first-query load (seconds of XLA warmup) inside a sweep
+        # point would be measured as tail latency, which is a cold-
+        # start number, not the steady-state the sweep claims
+        dq = srv.query_decoder({"user": "u0", "num": args.num})
+        for key in [s.key for s in registry.specs()]:
+            rt = registry.get_runtime(key)
+            if rt.batcher is not None:
+                bsz = 1
+                while bsz <= min(64, max(points_c) * 2):
+                    rt.batcher.batch_fn([dq] * bsz)
+                    bsz *= 2
     payloads = [
-        json.dumps({"user": f"u{int(u)}", "num": args.num})
-        for u in rng.integers(0, args.users, 256)
+        json.dumps({
+            "user": f"u{int(u)}", "num": args.num,
+            **({"app": f"app{j % tenants_n}"} if tenants_n > 1 else {}),
+        })
+        for j, u in enumerate(rng.integers(0, args.users, 256))
     ]
 
     def seg_snapshot():
@@ -595,6 +677,7 @@ def _bench_sweep(args, model, rng) -> None:
             "errors": res["errors"],
             "items": args.items,
             "rank": args.rank,
+            **({"tenants": tenants_n} if tenants_n > 1 else {}),
             "segments_ms": segments_ms,
             **({"arrival_rate": args.arrival_rate,
                 "service_p99_ms": round(res["service_p99_ms"], 3)}
@@ -616,6 +699,7 @@ def _bench_sweep(args, model, rng) -> None:
         "items": args.items,
         "rank": args.rank,
         "retrieval": args.retrieval,
+        **({"tenants": tenants_n} if tenants_n > 1 else {}),
         "points": points,
         **({"microbatch": mb} if mb else {}),
     }
@@ -645,12 +729,17 @@ def _bench_sweep(args, model, rng) -> None:
             "edge": args.edge,
             "items": args.items,
             "rank": args.rank,
+            **({"tenants": tenants_n} if tenants_n > 1 else {}),
         }
         print(json.dumps(rec), flush=True)
         if args.append_history:
             bench_gate.append_history(bench_gate.DEFAULT_HISTORY, rec)
         try:
-            bench_gate.write_pr_summary(rec, key="serving_sweep")
+            bench_gate.write_pr_summary(
+                rec,
+                key="serving_sweep_mt" if tenants_n > 1
+                else "serving_sweep",
+            )
         except Exception as e:
             print(f"# WARNING: could not write bench summary: {e}",
                   file=sys.stderr)

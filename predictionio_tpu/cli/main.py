@@ -385,6 +385,17 @@ def cmd_deploy(args, storage: Storage) -> int:
         import os
 
         os.environ["PIO_TPU_SCAN_CACHE"] = "1"
+    # pio-hive: `deploy --multi tenants.json` boots ONE server hosting
+    # every tenant in the manifest.  Tenant 0 is the anchor (loaded
+    # eagerly as the server's own components, pinned); the rest load
+    # lazily on first query under the registry's memory budget.
+    tenants = None
+    if getattr(args, "multi", None):
+        tenants = _build_tenant_registry(args, storage)
+        anchor = tenants.spec(tenants.anchor_key)
+        args.engine_json = anchor.engine_json
+        if anchor.instance_id and not args.engine_instance_id:
+            args.engine_instance_id = anchor.instance_id
     verify_template_min_version(Path(args.engine_json).parent)
     engine, ep, variant = load_engine_from_variant(
         args.engine_json, args.engine_factory
@@ -425,6 +436,7 @@ def cmd_deploy(args, storage: Storage) -> int:
         ),
         engine_id=engine_id,
         engine_variant=str(args.engine_json),
+        tenants=tenants,
     )
     # undeploy a stale server holding the port (CreateServer.scala:266-288)
     import urllib.error
@@ -456,6 +468,36 @@ def cmd_deploy(args, storage: Storage) -> int:
     return 0
 
 
+def _build_tenant_registry(args, storage):
+    """Parse ``--multi`` tenants.json into a TenantRegistry, resolving
+    each tenant's app id + access key from metadata (attribution and
+    accessKey-routing need them; absent apps just lose conversion
+    scanning, loudly)."""
+    from ..tenancy import TenantRegistry, load_tenant_manifest
+
+    specs, opts = load_tenant_manifest(args.multi)
+    for spec in specs:
+        if spec.engine_json is None:
+            _out(f"Error: tenant {spec.key_str} has no engineJson.")
+            raise SystemExit(1)
+    if getattr(args, "memory_budget", None) is not None:
+        opts["memory_budget_bytes"] = args.memory_budget
+    md = storage.get_metadata()
+    for spec in specs:
+        app = md.app_get_by_name(spec.app)
+        if app is None:
+            _out(f"Warning: tenant app '{spec.app}' not found in "
+                 "metadata; accessKey routing and online-eval "
+                 "conversion scanning are off for it.")
+            continue
+        spec.app_id = app.id
+        if spec.access_key is None:
+            keys = md.access_key_get_by_app(app.id)
+            if keys:
+                spec.access_key = keys[0].key
+    return TenantRegistry(specs, **opts)
+
+
 def _deploy_fleet(args) -> int:
     """``deploy --replicas N``: spawn N single-replica deploy
     subprocesses on ephemeral ports, then run the router in THIS
@@ -476,6 +518,9 @@ def _deploy_fleet(args) -> int:
         ("--engine-instance-id", args.engine_instance_id),
         ("--microbatch", args.microbatch),
         ("--edge", args.edge),
+        # pio-hive: every replica hosts the same tenant manifest, so
+        # the fleet multiplexes N tenants x N replicas
+        ("--multi", getattr(args, "multi", None)),
     ):
         if val:
             extra += [flag, str(val)]
@@ -483,6 +528,7 @@ def _deploy_fleet(args) -> int:
         ("--query-timeout", args.query_timeout),
         ("--foldin-poll", args.foldin_poll),
         ("--max-connections", args.max_connections),
+        ("--memory-budget", getattr(args, "memory_budget", None)),
     ):
         if val is not None:
             extra += [flag, str(val)]
@@ -1072,6 +1118,19 @@ def build_parser() -> argparse.ArgumentParser:
                    "supervisor (default: a dead replica process is "
                    "respawned with capped exponential backoff and "
                    "booked in pio_replica_respawns_total)")
+    d.add_argument("--multi", metavar="TENANTS_JSON",
+                   help="pio-hive: host EVERY tenant of this manifest "
+                   "in one process (or one fleet with --replicas): "
+                   "lazy load + LRU eviction under a device-memory "
+                   "budget, per-tenant breakers/quotas/metrics, and "
+                   "weighted sticky A/B variant routing; tenant 0 is "
+                   "the pinned anchor")
+    d.add_argument("--memory-budget", type=float, default=None,
+                   metavar="BYTES",
+                   help="override the manifest's memoryBudgetBytes "
+                   "(0 = unbounded): resident tenant models are "
+                   "LRU-evicted to stay under it; pinned and "
+                   "in-flight tenants are never evicted")
 
     fi = sub.add_parser(
         "foldin",

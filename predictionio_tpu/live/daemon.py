@@ -45,6 +45,8 @@ from .watermark import (
     WATERMARK_FILE,
     Watermark,
     WatermarkStore,
+    cursor_is_zero,
+    merge_cursors,
     scan_new_ratings,
 )
 
@@ -130,9 +132,9 @@ class FoldInRunner:
         es = storage.get_event_store()
         if not hasattr(es, "find_rows_since"):
             raise ValueError(
-                f"event store {type(es).__name__} has no rowid cursor "
-                "scan (find_rows_since); pio-live needs the SQLite "
-                "backend"
+                f"event store {type(es).__name__} has no incremental "
+                "cursor scan (find_rows_since); pio-live needs a "
+                "SQLite-backed store (single-file or sharded)"
             )
         self.es = es
 
@@ -184,16 +186,23 @@ class FoldInRunner:
             apply_model_delta(self.model, d)
             self.seq = d.seq
             wmk = d.watermark or {}
-            chain_rowid = max(chain_rowid, int(wmk.get("rowid", 0)))
+            # cursors may be int rowids (single-file store) or the
+            # sharded store's per-shard vector strings; merge_cursors
+            # is the component-wise max either way
+            chain_rowid = merge_cursors(chain_rowid, wmk.get("rowid", 0))
         wm = self.watermarks.get(self.app_id, self.channel_id)
-        self.cursor = max(wm.rowid, chain_rowid)
-        if from_now and self.cursor == 0 and not chain:
+        self.cursor = merge_cursors(wm.rowid, chain_rowid)
+        if from_now and cursor_is_zero(self.cursor) and not chain:
             # first-ever daemon start on an already-trained deployment:
             # skip the history the full train already saw instead of
             # re-folding every user once (safe only because nothing was
             # ever folded from this store — a persisted cursor/chain
             # always wins over the flag)
-            self.cursor = es.max_rowid(self.app_id, self.channel_id)
+            self.cursor = (
+                es.high_water_cursor(self.app_id, self.channel_id)
+                if hasattr(es, "high_water_cursor")
+                else es.max_rowid(self.app_id, self.channel_id)
+            )
         self.cycles = 0
 
     def _resolve_app_id(self, ds) -> int:
@@ -207,9 +216,15 @@ class FoldInRunner:
         return app.id
 
     def watermark_lag(self) -> int:
-        """Event-store rows past the cursor (the freshness debt)."""
+        """Event-store rows past the cursor (the freshness debt);
+        ``cursor_lag`` sums per shard on the sharded store."""
+        if hasattr(self.es, "cursor_lag"):
+            return self.es.cursor_lag(
+                self.app_id, self.channel_id, self.cursor
+            )
         return max(
-            self.es.max_rowid(self.app_id, self.channel_id) - self.cursor,
+            self.es.max_rowid(self.app_id, self.channel_id)
+            - int(self.cursor),
             0,
         )
 

@@ -24,16 +24,88 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Watermark", "WatermarkStore", "ScanBatch", "scan_new_ratings"]
+__all__ = [
+    "Watermark", "WatermarkStore", "ScanBatch", "scan_new_ratings",
+    "cursor_is_zero", "cursor_would_regress", "merge_cursors",
+]
 
 WATERMARK_FILE = "foldin_watermark.json"
+
+
+# -- cursor algebra ----------------------------------------------------------
+#
+# A cursor is an int rowid (single-file store) or a JSON shard-vector
+# string '{"0": r0, "1": r1, ...}' (ShardedSQLiteEventStore) — the
+# per-shard fold-in watermark.  Both kinds flow through the same
+# watermark files / delta metadata; these helpers are the only places
+# that look inside.
+
+
+def _as_dict(c):
+    if isinstance(c, str):
+        try:
+            d = json.loads(c)
+        except json.JSONDecodeError:
+            return None
+        if isinstance(d, dict):
+            return {str(k): int(v) for k, v in d.items()}
+    return None
+
+
+def cursor_is_zero(c) -> bool:
+    """True for the never-folded starting cursor (0 / empty / all-zero
+    vector)."""
+    d = _as_dict(c)
+    if d is not None:
+        return all(v == 0 for v in d.values())
+    return not c or int(c) == 0
+
+
+def cursor_would_regress(prev, new) -> bool:
+    """Whether replacing ``prev`` with ``new`` moves ANY component
+    backwards (the strictly-increasing watermark contract, per shard).
+    Mixed int/vector kinds regress unless the loser is zero — a store
+    swap mid-chain must be refused, not silently re-keyed."""
+    dp, dn = _as_dict(prev), _as_dict(new)
+    if dp is None and dn is None:
+        return int(new or 0) < int(prev or 0)
+    if dp is not None and dn is not None:
+        return any(dn.get(k, 0) < v for k, v in dp.items())
+    # kind change: fine only when the previous cursor is still zero
+    return not cursor_is_zero(prev)
+
+
+def merge_cursors(a, b):
+    """Component-wise max of two cursors of the SAME kind (zero merges
+    with anything) — how the daemon reconciles the watermark file with
+    the delta chain's recorded high-water on restart."""
+    if cursor_is_zero(a):
+        return b
+    if cursor_is_zero(b):
+        return a
+    da, db = _as_dict(a), _as_dict(b)
+    if da is None and db is None:
+        return max(int(a), int(b))
+    if da is not None and db is not None:
+        keys = set(da) | set(db)
+        return json.dumps(
+            {k: max(da.get(k, 0), db.get(k, 0)) for k in sorted(keys)},
+            sort_keys=True, separators=(",", ":"),
+        )
+    raise ValueError(
+        f"cannot merge cursor kinds {type(a).__name__} and "
+        f"{type(b).__name__} ({a!r} vs {b!r}); the event store "
+        "backend changed mid-chain"
+    )
 
 
 @dataclass
 class Watermark:
     app_id: int
     channel_id: int = 0
-    rowid: int = 0   # last event-store rowid folded in
+    # last event-store cursor folded in: an int rowid, or the sharded
+    # store's JSON shard-vector string (see cursor algebra above)
+    rowid: "int | str" = 0
     seq: int = 0     # last delta-chain seq produced from it
 
 
@@ -72,10 +144,11 @@ class WatermarkStore:
         cur = self._load_raw()["cursors"].get(f"{app_id}:{channel_id}")
         if not cur:
             return Watermark(app_id=app_id, channel_id=channel_id)
+        rowid = cur.get("rowid", 0)
         return Watermark(
             app_id=app_id,
             channel_id=channel_id,
-            rowid=int(cur.get("rowid", 0)),
+            rowid=rowid if isinstance(rowid, str) else int(rowid),
             seq=int(cur.get("seq", 0)),
         )
 
@@ -83,13 +156,14 @@ class WatermarkStore:
         raw = self._load_raw()
         key = f"{wm.app_id}:{wm.channel_id}"
         prev = raw["cursors"].get(key, {})
-        if int(prev.get("rowid", 0)) > wm.rowid:
+        if cursor_would_regress(prev.get("rowid", 0), wm.rowid):
             raise ValueError(
                 f"watermark for {key} would move backwards "
                 f"({prev.get('rowid')} -> {wm.rowid})"
             )
         raw["cursors"][key] = {
-            "rowid": int(wm.rowid),
+            "rowid": (wm.rowid if isinstance(wm.rowid, str)
+                      else int(wm.rowid)),
             "seq": int(wm.seq),
             "updatedAt": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -114,8 +188,8 @@ class ScanBatch:
         default_factory=lambda: np.empty(0, np.float32)
     )
     n_events: int = 0
-    cursor: int = 0       # the window's start rowid
-    new_cursor: int = 0   # the max rowid consumed
+    cursor: "int | str" = 0       # the window's start cursor
+    new_cursor: "int | str" = 0   # the high-water cursor consumed
 
 
 def scan_new_ratings(
@@ -183,6 +257,8 @@ def scan_new_ratings(
         item_ids=items,
         values=np.asarray(list(agg.values()), np.float32),
         n_events=len(rows),
-        cursor=int(cursor),
-        new_cursor=int(new_cursor),
+        # cursors pass through OPAQUELY: int rowid (single file) or the
+        # sharded store's shard-vector string
+        cursor=cursor,
+        new_cursor=new_cursor,
     )

@@ -325,6 +325,67 @@ RETRIEVAL_STAGE_SECONDS = _registry.histogram(
     buckets=log_buckets(1e-5, 10.0, per_decade=4),
 )
 
+# pio-hive (multi-tenant serving + live A/B) families: the tenant
+# registry books residency/eviction under its device-memory budget, the
+# per-tenant serving path books outcomes and latency under (app,
+# variant) labels — the label set that makes one tenant's overload or
+# open breaker visible WITHOUT reading another tenant's lines — and the
+# online-eval aggregator keeps per-variant impression/conversion counts
+# + CTR-style rate fresh for /metrics and the pio-tower manifest.
+TENANT_RESIDENT_BYTES = _registry.gauge(
+    "pio_tenant_resident_bytes",
+    "Accounted host+device bytes of one resident tenant model "
+    "(factor tables + cached device arrays)",
+    labels=("app", "variant"),
+)
+TENANT_MEMORY_BUDGET = _registry.gauge(
+    "pio_tenant_memory_budget_bytes",
+    "Configured device-memory budget the tenant registry evicts "
+    "toward (0 = unbounded)",
+)
+TENANTS_RESIDENT = _registry.gauge(
+    "pio_tenants_resident",
+    "Tenant models currently resident in the registry",
+)
+TENANT_LOADS_TOTAL = _registry.counter(
+    "pio_tenant_loads_total",
+    "Tenant registry lifecycle events (kind=load|evict|overcommit)",
+    labels=("app", "variant", "kind"),
+)
+TENANT_QUERIES_TOTAL = _registry.counter(
+    "pio_tenant_queries_total",
+    "Per-tenant serving outcomes (the isolation evidence: one "
+    "tenant's errors live on its own labels)",
+    labels=("app", "variant", "status"),
+)
+TENANT_QUERY_LATENCY = _registry.histogram(
+    "pio_tenant_query_latency_seconds",
+    "Per-tenant end-to-end serving latency",
+    labels=("app", "variant"),
+)
+TENANT_QUOTA_REJECTED = _registry.counter(
+    "pio_tenant_quota_rejected_total",
+    "Queries shed by a tenant's token-bucket quota (structured 429)",
+    labels=("app", "variant"),
+)
+VARIANT_REQUESTS_TOTAL = _registry.counter(
+    "pio_variant_requests_total",
+    "Online-eval impressions: queries served per (app, variant)",
+    labels=("app", "variant"),
+)
+VARIANT_FEEDBACK_TOTAL = _registry.counter(
+    "pio_variant_feedback_total",
+    "Online-eval conversions: variant-attributed feedback events "
+    "scanned back out of the event store",
+    labels=("app", "variant"),
+)
+VARIANT_RATE = _registry.gauge(
+    "pio_variant_outcome_rate",
+    "Online-eval CTR-style rate per (app, variant): conversions / "
+    "impressions over the aggregation window",
+    labels=("app", "variant"),
+)
+
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every
 # process's first scrape already shows the full (zero-valued) shape

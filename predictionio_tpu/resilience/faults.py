@@ -28,6 +28,11 @@ Injection points instrumented in this codebase::
                        with NaN after the targeted sweep (consulted via
                        :func:`fired` — the pio-tower convergence
                        watchdog must turn it into a typed abort)
+    tenant.dispatch    the per-tenant serving path just before device
+                       work (pio-hive; consulted via
+                       :func:`check_tenant` — a ``tenant=app/variant``
+                       option scopes the rule to ONE tenant, the
+                       isolation-chaos selector)
 
 Plan grammar (``;``-separated rules, ``,``-separated options)::
 
@@ -49,6 +54,8 @@ Options per rule:
 * ``shard=I`` — the target shard of a ``dist.*`` rule (0-based mesh
   shard index, default 0); returned by :func:`fired_shard` so the
   distributed orchestration knows WHICH shard to degrade
+* ``tenant=APP/VARIANT`` — scope the rule to one tenant's calls at a
+  :func:`check_tenant` boundary (other tenants don't even count calls)
 
 Two consultation styles:
 
@@ -71,7 +78,8 @@ import urllib.error
 from typing import Optional
 
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "POINTS",
-           "arm", "disarm", "armed", "check", "fired", "fired_shard"]
+           "arm", "disarm", "armed", "check", "check_tenant", "fired",
+           "fired_shard"]
 
 POINTS = (
     "storage.write",
@@ -85,6 +93,7 @@ POINTS = (
     "dist.worker_kill",
     "dist.exchange_torn",
     "train.nan",
+    "tenant.dispatch",
 )
 
 
@@ -110,7 +119,8 @@ class FaultRule:
     def __init__(self, point: str, nth: int = 1,
                  times: Optional[int] = None, prob: Optional[float] = None,
                  delay: Optional[float] = None, exc: Optional[str] = None,
-                 seed: Optional[int] = None, shard: Optional[int] = None):
+                 seed: Optional[int] = None, shard: Optional[int] = None,
+                 tenant: Optional[str] = None):
         if point not in POINTS:
             raise ValueError(
                 f"unknown injection point {point!r}; known: {POINTS}"
@@ -128,6 +138,10 @@ class FaultRule:
         self.point = point
         self.nth = nth
         self.shard = shard
+        # pio-hive: a `tenant=app/variant` rule fires only for that
+        # tenant's calls (the per-tenant isolation chaos selector);
+        # None matches every tenant
+        self.tenant = tenant
         self.times = times
         self.prob = prob
         self.delay = delay
@@ -213,15 +227,23 @@ class FaultPlan:
                     kw[k] = v.strip()
                 elif k == "seed":
                     kw[k] = int(v)
+                elif k == "tenant":
+                    kw[k] = v.strip()
                 else:
                     raise ValueError(f"unknown fault option {k!r}")
             kw.setdefault("seed", seed)
             rules.append(FaultRule(point.strip(), **kw))
         return cls(rules)
 
-    def hit(self, point: str) -> None:
+    def hit(self, point: str, tenant: Optional[str] = None) -> None:
         rule = self._rules.get(point)
         if rule is None:
+            return
+        if rule.tenant is not None and tenant != rule.tenant:
+            # a tenant-scoped rule is invisible to other tenants' calls
+            # (not even counted: nth/times describe the TARGET tenant's
+            # call sequence, which is what makes isolation plans
+            # deterministic under interleaved multi-tenant traffic)
             return
         with self._lock:
             fired, exc = rule.hit()
@@ -312,6 +334,18 @@ def fired_shard(point: str,
     if plan is None:
         return None
     return plan.hit_shard(point, max_wait=max_wait)
+
+
+def check_tenant(point: str, tenant: str) -> None:
+    """Tenant-scoped instrumented boundary (``tenant.dispatch``): a
+    rule carrying ``tenant=app/variant`` fires only for that tenant's
+    calls — how a chaos plan opens ONE tenant's breaker while its
+    neighbors keep serving.  A rule without the option behaves like
+    :func:`check`.  No plan armed => one global load."""
+    plan = _plan
+    if plan is None:
+        return
+    plan.hit(point, tenant=tenant)
 
 
 def fired(point: str) -> bool:

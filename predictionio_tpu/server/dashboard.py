@@ -69,6 +69,7 @@ class DashboardServer(HTTPServerBase):
             "<a href='/xray.html'>x-ray</a> &middot; "
             "<a href='/pulse.html'>pulse</a> &middot; "
             "<a href='/train.html'>training console</a> &middot; "
+            "<a href='/tenants.html'>tenants</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
         )
@@ -234,6 +235,123 @@ class DashboardServer(HTTPServerBase):
             "<table border='1'><tr><th>trace</th><th>ms</th>"
             "<th>spans</th></tr>" + "\n".join(flight_rows) + "</table>"
             "</body></html>"
+        )
+
+    def tenants_html(self) -> str:
+        """Operator view of the pio-hive layer: per-(app, variant)
+        serving outcomes and latency, residency/eviction counters, and
+        the online A/B table (impressions / conversions / rate) — the
+        same registry families ``/metrics`` exposes, rendered per
+        tenant.  (Full registry detail lives on the engine server's
+        ``GET /debug/tenants``.)"""
+        from ..obs import (
+            TENANT_LOADS_TOTAL,
+            TENANT_MEMORY_BUDGET,
+            TENANT_QUERIES_TOTAL,
+            TENANT_QUERY_LATENCY,
+            TENANT_RESIDENT_BYTES,
+            TENANTS_RESIDENT,
+            VARIANT_FEEDBACK_TOTAL,
+            VARIANT_RATE,
+            VARIANT_REQUESTS_TOTAL,
+        )
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        def by_tenant(family, value_of):
+            out: dict[tuple, dict] = {}
+            for key, child in family.children():
+                k = dict(key)
+                tenant = (k.get("app", "?"), k.get("variant", "?"))
+                out.setdefault(tenant, {}).update(value_of(k, child))
+            return out
+
+        tenants: dict[tuple, dict] = {}
+        for (app, variant), d in by_tenant(
+            TENANT_QUERIES_TOTAL,
+            lambda k, c: {f"q_{k.get('status', '?')}": c.value()},
+        ).items():
+            tenants.setdefault((app, variant), {}).update(d)
+        for (app, variant), d in by_tenant(
+            TENANT_RESIDENT_BYTES,
+            lambda k, c: {"resident": c.value()},
+        ).items():
+            tenants.setdefault((app, variant), {}).update(d)
+        for key, child in TENANT_QUERY_LATENCY.children():
+            k = dict(key)
+            snap = child.snapshot()
+            if snap["count"]:
+                tenants.setdefault(
+                    (k.get("app", "?"), k.get("variant", "?")), {}
+                ).update({
+                    "p50_ms": child.percentile(50, snap) * 1e3,
+                    "p95_ms": child.percentile(95, snap) * 1e3,
+                })
+        rows = []
+        for (app, variant) in sorted(tenants):
+            d = tenants[(app, variant)]
+            rows.append(
+                "<tr><td>{a}/{v}</td><td>{r}</td><td>{ok:g}</td>"
+                "<td>{err:g}</td><td>{shed:g}</td><td>{q:g}</td>"
+                "<td>{p50:.2f} / {p95:.2f}</td></tr>".format(
+                    a=esc(app), v=esc(variant),
+                    r=("%.1f KB" % (d["resident"] / 1e3)
+                       if d.get("resident") else "—"),
+                    ok=d.get("q_ok", 0.0), err=d.get("q_error", 0.0),
+                    shed=d.get("q_shed", 0.0) + d.get("q_rejected", 0.0),
+                    q=d.get("q_quota", 0.0),
+                    p50=d.get("p50_ms", 0.0), p95=d.get("p95_ms", 0.0),
+                )
+            )
+        ab: dict[tuple, dict] = {}
+        for fam, field in ((VARIANT_REQUESTS_TOTAL, "impressions"),
+                           (VARIANT_FEEDBACK_TOTAL, "conversions"),
+                           (VARIANT_RATE, "rate")):
+            for key, child in fam.children():
+                k = dict(key)
+                ab.setdefault(
+                    (k.get("app", "?"), k.get("variant", "?")), {}
+                )[field] = child.value()
+        ab_rows = [
+            "<tr><td>{a}/{v}</td><td>{i:g}</td><td>{c:g}</td>"
+            "<td>{r:.4f}</td></tr>".format(
+                a=esc(app), v=esc(variant),
+                i=d.get("impressions", 0.0),
+                c=d.get("conversions", 0.0),
+                r=d.get("rate", 0.0),
+            )
+            for (app, variant), d in sorted(ab.items())
+        ]
+        loads = {"load": 0.0, "evict": 0.0, "overcommit": 0.0}
+        for key, child in TENANT_LOADS_TOTAL.children():
+            kind = dict(key).get("kind", "?")
+            loads[kind] = loads.get(kind, 0.0) + child.value()
+        budget = TENANT_MEMORY_BUDGET.child().value()
+        head = (
+            "<p>resident tenants: <b>{:g}</b> &middot; memory budget: "
+            "<b>{}</b> &middot; loads {:g} / evictions {:g} / "
+            "overcommits {:g}</p>".format(
+                TENANTS_RESIDENT.child().value(),
+                ("%.1f MB" % (budget / 1e6)) if budget else "unbounded",
+                loads["load"], loads["evict"], loads["overcommit"],
+            )
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>pio-hive tenants</title>"
+            "<meta http-equiv='refresh' content='5'>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td,th{padding:3px 8px;font-family:monospace}</style>"
+            "</head><body><h1>Tenants (pio-hive)</h1>" + head +
+            "<h2>Per-tenant serving</h2>"
+            "<table border='1'><tr><th>tenant</th><th>resident</th>"
+            "<th>ok</th><th>errors</th><th>shed</th><th>quota 429s</th>"
+            "<th>p50 / p95 ms</th></tr>" + "".join(rows) + "</table>"
+            "<h2>Online A/B (per variant)</h2>"
+            "<table border='1'><tr><th>variant</th><th>impressions</th>"
+            "<th>conversions</th><th>rate</th></tr>" +
+            "".join(ab_rows) + "</table>"
+            "<p><a href='/'>index</a></p></body></html>"
         )
 
     def pulse_html(self) -> str:
@@ -494,6 +612,10 @@ class DashboardServer(HTTPServerBase):
                     return
                 if path == "/train.html":
                     self._reply(200, server.train_html().encode(),
+                                "text/html")
+                    return
+                if path == "/tenants.html":
+                    self._reply(200, server.tenants_html().encode(),
                                 "text/html")
                     return
                 parts = [x for x in path.split("/") if x]

@@ -665,6 +665,87 @@ class RouterServer(HTTPServerBase):
             except RuntimeError:
                 respond(503, {"message": "router is stopping"})
             return
+        if req.method == "POST" and path == "/admin/tenants/weights":
+            # pio-hive: broadcast a variant-weight update fleet-wide so
+            # every replica's experiment assigns identically (sticky
+            # assignment is pure hash + weights — same weights on every
+            # replica == same variant for every user everywhere)
+            pool = self._pool
+            if pool is None:
+                respond(503, {"message": "router is stopping"})
+                return
+            body = req.body
+
+            def broadcast():
+                results = []
+                for r in self.replicas:
+                    if not r.healthy:
+                        results.append({
+                            "replica": r.name, "skipped": "unhealthy",
+                        })
+                        continue
+                    try:
+                        status, data, _ = r.request(
+                            "POST", "/tenants/weights", body,
+                            timeout_s=self.config.forward_timeout_s,
+                        )
+                        entry = {"replica": r.name, "status": status}
+                        try:
+                            entry.update(json.loads(data.decode()))
+                        except (json.JSONDecodeError, UnicodeDecodeError):
+                            pass
+                        results.append(entry)
+                    except Exception as e:
+                        r.mark_down(f"{type(e).__name__}: {e}")
+                        results.append({
+                            "replica": r.name,
+                            "error": f"{type(e).__name__}: {e}",
+                        })
+                try:
+                    respond(200, {"pushed": results})
+                except RuntimeError:
+                    pass
+
+            try:
+                pool.submit(broadcast)
+            except RuntimeError:
+                respond(503, {"message": "router is stopping"})
+            return
+        if req.method == "GET" and path == "/debug/tenants":
+            # fleet view: each replica's registry document keyed by
+            # replica name (one curl answers "which replica holds which
+            # tenants resident, and what are the A/B rates")
+            pool = self._pool
+            if pool is None:
+                respond(503, {"message": "router is stopping"})
+                return
+
+            def gather():
+                out = {}
+                for r in self.replicas:
+                    try:
+                        status, data, _ = r.request(
+                            "GET", "/debug/tenants", None,
+                            timeout_s=self.config.health_timeout_s,
+                        )
+                        out[r.name] = (
+                            json.loads(data.decode()) if status == 200
+                            else {"status": status}
+                        )
+                    except Exception as e:
+                        out[r.name] = {
+                            "error": f"{type(e).__name__}: {e}",
+                        }
+                try:
+                    respond(200, {"replicas": out})
+                except RuntimeError:
+                    pass
+
+            try:
+                pool.submit(gather)
+            except RuntimeError:
+                respond(503, {"message": "router is stopping"})
+            return
         if req.method == "POST" and path == "/stop":
             respond(200, {"message": "stopping"})
             threading.Thread(target=self.stop, daemon=True).start()

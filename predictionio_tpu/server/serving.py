@@ -68,6 +68,7 @@ from ..resilience.policy import (
     RetryPolicy,
     deadline_scope,
 )
+from ..tenancy.errors import QuotaExceeded, TenantUnavailable
 from ..workflow.train import prepare_deploy_components
 
 logger = logging.getLogger(__name__)
@@ -148,13 +149,14 @@ class ServerConfig:
 class _QueryCtx:
     """Per-query snapshot shared by the blocking and event-loop paths:
     decoded query, deadline, the components captured under the state
-    lock, and the pio-live attribution fields."""
+    lock, the pio-live attribution fields, and (pio-hive) the tenant
+    lease the query holds."""
 
     __slots__ = ("query", "deadline", "algorithms", "models", "serving",
-                 "batcher", "freshness", "foldin_seq")
+                 "batcher", "freshness", "foldin_seq", "lease")
 
     def __init__(self, query, deadline, algorithms, models, serving,
-                 batcher, freshness, foldin_seq):
+                 batcher, freshness, foldin_seq, lease=None):
         self.query = query
         self.deadline = deadline
         self.algorithms = algorithms
@@ -163,6 +165,23 @@ class _QueryCtx:
         self.batcher = batcher
         self.freshness = freshness
         self.foldin_seq = foldin_seq
+        self.lease = lease
+
+
+def _lease_status(e: BaseException) -> str:
+    """Map a query-path exception to the per-tenant outcome label (the
+    same taxonomy the HTTP error mapping uses)."""
+    if isinstance(e, QuotaExceeded):
+        return "quota"
+    if isinstance(e, TenantUnavailable):
+        return "shed"
+    if isinstance(e, AdmissionRejected):
+        return "rejected"
+    if isinstance(e, DeadlineExceeded):
+        return "timeout"
+    if isinstance(e, (KeyError, ValueError, TypeError)):
+        return "bad_request"
+    return "error"
 
 
 def _takes_max_batch(fn: Callable) -> bool:
@@ -179,6 +198,42 @@ def _takes_max_batch(fn: Callable) -> bool:
     return "max_batch" in params or any(
         p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
     )
+
+
+def _warm_components(algorithms, models, warm_max: int) -> None:
+    """Run each algorithm's warmup ladder (shared by the engine
+    server's own ``_load`` and the pio-hive tenant loader — a lazily
+    loaded tenant gets the exact same compile obligations a deployed
+    single model does).  A warmup failure only costs the first query a
+    compile; it never fails the load."""
+    for algo, model in zip(algorithms, models):
+        t0 = time.perf_counter()
+        try:
+            # pass the batcher's real maximum so the warmup ladder
+            # covers every pow2 size the padding can dispatch; algos
+            # with the pre-max_batch one-arg signature still work
+            if _takes_max_batch(algo.warmup):
+                try:
+                    algo.warmup(model, max_batch=warm_max)
+                except TypeError:
+                    # a decorator-erased signature (*args/**kwargs
+                    # wrapper around an old one-arg hook) can lie
+                    # about accepting max_batch; retry plain once
+                    # rather than regress a hook that warmed fine
+                    # before max_batch existed
+                    algo.warmup(model)
+            else:
+                algo.warmup(model)
+        except Exception:
+            logger.exception(
+                "warmup failed for %s (first query will compile)",
+                type(algo).__name__,
+            )
+        else:
+            dt = time.perf_counter() - t0
+            if dt > 0.05:
+                logger.info("%s warmed up in %.2fs",
+                            type(algo).__name__, dt)
 
 
 def _default_query_decoder(engine: Engine, engine_params: EngineParams):
@@ -235,6 +290,7 @@ class EngineServer(HTTPServerBase):
         engine_id: str = "default",
         engine_version: str = "1",
         engine_variant: str = "engine.json",
+        tenants=None,
     ):
         self.engine = engine
         self.engine_params = engine_params
@@ -244,6 +300,15 @@ class EngineServer(HTTPServerBase):
         self.engine_id = engine_id
         self.engine_version = engine_version
         self.engine_variant = engine_variant
+        # pio-hive: an optional TenantRegistry turns this server into a
+        # multi-tenant host — queries carrying app/appId/accessKey (+
+        # optional variant) route to the registry's resident models,
+        # everything else rides the anchor components loaded below.
+        # The registry gets this server's component loader unless the
+        # caller injected its own (benches/tests pass prebuilt models).
+        self.tenants = tenants
+        if tenants is not None and tenants.loader is None:
+            tenants.loader = self._tenant_loader
         self.query_decoder = query_decoder or _default_query_decoder(
             engine, engine_params
         )
@@ -301,6 +366,15 @@ class EngineServer(HTTPServerBase):
                 daemon=True,
                 name="foldin-poll",
             ).start()
+        # pio-hive: the online-eval poller folds variant-attributed
+        # conversion events back out of the event store on a cadence
+        self._eval_stop = threading.Event()
+        if self.tenants is not None:
+            threading.Thread(
+                target=self._online_eval_loop,
+                daemon=True,
+                name="hive-eval",
+            ).start()
         # serving stats (CreateServer.scala:396-398).  Latency is
         # histogram-backed (pio-obs): this instance's private histogram
         # drives the /status percentiles + average, and the same deltas
@@ -356,34 +430,7 @@ class EngineServer(HTTPServerBase):
         # 0 = "no batched path at all" (empty warmup ladder); a real
         # batcher with microbatch_max=1 still needs its B=1 shapes
         warm_max = self.config.microbatch_max if batcher is not None else 0
-        for algo, model in zip(algorithms, models):
-            t0 = time.perf_counter()
-            try:
-                # pass the batcher's real maximum so the warmup ladder
-                # covers every pow2 size the padding can dispatch; algos
-                # with the pre-max_batch one-arg signature still work
-                if _takes_max_batch(algo.warmup):
-                    try:
-                        algo.warmup(model, max_batch=warm_max)
-                    except TypeError:
-                        # a decorator-erased signature (*args/**kwargs
-                        # wrapper around an old one-arg hook) can lie
-                        # about accepting max_batch; retry plain once
-                        # rather than regress a hook that warmed fine
-                        # before max_batch existed
-                        algo.warmup(model)
-                else:
-                    algo.warmup(model)
-            except Exception:
-                logger.exception(
-                    "warmup failed for %s (first query will compile)",
-                    type(algo).__name__,
-                )
-            else:
-                dt = time.perf_counter() - t0
-                if dt > 0.05:
-                    logger.info("%s warmed up in %.2fs",
-                                type(algo).__name__, dt)
+        _warm_components(algorithms, models, warm_max)
         with self._lock:
             old_batcher = getattr(self, "batcher", None)
             self.engine_params = engine_params
@@ -407,6 +454,99 @@ class EngineServer(HTTPServerBase):
         # catch up on delta links already published for this instance
         # (a redeploy/reload must not serve staler than the chain)
         self._apply_available_deltas()
+        # pio-hive: re-adopt the freshly loaded components as the
+        # anchor tenant's runtime (ONE model copy serves both the
+        # tenant-less default path and explicit anchor queries; a
+        # /reload therefore advances the anchor tenant too)
+        if getattr(self, "tenants", None) is not None:
+            self._adopt_anchor_runtime()
+
+    # -- pio-hive: tenant component loading --------------------------------
+    def _tenant_breaker(self) -> CircuitBreaker:
+        return CircuitBreaker(
+            failure_threshold=self.config.breaker_failures,
+            reset_timeout_s=self.config.breaker_reset_s,
+        )
+
+    def _tenant_quota(self, spec):
+        from ..tenancy.quota import TokenBucket
+
+        if spec.quota_qps is None:
+            return None
+        return TokenBucket(spec.quota_qps, spec.quota_burst)
+
+    def _adopt_anchor_runtime(self) -> None:
+        from ..tenancy.registry import TenantRuntime
+
+        spec = self.tenants.spec(self.tenants.anchor_key)
+        with self._lock:
+            rt = TenantRuntime(
+                spec, self.engine, self.engine_params, self.instance_id,
+                self.algorithms, self.models, self.serving, self.batcher,
+                self.query_decoder, self.ctx,
+                breaker=self._tenant_breaker(),
+                quota=self._tenant_quota(spec),
+            )
+        self.tenants.adopt_anchor(rt)
+
+    def _resolve_tenant_components(self, spec):
+        """(engine, engine_params, instance_id, ctx) for a spec —
+        prebuilt objects win, else the engine.json is loaded and the
+        latest COMPLETED instance resolved exactly like ``deploy``."""
+        ctx = spec.ctx or self.ctx
+        if spec.engine is not None:
+            if spec.instance_id is None:
+                raise ValueError(
+                    f"tenant {spec.key_str}: a prebuilt engine needs an "
+                    "instance_id"
+                )
+            return spec.engine, spec.engine_params, spec.instance_id, ctx
+        from ..cli.main import load_engine_from_variant
+
+        engine, ep, variant = load_engine_from_variant(spec.engine_json)
+        iid = spec.instance_id
+        if iid is None:
+            md = ctx.storage.get_metadata()
+            latest = md.engine_instance_get_latest_completed(
+                variant.get("id", "default"), "1", str(spec.engine_json)
+            )
+            if latest is None:
+                raise LookupError(
+                    f"tenant {spec.key_str}: no completed engine "
+                    f"instance for {spec.engine_json}; train it first"
+                )
+            iid = latest.id
+        return engine, ep, iid, ctx
+
+    def _tenant_loader(self, spec):
+        """Build one tenant's full serving runtime — the same component
+        pipeline ``_load`` runs for the anchor: prepare + batcher +
+        warmup ladder + decoder, plus the per-tenant breaker/quota."""
+        from ..tenancy.registry import TenantRuntime
+
+        engine, ep, iid, ctx = self._resolve_tenant_components(spec)
+        algorithms, models, serving = prepare_deploy_components(
+            engine, ep, iid, ctx=ctx
+        )
+        batcher = self._make_batcher(algorithms, models)
+        warm_max = self.config.microbatch_max if batcher is not None else 0
+        _warm_components(algorithms, models, warm_max)
+        return TenantRuntime(
+            spec, engine, ep, iid, algorithms, models, serving, batcher,
+            _default_query_decoder(engine, ep), ctx,
+            breaker=self._tenant_breaker(),
+            quota=self._tenant_quota(spec),
+        )
+
+    def _online_eval_loop(self) -> None:
+        interval = max(float(self.tenants.eval_interval_s), 0.5)
+        while not self._eval_stop.wait(interval):
+            try:
+                self.tenants.refresh_online_eval(
+                    self.ctx.storage.get_event_store()
+                )
+            except Exception:
+                logger.exception("online-eval refresh failed")
 
     def _make_batcher(self, algorithms, models):
         """Build the query micro-batcher for this (algorithms, models)
@@ -559,6 +699,12 @@ class EngineServer(HTTPServerBase):
             try:
                 with deadline_scope(Deadline.after(max(interval, 1.0))):
                     self._apply_available_deltas()
+                    if self.tenants is not None:
+                        # per-tenant chains; one tenant's error is
+                        # booked on that tenant inside the registry and
+                        # never pauses the others (the fold-in half of
+                        # the isolation contract)
+                        self.tenants.apply_available_deltas()
             except Exception as e:
                 logger.exception(
                     "fold-in delta apply failed; serving keeps the "
@@ -596,7 +742,15 @@ class EngineServer(HTTPServerBase):
         if wm:
             try:
                 es = self.ctx.storage.get_event_store()
-                if hasattr(es, "max_rowid"):
+                if hasattr(es, "cursor_lag"):
+                    # handles both cursor kinds (int rowid / sharded
+                    # per-shard vector string) in the store itself
+                    lag = max(es.cursor_lag(
+                        int(wm.get("appId", -1)),
+                        int(wm.get("channelId", 0)),
+                        wm.get("rowid", 0),
+                    ), 0)
+                elif hasattr(es, "max_rowid"):
                     lag = max(
                         es.max_rowid(
                             int(wm.get("appId", -1)),
@@ -634,46 +788,84 @@ class EngineServer(HTTPServerBase):
         budget = timeout_s if timeout_s is not None \
             else self.config.query_timeout_s
         deadline = Deadline.after(budget) if budget is not None else None
-        query = self.query_decoder(query_json)
-        tl.mark("parse")
-        with self._lock:
-            ctx = _QueryCtx(
-                query=query,
-                deadline=deadline,
-                algorithms=self.algorithms,
-                models=self.models,
-                serving=self.serving,
-                batcher=self.batcher,
-                # pio-live attribution, captured with the snapshot: a
-                # slow query concurrent with a fold-in apply is
-                # explicable from its flight record alone
-                freshness=time.monotonic() - self.model_advanced_mono,
-                foldin_seq=max(
-                    self.foldin_applied_seq.values(), default=0
-                ),
-            )
-        faults.check("device.dispatch")
-        tl.mark("auth")
-        if deadline is not None:
-            # deadline-aware admission (pio-surge): a request that
-            # cannot make its SLO is answered a structured 503 NOW
-            # instead of queued to die.  The breaker is the cheap-shed
-            # mode: after repeated rejects it opens and deadlined
-            # requests shed without estimator math until a success.
-            if not self._admission_breaker.allow():
-                raise AdmissionRejected(
-                    "admission breaker open: the edge is shedding "
-                    "deadlined requests (overload)"
+        # pio-hive: route to the tenant FIRST — quota and the
+        # per-tenant breaker shed inside resolve(), before any decode
+        # or device work spends on a query its tenant cannot serve
+        lease = None
+        if self.tenants is not None:
+            lease = self.tenants.resolve(query_json)
+        try:
+            decoder = (lease.runtime.query_decoder if lease is not None
+                       else self.query_decoder)
+            query = decoder(query_json)
+            tl.mark("parse")
+            if lease is not None:
+                rt = lease.runtime
+                ctx = _QueryCtx(
+                    query=query,
+                    deadline=deadline,
+                    algorithms=rt.algorithms,
+                    models=rt.models,
+                    serving=rt.serving,
+                    batcher=rt.batcher,
+                    freshness=time.monotonic() - rt.model_advanced_mono,
+                    foldin_seq=max(
+                        rt.foldin_applied_seq.values(), default=0
+                    ),
+                    lease=lease,
                 )
-            try:
-                if ctx.batcher is not None:
-                    ctx.batcher.check_admission(deadline)
-                else:
-                    deadline.check("query admission")
-            except AdmissionRejected:
-                self._admission_breaker.record_failure()
-                raise
-        return ctx
+            else:
+                with self._lock:
+                    ctx = _QueryCtx(
+                        query=query,
+                        deadline=deadline,
+                        algorithms=self.algorithms,
+                        models=self.models,
+                        serving=self.serving,
+                        batcher=self.batcher,
+                        # pio-live attribution, captured with the
+                        # snapshot: a slow query concurrent with a
+                        # fold-in apply is explicable from its flight
+                        # record alone
+                        freshness=time.monotonic()
+                        - self.model_advanced_mono,
+                        foldin_seq=max(
+                            self.foldin_applied_seq.values(), default=0
+                        ),
+                    )
+            faults.check("device.dispatch")
+            if lease is not None:
+                faults.check_tenant("tenant.dispatch", lease.key_str)
+            tl.mark("auth")
+            if deadline is not None:
+                # deadline-aware admission (pio-surge): a request that
+                # cannot make its SLO is answered a structured 503 NOW
+                # instead of queued to die.  The breaker is the
+                # cheap-shed mode: after repeated rejects it opens and
+                # deadlined requests shed without estimator math until
+                # a success.  With a lease, the TENANT's breaker
+                # already gated inside resolve() (re-calling allow()
+                # here would strand its half-open probe); rejects feed
+                # it through lease.complete below.
+                if lease is None and not self._admission_breaker.allow():
+                    raise AdmissionRejected(
+                        "admission breaker open: the edge is shedding "
+                        "deadlined requests (overload)"
+                    )
+                try:
+                    if ctx.batcher is not None:
+                        ctx.batcher.check_admission(deadline)
+                    else:
+                        deadline.check("query admission")
+                except AdmissionRejected:
+                    if lease is None:
+                        self._admission_breaker.record_failure()
+                    raise
+            return ctx
+        except BaseException as e:
+            if lease is not None:
+                lease.complete(_lease_status(e))
+            raise
 
     def _query_finish(self, ctx: "_QueryCtx", predictions, tl, t0: float,
                       query_json: dict) -> Any:
@@ -684,6 +876,12 @@ class EngineServer(HTTPServerBase):
             ctx.deadline.check("query serving")
         result = ctx.serving.serve(ctx.query, predictions)
         out = _result_to_json(result)
+        lease = ctx.lease
+        if lease is not None and isinstance(out, dict):
+            # the assigned variant rides the reply so clients can echo
+            # it (with prId) on their conversion events — the
+            # attribution loop online eval closes
+            out = {**out, "variant": lease.variant}
         tl.mark("serialize")
         self._admission_breaker.record_success()
         dt = time.perf_counter() - t0
@@ -709,12 +907,24 @@ class EngineServer(HTTPServerBase):
         }
         if ctx.foldin_seq:
             attrs["foldinSeq"] = ctx.foldin_seq
+        if lease is not None:
+            # pio-hive: per-tenant latency histogram + online-eval
+            # impression + trace/flight attribution (a slow query's
+            # flight record names its tenant AND variant)
+            attrs["tenant"] = lease.key_str
+            attrs["variant"] = lease.variant
+            lease.observe_latency(dt, exemplar=tid)
+            self.tenants.online.impression(
+                lease.runtime.spec.app, lease.variant
+            )
         get_tracer().record("serve.query", dt, attrs=attrs)
         get_flight_recorder().offer(
             tid, dt, name="serve.query", attrs=attrs
         )
         if self.config.feedback and self.config.event_server_url:
-            out = self._send_feedback(query_json, out)
+            out = self._send_feedback(query_json, out, lease=lease)
+        if lease is not None:
+            lease.complete("ok")
         return out
 
     def predict_json(self, query_json: dict,
@@ -733,6 +943,7 @@ class EngineServer(HTTPServerBase):
             tl = timeline.Timeline("serve")
         t0 = time.perf_counter()
         _m_inflight.inc()
+        ctx = None
         try:
             with timeline.timeline_scope(tl), annotate("pio.serve.query"):
                 ctx = self._query_setup(query_json, timeout_s, tl)
@@ -761,6 +972,12 @@ class EngineServer(HTTPServerBase):
                     out = self._query_finish(
                         ctx, predictions, tl, t0, query_json
                     )
+        except BaseException as e:
+            # _query_setup completes its own lease on setup failures;
+            # this covers post-setup failures (device, serve, deadline)
+            if ctx is not None and ctx.lease is not None:
+                ctx.lease.complete(_lease_status(e))
+            raise
         finally:
             _m_inflight.dec()
         if owned:
@@ -829,6 +1046,8 @@ class EngineServer(HTTPServerBase):
                 threading.Thread(target=self.stop, daemon=True).start()
             elif path == "/foldin/apply":
                 self._aux(respond, self._blocking_foldin_apply)
+            elif path == "/tenants/weights":
+                self._aux(respond, self._blocking_set_weights, req.body)
             else:
                 respond(404, {"message": "not found"})
             return
@@ -843,6 +1062,13 @@ class EngineServer(HTTPServerBase):
         if ans is not None:
             code, payload, ctype = ans
             return code, payload, ctype or "application/json", ()
+        if path == "/debug/tenants":
+            if self.tenants is None:
+                return (404, {"message": "tenancy is not enabled "
+                              "(deploy --multi)"},
+                        "application/json", ())
+            return (200, self.tenants.debug_payload(),
+                    "application/json", ())
         if path == "/":
             if "text/html" in accept:
                 return (200, self.status_html().encode(),
@@ -863,11 +1089,40 @@ class EngineServer(HTTPServerBase):
     def _blocking_foldin_apply(self):
         """POST /foldin/apply: apply any pending fold-in delta links
         NOW (the router's rolling delta push calls this per replica —
-        push semantics on top of the poll machinery)."""
+        push semantics on top of the poll machinery).  With tenancy
+        on, every resident tenant's chain is walked too."""
         n = self._apply_available_deltas()
+        if self.tenants is not None:
+            n += self.tenants.apply_available_deltas()
         out = {"applied": n}
         out.update(self._foldin_status())
         return 200, out, "application/json", ()
+
+    def _blocking_set_weights(self, raw: bytes):
+        """POST /tenants/weights: hot-update an app's A/B variant
+        weights — ``{"app": ..., "weights": {"variant": w, ...}}``.
+        The router broadcasts this to every replica so the whole fleet
+        assigns identically."""
+        if self.tenants is None:
+            return (404, {"message": "tenancy is not enabled"},
+                    "application/json", ())
+        try:
+            doc = json.loads(raw.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return (400, {"message": f"invalid JSON: {e}"},
+                    "application/json", ())
+        app = doc.get("app")
+        weights = doc.get("weights")
+        if not app or not isinstance(weights, dict) or not weights:
+            return (400, {"message": "body needs app + weights{}"},
+                    "application/json", ())
+        try:
+            snap = self.tenants.set_weights(str(app), weights)
+        except KeyError as e:
+            return 404, {"message": str(e)}, "application/json", ()
+        except (TypeError, ValueError) as e:
+            return 400, {"message": str(e)}, "application/json", ()
+        return 200, {"updated": snap}, "application/json", ()
 
     @callback_scope
     def _el_query(self, req, query_str: str, respond) -> None:
@@ -925,7 +1180,8 @@ class EngineServer(HTTPServerBase):
                         )
                 except Exception as e:
                     _m_inflight.dec()
-                    self._el_reply_error(e, respond, hdrs)
+                    self._el_reply_error(e, respond, hdrs,
+                                         lease=ctx.lease)
                     return
                 _m_inflight.dec()
                 self._m_queries["ok"].inc()
@@ -949,7 +1205,7 @@ class EngineServer(HTTPServerBase):
                     err = e
             _m_inflight.dec()
             if err is not None:
-                self._el_reply_error(err, respond, hdrs)
+                self._el_reply_error(err, respond, hdrs, lease=ctx.lease)
                 return
             self._m_queries["ok"].inc()
             respond(200, out, extra_headers=hdrs, tl=tl)
@@ -960,10 +1216,12 @@ class EngineServer(HTTPServerBase):
             )
         except RuntimeError:
             # the snapshot raced a reload that closed this batcher:
-            # retry once on the current one
+            # retry once on the current one (single-tenant path only —
+            # a tenant's batcher is replaced only by its own reload)
             with self._lock:
                 batcher = self.batcher
-            if batcher is not None and batcher is not ctx.batcher:
+            if (ctx.lease is None and batcher is not None
+                    and batcher is not ctx.batcher):
                 ctx.batcher = batcher
                 batcher.submit_nowait(
                     ctx.query, done, deadline=ctx.deadline, timeline=tl
@@ -972,14 +1230,31 @@ class EngineServer(HTTPServerBase):
                 _m_inflight.dec()
                 self._el_reply_error(
                     RuntimeError("batcher unavailable during reload"),
-                    respond, hdrs,
+                    respond, hdrs, lease=ctx.lease,
                 )
 
-    def _el_reply_error(self, e: BaseException, respond, hdrs) -> None:
+    def _el_reply_error(self, e: BaseException, respond, hdrs,
+                        lease=None) -> None:
         """Map a query-path exception to the same structured replies
-        the threading edge produces (and the same counters)."""
+        the threading edge produces (and the same counters).  A lease
+        passed here books the tenant outcome (idempotent — setup
+        failures were already completed inside ``_query_setup``)."""
+        if lease is not None:
+            lease.complete(_lease_status(e))
         try:
-            if isinstance(e, AdmissionRejected):
+            if isinstance(e, QuotaExceeded):
+                # per-tenant token bucket: the client is over ITS
+                # rate, not the server over capacity — 429, not 503
+                self._m_queries["rejected"].inc()
+                respond(429, {"message": str(e),
+                              "error": "QuotaExceeded"},
+                        extra_headers=hdrs + [("Retry-After", "1")])
+            elif isinstance(e, TenantUnavailable):
+                self._m_queries["rejected"].inc()
+                respond(503, {"message": str(e),
+                              "error": "TenantUnavailable"},
+                        extra_headers=hdrs + [("Retry-After", "1")])
+            elif isinstance(e, AdmissionRejected):
                 self._m_queries["rejected"].inc()
                 respond(503, {"message": str(e),
                               "error": "AdmissionRejected"},
@@ -1002,7 +1277,8 @@ class EngineServer(HTTPServerBase):
         except RuntimeError:
             pass  # request already answered
 
-    def _send_feedback(self, query_json: dict, result_json: Any) -> Any:
+    def _send_feedback(self, query_json: dict, result_json: Any,
+                       lease=None) -> Any:
         """Enqueue a pio_pr feedback event with prId injection, off the
         hot path (reference `CreateServer.scala:480-550` does this async
         too).  The bounded delivery queue retries with backoff behind a
@@ -1012,15 +1288,26 @@ class EngineServer(HTTPServerBase):
         pr_id = (
             result_json.get("prId") if isinstance(result_json, dict) else None
         ) or uuid.uuid4().hex
+        props = {"query": query_json, "prediction": result_json}
+        access_key = self.config.access_key
+        if lease is not None:
+            # pio-hive: the A/B attribution tag — every feedback event
+            # flowing back through the event store names its (app,
+            # variant), which is what makes interleaved serving an
+            # ONLINE evaluation (online_eval.py scans these back out)
+            props["variant"] = lease.variant
+            props["app"] = lease.runtime.spec.app
+            if lease.runtime.spec.access_key:
+                access_key = lease.runtime.spec.access_key
         event = {
             "event": "predict",
             "entityType": "pio_pr",
             "entityId": pr_id,
-            "properties": {"query": query_json, "prediction": result_json},
+            "properties": props,
         }
         url = (
             f"{self.config.event_server_url}/events.json"
-            f"?accessKey={self.config.access_key or ''}"
+            f"?accessKey={access_key or ''}"
         )
         from ..obs import current_trace_id
 
@@ -1108,6 +1395,10 @@ class EngineServer(HTTPServerBase):
             "feedback": self._feedback_queue.stats(),
             "remoteLog": self._log_queue.stats(),
         }
+        # pio-hive: registry residency/budget counters (full per-tenant
+        # detail lives on /debug/tenants)
+        if self.tenants is not None:
+            out["tenancy"] = self.tenants.summary()
         # pio-xray: the worst-N flight records (ids + durations; full
         # span trees live on /debug/xray) and the histogram's bucket
         # exemplars, so /status alone links a slow bucket to a trace id
@@ -1224,6 +1515,9 @@ class EngineServer(HTTPServerBase):
         # drain threads (pending entries are abandoned — the process is
         # going away)
         self._foldin_stop.set()
+        self._eval_stop.set()
+        if self.tenants is not None:
+            self.tenants.close()
         with self._lock:
             batcher = getattr(self, "batcher", None)
         if batcher is not None:
@@ -1285,6 +1579,12 @@ class EngineServer(HTTPServerBase):
                     except Exception as e:
                         logger.exception("reload failed")
                         self._reply(500, {"message": f"reload failed: {e}"})
+                elif self.path.startswith("/debug/tenants"):
+                    if server.tenants is None:
+                        self._reply(404, {"message": "tenancy is not "
+                                          "enabled (deploy --multi)"})
+                    else:
+                        self._reply(200, server.tenants.debug_payload())
                 else:
                     self._reply(404, {"message": "not found"})
 
@@ -1312,6 +1612,15 @@ class EngineServer(HTTPServerBase):
                         self._reply(code, payload)
                     except Exception as e:
                         logger.exception("foldin apply failed")
+                        self._reply(500, {"message": str(e)})
+                elif self.path.startswith("/tenants/weights"):
+                    try:
+                        code, payload, _, _ = (
+                            server._blocking_set_weights(raw)
+                        )
+                        self._reply(code, payload)
+                    except Exception as e:
+                        logger.exception("weights update failed")
                         self._reply(500, {"message": str(e)})
                 elif self.path.startswith("/stop"):
                     self._reply(200, {"message": "stopping"})
@@ -1349,6 +1658,22 @@ class EngineServer(HTTPServerBase):
                     tl.mark("write")
                     tl.finish()
                     m_ok.inc()
+                except QuotaExceeded as e:
+                    # pio-hive: over the tenant's token bucket — the
+                    # client's rate problem, a structured 429
+                    m_rejected.inc()
+                    self.extra_headers.append(("Retry-After", "1"))
+                    self._reply(429, {
+                        "message": str(e),
+                        "error": "QuotaExceeded",
+                    })
+                except TenantUnavailable as e:
+                    m_rejected.inc()
+                    self.extra_headers.append(("Retry-After", "1"))
+                    self._reply(503, {
+                        "message": str(e),
+                        "error": "TenantUnavailable",
+                    })
                 except AdmissionRejected as e:
                     # deadline-aware admission shed the request before
                     # it queued (pio-surge): same structured 503, its

@@ -351,6 +351,132 @@ class ShardedSQLiteEventStore(EventStore):
             items=items,
         )
 
+    # -- incremental scans (per-shard fold-in watermarks) -----------------
+    #
+    # The single-file store's watermark cursor is one rowid; a sharded
+    # store has N independent rowid sequences, so its cursor is a
+    # VECTOR — JSON-encoded ``{"0": rowid, "1": rowid, ...}`` — carried
+    # opaquely by every consumer (pio-live watermark files, delta-link
+    # metadata, online-eval cursors).  Integer 0 still means "from the
+    # beginning" so single-file call sites work unchanged; any other
+    # integer is refused loudly (it cannot name a position in N
+    # sequences).
+
+    def _decode_cursor(self, cursor) -> list[int]:
+        if isinstance(cursor, str):
+            try:
+                d = json.loads(cursor)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"bad shard cursor {cursor!r}: {e}"
+                ) from None
+            if not isinstance(d, dict):
+                raise ValueError(
+                    f"shard cursor must be a JSON object, got {cursor!r}"
+                )
+            return [int(d.get(str(i), 0)) for i in range(self.n_shards)]
+        c = int(cursor or 0)
+        if c == 0:
+            return [0] * self.n_shards
+        raise ValueError(
+            f"sharded event-store cursors are JSON shard-vector "
+            f"strings; a nonzero integer ({c}) cannot address "
+            f"{self.n_shards} independent rowid sequences"
+        )
+
+    def _encode_cursor(self, per_shard) -> str:
+        return json.dumps(
+            {str(i): int(v) for i, v in enumerate(per_shard)},
+            sort_keys=True, separators=(",", ":"),
+        )
+
+    def find_rows_since(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        cursor=0,
+        limit: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        newest_first: bool = False,
+    ) -> tuple[list[tuple], str]:
+        """Rows written after a shard-vector watermark; returns
+        ``(rows, new_cursor)`` with ``new_cursor`` the JSON-encoded
+        per-shard vector (see above).  Rows are the same
+        ``(rowid, <11 columns>)`` tuples the single store yields —
+        NOTE the rowid is shard-LOCAL (display/debug only; the cursor
+        is the paging contract, never arithmetic on row ids).
+
+        Ordering is per-shard rowid-ascending, shards concatenated in
+        index order.  Per-ENTITY ordering — the property fold-in
+        correctness rests on ("last rating wins" within a window) — is
+        exact, because routing pins an entity to one shard.  ``limit``
+        bounds the merged page: shards are consumed in order and the
+        cursor only advances for rows actually returned, so paging
+        with the returned cursor walks the full backlog without
+        skipping or repeating."""
+        per_shard = self._decode_cursor(cursor)
+        out_rows: list[tuple] = []
+        new_cursor = list(per_shard)
+        remaining = limit
+        for i, shard in enumerate(self.shards):
+            if remaining is not None and remaining <= 0:
+                break
+            rows, nc = shard.find_rows_since(
+                app_id, channel_id, cursor=per_shard[i],
+                limit=remaining, event_names=event_names,
+                newest_first=newest_first,
+            )
+            out_rows.extend(rows)
+            new_cursor[i] = int(nc)
+            if remaining is not None:
+                remaining -= len(rows)
+        return out_rows, self._encode_cursor(new_cursor)
+
+    def find_since(
+        self,
+        app_id: int,
+        channel_id: int = 0,
+        cursor=0,
+        limit: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        newest_first: bool = False,
+    ) -> tuple[list[tuple[int, Event]], str]:
+        """:meth:`find_rows_since` decoded to ``(rowid, Event)`` pairs
+        (shard-local rowids; the dashboard's recent-events view)."""
+        rows, new_cursor = self.find_rows_since(
+            app_id, channel_id, cursor, limit, event_names, newest_first
+        )
+        return (
+            [(int(r[0]), SQLiteEventStore._event_from_row(r[1:]))
+             for r in rows],
+            new_cursor,
+        )
+
+    def max_rowid(self, app_id: int, channel_id: int = 0) -> int:
+        """SUM of the per-shard high-water rowids: a scalar volume
+        indicator (dashboards, coarse lag display), NOT a cursor —
+        cursors are vectors (:meth:`high_water_cursor`)."""
+        return sum(
+            s.max_rowid(app_id, channel_id) for s in self.shards
+        )
+
+    def high_water_cursor(self, app_id: int, channel_id: int = 0) -> str:
+        """The encoded shard-vector cursor at the current high-water
+        mark (``foldin --from-now`` starts here)."""
+        return self._encode_cursor([
+            s.max_rowid(app_id, channel_id) for s in self.shards
+        ])
+
+    def cursor_lag(self, app_id: int, channel_id: int = 0,
+                   cursor=0) -> int:
+        """Rows written past ``cursor`` summed over shards — the
+        freshness debt the watermark gauges report."""
+        per_shard = self._decode_cursor(cursor)
+        return sum(
+            max(s.max_rowid(app_id, channel_id) - per_shard[i], 0)
+            for i, s in enumerate(self.shards)
+        )
+
     def find_columnar(
         self,
         app_id: int,

@@ -827,6 +827,17 @@ class SQLiteEventStore(EventStore):
         row = self._conn.execute(f"SELECT MAX(rowid) FROM {t}").fetchone()
         return int(row[0]) if row and row[0] is not None else 0
 
+    def high_water_cursor(self, app_id: int, channel_id: int = 0) -> int:
+        """The cursor at the current high-water mark (same shape the
+        sharded store exposes; here a cursor IS a rowid)."""
+        return self.max_rowid(app_id, channel_id)
+
+    def cursor_lag(self, app_id: int, channel_id: int = 0,
+                   cursor: int = 0) -> int:
+        """Rows written past ``cursor`` — the freshness debt the
+        watermark gauges report (the sharded store sums per shard)."""
+        return max(self.max_rowid(app_id, channel_id) - int(cursor), 0)
+
     def find_rows_since(
         self,
         app_id: int,

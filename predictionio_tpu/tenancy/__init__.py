@@ -1,0 +1,39 @@
+"""pio-hive: multi-tenant model serving with live A/B experimentation.
+
+One serving process (or the pio-surge fleet) hosts many (app,
+engine_variant) models behind a device-memory-budgeted
+:class:`TenantRegistry` — lazy load, LRU eviction + pinning, per-tenant
+circuit breakers / token-bucket quotas / metric labels — with weighted
+sticky variant assignment, per-variant feedback attribution through the
+event store, and an online-eval aggregator feeding ``/metrics`` and
+pio-tower manifests.  See ``docs/ARCHITECTURE.md`` "Multi-tenancy".
+"""
+
+from .errors import QuotaExceeded, TenantUnavailable, UnknownTenant
+from .experiment import Experiment, assign_bucket
+from .online_eval import OnlineEval
+from .quota import TokenBucket
+from .registry import (
+    TenantLease,
+    TenantRegistry,
+    TenantRuntime,
+    TenantSpec,
+    load_tenant_manifest,
+    model_resident_bytes,
+)
+
+__all__ = [
+    "Experiment",
+    "OnlineEval",
+    "QuotaExceeded",
+    "TenantLease",
+    "TenantRegistry",
+    "TenantRuntime",
+    "TenantSpec",
+    "TenantUnavailable",
+    "TokenBucket",
+    "UnknownTenant",
+    "assign_bucket",
+    "load_tenant_manifest",
+    "model_resident_bytes",
+]

@@ -1,0 +1,183 @@
+"""Online (interleaved) evaluation: per-variant outcome aggregation.
+
+The offline eval machinery scores candidates against held-out history;
+the hive turns it ONLINE: every served query books an impression under
+its (app, variant), the variant tag rides the feedback loop into the
+event store (``_send_feedback`` stamps it; clients echo it on their
+conversion events), and this aggregator scans the store's incremental
+cursor (``find_rows_since`` — the same primitive pio-live folds in on)
+to count variant-attributed conversions back out.
+
+The result is a CTR-style table — ``rate = conversions / impressions``
+per (app, variant) — exported three ways:
+
+* ``pio_variant_requests_total`` / ``pio_variant_feedback_total`` /
+  ``pio_variant_outcome_rate`` on ``/metrics``,
+* the ``onlineEval`` block of ``GET /debug/tenants``,
+* ``candidate`` records appended to a pio-tower run manifest
+  (``$PIO_TPU_HOME/telemetry/runs/hive-online-<id>/run.jsonl``), so
+  ``tools/runlog.py summarize`` reads an A/B the way it reads an eval
+  sweep.
+
+Impressions are in-process counters (the serving edge books them at
+serve time); conversions come from the store scan, so a multi-replica
+fleet's per-replica tables aggregate exactly like every other counter
+family (pio-tower cluster merge).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Optional
+
+from ..obs import (
+    VARIANT_FEEDBACK_TOTAL,
+    VARIANT_RATE,
+    VARIANT_REQUESTS_TOTAL,
+)
+
+__all__ = ["OnlineEval"]
+
+logger = logging.getLogger(__name__)
+
+# events that are impressions flowing back through the feedback loop,
+# not client conversions — counting them would make every rate ~1.0
+_FEEDBACK_EVENT = "predict"
+
+
+class OnlineEval:
+    def __init__(self, salt: str = "pio-hive",
+                 manifest_id: Optional[str] = None,
+                 scan_page: int = 5000):
+        self._lock = threading.Lock()
+        # (app, variant) -> {"impressions": n, "conversions": n}
+        self._stats: dict[tuple[str, str], dict] = {}
+        # app -> opaque store cursor (int for the single-file store,
+        # JSON shard-vector string for the sharded store — passed back
+        # verbatim, never interpreted here)
+        self._cursors: dict[str, object] = {}
+        self.salt = salt
+        self.scan_page = scan_page
+        self.manifest_id = manifest_id or f"hive-online-{uuid.uuid4().hex[:8]}"
+        self._manifest = None
+        self.refreshes = 0
+
+    def _cell(self, app: str, variant: str) -> dict:
+        key = (app, variant)
+        cell = self._stats.get(key)
+        if cell is None:
+            cell = {"impressions": 0, "conversions": 0}
+            self._stats[key] = cell
+        return cell
+
+    def impression(self, app: str, variant: str) -> None:
+        with self._lock:
+            self._cell(app, variant)["impressions"] += 1
+        VARIANT_REQUESTS_TOTAL.labels(app=app, variant=variant).inc()
+
+    # -- conversion scan ---------------------------------------------------
+    def refresh(self, event_store, app_ids: dict[str, int]) -> dict:
+        """Scan each app's store past its cursor for variant-attributed
+        conversion events, update rates, and append the table to the
+        tower manifest.  Returns :meth:`snapshot`.  Store errors are
+        logged and skipped — online eval must never fail serving."""
+        for app, app_id in sorted(app_ids.items()):
+            if not hasattr(event_store, "find_rows_since"):
+                break
+            with self._lock:
+                cursor = self._cursors.get(app, 0)
+            try:
+                rows, new_cursor = event_store.find_rows_since(
+                    app_id, 0, cursor=cursor, limit=self.scan_page,
+                )
+            except Exception:
+                logger.exception("online-eval scan failed for app %s", app)
+                continue
+            counted: dict[str, int] = {}
+            for r in rows:
+                # r = (rowid, event_id, event, entity_type, entity_id,
+                #      tet, tei, properties, event_time, tags, pr_id,
+                #      creation_time)
+                if r[2] == _FEEDBACK_EVENT:
+                    continue
+                try:
+                    variant = json.loads(r[7]).get("variant")
+                except (json.JSONDecodeError, TypeError):
+                    continue
+                if variant:
+                    counted[str(variant)] = counted.get(
+                        str(variant), 0
+                    ) + 1
+            with self._lock:
+                self._cursors[app] = new_cursor
+                for variant, n in counted.items():
+                    self._cell(app, variant)["conversions"] += n
+            for variant, n in counted.items():
+                VARIANT_FEEDBACK_TOTAL.labels(
+                    app=app, variant=variant
+                ).inc(n)
+        snap = self.snapshot()
+        self._export(snap)
+        return snap
+
+    def _export(self, snap: dict) -> None:
+        """Gauges + one manifest record per (app, variant)."""
+        with self._lock:
+            self.refreshes += 1
+            refresh_ix = self.refreshes
+        for key, cell in snap.items():
+            app, _, variant = key.partition("/")
+            VARIANT_RATE.labels(app=app, variant=variant).set(
+                cell["rate"]
+            )
+        manifest = self._ensure_manifest()
+        if manifest is None:
+            return
+        for key, cell in sorted(snap.items()):
+            app, _, variant = key.partition("/")
+            manifest.candidate(
+                refresh_ix, app=app, variant=variant,
+                impressions=cell["impressions"],
+                conversions=cell["conversions"],
+                rate=cell["rate"],
+            )
+
+    def _ensure_manifest(self):
+        if self._manifest is None:
+            try:
+                from ..obs.runlog import RunManifest
+
+                self._manifest = RunManifest(
+                    self.manifest_id, kind="online_eval",
+                    meta={"salt": self.salt, "startedAt": time.time()},
+                )
+            except Exception:
+                logger.exception("online-eval manifest unavailable")
+                return None
+        return self._manifest
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                f"{app}/{variant}": {
+                    "impressions": cell["impressions"],
+                    "conversions": cell["conversions"],
+                    "rate": (
+                        round(cell["conversions"]
+                              / cell["impressions"], 6)
+                        if cell["impressions"] else 0.0
+                    ),
+                }
+                for (app, variant), cell in sorted(self._stats.items())
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            refreshes = self.refreshes
+        m = self._manifest
+        if m is not None:
+            m.finalize("completed", refreshes=refreshes)

@@ -717,3 +717,134 @@ def test_cli_foldin_once(sqlite_storage, tmp_path, monkeypatch):
     )
     base_dir = sqlite_storage.model_data_dir() / iid
     assert list_model_deltas(base_dir, model_key(iid, 0, "als"))
+
+
+# ---------------------------------------------------------------------------
+# per-shard fold-in watermarks (pio-hive satellite: vector cursors)
+# ---------------------------------------------------------------------------
+
+
+def test_watermark_vector_cursor_roundtrip_and_regress(tmp_path):
+    """The sharded store's cursor is a JSON shard-vector STRING; the
+    watermark file persists it opaquely and the backwards-move refusal
+    applies PER SHARD."""
+    from predictionio_tpu.live.watermark import (
+        cursor_is_zero, cursor_would_regress, merge_cursors,
+    )
+
+    ws = WatermarkStore(tmp_path / "wm.json")
+    vec = '{"0":5,"1":9,"2":0}'
+    ws.advance(Watermark(1, 0, rowid=vec, seq=1))
+    got = ws.get(1)
+    assert got.rowid == vec and got.seq == 1
+    # all components forward (or equal) is fine
+    ws.advance(Watermark(1, 0, rowid='{"0":6,"1":9,"2":2}', seq=2))
+    # ANY component moving backwards refuses
+    with pytest.raises(ValueError, match="backwards"):
+        ws.advance(Watermark(1, 0, rowid='{"0":7,"1":8,"2":2}', seq=3))
+    # kind change mid-chain refuses too (store backend swapped)
+    with pytest.raises(ValueError, match="backwards"):
+        ws.advance(Watermark(1, 0, rowid=100, seq=3))
+    # cursor algebra
+    assert cursor_is_zero('{"0":0}') and cursor_is_zero(0)
+    assert not cursor_is_zero(vec)
+    assert merge_cursors(0, vec) == vec
+    assert merge_cursors('{"0":1,"1":20}', '{"0":9,"1":2}') \
+        == '{"0":9,"1":20}'
+    assert merge_cursors(3, 7) == 7
+    with pytest.raises(ValueError):
+        merge_cursors(5, vec)
+    assert cursor_would_regress(vec, '{"0":5,"1":8,"2":0}')
+    assert not cursor_would_regress(vec, vec)
+
+
+@pytest.fixture()
+def sharded_storage(tmp_path):
+    from predictionio_tpu.storage import Storage, reset_storage
+
+    s = Storage(env={
+        "PIO_TPU_HOME": str(tmp_path),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SHARDS",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITEMD",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+        "PIO_STORAGE_SOURCES_SHARDS_TYPE": "sqlite-sharded",
+        "PIO_STORAGE_SOURCES_SHARDS_PATH": str(tmp_path / "ev-shards"),
+        "PIO_STORAGE_SOURCES_SHARDS_SHARDS": "3",
+        "PIO_STORAGE_SOURCES_SQLITEMD_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITEMD_PATH": str(tmp_path / "md.db"),
+        "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_LOCALFS_PATH": str(tmp_path / "models"),
+    })
+    reset_storage(s)
+    yield s
+    reset_storage(None)
+
+
+def test_runner_cycle_end_to_end_on_sharded_store(sharded_storage):
+    """The headline of the satellite: fold-in WORKS on the sharded
+    store (daemon.py used to refuse it), with a per-shard vector
+    cursor advancing through watermark file + delta metadata."""
+    from predictionio_tpu.controller import WorkflowContext
+
+    engine, ep, iid, app_id, es = _train_small(sharded_storage)
+    runner = FoldInRunner(
+        sharded_storage, engine, ep, iid,
+        ctx=WorkflowContext(storage=sharded_storage, mode="Serving"),
+        from_now=True,
+    )
+    assert isinstance(runner.cursor, str)  # vector cursor from day one
+    assert runner.cycle() is None          # from_now: history consumed
+    es.insert_batch(
+        [_rate("brand_new", f"i{i}", 5.0, d=2) for i in (1, 3, 5)],
+        app_id=app_id,
+    )
+    assert runner.watermark_lag() == 3
+    stats = runner.cycle()
+    assert stats is not None and stats["appendedUsers"] == 1
+    assert isinstance(stats["watermark"], str)
+    assert runner.cycle() is None          # cursor advanced
+    assert runner.watermark_lag() == 0
+    # a restarted runner resumes from the persisted vector cursor
+    r2 = FoldInRunner(
+        sharded_storage, engine, ep, iid,
+        ctx=WorkflowContext(storage=sharded_storage, mode="Serving"),
+    )
+    assert r2.seq == 1 and r2.cursor == runner.cursor
+    assert r2.cycle() is None
+    assert r2.model.users.get("brand_new") >= 0
+
+
+def test_serving_foldin_status_on_sharded_store(sharded_storage):
+    """The serving-side watermark-lag gauge understands vector
+    cursors (cursor_lag) after a delta apply."""
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.server.serving import EngineServer, ServerConfig
+
+    engine, ep, iid, app_id, es = _train_small(sharded_storage)
+    srv = EngineServer(
+        engine, ep, iid,
+        ctx=WorkflowContext(storage=sharded_storage, mode="Serving"),
+        config=ServerConfig(port=0, microbatch="off"),
+        engine_variant="live.json",
+    )
+    runner = FoldInRunner(
+        sharded_storage, engine, ep, iid,
+        ctx=WorkflowContext(storage=sharded_storage, mode="Serving"),
+        from_now=True,
+    )
+    es.insert_batch(
+        [_rate("ghost", f"i{i}", 5.0, d=2) for i in (1, 3, 5)],
+        app_id=app_id,
+    )
+    assert runner.cycle() is not None
+    assert srv._apply_available_deltas() == 1
+    out = srv.predict_json({"user": "ghost", "num": 3})
+    assert len(out["itemScores"]) == 3
+    st = srv.status_json()
+    assert st["foldinWatermarkLag"] == 0
+    # new unfolded rows count as lag, summed across shards
+    es.insert_batch([_rate("ghost", "i7", 5.0, d=3),
+                     _rate("ghost2", "i2", 4.0, d=3)], app_id=app_id)
+    assert srv.status_json()["foldinWatermarkLag"] == 2
+    srv._foldin_stop.set()
+    srv._eval_stop.set()

@@ -25,6 +25,7 @@ class FakeReplica:
     def __init__(self, name: str, fail: bool = False):
         self.name = name
         self.queries = 0
+        self.weight_updates = []
         self.applies = []
         self.apply_gate = threading.Event()
         self.apply_gate.set()
@@ -41,6 +42,12 @@ class FakeReplica:
         if req.method == "POST" and req.path.startswith("/queries.json"):
             self.queries += 1
             respond(200, {"replica": self.name, "n": self.queries})
+        elif req.method == "POST" and req.path == "/tenants/weights":
+            doc = json.loads(req.body.decode() or "{}")
+            self.weight_updates.append(doc)
+            respond(200, {"updated": doc})
+        elif req.method == "GET" and req.path == "/debug/tenants":
+            respond(200, {"tenants": 2, "replicaName": self.name})
         elif req.method == "POST" and req.path == "/foldin/apply":
             self.apply_gate.wait(5)
             self.applies.append(time.monotonic())
@@ -408,3 +415,42 @@ def test_supervisor_failed_respawn_backs_off():
         assert st["attempts"] >= 2
     finally:
         fake.kill()
+
+
+def test_router_broadcasts_weight_updates_fleet_wide(fleet):
+    """pio-hive: POST /admin/tenants/weights fans the update out to
+    every healthy replica so the whole fleet assigns identically."""
+    fakes, router = fleet
+    body = json.dumps({
+        "app": "shop", "weights": {"control": 0.2, "treatment": 0.8},
+    }).encode()
+    status, out = _post(router.port, "/admin/tenants/weights", body)
+    assert status == 200
+    assert len(out["pushed"]) == 2
+    assert all(e.get("status") == 200 for e in out["pushed"])
+    for f in fakes:
+        assert f.weight_updates == [{
+            "app": "shop",
+            "weights": {"control": 0.2, "treatment": 0.8},
+        }]
+    # an unhealthy replica is skipped, not failed
+    router.replicas[1].healthy = False
+    status, out = _post(router.port, "/admin/tenants/weights", body)
+    assert status == 200
+    skipped = [e for e in out["pushed"] if e.get("skipped")]
+    assert len(skipped) == 1 and skipped[0]["replica"] == "r1"
+    assert len(fakes[0].weight_updates) == 2
+    assert len(fakes[1].weight_updates) == 1
+
+
+def test_router_debug_tenants_fans_in_per_replica(fleet):
+    fakes, router = fleet
+    c = http.client.HTTPConnection("127.0.0.1", router.port, timeout=10)
+    c.request("GET", "/debug/tenants")
+    r = c.getresponse()
+    assert r.status == 200
+    doc = json.loads(r.read().decode())
+    c.close()
+    assert set(doc["replicas"]) == {"r0", "r1"}
+    assert doc["replicas"]["r0"]["replicaName"] == "r0"
+    assert doc["replicas"]["r1"]["tenants"] == 2

@@ -1,0 +1,615 @@
+"""pio-hive unit/property suite: the tenant registry's budget/LRU/
+pinning invariants, sticky weighted variant assignment, token-bucket
+quotas, resident-bytes accounting, online-eval aggregation, and the
+multi-tenant EngineServer routing surface (both query edges ride the
+same ``_query_setup``, so the server tests drive ``predict_json``)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.tenancy import (
+    Experiment,
+    OnlineEval,
+    QuotaExceeded,
+    TenantRegistry,
+    TenantSpec,
+    TenantUnavailable,
+    TokenBucket,
+    UnknownTenant,
+    load_tenant_manifest,
+    model_resident_bytes,
+)
+from predictionio_tpu.tenancy.registry import TenantRuntime
+
+
+# ---------------------------------------------------------------------------
+# token bucket
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_rate_and_burst_deterministic():
+    clock = [0.0]
+    tb = TokenBucket(10.0, burst=2.0, clock=lambda: clock[0])
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()          # burst exhausted
+    clock[0] += 0.1                      # refills exactly one token
+    assert tb.try_acquire()
+    assert not tb.try_acquire()
+    clock[0] += 100.0                    # refill clamps at burst
+    assert tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()
+    snap = tb.snapshot()
+    assert snap["acquired"] == 5 and snap["rejected"] == 3
+
+
+def test_token_bucket_validation():
+    with pytest.raises(ValueError):
+        TokenBucket(0.0)
+    with pytest.raises(ValueError):
+        TokenBucket(5.0, burst=0.5)
+
+
+# ---------------------------------------------------------------------------
+# experiment: sticky weighted assignment
+# ---------------------------------------------------------------------------
+
+
+def test_assignment_sticky_across_restarts():
+    """Assignment is pure hash(salt, app, user): a rebuilt Experiment
+    (process restart, another replica) assigns identically."""
+    e1 = Experiment("shop", {"a": 0.3, "b": 0.7}, salt="exp1")
+    e2 = Experiment("shop", {"a": 0.3, "b": 0.7}, salt="exp1")
+    users = [f"u{i}" for i in range(500)]
+    assert [e1.assign(u) for u in users] == [e2.assign(u) for u in users]
+    # a different salt reshuffles
+    e3 = Experiment("shop", {"a": 0.3, "b": 0.7}, salt="exp2")
+    assert [e1.assign(u) for u in users] != [e3.assign(u) for u in users]
+
+
+def test_assignment_respects_weights_within_tolerance():
+    """Property over 10k users: observed shares track the configured
+    weights within 2 points, before AND after a hot weight update."""
+    exp = Experiment("shop", {"a": 0.5, "b": 0.3, "c": 0.2}, salt="s")
+    users = [f"user-{i}" for i in range(10_000)]
+
+    def shares():
+        counts: dict[str, int] = {}
+        for u in users:
+            v = exp.assign(u)
+            counts[v] = counts.get(v, 0) + 1
+        return {k: v / len(users) for k, v in counts.items()}
+
+    got = shares()
+    for name, w in (("a", 0.5), ("b", 0.3), ("c", 0.2)):
+        assert abs(got.get(name, 0.0) - w) < 0.02, (name, got)
+    exp.set_weights({"a": 0.1, "b": 0.1, "c": 0.8})
+    got = shares()
+    for name, w in (("a", 0.1), ("b", 0.1), ("c", 0.8)):
+        assert abs(got.get(name, 0.0) - w) < 0.02, (name, got)
+
+
+def test_weight_update_moves_minimal_users():
+    """Only the shifted interval mass moves: nudging one boundary by
+    10 points reassigns ~10% of users, not a reshuffle."""
+    exp = Experiment("shop", {"a": 0.5, "b": 0.5}, salt="s")
+    users = [f"user-{i}" for i in range(10_000)]
+    before = [exp.assign(u) for u in users]
+    exp.set_weights({"a": 0.4, "b": 0.6})
+    after = [exp.assign(u) for u in users]
+    moved = sum(x != y for x, y in zip(before, after)) / len(users)
+    assert 0.05 < moved < 0.15, moved
+
+
+def test_weight_update_validation():
+    exp = Experiment("shop", {"a": 1.0, "b": 1.0})
+    with pytest.raises(KeyError):
+        exp.set_weights({"nope": 1.0})
+    with pytest.raises(ValueError):
+        exp.set_weights({"a": 0.0, "b": 0.0})
+    with pytest.raises(ValueError):
+        exp.set_weights({"a": -1.0})
+    # failed updates leave the weights untouched
+    assert exp.weights() == {"a": 1.0, "b": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# resident-bytes accounting
+# ---------------------------------------------------------------------------
+
+
+class _FakeModel:
+    def __init__(self, n_bytes: int):
+        self.table = np.zeros(n_bytes, dtype=np.uint8)
+        self.alias = self.table          # same array: must dedup
+        self.caches = {"a": self.table}  # nested + deduped too
+
+
+def test_model_resident_bytes_counts_and_dedups():
+    m = _FakeModel(1000)
+    assert model_resident_bytes([m]) == 1000
+    m2 = _FakeModel(500)
+    assert model_resident_bytes([m, m2]) == 1500
+    # the same model twice is one residency
+    assert model_resident_bytes([m, m]) == 1000
+
+
+# ---------------------------------------------------------------------------
+# registry: budget / LRU / pinning / in-flight safety
+# ---------------------------------------------------------------------------
+
+
+def _fake_loader(sizes, load_log=None, fail=()):
+    """loader(spec) -> TenantRuntime with a fixed fake resident size
+    (registry tests need budget math, not real engines)."""
+
+    def load(spec):
+        if spec.key in fail:
+            raise RuntimeError(f"boom {spec.key_str}")
+        if load_log is not None:
+            load_log.append(spec.key)
+        rt = TenantRuntime(
+            spec, engine=None, engine_params=None,
+            instance_id=f"iid-{spec.key_str}",
+            algorithms=[], models=[], serving=None, batcher=None,
+            query_decoder=lambda d: d, ctx=None,
+            quota=(TokenBucket(spec.quota_qps, spec.quota_burst)
+                   if spec.quota_qps else None),
+        )
+        rt.resident_bytes = sizes[spec.key]
+        return rt
+
+    return load
+
+
+def _registry(n=4, budget=None, sizes=None, load_log=None, fail=(),
+              weights=None, quota=None):
+    specs = [
+        TenantSpec(f"app{i}", "main", engine_json="x.json",
+                   quota_qps=quota)
+        for i in range(n)
+    ]
+    sizes = sizes or {s.key: 100 for s in specs}
+    reg = TenantRegistry(
+        specs, memory_budget_bytes=budget, salt="t",
+        loader=_fake_loader(sizes, load_log, fail),
+    )
+    return reg
+
+
+def test_lazy_load_and_touch():
+    log = []
+    reg = _registry(3, load_log=log)
+    lease = reg.resolve({"app": "app1", "user": "u"})
+    assert lease.runtime.instance_id == "iid-app1/main"
+    lease.complete("ok")
+    assert log == [("app1", "main")]
+    # second resolve is a hit, not a reload
+    reg.resolve({"app": "app1", "user": "u"}).complete("ok")
+    assert log == [("app1", "main")]
+    assert reg.summary()["loads"] == 1
+
+
+def test_lru_eviction_is_deterministic_under_seeded_pattern():
+    """The LRU tick is a deterministic integer: the same access
+    pattern produces the same eviction sequence on every run."""
+    rng = np.random.default_rng(7)
+    pattern = [f"app{i}" for i in rng.integers(0, 6, 60)]
+
+    def run_once():
+        log = []
+        reg = _registry(6, budget=250, load_log=log)
+        for app in pattern:
+            reg.resolve({"app": app, "user": "u"}).complete("ok")
+        return log, sorted(reg.resident_keys()), reg.summary()
+
+    log1, resident1, sum1 = run_once()
+    log2, resident2, sum2 = run_once()
+    assert log1 == log2
+    assert resident1 == resident2
+    assert sum1["evictions"] == sum2["evictions"] > 0
+    # at most floor(250/100) = 2 resident at any time
+    assert len(resident1) <= 2
+
+
+def test_lru_evicts_least_recently_used():
+    reg = _registry(3, budget=200)
+    reg.resolve({"app": "app0", "user": "u"}).complete("ok")
+    reg.resolve({"app": "app1", "user": "u"}).complete("ok")
+    # app0 is older; loading app2 must evict app0
+    reg.resolve({"app": "app2", "user": "u"}).complete("ok")
+    assert sorted(reg.resident_keys()) == [
+        ("app1", "main"), ("app2", "main"),
+    ]
+    # touching app1 then loading app0 evicts app2 (recency updated)
+    reg.resolve({"app": "app1", "user": "u"}).complete("ok")
+    reg.resolve({"app": "app0", "user": "u"}).complete("ok")
+    assert sorted(reg.resident_keys()) == [
+        ("app0", "main"), ("app1", "main"),
+    ]
+
+
+def test_pinned_tenant_never_evicted():
+    specs = [
+        TenantSpec("app0", "main", engine_json="x.json", pinned=True),
+        TenantSpec("app1", "main", engine_json="x.json"),
+        TenantSpec("app2", "main", engine_json="x.json"),
+    ]
+    sizes = {s.key: 100 for s in specs}
+    reg = TenantRegistry(specs, memory_budget_bytes=150, salt="t",
+                         loader=_fake_loader(sizes))
+    reg.resolve({"app": "app0", "user": "u"}).complete("ok")
+    reg.resolve({"app": "app1", "user": "u"}).complete("ok")
+    reg.resolve({"app": "app2", "user": "u"}).complete("ok")
+    assert ("app0", "main") in reg.resident_keys()
+    assert reg.summary()["overcommits"] >= 0  # pinned may force overcommit
+
+
+def test_inflight_tenant_never_evicted():
+    reg = _registry(3, budget=100)
+    held = reg.resolve({"app": "app0", "user": "u"})  # NOT completed
+    reg.resolve({"app": "app1", "user": "u"}).complete("ok")
+    # app0 holds an in-flight lease: it cannot be evicted even though
+    # the budget only fits one tenant — the load overcommits loudly
+    assert ("app0", "main") in reg.resident_keys()
+    assert reg.summary()["overcommits"] >= 1
+    held.complete("ok")
+    # now it IS evictable
+    reg.resolve({"app": "app2", "user": "u"}).complete("ok")
+    assert ("app0", "main") not in reg.resident_keys()
+
+
+def test_set_memory_budget_shrink_evicts_immediately():
+    reg = _registry(3, budget=None)
+    for i in range(3):
+        reg.resolve({"app": f"app{i}", "user": "u"}).complete("ok")
+    assert len(reg.resident_keys()) == 3
+    evicted = reg.set_memory_budget(150)
+    assert len(evicted) == 2
+    assert len(reg.resident_keys()) == 1
+
+
+def test_explicit_evict_respects_safety():
+    reg = _registry(2)
+    held = reg.resolve({"app": "app0", "user": "u"})
+    assert not reg.evict(("app0", "main"))    # in-flight
+    held.complete("ok")
+    assert reg.evict(("app0", "main"))
+    assert not reg.evict(("app0", "main"))    # already gone
+
+
+def test_load_failure_is_tenant_unavailable_and_does_not_stick():
+    sizes = {("app0", "main"): 1, ("app1", "main"): 1}
+    specs = [TenantSpec("app0", "main", engine_json="x.json"),
+             TenantSpec("app1", "main", engine_json="x.json")]
+    reg = TenantRegistry(specs, salt="t",
+                         loader=_fake_loader(sizes, fail={("app1", "main")}))
+    with pytest.raises(TenantUnavailable):
+        reg.resolve({"app": "app1", "user": "u"})
+    # the other tenant is unaffected
+    reg.resolve({"app": "app0", "user": "u"}).complete("ok")
+
+
+def test_unknown_tenant_and_access_key_routing():
+    specs = [
+        TenantSpec("app0", "main", engine_json="x.json",
+                   access_key="KEY0"),
+        TenantSpec("app1", "main", engine_json="x.json"),
+    ]
+    sizes = {s.key: 1 for s in specs}
+    reg = TenantRegistry(specs, salt="t", loader=_fake_loader(sizes))
+    with pytest.raises(UnknownTenant):
+        reg.resolve({"app": "nope"})
+    with pytest.raises(UnknownTenant):
+        reg.resolve({"app": "app0", "variant": "nope"})
+    with pytest.raises(UnknownTenant):
+        reg.resolve({"accessKey": "WRONG"})
+    lease = reg.resolve({"accessKey": "KEY0", "user": "u"})
+    assert lease.runtime.spec.app == "app0"
+    lease.complete("ok")
+    # no routing fields -> the anchor (first spec)
+    lease = reg.resolve({"user": "u"})
+    assert lease.runtime.spec.app == "app0"
+    lease.complete("ok")
+
+
+def test_quota_and_breaker_shedding():
+    reg = _registry(2, quota=1000.0)
+    # exhaust the bucket: burst = rate (1000); drain it
+    rt = reg.get_runtime(("app0", "main"))
+    rt.quota._tokens = 0.0
+    rt.quota._last = time.monotonic()
+    with pytest.raises(QuotaExceeded):
+        reg.resolve({"app": "app0", "user": "u"})
+    # breaker: repeated errors open it -> TenantUnavailable sheds
+    for _ in range(5):
+        lease = reg.resolve({"app": "app1", "user": "u"})
+        lease.complete("error")
+    with pytest.raises(TenantUnavailable):
+        reg.resolve({"app": "app1", "user": "u"})
+    # a success after the reset closes it again
+    rt1 = reg.get_runtime(("app1", "main"))
+    rt1.breaker._opened_at -= 1000.0     # fast-forward the reset
+    lease = reg.resolve({"app": "app1", "user": "u"})
+    lease.complete("ok")
+    reg.resolve({"app": "app1", "user": "u"}).complete("ok")
+
+
+def test_variant_assignment_through_resolve_is_sticky():
+    specs = [
+        TenantSpec("shop", "control", engine_json="x.json", weight=0.5),
+        TenantSpec("shop", "treatment", engine_json="x.json",
+                   weight=0.5),
+    ]
+    sizes = {s.key: 1 for s in specs}
+    reg = TenantRegistry(specs, salt="t", loader=_fake_loader(sizes))
+    got = {}
+    for i in range(200):
+        lease = reg.resolve({"app": "shop", "user": f"u{i}"})
+        got[f"u{i}"] = lease.variant
+        assert lease.assigned
+        lease.complete("ok")
+    assert set(got.values()) == {"control", "treatment"}
+    for u, v in list(got.items())[:20]:
+        lease = reg.resolve({"app": "shop", "user": u})
+        assert lease.variant == v
+        lease.complete("ok")
+    # explicit variant bypasses assignment
+    lease = reg.resolve({"app": "shop", "user": "u0",
+                         "variant": "treatment"})
+    assert lease.variant == "treatment" and not lease.assigned
+    lease.complete("ok")
+
+
+def test_concurrent_same_tenant_resolution_loads_once():
+    log = []
+    sizes = {("app0", "main"): 1}
+    spec = TenantSpec("app0", "main", engine_json="x.json")
+    slow_started = threading.Event()
+
+    def slow_loader(s):
+        slow_started.set()
+        time.sleep(0.2)
+        return _fake_loader(sizes, load_log=log)(s)
+
+    reg = TenantRegistry([spec], salt="t", loader=slow_loader)
+    results = []
+
+    def resolve():
+        lease = reg.resolve({"app": "app0", "user": "u"})
+        results.append(lease.runtime)
+        lease.complete("ok")
+
+    threads = [threading.Thread(target=resolve) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(log) == 1          # one load
+    assert len(results) == 4
+    assert all(r is results[0] for r in results)
+
+
+# ---------------------------------------------------------------------------
+# online eval aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_online_eval_counts_and_rates(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_TPU_RUNLOG_DIR", str(tmp_path / "runs"))
+    from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+    from predictionio_tpu.storage.event import DataMap, Event
+    import datetime as dt
+
+    es = SQLiteEventStore(str(tmp_path / "ev.db"))
+    es.init_channel(1)
+    oe = OnlineEval(manifest_id="hive-test")
+    for _ in range(10):
+        oe.impression("shop", "a")
+    for _ in range(5):
+        oe.impression("shop", "b")
+    evs = []
+    for variant, n in (("a", 4), ("b", 1)):
+        for i in range(n):
+            evs.append(Event(
+                event="click", entity_type="user", entity_id=f"u{i}",
+                target_entity_type="item", target_entity_id="i0",
+                properties=DataMap({"variant": variant}),
+                event_time=dt.datetime(2020, 1, 1,
+                                       tzinfo=dt.timezone.utc),
+            ))
+    # predict feedback events must NOT count as conversions
+    evs.append(Event(
+        event="predict", entity_type="pio_pr", entity_id="p1",
+        properties=DataMap({"variant": "a"}),
+        event_time=dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc),
+    ))
+    es.insert_batch(evs, app_id=1)
+    snap = oe.refresh(es, {"shop": 1})
+    assert snap["shop/a"] == {
+        "impressions": 10, "conversions": 4, "rate": 0.4,
+    }
+    assert snap["shop/b"]["conversions"] == 1
+    # incremental: a second refresh scans only past the cursor
+    snap = oe.refresh(es, {"shop": 1})
+    assert snap["shop/a"]["conversions"] == 4
+    oe.close()
+    # the tower manifest holds per-variant candidate records
+    from predictionio_tpu.obs.runlog import read_manifest
+
+    view = read_manifest(tmp_path / "runs" / "hive-test")
+    assert view is not None and view["final"]["status"] == "completed"
+    assert any(
+        c.get("variant") == "a" and c.get("rate") == 0.4
+        for c in view["candidates"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# tenants.json manifest
+# ---------------------------------------------------------------------------
+
+
+def test_load_tenant_manifest(tmp_path):
+    (tmp_path / "a").mkdir()
+    doc = {
+        "memoryBudgetBytes": 1234,
+        "experimentSalt": "s-7",
+        "defaultQuotaQps": 100,
+        "tenants": [
+            {"app": "shop", "variant": "control",
+             "engineJson": "a/engine.json", "weight": 0.7,
+             "pinned": True},
+            {"app": "shop", "variant": "treatment",
+             "engineJson": "a/engine.json", "weight": 0.3,
+             "quotaQps": 5},
+        ],
+    }
+    p = tmp_path / "tenants.json"
+    p.write_text(json.dumps(doc))
+    specs, opts = load_tenant_manifest(p)
+    assert [s.key for s in specs] == [
+        ("shop", "control"), ("shop", "treatment"),
+    ]
+    assert specs[0].pinned and specs[0].weight == 0.7
+    # engineJson passes through VERBATIM: it doubles as the trained
+    # instance's engine-variant key (the --engine-json contract)
+    assert specs[0].engine_json == "a/engine.json"
+    assert specs[1].quota_qps == 5
+    assert opts["memory_budget_bytes"] == 1234
+    assert opts["salt"] == "s-7"
+    reg = TenantRegistry(specs, **opts)
+    assert reg.spec(("shop", "control")).quota_qps == 100  # default fill
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"tenants": []}))
+    with pytest.raises(ValueError):
+        load_tenant_manifest(empty)
+
+
+def test_duplicate_spec_refused():
+    specs = [TenantSpec("a", "v", engine_json="x.json"),
+             TenantSpec("a", "v", engine_json="x.json")]
+    with pytest.raises(ValueError):
+        TenantRegistry(specs)
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant EngineServer (real components, in-process)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hive_server():
+    """One EngineServer hosting two prebuilt tenants (module-scoped:
+    engine builds pay XLA warmup)."""
+    import bench_serving as bs
+    from predictionio_tpu.server.serving import (
+        EngineServer, ServerConfig,
+    )
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.recommendation import ALSModel
+
+    def mk_model(seed, items=40, users=10, rank=4):
+        rng = np.random.default_rng(seed)
+        return ALSModel(
+            user_factors=rng.normal(size=(users, rank)).astype(
+                np.float32),
+            item_factors=rng.normal(size=(items, rank)).astype(
+                np.float32),
+            users=StringIndex([f"u{i}" for i in range(users)]),
+            items=StringIndex([f"i{i}" for i in range(items)]),
+            item_props={},
+        )
+
+    specs = []
+    for i in range(2):
+        engine, ep, iid, ctx = bs._prebuilt_engine(mk_model(i))
+        specs.append(TenantSpec(
+            f"app{i}", "main", engine=engine, engine_params=ep,
+            instance_id=iid, ctx=ctx,
+        ))
+    reg = TenantRegistry(specs, salt="t")
+    anchor = specs[0]
+    srv = EngineServer(
+        anchor.engine, anchor.engine_params, anchor.instance_id,
+        ctx=anchor.ctx, config=ServerConfig(port=0, microbatch="off"),
+        tenants=reg,
+    )
+    yield srv, reg
+    srv.stop()
+
+
+def test_server_routes_by_app_and_books_tenant_metrics(hive_server):
+    srv, reg = hive_server
+    out = srv.predict_json({"user": "u1", "num": 3, "app": "app1"})
+    assert len(out["itemScores"]) == 3
+    assert out["variant"] == "main"
+    rt = reg.get_runtime(("app1", "main"))
+    assert rt.m_queries["ok"].value() >= 1
+    # anchor fallback without routing fields
+    out0 = srv.predict_json({"user": "u1", "num": 3})
+    assert len(out0["itemScores"]) == 3
+    # different tenants serve DIFFERENT models
+    s1 = [s["item"] for s in out["itemScores"]]
+    s0 = [s["item"] for s in out0["itemScores"]]
+    assert s1 != s0 or out != out0
+
+
+def test_server_unknown_tenant_is_bad_request(hive_server):
+    srv, _ = hive_server
+    with pytest.raises(KeyError):
+        srv.predict_json({"user": "u1", "num": 3, "app": "ghost"})
+
+
+def test_server_tenant_fault_isolation(hive_server):
+    """A tenant-scoped fault plan fails app1's queries and opens ITS
+    breaker; app0 (the anchor tenant) keeps serving clean."""
+    from predictionio_tpu.resilience import faults
+
+    srv, reg = hive_server
+    rt1 = reg.get_runtime(("app1", "main"))
+    errors_before = rt1.m_queries["error"].value()
+    faults.arm("tenant.dispatch:tenant=app1/main,exc=fault")
+    try:
+        failures = 0
+        sheds = 0
+        for _ in range(12):
+            try:
+                srv.predict_json({"user": "u1", "num": 3, "app": "app1"})
+            except TenantUnavailable:
+                sheds += 1
+            except RuntimeError:
+                failures += 1
+        assert failures >= srv.config.breaker_failures
+        assert sheds >= 1
+        # the OTHER tenant is untouched the whole time
+        for _ in range(5):
+            out = srv.predict_json({"user": "u2", "num": 3,
+                                    "app": "app0"})
+            assert len(out["itemScores"]) == 3
+    finally:
+        faults.disarm()
+    assert rt1.m_queries["error"].value() > errors_before
+    rt0 = reg.get_runtime(("app0", "main"))
+    assert rt0.breaker.state == "closed"
+    # recovery: fast-forward the reset; one good query closes app1
+    rt1.breaker._opened_at -= 1000.0
+    out = srv.predict_json({"user": "u1", "num": 3, "app": "app1"})
+    assert len(out["itemScores"]) == 3
+    assert rt1.breaker.state == "closed"
+
+
+def test_server_status_and_debug_payloads(hive_server):
+    srv, reg = hive_server
+    st = srv.status_json()
+    assert st["tenancy"]["tenants"] == 2
+    assert st["tenancy"]["resident"] >= 1
+    dbg = reg.debug_payload()
+    assert dbg["anchor"] == "app0/main"
+    assert {s["app"] for s in dbg["specs"]} == {"app0", "app1"}
+    assert "experiments" in dbg and "onlineEval" in dbg

@@ -40,6 +40,13 @@ def main() -> None:
                     "measure store-level concurrent bulk-write "
                     "throughput per count (the region-parallel write "
                     "analogue; VERDICT r4 #9)")
+    ap.add_argument("--append-history", action="store_true",
+                    help="append ONE canonical fenced "
+                    "ingest_events_per_s record (the batch-50 REST "
+                    "path, direction up) to BENCH_HISTORY.jsonl and "
+                    "nest it into BENCH_PR<k>.json under 'ingest' — "
+                    "tools/bench_gate.py then judges ingest "
+                    "throughput like QPS/freshness/recall")
     args = ap.parse_args()
 
     from predictionio_tpu.server.event_server import (
@@ -81,9 +88,10 @@ def main() -> None:
     for k in range(args.n):
         post("/events.json", ev(k))
     dt = time.perf_counter() - t0
+    single_v = round(args.n / dt, 1)
     print(json.dumps({
         "metric": "ingest_single_events_per_s",
-        "value": round(args.n / dt, 1), "unit": "events/s",
+        "value": single_v, "unit": "events/s",
     }), flush=True)
 
     # batch path (reference cap: 50/request); the endpoint replies 200
@@ -97,9 +105,10 @@ def main() -> None:
         )
         assert all(item.get("status") == 201 for item in body), body[:3]
     dt = time.perf_counter() - t0
+    batch_v = round(batches * 50 / dt, 1)
     print(json.dumps({
         "metric": "ingest_batch50_events_per_s",
-        "value": round(batches * 50 / dt, 1), "unit": "events/s",
+        "value": batch_v, "unit": "events/s",
     }), flush=True)
 
     if args.threads > 0:
@@ -138,10 +147,38 @@ def main() -> None:
     t0 = time.perf_counter()
     n = import_events(path, es, app.id)
     dt = time.perf_counter() - t0
+    import_v = round(n / dt, 1)
     print(json.dumps({
         "metric": "import_bulk_events_per_s",
-        "value": round(n / dt, 1), "unit": "events/s",
+        "value": import_v, "unit": "events/s",
     }), flush=True)
+
+    if args.append_history:
+        # the canonical gate record: the batch-50 REST path — the
+        # documented throughput-writer route is the number production
+        # ingest lives or dies by.  Wall time here is device-free and
+        # HTTP-round-trip complete, so the timing is fenced by
+        # construction.
+        sys.path.insert(0, str(Path(__file__).parent / "tools"))
+        import bench_gate
+
+        rec = {
+            "metric": "ingest_events_per_s",
+            "value": batch_v,
+            "unit": "events/s",
+            "platform": "cpu",
+            "scale": float(args.n),
+            "fenced": True,
+            "direction": "up",
+            "mode": "batch50",
+            "single_events_per_s": single_v,
+            "import_bulk_events_per_s": import_v,
+            "store": "sqlite",
+        }
+        bench_gate.append_history(bench_gate.DEFAULT_HISTORY, rec)
+        path_out = bench_gate.write_pr_summary(rec, key="ingest")
+        print(json.dumps({"appended": "ingest_events_per_s",
+                          "pr_summary": str(path_out)}), flush=True)
 
     if args.shards:
         _bench_shard_scaling(args, tmp)

@@ -17,6 +17,7 @@ from typing import Optional
 
 from .asynclint import AsyncEngine
 from .core import RULES, Baseline, Finding, SourceFile, load_baseline
+from .enginelint import EngineImportEngine
 from .jaxlint import JaxEngine
 from .locklint import LockEngine
 from .timelint import TimeEngine
@@ -48,6 +49,23 @@ def _is_bench_scope(path: Path, root: Path) -> bool:
         rel = Path(path.name)
     return rel.name.startswith("bench") or (
         len(rel.parts) > 1 and rel.parts[0] == "tools"
+    )
+
+
+def _is_engine_scope(path: Path, root: Path) -> bool:
+    """PIO301 (engine isolation) scope: engine template modules —
+    ``predictionio_tpu/templates/*.py`` minus ``_``-prefixed
+    infrastructure files (``_common.py`` wraps platform utilities for
+    engines; it IS the sanctioned boundary)."""
+    try:
+        rel = path.resolve().relative_to(root.resolve())
+    except ValueError:
+        return False
+    return (
+        len(rel.parts) == 3
+        and rel.parts[0] == "predictionio_tpu"
+        and rel.parts[1] == "templates"
+        and not rel.name.startswith("_")
     )
 
 
@@ -109,6 +127,8 @@ def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
     findings += AsyncEngine(src).run()
     if _is_pkg_scope(path, root):
         findings += TimeEngine(src).run()
+    if _is_engine_scope(path, root):
+        findings += EngineImportEngine(src).run()
     return findings
 
 

@@ -54,6 +54,10 @@ RULES: dict[str, str] = {
               "without holding the lock",
     "PIO203": "lock discipline: manual .acquire() without a matching "
               "try/finally release",
+    "PIO301": "engine isolation: an engine template file imports "
+              "server internals (predictionio_tpu.server) — engines "
+              "declare components, the platform owns serving "
+              "(templates/*.py excluding _-prefixed infra)",
 }
 
 

@@ -68,14 +68,38 @@ def load_engine_from_variant(
 ):
     """engine.json -> (engine, engine_params, variant dict).
 
-    ``return_factory=True`` appends the factory object (an EngineFactory
-    instance, or the bare callable) so callers needing factory-level API
-    like ``engine_params(key)`` don't re-resolve/instantiate it."""
+    Two dispatch forms: ``engineFactory`` (dotted path, the classic
+    reflection-loader analogue) or ``engine`` (a pio-forge registry
+    name — the engine.json of a one-file engine is just
+    ``{"engine": "myengine"}`` plus optional component overrides; the
+    spec's default params fill the gaps).  ``return_factory=True``
+    appends the factory object (an EngineFactory instance, or the bare
+    callable) so callers needing factory-level API like
+    ``engine_params(key)`` don't re-resolve/instantiate it."""
     variant = json.loads(Path(variant_path).read_text())
     factory_path = engine_factory or variant.get("engineFactory")
     if not factory_path:
+        name = variant.get("engine")
+        if name:
+            from .. import engines
+
+            try:
+                spec = engines.get_engine_spec(name)
+            except KeyError:
+                # an engine.json inside a not-yet-discovered engine dir:
+                # load THAT dir (the --engine-json form must work
+                # without PIO_TPU_ENGINE_PATH)
+                engines.discovery.load_engine_dir(
+                    Path(variant_path).resolve().parent
+                )
+                spec = engines.get_engine_spec(name)
+            merged = spec.default_variant()
+            merged.update(variant)
+            engine = spec.build()
+            out = (engine, engine.params_from_variant(merged), merged)
+            return (*out, spec.factory) if return_factory else out
         raise ValueError(
-            "engine.json must declare 'engineFactory' "
+            "engine.json must declare 'engineFactory' or 'engine' "
             "(or pass --engine-factory)"
         )
     _engine_dir_on_path(variant_path, factory_path)
@@ -316,10 +340,75 @@ def cmd_accesskey(args, storage: Storage) -> int:
 # --------------------------------------------------------------------------
 
 
+def _load_engine_for_args(args, return_factory: bool = False):
+    """ONE resolution path for every workflow command: ``--engine NAME``
+    (pio-forge registry dispatch — no engine.json file needed) or
+    ``--engine-json PATH``.  Returns ``(engine, ep, variant,
+    variant_key[, factory])`` where ``variant_key`` is the
+    engine-variant string instances are registered/looked up under."""
+    from ..tools.template_gallery import verify_template_min_version
+
+    name = getattr(args, "engine", None)
+    if name:
+        from .. import engines
+
+        spec = engines.get_engine_spec(name)
+        engine, ep, variant = engines.resolve(name)
+        out = (engine, ep, variant, spec.instance_variant_key())
+        return (*out, spec.factory) if return_factory else out
+    verify_template_min_version(Path(args.engine_json).parent)
+    loaded = load_engine_from_variant(
+        args.engine_json, args.engine_factory, return_factory=return_factory
+    )
+    out = (*loaded[:3], str(args.engine_json))
+    return (*out, loaded[3]) if return_factory else out
+
+
+def _resolve_instance_id(md, engine_id: str, variant_key: str,
+                         explicit: Optional[str]):
+    """The deploy/foldin glue, deduplicated: an explicit instance id is
+    verified, else the latest COMPLETED instance for (engine_id,
+    variant_key) wins.  Returns ``(iid, error_message)``."""
+    if explicit:
+        if md.engine_instance_get(explicit) is None:
+            return None, f"engine instance '{explicit}' not found."
+        return explicit, None
+    latest = md.engine_instance_get_latest_completed(
+        engine_id, "1", variant_key
+    )
+    if latest is None:
+        return None, ("no completed engine instance found; "
+                      "run train first.")
+    return latest.id, None
+
+
+def cmd_engines(args, storage: Storage) -> int:
+    """pio-forge registry view: every engine one registration away from
+    `train/deploy/eval --engine NAME` — built-ins plus anything on
+    PIO_TPU_ENGINE_PATH."""
+    from .. import engines
+
+    if args.engines_command == "list":
+        specs = engines.list_engine_specs()
+        for spec in specs:
+            src = "" if spec.source == "builtin" else f"  [{spec.source}]"
+            _out(f"{spec.name:<26} {spec.description}{src}")
+        _out(f"({len(specs)} engines registered)")
+        return 0
+    if args.engines_command == "describe":
+        try:
+            spec = engines.get_engine_spec(args.name)
+        except KeyError as e:
+            _out(f"Error: {e.args[0]}")
+            return 1
+        _out(json.dumps(spec.describe(), indent=2))
+        return 0
+    raise AssertionError(args.engines_command)
+
+
 def cmd_train(args, storage: Storage) -> int:
     from ..controller.base import WorkflowContext
     from ..parallel.mesh import enable_compilation_cache
-    from ..tools.template_gallery import verify_template_min_version
     from ..workflow.params import WorkflowParams
     from ..workflow.train import run_train
 
@@ -328,7 +417,6 @@ def cmd_train(args, storage: Storage) -> int:
         import os
 
         os.environ["PIO_TPU_SCAN_CACHE"] = "1"
-    verify_template_min_version(Path(args.engine_json).parent)
     if args.coordinator or args.num_processes is not None:
         # multi-host bring-up: each host runs the same `pio-tpu train`
         # with its own --process-id; collectives then span hosts
@@ -339,8 +427,8 @@ def cmd_train(args, storage: Storage) -> int:
             num_processes=args.num_processes,
             process_id=args.process_id,
         )
-    engine, ep, variant, factory = load_engine_from_variant(
-        args.engine_json, args.engine_factory, return_factory=True
+    engine, ep, variant, variant_key, factory = _load_engine_for_args(
+        args, return_factory=True
     )
     if args.engine_params_key:
         # programmatic params override: EngineFactory.engine_params(key)
@@ -364,7 +452,7 @@ def cmd_train(args, storage: Storage) -> int:
     iid = run_train(
         engine, ep, ctx=ctx, workflow_params=wp,
         engine_id=variant.get("id", "default"),
-        engine_variant=str(args.engine_json),
+        engine_variant=variant_key,
         engine_factory=args.engine_factory or variant.get("engineFactory", ""),
     )
     _out(f"Training completed. Engine instance id: {iid}")
@@ -375,7 +463,6 @@ def cmd_deploy(args, storage: Storage) -> int:
     from ..controller.base import WorkflowContext
     from ..parallel.mesh import enable_compilation_cache
     from ..server.serving import EngineServer, ServerConfig
-    from ..tools.template_gallery import verify_template_min_version
 
     if getattr(args, "replicas", 0) and args.replicas > 1:
         # pio-surge fleet mode: N replica processes + one router
@@ -393,28 +480,21 @@ def cmd_deploy(args, storage: Storage) -> int:
     if getattr(args, "multi", None):
         tenants = _build_tenant_registry(args, storage)
         anchor = tenants.spec(tenants.anchor_key)
-        args.engine_json = anchor.engine_json
+        if anchor.engine_name:
+            args.engine = anchor.engine_name
+        else:
+            args.engine_json = anchor.engine_json
         if anchor.instance_id and not args.engine_instance_id:
             args.engine_instance_id = anchor.instance_id
-    verify_template_min_version(Path(args.engine_json).parent)
-    engine, ep, variant = load_engine_from_variant(
-        args.engine_json, args.engine_factory
-    )
+    engine, ep, variant, variant_key = _load_engine_for_args(args)
     md = storage.get_metadata()
     engine_id = variant.get("id", "default")
-    if args.engine_instance_id:
-        iid = args.engine_instance_id
-        if md.engine_instance_get(iid) is None:
-            _out(f"Error: engine instance '{iid}' not found.")
-            return 1
-    else:
-        latest = md.engine_instance_get_latest_completed(
-            engine_id, "1", str(args.engine_json)
-        )
-        if latest is None:
-            _out("Error: no completed engine instance found; run train first.")
-            return 1
-        iid = latest.id
+    iid, err = _resolve_instance_id(
+        md, engine_id, variant_key, args.engine_instance_id
+    )
+    if err:
+        _out(f"Error: {err}")
+        return 1
     ctx = WorkflowContext(storage=storage, mode="Serving")
     server = EngineServer(
         engine, ep, iid, ctx=ctx,
@@ -435,7 +515,7 @@ def cmd_deploy(args, storage: Storage) -> int:
             max_connections=args.max_connections,
         ),
         engine_id=engine_id,
-        engine_variant=str(args.engine_json),
+        engine_variant=variant_key,
         tenants=tenants,
     )
     # undeploy a stale server holding the port (CreateServer.scala:266-288)
@@ -477,8 +557,9 @@ def _build_tenant_registry(args, storage):
 
     specs, opts = load_tenant_manifest(args.multi)
     for spec in specs:
-        if spec.engine_json is None:
-            _out(f"Error: tenant {spec.key_str} has no engineJson.")
+        if spec.engine_json is None and spec.engine_name is None:
+            _out(f"Error: tenant {spec.key_str} has no engineJson or "
+                 "engine name.")
             raise SystemExit(1)
     if getattr(args, "memory_budget", None) is not None:
         opts["memory_budget_bytes"] = args.memory_budget
@@ -536,7 +617,8 @@ def _deploy_fleet(args) -> int:
         extra.append("--scan-cache")
     def spawner(i):
         return spawn_replica(args.engine_json, i, coord_dir,
-                             extra_args=extra)
+                             extra_args=extra,
+                             engine_name=getattr(args, "engine", None))
 
     spawned = [spawner(i) for i in range(args.replicas)]
     supervisor = (
@@ -604,29 +686,17 @@ def cmd_foldin(args, storage: Storage) -> int:
     from ..controller.base import WorkflowContext
     from ..live import FoldInRunner
     from ..parallel.mesh import enable_compilation_cache
-    from ..tools.template_gallery import verify_template_min_version
 
     enable_compilation_cache()
-    verify_template_min_version(Path(args.engine_json).parent)
-    engine, ep, variant = load_engine_from_variant(
-        args.engine_json, args.engine_factory
-    )
+    engine, ep, variant, variant_key = _load_engine_for_args(args)
     md = storage.get_metadata()
     engine_id = variant.get("id", "default")
-    if args.engine_instance_id:
-        iid = args.engine_instance_id
-        if md.engine_instance_get(iid) is None:
-            _out(f"Error: engine instance '{iid}' not found.")
-            return 1
-    else:
-        latest = md.engine_instance_get_latest_completed(
-            engine_id, "1", str(args.engine_json)
-        )
-        if latest is None:
-            _out("Error: no completed engine instance found; "
-                 "run train first.")
-            return 1
-        iid = latest.id
+    iid, err = _resolve_instance_id(
+        md, engine_id, variant_key, args.engine_instance_id
+    )
+    if err:
+        _out(f"Error: {err}")
+        return 1
     ctx = WorkflowContext(storage=storage, mode="Serving")
     try:
         runner = FoldInRunner(
@@ -669,7 +739,22 @@ def cmd_eval(args, storage: Storage) -> int:
         import os
 
         os.environ["PIO_TPU_SCAN_CACHE"] = "1"
-    evaluation = resolve_attr(args.evaluation)
+    if getattr(args, "engine", None):
+        # pio-forge: `eval --engine NAME` dispatches the spec's
+        # declared evaluation — no dotted path to remember
+        from .. import engines
+
+        spec = engines.get_engine_spec(args.engine)
+        if spec.evaluation is None:
+            _out(f"Error: engine '{spec.name}' declares no evaluation; "
+                 "pass a dotted evaluation path instead.")
+            return 1
+        evaluation = spec.evaluation
+    elif args.evaluation:
+        evaluation = resolve_attr(args.evaluation)
+    else:
+        _out("Error: pass an evaluation dotted path or --engine NAME.")
+        return 1
     if callable(evaluation) and not hasattr(evaluation, "engine"):
         evaluation = evaluation()
     params_list = None
@@ -679,9 +764,14 @@ def cmd_eval(args, storage: Storage) -> int:
             gen = gen()
         params_list = list(gen.engine_params_list)
     ctx = WorkflowContext(storage=storage, mode="Evaluation", batch=args.batch)
+    eval_class = args.evaluation
+    if not eval_class and getattr(args, "engine", None):
+        from .. import engines
+
+        eval_class = engines.get_engine_spec(args.engine).evaluation_path
     eval_id, result = run_evaluation(
         evaluation, params_list, ctx=ctx,
-        evaluation_class=args.evaluation,
+        evaluation_class=eval_class or "",
         engine_params_generator_class=args.engine_params_generator or "",
         parallelism=args.parallelism,
     )
@@ -1011,9 +1101,22 @@ def build_parser() -> argparse.ArgumentParser:
     x = aks.add_parser("delete")
     x.add_argument("key")
 
+    en = sub.add_parser("engines",
+                        help="pio-forge engine registry (built-in "
+                        "templates + PIO_TPU_ENGINE_PATH dirs)")
+    ens = en.add_subparsers(dest="engines_command", required=True)
+    ens.add_parser("list", help="list every registered engine")
+    x = ens.add_parser("describe",
+                       help="JSON spec of one registered engine")
+    x.add_argument("name")
+
     t = sub.add_parser("train", help="train an engine")
     _add_obs_args(t)
     t.add_argument("--engine-json", default="engine.json")
+    t.add_argument("--engine", metavar="NAME",
+                   help="train a REGISTERED engine by name (pio-forge "
+                   "registry dispatch, no engine.json needed; see "
+                   "`pio-tpu engines list`)")
     t.add_argument("--engine-factory")
     t.add_argument("--batch", default="")
     t.add_argument("--skip-sanity-check", action="store_true")
@@ -1037,6 +1140,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="snapshot columnar event scans to npz keyed by a "
                    "table write-version (storage/scan_cache.py)")
     d.add_argument("--engine-json", default="engine.json")
+    d.add_argument("--engine", metavar="NAME",
+                   help="deploy a REGISTERED engine by name (serves "
+                   "the latest instance trained with `train --engine "
+                   "NAME`)")
     d.add_argument("--engine-factory")
     d.add_argument("--engine-instance-id")
     d.add_argument("--ip", default="0.0.0.0")
@@ -1139,6 +1246,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_obs_args(fi)
     fi.add_argument("--engine-json", default="engine.json")
+    fi.add_argument("--engine", metavar="NAME",
+                    help="fold into a REGISTERED engine by name")
     fi.add_argument("--engine-factory")
     fi.add_argument("--engine-instance-id",
                     help="fold into this instance (default: latest "
@@ -1161,8 +1270,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     e = sub.add_parser("eval", help="run an evaluation sweep")
     _add_obs_args(e)
-    e.add_argument("evaluation",
-                   help="dotted path to an Evaluation (or factory)")
+    e.add_argument("evaluation", nargs="?",
+                   help="dotted path to an Evaluation (or factory); "
+                   "omit with --engine NAME to run the registered "
+                   "engine's declared evaluation")
+    e.add_argument("--engine", metavar="NAME",
+                   help="run the evaluation a REGISTERED engine "
+                   "declares in its spec")
     e.add_argument("engine_params_generator", nargs="?",
                    help="dotted path to an EngineParamsGenerator")
     e.add_argument("--batch", default="")
@@ -1268,6 +1382,7 @@ def build_parser() -> argparse.ArgumentParser:
 _DISPATCH = {
     "app": cmd_app,
     "accesskey": cmd_accesskey,
+    "engines": cmd_engines,
     "train": cmd_train,
     "deploy": cmd_deploy,
     "foldin": cmd_foldin,

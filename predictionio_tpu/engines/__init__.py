@@ -1,0 +1,62 @@
+"""pio-forge: the engine platform — a new engine is ONE file.
+
+``spec.py`` holds the :class:`EngineSpec` registry (declare + register
+by decorator), ``discovery.py`` finds engines (built-in ``templates/``
+package + user dirs on ``PIO_TPU_ENGINE_PATH``), and ``resolve()`` is
+the dispatch point the CLI / tenancy / conformance surfaces share.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .discovery import ENGINE_PATH_ENV, discover
+from .spec import (
+    ConformanceFixture,
+    EngineSpec,
+    clear_registry,
+    engine_spec,
+    get_engine_spec,
+    list_engine_specs,
+    register,
+    spec_name_of,
+)
+
+__all__ = [
+    "ConformanceFixture",
+    "EngineSpec",
+    "ENGINE_PATH_ENV",
+    "clear_registry",
+    "discover",
+    "engine_label_of",
+    "engine_spec",
+    "get_engine_spec",
+    "list_engine_specs",
+    "register",
+    "resolve",
+    "spec_name_of",
+]
+
+
+def resolve(name: str, variant_overrides: Optional[dict] = None):
+    """Registry dispatch: ``(engine, engine_params, variant)`` for a
+    registered engine name — the no-engine.json analogue of
+    ``cli.main.load_engine_from_variant``.  ``variant_overrides``
+    replace same-named component keys of the spec's default variant
+    (an engine.json that says ``{"engine": "trending", "algorithms":
+    [...]}`` keeps the spec's datasource defaults but its own algorithm
+    params)."""
+    spec = get_engine_spec(name)
+    variant = spec.default_variant()
+    if variant_overrides:
+        variant.update({k: v for k, v in variant_overrides.items()
+                        if v is not None})
+    engine = spec.build()
+    return engine, engine.params_from_variant(variant), variant
+
+
+def engine_label_of(engine: Any, fallback: str = "custom") -> str:
+    """The obs/tower label for an engine instance: its registered spec
+    name, else ``fallback`` (unregistered engines stay observable, just
+    under a generic label)."""
+    return spec_name_of(engine) or fallback

@@ -1,0 +1,254 @@
+"""pio-forge engine specs: the one-file-engine registry.
+
+The reference PredictionIO's lasting value was its template ecosystem —
+``DataSource -> Preparator -> Algorithm -> Serving`` made a *new engine*
+cheap and the surrounding server did the rest (``pio train/deploy/eval``
+over pluggable engines).  :class:`EngineSpec` is that contract made
+explicit: ONE declaration per engine (factory + params schema + query
+example + conformance fixture), registered by decorator, and every
+platform surface lights up from registration alone:
+
+* ``pio-tpu engines list/describe`` and ``train/deploy/eval/foldin
+  --engine NAME`` dispatch (`cli/main.py`);
+* ``pio-tpu template list/get`` gallery entries
+  (`tools/template_gallery.py` derives its gallery from this registry);
+* pio-tower run manifests and the ``pio_engine_queries_total{engine=}``
+  obs labels (`workflow/train.py`, `server/serving.py`);
+* pio-hive tenant manifests (a ``tenants.json`` entry may name any
+  registered engine instead of an engine.json path);
+* the registry-parametrized conformance suite
+  (`tests/test_engine_conformance.py`) — every registered engine is
+  driven train -> deploy -> query -> feedback -> eval plus a chaos and
+  an obs assertion, so a new engine inherits the serving/obs/chaos
+  infrastructure by construction.
+
+Registration is side-effect-of-import: decorating a zero-arg factory
+registers the spec, and :func:`~predictionio_tpu.engines.discovery.
+discover` imports the built-in ``templates/`` package plus any user
+engine dirs on ``PIO_TPU_ENGINE_PATH``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+__all__ = [
+    "ConformanceFixture",
+    "EngineSpec",
+    "engine_spec",
+    "register",
+    "get_engine_spec",
+    "list_engine_specs",
+    "spec_name_of",
+    "clear_registry",
+]
+
+
+@dataclass(frozen=True)
+class ConformanceFixture:
+    """Everything the conformance suite needs to drive an engine end to
+    end with NO engine-specific test code: events to seed, a tiny-train
+    variant, queries to fire, and a predicate over the result JSON.
+
+    ``seed_events`` is a zero-arg callable (not a literal list) so event
+    times can be minted at run time — the trending engine's decay math
+    needs *recent* timestamps, not scaffold-time constants.
+    """
+
+    app_name: str
+    seed_events: Callable[[], Sequence[Any]]
+    queries: tuple[dict, ...]
+    check: Optional[Callable[[Any], bool]] = None
+    # tiny-train variant override; None = the spec's default_params
+    # (conformance must stay seconds-per-engine, so specs whose gallery
+    # defaults train 20 ALS sweeps pass a rank-4 / 3-sweep variant here)
+    variant: Optional[Mapping[str, Any]] = None
+
+
+@dataclass(frozen=True)
+class EngineSpec:
+    """One engine, declared once.
+
+    ``factory`` is the zero-arg callable producing the
+    :class:`~predictionio_tpu.controller.engine.Engine`;
+    ``default_params`` is the engine.json-shaped component params dict
+    (``datasource``/``preparator``/``algorithms``/``serving`` keys) that
+    seeds both the template gallery scaffold and ``--engine NAME``
+    dispatch when no engine.json exists."""
+
+    name: str
+    description: str
+    factory: Callable[[], Any]
+    factory_path: str
+    default_params: Mapping[str, Any] = field(default_factory=dict)
+    query_example: Mapping[str, Any] = field(default_factory=dict)
+    # optional zero-arg callable returning a controller Evaluation —
+    # `pio-tpu eval --engine NAME` dispatches through it
+    evaluation: Optional[Callable[[], Any]] = None
+    evaluation_path: Optional[str] = None
+    conformance: Optional[ConformanceFixture] = None
+    source: str = "builtin"
+
+    # -- dispatch ---------------------------------------------------------
+    def build(self):
+        """Factory call; the instance is stamped with the spec name so
+        every downstream surface (serving labels, tower manifests) can
+        recover it without threading one more argument around."""
+        engine = self.factory()
+        engine._engine_spec_name = self.name
+        return engine
+
+    def default_variant(self) -> dict:
+        """The synthetic engine.json for registry dispatch: what
+        ``--engine NAME`` trains/serves when no engine.json file
+        exists.  ``engine`` (not ``engineFactory``) is the loader key;
+        ``engine:<name>`` is the engine-variant string instances are
+        registered under (`instance_variant_key`)."""
+        return {
+            "id": self.name,
+            "engine": self.name,
+            "description": self.description,
+            **{k: _plain(v) for k, v in self.default_params.items()},
+        }
+
+    def instance_variant_key(self) -> str:
+        return f"engine:{self.name}"
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "description": self.description,
+            "factory": self.factory_path,
+            "source": self.source,
+            "defaultParams": _plain(self.default_params),
+            "queryExample": _plain(self.query_example),
+            "evaluation": self.evaluation_path,
+            "conformance": self.conformance is not None,
+        }
+
+
+def _plain(v):
+    """Deep-copy mappings/sequences to plain json-shaped types."""
+    if isinstance(v, Mapping):
+        return {k: _plain(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    return v
+
+
+_lock = threading.Lock()
+_registry: dict[str, EngineSpec] = {}
+# set by discovery while importing a user engine dir so decorators in
+# that module register with the right provenance
+_current_source: str = "builtin"
+
+
+def register(spec: EngineSpec) -> EngineSpec:
+    """Idempotent per (name, factory_path); a DIFFERENT factory under an
+    existing name is a collision and refuses loudly — silently shadowing
+    a built-in engine would make `--engine NAME` ambiguous."""
+    with _lock:
+        prior = _registry.get(spec.name)
+        if prior is not None and prior.factory_path != spec.factory_path:
+            raise ValueError(
+                f"engine {spec.name!r} is already registered by "
+                f"{prior.factory_path} (source: {prior.source}); "
+                f"refusing to overwrite with {spec.factory_path}"
+            )
+        _registry[spec.name] = spec
+    return spec
+
+
+def engine_spec(
+    name: str,
+    *,
+    description: str = "",
+    default_params: Optional[Mapping[str, Any]] = None,
+    query_example: Optional[Mapping[str, Any]] = None,
+    evaluation: Optional[Callable[[], Any]] = None,
+    conformance: Optional[ConformanceFixture] = None,
+):
+    """Decorator: register a zero-arg engine factory as an engine.
+
+    The decorated function keeps working as a plain factory (examples
+    and tests call it directly); engines it returns are stamped with the
+    spec name either way."""
+
+    def wrap(factory: Callable[[], Any]):
+        import functools
+
+        @functools.wraps(factory)
+        def stamped():
+            engine = factory()
+            engine._engine_spec_name = name
+            return engine
+
+        desc = description
+        if not desc and factory.__doc__:
+            desc = factory.__doc__.strip().splitlines()[0]
+        spec = EngineSpec(
+            name=name,
+            description=desc,
+            factory=stamped,
+            factory_path=f"{factory.__module__}.{factory.__qualname__}",
+            default_params=dict(default_params or {}),
+            query_example=dict(query_example or {}),
+            evaluation=evaluation,
+            evaluation_path=(
+                f"{evaluation.__module__}.{evaluation.__qualname__}"
+                if evaluation is not None else None
+            ),
+            conformance=conformance,
+            source=_current_source,
+        )
+        register(spec)
+        stamped.__engine_spec__ = spec
+        return stamped
+
+    return wrap
+
+
+def get_engine_spec(name: str) -> EngineSpec:
+    from .discovery import discover
+
+    discover()
+    with _lock:
+        spec = _registry.get(name)
+        if spec is None:
+            known = ", ".join(sorted(_registry)) or "(none)"
+            raise KeyError(
+                f"no engine named {name!r} is registered; known: {known}"
+                " — set PIO_TPU_ENGINE_PATH to add user engine dirs"
+            )
+        return spec
+
+
+def list_engine_specs() -> list[EngineSpec]:
+    from .discovery import discover
+
+    discover()
+    with _lock:
+        return sorted(_registry.values(), key=lambda s: s.name)
+
+
+def spec_name_of(obj: Any) -> Optional[str]:
+    """The registered engine name of an Engine instance (or a factory),
+    or None for engines built outside the registry."""
+    name = getattr(obj, "_engine_spec_name", None)
+    if name is not None:
+        return name
+    spec = getattr(obj, "__engine_spec__", None)
+    return spec.name if spec is not None else None
+
+
+def clear_registry(keep_builtin: bool = True) -> None:
+    """Test hook: drop user-dir registrations (or everything)."""
+    with _lock:
+        if keep_builtin:
+            for k in [k for k, s in _registry.items()
+                      if s.source != "builtin"]:
+                del _registry[k]
+        else:
+            _registry.clear()

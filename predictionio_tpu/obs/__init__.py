@@ -164,6 +164,12 @@ QUERIES_TOTAL = _registry.counter(
     "Serving queries by outcome",
     labels=("status",),
 )
+ENGINE_QUERIES_TOTAL = _registry.counter(
+    "pio_engine_queries_total",
+    "Serving queries by registered engine (pio-forge spec name; "
+    "'custom' for engines built outside the registry) and outcome",
+    labels=("engine", "status"),
+)
 RELOADS_TOTAL = _registry.counter(
     "pio_reloads_total",
     "Hot model reloads by outcome",

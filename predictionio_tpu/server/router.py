@@ -533,6 +533,51 @@ class RouterServer(HTTPServerBase):
                   if not r.healthy and r.breaker.allow()]
         return healthy + probes
 
+    def _broadcast_post(self, target: str, body: bytes, respond) -> None:
+        """POST ``body`` to ``target`` on every healthy replica from
+        the forward pool and answer the merged per-replica results —
+        the admin fan-out shared by the weights and tenant-lifecycle
+        routes."""
+        pool = self._pool
+        if pool is None:
+            respond(503, {"message": "router is stopping"})
+            return
+
+        def broadcast():
+            results = []
+            for r in self.replicas:
+                if not r.healthy:
+                    results.append({
+                        "replica": r.name, "skipped": "unhealthy",
+                    })
+                    continue
+                try:
+                    status, data, _ = r.request(
+                        "POST", target, body,
+                        timeout_s=self.config.forward_timeout_s,
+                    )
+                    entry = {"replica": r.name, "status": status}
+                    try:
+                        entry.update(json.loads(data.decode()))
+                    except (json.JSONDecodeError, UnicodeDecodeError):
+                        pass
+                    results.append(entry)
+                except Exception as e:
+                    r.mark_down(f"{type(e).__name__}: {e}")
+                    results.append({
+                        "replica": r.name,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+            try:
+                respond(200, {"pushed": results})
+            except RuntimeError:
+                pass
+
+        try:
+            pool.submit(broadcast)
+        except RuntimeError:
+            respond(503, {"message": "router is stopping"})
+
     def _forward_query(self, path_qs: str, body: bytes,
                        trace_id: Optional[str], respond) -> None:
         """Worker-pool half of the hot path: try candidates in order
@@ -665,51 +710,17 @@ class RouterServer(HTTPServerBase):
             except RuntimeError:
                 respond(503, {"message": "router is stopping"})
             return
-        if req.method == "POST" and path == "/admin/tenants/weights":
-            # pio-hive: broadcast a variant-weight update fleet-wide so
-            # every replica's experiment assigns identically (sticky
-            # assignment is pure hash + weights — same weights on every
-            # replica == same variant for every user everywhere)
-            pool = self._pool
-            if pool is None:
-                respond(503, {"message": "router is stopping"})
-                return
-            body = req.body
-
-            def broadcast():
-                results = []
-                for r in self.replicas:
-                    if not r.healthy:
-                        results.append({
-                            "replica": r.name, "skipped": "unhealthy",
-                        })
-                        continue
-                    try:
-                        status, data, _ = r.request(
-                            "POST", "/tenants/weights", body,
-                            timeout_s=self.config.forward_timeout_s,
-                        )
-                        entry = {"replica": r.name, "status": status}
-                        try:
-                            entry.update(json.loads(data.decode()))
-                        except (json.JSONDecodeError, UnicodeDecodeError):
-                            pass
-                        results.append(entry)
-                    except Exception as e:
-                        r.mark_down(f"{type(e).__name__}: {e}")
-                        results.append({
-                            "replica": r.name,
-                            "error": f"{type(e).__name__}: {e}",
-                        })
-                try:
-                    respond(200, {"pushed": results})
-                except RuntimeError:
-                    pass
-
-            try:
-                pool.submit(broadcast)
-            except RuntimeError:
-                respond(503, {"message": "router is stopping"})
+        if req.method == "POST" and path in ("/admin/tenants/weights",
+                                             "/admin/tenants"):
+            # pio-hive admin broadcast: a variant-weight update or a
+            # tenant add/remove fans out to EVERY replica so the whole
+            # fleet stays identical (sticky assignment is pure hash +
+            # weights — same registry state everywhere == same variant
+            # for every user everywhere)
+            target = ("/tenants/weights"
+                      if path == "/admin/tenants/weights"
+                      else "/admin/tenants")
+            self._broadcast_post(target, req.body, respond)
             return
         if req.method == "GET" and path == "/debug/tenants":
             # fleet view: each replica's registry document keyed by
@@ -784,11 +795,14 @@ class RouterServer(HTTPServerBase):
 
 def spawn_replica(engine_json, index: int, coord_dir,
                   extra_args=(), env=None,
-                  python: str = sys.executable) -> dict:
+                  python: str = sys.executable,
+                  engine_name=None) -> dict:
     """Launch one replica as a real subprocess (`pio-tpu deploy` on an
     ephemeral port, announcing it through a port file in
-    ``coord_dir``).  Returns ``{"proc", "port_file", "log_path",
-    "index"}``; pair with :func:`wait_for_port_file`."""
+    ``coord_dir``).  ``engine_name`` dispatches a pio-forge registry
+    engine (``deploy --engine NAME``) instead of an engine.json path.
+    Returns ``{"proc", "port_file", "log_path", "index"}``; pair with
+    :func:`wait_for_port_file`."""
     coord_dir = Path(coord_dir)
     coord_dir.mkdir(parents=True, exist_ok=True)
     port_file = coord_dir / f"replica-{index}.port"
@@ -803,9 +817,13 @@ def spawn_replica(engine_json, index: int, coord_dir,
         env["PYTHONPATH"] = (
             pkg_root + (_os.pathsep + pp if pp else "")
         )
+    engine_arg = (
+        ["--engine", str(engine_name)] if engine_name
+        else ["--engine-json", str(engine_json)]
+    )
     cmd = [
         python, "-m", "predictionio_tpu.cli.main", "deploy",
-        "--engine-json", str(engine_json),
+        *engine_arg,
         "--ip", "127.0.0.1", "--port", "0",
         "--port-file", str(port_file),
         *extra_args,

@@ -41,6 +41,7 @@ from .eventloop import callback_scope
 from .microbatch import AdmissionRejected
 from ..controller.engine import Engine, EngineParams
 from ..obs import (
+    ENGINE_QUERIES_TOTAL,
     FOLDIN_APPLIES_TOTAL,
     FOLDIN_PHASE_SECONDS,
     FOLDIN_WATERMARK_LAG,
@@ -391,6 +392,18 @@ class EngineServer(HTTPServerBase):
             s: QUERIES_TOTAL.labels(status=s)
             for s in ("ok", "bad_request", "timeout", "error", "rejected")
         }
+        # pio-forge: the engine-labeled mirror — every query books
+        # {engine=<registered spec name>} so multi-engine fleets (and
+        # the conformance suite) read per-engine traffic off /metrics
+        from ..engines import engine_label_of
+
+        self.engine_name = engine_label_of(engine, fallback=engine_id)
+        self._m_engine_queries = {
+            s: ENGINE_QUERIES_TOTAL.labels(engine=self.engine_name,
+                                           status=s)
+            for s in ("ok", "bad_request", "timeout", "error",
+                      "rejected", "quota", "shed")
+        }
         self._httpd: Optional[ThreadingHTTPServer] = None
         # pio-xray: compile/cache events during warmup+serving book into
         # /metrics, and the daemon device sampler keeps the per-device
@@ -491,8 +504,10 @@ class EngineServer(HTTPServerBase):
 
     def _resolve_tenant_components(self, spec):
         """(engine, engine_params, instance_id, ctx) for a spec —
-        prebuilt objects win, else the engine.json is loaded and the
-        latest COMPLETED instance resolved exactly like ``deploy``."""
+        prebuilt objects win, then a registered engine name (pio-forge
+        registry dispatch), else the engine.json is loaded; either way
+        the latest COMPLETED instance resolves exactly like
+        ``deploy``."""
         ctx = spec.ctx or self.ctx
         if spec.engine is not None:
             if spec.instance_id is None:
@@ -501,19 +516,28 @@ class EngineServer(HTTPServerBase):
                     "instance_id"
                 )
             return spec.engine, spec.engine_params, spec.instance_id, ctx
-        from ..cli.main import load_engine_from_variant
+        if spec.engine_name:
+            from .. import engines
 
-        engine, ep, variant = load_engine_from_variant(spec.engine_json)
+            engine, ep, variant = engines.resolve(spec.engine_name)
+            variant_key = f"engine:{spec.engine_name}"
+        else:
+            from ..cli.main import load_engine_from_variant
+
+            engine, ep, variant = load_engine_from_variant(
+                spec.engine_json
+            )
+            variant_key = str(spec.engine_json)
         iid = spec.instance_id
         if iid is None:
             md = ctx.storage.get_metadata()
             latest = md.engine_instance_get_latest_completed(
-                variant.get("id", "default"), "1", str(spec.engine_json)
+                variant.get("id", "default"), "1", variant_key
             )
             if latest is None:
                 raise LookupError(
                     f"tenant {spec.key_str}: no completed engine "
-                    f"instance for {spec.engine_json}; train it first"
+                    f"instance for {variant_key}; train it first"
                 )
             iid = latest.id
         return engine, ep, iid, ctx
@@ -900,8 +924,10 @@ class EngineServer(HTTPServerBase):
         tid = current_trace_id()
         self._latency.observe(dt, exemplar=tid)
         self._m_latency.observe(dt, exemplar=tid)
+        self._m_engine_queries["ok"].inc()
         attrs = {
             "instance": instance_id,
+            "engine": self.engine_name,
             "modelFreshnessSec": round(max(ctx.freshness, 0.0), 3),
             "segmentsMs": tl.snapshot_ms(),
         }
@@ -1048,6 +1074,9 @@ class EngineServer(HTTPServerBase):
                 self._aux(respond, self._blocking_foldin_apply)
             elif path == "/tenants/weights":
                 self._aux(respond, self._blocking_set_weights, req.body)
+            elif path == "/admin/tenants":
+                self._aux(respond, self._blocking_admin_tenants,
+                          req.body)
             else:
                 respond(404, {"message": "not found"})
             return
@@ -1123,6 +1152,89 @@ class EngineServer(HTTPServerBase):
         except (TypeError, ValueError) as e:
             return 400, {"message": str(e)}, "application/json", ()
         return 200, {"updated": snap}, "application/json", ()
+
+    def _blocking_admin_tenants(self, raw: bytes):
+        """POST /admin/tenants: live tenant lifecycle (ROADMAP 5d) —
+        ``{"action": "add", "tenant": {...manifest-entry fields...}}``
+        registers a tenant without redeploy (model loads lazily on its
+        first query, budget rules apply); ``{"action": "remove",
+        "app": ..., "variant": ...}`` stops new queries immediately,
+        drains in-flight leases, and unloads.  Guarded: 404 without
+        tenancy, the anchor tenant is never removable, malformed specs
+        answer 400.  The router broadcasts this route fleet-wide."""
+        if self.tenants is None:
+            return (404, {"message": "tenancy is not enabled"},
+                    "application/json", ())
+        try:
+            doc = json.loads(raw.decode() or "{}")
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            return (400, {"message": f"invalid JSON: {e}"},
+                    "application/json", ())
+        action = doc.get("action")
+        if action == "add":
+            t = doc.get("tenant")
+            if not isinstance(t, dict):
+                return (400, {"message": "body needs a tenant{} object"},
+                        "application/json", ())
+            from ..tenancy import TenantSpec
+
+            try:
+                spec = TenantSpec(
+                    app=t.get("app", ""),
+                    variant=t.get("variant", "default"),
+                    engine_json=t.get("engineJson"),
+                    engine_name=t.get("engine"),
+                    instance_id=t.get("engineInstanceId"),
+                    access_key=t.get("accessKey"),
+                    weight=float(t.get("weight", 1.0)),
+                    pinned=bool(t.get("pinned", False)),
+                    quota_qps=t.get("quotaQps"),
+                    quota_burst=t.get("quotaBurst"),
+                )
+            except (TypeError, ValueError) as e:
+                return (400, {"message": str(e)},
+                        "application/json", ())
+            # resolve app id + default access key from metadata (the
+            # same enrichment `deploy --multi` does at boot)
+            try:
+                md = self.ctx.storage.get_metadata()
+                app_rec = md.app_get_by_name(spec.app)
+                if app_rec is not None:
+                    spec.app_id = app_rec.id
+                    if spec.access_key is None:
+                        keys = md.access_key_get_by_app(app_rec.id)
+                        if keys:
+                            spec.access_key = keys[0].key
+            except Exception:
+                logger.exception(
+                    "tenant add: metadata enrichment failed; "
+                    "accessKey routing is off for %s", spec.key_str,
+                )
+            try:
+                out = self.tenants.add_tenant(spec)
+            except ValueError as e:
+                return (400, {"message": str(e)},
+                        "application/json", ())
+            return 200, out, "application/json", ()
+        if action == "remove":
+            app = doc.get("app")
+            if not app:
+                return (400, {"message": "remove needs an app"},
+                        "application/json", ())
+            try:
+                out = self.tenants.remove_tenant(
+                    (str(app), str(doc.get("variant", "default"))),
+                    drain_timeout_s=float(
+                        doc.get("drainTimeoutSec", 10.0)
+                    ),
+                )
+            except KeyError as e:  # UnknownTenant ⊂ KeyError
+                return 404, {"message": str(e)}, "application/json", ()
+            except ValueError as e:
+                return 400, {"message": str(e)}, "application/json", ()
+            return 200, out, "application/json", ()
+        return (400, {"message": "action must be 'add' or 'remove'"},
+                "application/json", ())
 
     @callback_scope
     def _el_query(self, req, query_str: str, respond) -> None:
@@ -1241,6 +1353,7 @@ class EngineServer(HTTPServerBase):
         failures were already completed inside ``_query_setup``)."""
         if lease is not None:
             lease.complete(_lease_status(e))
+        self._book_engine_query(_lease_status(e))
         try:
             if isinstance(e, QuotaExceeded):
                 # per-tenant token bucket: the client is over ITS
@@ -1276,6 +1389,13 @@ class EngineServer(HTTPServerBase):
                 self.remote_log(f"Query failed: {e}")
         except RuntimeError:
             pass  # request already answered
+
+    def _book_engine_query(self, status: str) -> None:
+        """Book one engine-labeled outcome (unknown statuses fold into
+        'error' so the label space stays bounded)."""
+        child = self._m_engine_queries.get(status)
+        (child if child is not None
+         else self._m_engine_queries["error"]).inc()
 
     def _send_feedback(self, query_json: dict, result_json: Any,
                        lease=None) -> Any:
@@ -1622,6 +1742,15 @@ class EngineServer(HTTPServerBase):
                     except Exception as e:
                         logger.exception("weights update failed")
                         self._reply(500, {"message": str(e)})
+                elif self.path.startswith("/admin/tenants"):
+                    try:
+                        code, payload, _, _ = (
+                            server._blocking_admin_tenants(raw)
+                        )
+                        self._reply(code, payload)
+                    except Exception as e:
+                        logger.exception("tenant admin failed")
+                        self._reply(500, {"message": str(e)})
                 elif self.path.startswith("/stop"):
                     self._reply(200, {"message": "stopping"})
                     threading.Thread(target=server.stop, daemon=True).start()
@@ -1662,6 +1791,7 @@ class EngineServer(HTTPServerBase):
                     # pio-hive: over the tenant's token bucket — the
                     # client's rate problem, a structured 429
                     m_rejected.inc()
+                    server._book_engine_query("quota")
                     self.extra_headers.append(("Retry-After", "1"))
                     self._reply(429, {
                         "message": str(e),
@@ -1669,6 +1799,7 @@ class EngineServer(HTTPServerBase):
                     })
                 except TenantUnavailable as e:
                     m_rejected.inc()
+                    server._book_engine_query("shed")
                     self.extra_headers.append(("Retry-After", "1"))
                     self._reply(503, {
                         "message": str(e),
@@ -1679,6 +1810,7 @@ class EngineServer(HTTPServerBase):
                     # it queued (pio-surge): same structured 503, its
                     # own counter
                     m_rejected.inc()
+                    server._book_engine_query("rejected")
                     self.extra_headers.append(("Retry-After", "1"))
                     self._reply(503, {
                         "message": str(e),
@@ -1688,6 +1820,7 @@ class EngineServer(HTTPServerBase):
                     # structured overload answer, not a hang: the
                     # client can back off and retry
                     m_timeout.inc()
+                    server._book_engine_query("timeout")
                     self.extra_headers.append(("Retry-After", "1"))
                     self._reply(503, {
                         "message": str(e),
@@ -1695,6 +1828,7 @@ class EngineServer(HTTPServerBase):
                     })
                 except (KeyError, ValueError, TypeError) as e:
                     m_bad.inc()
+                    server._book_engine_query("bad_request")
                     self._reply(400, {"message": f"bad query: {e}"})
                     server.remote_log(
                         f"Query {raw.decode(errors='replace')} "
@@ -1702,6 +1836,7 @@ class EngineServer(HTTPServerBase):
                     )
                 except Exception as e:
                     m_err.inc()
+                    server._book_engine_query("error")
                     logger.exception("query failed")
                     self._reply(500, {"message": str(e)})
                     server.remote_log(

@@ -390,6 +390,11 @@ class ShardedSQLiteEventStore(EventStore):
             sort_keys=True, separators=(",", ":"),
         )
 
+    # advertised capability: callers that can exploit a concurrent
+    # shard scan (the trending engine's full-backlog aggregation) probe
+    # this instead of sniffing types
+    supports_parallel_scan = True
+
     def find_rows_since(
         self,
         app_id: int,
@@ -398,6 +403,7 @@ class ShardedSQLiteEventStore(EventStore):
         limit: Optional[int] = None,
         event_names: Optional[Sequence[str]] = None,
         newest_first: bool = False,
+        parallel: bool = False,
     ) -> tuple[list[tuple], str]:
         """Rows written after a shard-vector watermark; returns
         ``(rows, new_cursor)`` with ``new_cursor`` the JSON-encoded
@@ -413,8 +419,35 @@ class ShardedSQLiteEventStore(EventStore):
         bounds the merged page: shards are consumed in order and the
         cursor only advances for rows actually returned, so paging
         with the returned cursor walks the full backlog without
-        skipping or repeating."""
+        skipping or repeating.
+
+        ``parallel=True`` scans every shard concurrently — the
+        region-parallel read analogue (ROADMAP item 3's scan half) for
+        unbounded scans: N independent B-tree range scans on N
+        connections instead of one serialized walk.  Results are
+        concatenated in shard-index order, so the output is BITWISE the
+        sequential scan's.  Ignored when ``limit`` is set (a bounded
+        page consumes shards in order — scanning all of them would read
+        rows the page must then discard) or when there is one shard."""
         per_shard = self._decode_cursor(cursor)
+        if parallel and limit is None and self.n_shards > 1:
+            import concurrent.futures
+
+            def scan(i):
+                return self.shards[i].find_rows_since(
+                    app_id, channel_id, cursor=per_shard[i],
+                    event_names=event_names, newest_first=newest_first,
+                )
+
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(self.n_shards, 8),
+                thread_name_prefix="shard-scan",
+            ) as ex:
+                results = list(ex.map(scan, range(self.n_shards)))
+            out_rows = [r for rows, _ in results for r in rows]
+            return out_rows, self._encode_cursor(
+                [int(nc) for _, nc in results]
+            )
         out_rows: list[tuple] = []
         new_cursor = list(per_shard)
         remaining = limit

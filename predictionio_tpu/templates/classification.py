@@ -246,3 +246,51 @@ def classification_engine() -> Engine:
          "": NaiveBayesAlgorithm},
         FirstServing,
     )
+
+
+# -- pio-forge registration -------------------------------------------------
+
+
+def _conformance_events():
+    from ..storage import DataMap, Event
+
+    events = []
+    for n in range(16):
+        label = "hot" if n % 2 == 0 else "cold"
+        base = 3.0 if label == "hot" else 0.0
+        events.append(Event(
+            event="$set", entity_type="user", entity_id=f"u{n}",
+            properties=DataMap({
+                "attr0": base + (n % 3) * 0.1,
+                "attr1": float(n % 2),
+                "attr2": base * 0.5,
+                "label": label,
+            }),
+        ))
+    return events
+
+
+from ..engines import ConformanceFixture, engine_spec  # noqa: E402
+
+classification_engine = engine_spec(
+    "classification",
+    description=(
+        "Attribute classification: naive bayes / TPU logistic "
+        "(scala-parallel-classification analogue)"
+    ),
+    default_params={
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+    },
+    query_example={"features": [2.0, 0.0, 0.0]},
+    conformance=ConformanceFixture(
+        app_name="forge-conf",
+        seed_events=_conformance_events,
+        queries=({"features": [3.1, 0.0, 1.5]},),
+        check=lambda r: r.get("label") in ("hot", "cold"),
+        variant={
+            "datasource": {"params": {"appName": "forge-conf"}},
+            "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
+        },
+    ),
+)(classification_engine)

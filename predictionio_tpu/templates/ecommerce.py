@@ -306,3 +306,63 @@ def ecommerce_engine() -> Engine:
         {"ecomm": ECommAlgorithm, "": ECommAlgorithm},
         FirstServing,
     )
+
+
+# -- pio-forge registration -------------------------------------------------
+
+
+def _conformance_events():
+    from ..storage import Event
+
+    events = []
+    for u in range(10):
+        for j in range(4):
+            i = (u * 3 + j) % 8
+            events.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+            ))
+    return events
+
+
+from ..engines import ConformanceFixture, engine_spec  # noqa: E402
+
+ecommerce_engine = engine_spec(
+    "ecommercerecommendation",
+    description=(
+        "E-commerce recommendation with serving-time event filtering "
+        "(scala-parallel-ecommercerecommendation analogue)"
+    ),
+    default_params={
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [
+            {
+                "name": "ecomm",
+                "params": {
+                    "appName": "MyApp",
+                    "unseenOnly": True,
+                    "seenEvents": ["buy", "view"],
+                    "rank": 10,
+                    "numIterations": 20,
+                    "lambda": 0.01,
+                    "seed": 3,
+                },
+            }
+        ],
+    },
+    query_example={"user": "u1", "num": 4},
+    conformance=ConformanceFixture(
+        app_name="forge-conf",
+        seed_events=_conformance_events,
+        queries=({"user": "u1", "num": 3},),
+        check=lambda r: len(r.get("itemScores", [])) >= 1,
+        variant={
+            "datasource": {"params": {"appName": "forge-conf"}},
+            "algorithms": [
+                {"name": "ecomm",
+                 "params": {"rank": 4, "numIterations": 3,
+                            "lambda": 0.1, "alpha": 10.0, "seed": 1}}
+            ],
+        },
+    ),
+)(ecommerce_engine)

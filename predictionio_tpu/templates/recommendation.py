@@ -853,3 +853,76 @@ def recommendation_evaluation():
         RecommendationServing,
     )
     return Evaluation(engine, RMSEMetric())
+
+
+# --------------------------------------------------------------------------
+# pio-forge registration: ONE declaration lights up `pio-tpu engines
+# list/describe`, `--engine recommendation` dispatch, the template
+# gallery entry, obs/tower engine labels, tenancy manifests, and the
+# registry conformance suite (tests/test_engine_conformance.py)
+# --------------------------------------------------------------------------
+
+
+def _conformance_events():
+    from ..storage import DataMap, Event
+
+    events = []
+    for u in range(8):
+        for j in range(4):
+            i = (u + j * 3) % 10
+            events.append(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                properties=DataMap({"rating": float((u + i) % 5 + 1)}),
+            ))
+    for j in range(10):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{j}",
+            properties=DataMap(
+                {"categories": ["even" if j % 2 == 0 else "odd"]}),
+        ))
+    return events
+
+
+from ..engines import ConformanceFixture, engine_spec  # noqa: E402
+
+recommendation_engine = engine_spec(
+    "recommendation",
+    description=(
+        "Personalized recommendation via block-ALS on TPU "
+        "(scala-parallel-recommendation analogue)"
+    ),
+    default_params={
+        "datasource": {
+            "params": {"appName": "MyApp", "eventNames": ["rate", "buy"]}
+        },
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 20,
+                           "lambda": 0.01, "seed": 3},
+            }
+        ],
+    },
+    query_example={"user": "1", "num": 4},
+    evaluation=recommendation_evaluation,
+    conformance=ConformanceFixture(
+        app_name="forge-conf",
+        seed_events=_conformance_events,
+        queries=({"user": "u1", "num": 3},),
+        check=lambda r: len(r.get("itemScores", [])) >= 1,
+        variant={
+            # evalK 2: the conformance suite's eval step runs a REAL
+            # 2-fold read_eval for this engine (the others exercise
+            # eval dispatch with an empty set)
+            "datasource": {"params": {"appName": "forge-conf",
+                                      "eventNames": ["rate"],
+                                      "evalK": 2}},
+            "algorithms": [
+                {"name": "als",
+                 "params": {"rank": 4, "numIterations": 3,
+                            "lambda": 0.1, "seed": 1}}
+            ],
+        },
+    ),
+)(recommendation_engine)

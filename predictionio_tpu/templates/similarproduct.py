@@ -285,3 +285,65 @@ def similarproduct_engine() -> Engine:
         {"als": SimilarProductAlgorithm, "": SimilarProductAlgorithm},
         FirstServing,
     )
+
+
+# -- pio-forge registration -------------------------------------------------
+
+
+def _conformance_events():
+    from ..storage import DataMap, Event
+
+    events = []
+    # two co-view clusters (even / odd items)
+    for u in range(12):
+        cluster = u % 2
+        for j in range(5):
+            i = (2 * j + cluster) % 10
+            events.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+            ))
+    for j in range(10):
+        events.append(Event(
+            event="$set", entity_type="item", entity_id=f"i{j}",
+            properties=DataMap(
+                {"categories": ["even" if j % 2 == 0 else "odd"]}),
+        ))
+    return events
+
+
+from ..engines import ConformanceFixture, engine_spec  # noqa: E402
+
+similarproduct_engine = engine_spec(
+    "similarproduct",
+    description=(
+        "Similar-product ranking from item factors "
+        "(scala-parallel-similarproduct analogue)"
+    ),
+    default_params={
+        "datasource": {"params": {"appName": "MyApp"}},
+        "algorithms": [
+            {
+                "name": "als",
+                "params": {"rank": 10, "numIterations": 20,
+                           "lambda": 0.01, "seed": 3},
+            }
+        ],
+    },
+    query_example={"items": ["1"], "num": 4},
+    conformance=ConformanceFixture(
+        app_name="forge-conf",
+        seed_events=_conformance_events,
+        queries=({"items": ["i0"], "num": 3},),
+        check=lambda r: len(r.get("itemScores", [])) >= 1
+        and all(s["item"] != "i0" for s in r["itemScores"]),
+        variant={
+            "datasource": {"params": {"appName": "forge-conf"}},
+            "algorithms": [
+                {"name": "als",
+                 "params": {"rank": 4, "numIterations": 3,
+                            "lambda": 0.1, "alpha": 10.0, "seed": 1}}
+            ],
+        },
+    ),
+)(similarproduct_engine)

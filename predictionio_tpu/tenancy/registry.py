@@ -137,15 +137,16 @@ class TenantSpec:
                  weight: float = 1.0,
                  pinned: bool = False,
                  quota_qps: Optional[float] = None,
-                 quota_burst: Optional[float] = None):
+                 quota_burst: Optional[float] = None,
+                 engine_name: Optional[str] = None):
         if not app:
             raise ValueError("tenant spec needs a non-empty app name")
         if not variant:
             raise ValueError("tenant spec needs a non-empty variant name")
-        if engine_json is None and engine is None:
+        if engine_json is None and engine is None and engine_name is None:
             raise ValueError(
-                f"tenant {app}/{variant}: provide engine_json or a "
-                "prebuilt engine"
+                f"tenant {app}/{variant}: provide engine_json, a "
+                "registered engine name, or a prebuilt engine"
             )
         if not (weight >= 0.0):
             raise ValueError(
@@ -155,6 +156,11 @@ class TenantSpec:
         self.app = str(app)
         self.variant = str(variant)
         self.engine_json = engine_json
+        # pio-forge: a tenants.json entry may name any REGISTERED
+        # engine ("engine": "trending") instead of an engine.json path;
+        # the loader resolves it through the registry, and the trained
+        # instance is looked up under the `engine:<name>` variant key
+        self.engine_name = engine_name
         self.engine = engine
         self.engine_params = engine_params
         self.instance_id = instance_id
@@ -327,6 +333,7 @@ class TenantRegistry:
         self.anchor_key = specs[0].key
         self.salt = salt
         self.loader = loader
+        self.default_quota_qps = default_quota_qps
         self.eval_interval_s = eval_interval_s
         self.memory_budget_bytes = (
             int(memory_budget_bytes) if memory_budget_bytes else 0
@@ -364,13 +371,15 @@ class TenantRegistry:
         return s
 
     def apps(self) -> list[str]:
-        return sorted(self._experiments)
+        with self._lock:
+            return sorted(self._experiments)
 
     def experiment(self, app: str) -> Experiment:
-        try:
-            return self._experiments[app]
-        except KeyError:
-            raise UnknownTenant(f"unknown app {app!r}") from None
+        with self._lock:
+            exp = self._experiments.get(app)
+        if exp is None:
+            raise UnknownTenant(f"unknown app {app!r}")
+        return exp
 
     def set_weights(self, app: str, weights: dict) -> dict:
         """Hot-update an app's variant weights; returns the new
@@ -378,6 +387,104 @@ class TenantRegistry:
         exp = self.experiment(app)
         exp.set_weights({str(k): float(v) for k, v in weights.items()})
         return exp.snapshot()
+
+    # -- lifecycle admin (POST /admin/tenants) -----------------------------
+    def add_tenant(self, spec: TenantSpec) -> dict:
+        """Live-add a tenant without redeploy (ROADMAP 5d).  The spec
+        registers immediately; the model loads lazily on first query
+        exactly like a boot-manifest tenant (budget eviction applies).
+        Adding a new variant to an existing app rebuilds that app's
+        experiment with the extended weight set — sticky assignment is
+        pure hash math, so existing variants' users keep their
+        assignment except for the interval mass the new weight
+        claims."""
+        with self._lock:
+            if spec.key in self._specs:
+                raise ValueError(
+                    f"tenant {spec.key_str} already exists"
+                )
+            if spec.quota_qps is None and self.default_quota_qps is not None:
+                spec.quota_qps = self.default_quota_qps
+            self._specs[spec.key] = spec
+            exp = self._experiments.get(spec.app)
+            weights = dict(exp.weights()) if exp is not None else {}
+            weights[spec.variant] = spec.weight
+            self._experiments[spec.app] = Experiment(
+                spec.app, weights, salt=self.salt
+            )
+            if spec.access_key:
+                self._by_access_key[spec.access_key] = spec.app
+            new_weights = self._experiments[spec.app].weights()
+        TENANT_LOADS_TOTAL.labels(
+            app=spec.app, variant=spec.variant, kind="admin_add"
+        ).inc()
+        logger.info("tenant %s added live", spec.key_str)
+        return {"added": spec.key_str, "weights": new_weights}
+
+    def remove_tenant(self, key: tuple[str, str],
+                      drain_timeout_s: float = 10.0) -> dict:
+        """Live-remove a tenant: new queries stop resolving to it
+        IMMEDIATELY (spec + experiment variant dropped under the lock),
+        then the resident model waits for its in-flight leases to
+        drain — the same in-flight safety the eviction path enforces,
+        made blocking — before unload.  The anchor tenant is refused
+        (it IS the process's base components).  Returns
+        ``{"removed", "drained", "wasResident"}``; ``drained=False``
+        means the drain timed out and the runtime was unloaded with
+        leases still open (logged loudly)."""
+        key = (str(key[0]), str(key[1]))
+        with self._lock:
+            spec = self._specs.get(key)
+            if spec is None:
+                raise UnknownTenant(f"unknown tenant {key}")
+            if key == self.anchor_key:
+                raise ValueError(
+                    "cannot remove the anchor tenant (it is the "
+                    "server's own model); redeploy instead"
+                )
+            del self._specs[key]
+            app, variant = key
+            exp = self._experiments.get(app)
+            if exp is not None:
+                weights = dict(exp.weights())
+                weights.pop(variant, None)
+                if weights and sum(weights.values()) > 0:
+                    self._experiments[app] = Experiment(
+                        app, weights, salt=self.salt
+                    )
+                else:
+                    # last variant of the app: the app itself goes
+                    del self._experiments[app]
+            if spec.access_key:
+                self._by_access_key.pop(spec.access_key, None)
+            rt = self._runtimes.get(key)
+        drained = True
+        if rt is not None:
+            deadline = time.monotonic() + max(drain_timeout_s, 0.0)
+            while True:
+                with self._lock:
+                    if rt.inflight == 0:
+                        self._runtimes.pop(key, None)
+                        self._book_residency_locked(rt, "admin_remove")
+                        break
+                if time.monotonic() > deadline:
+                    drained = False
+                    logger.warning(
+                        "tenant %s removal drain timed out with %d "
+                        "leases in flight; unloading anyway",
+                        spec.key_str, rt.inflight,
+                    )
+                    with self._lock:
+                        self._runtimes.pop(key, None)
+                        self._book_residency_locked(rt, "admin_remove")
+                    break
+                time.sleep(0.005)
+            self._close_runtime(rt)
+            self._sample_device_memory()
+        logger.info("tenant %s removed (drained=%s)", spec.key_str,
+                    drained)
+        return {"removed": spec.key_str, "drained": drained,
+                "wasResident": rt is not None}
 
     # -- resolution (the per-query hot path) ------------------------------
     def resolve(self, query_json: dict) -> TenantLease:
@@ -388,18 +495,25 @@ class TenantRegistry:
         (sticky weighted A/B).  Applies quota THEN breaker admission,
         loads the model lazily, and returns a lease pinning the tenant
         for the query's duration."""
+        with self._lock:
+            # one snapshot of the routing tables: tenant add/remove
+            # mutates them live, and a query's app->experiment->spec
+            # walk must be self-consistent
+            by_access_key = dict(self._by_access_key)
+            experiments = dict(self._experiments)
+            spec_keys = set(self._specs)
         app = query_json.get("app") or query_json.get("appId")
         if app is None:
             ak = query_json.get("accessKey")
             if ak is not None:
-                app = self._by_access_key.get(str(ak))
+                app = by_access_key.get(str(ak))
                 if app is None:
                     raise UnknownTenant(f"unknown access key {str(ak)[:8]}…")
         if app is None:
             app, default_variant = self.anchor_key
         else:
             app, default_variant = str(app), None
-        exp = self._experiments.get(app)
+        exp = experiments.get(app)
         if exp is None:
             raise UnknownTenant(f"unknown app {app!r}")
         variant = query_json.get("variant")
@@ -411,7 +525,7 @@ class TenantRegistry:
                 variant = exp.assign(str(query_json.get("user", "")))
                 assigned = True
         key = (app, str(variant))
-        if key not in self._specs:
+        if key not in spec_keys:
             raise UnknownTenant(
                 f"unknown variant {variant!r} for app {app!r}"
             )
@@ -735,6 +849,7 @@ class TenantRegistry:
                 }
                 for s in self._specs.values()
             ]
+            experiments = dict(self._experiments)
         out = {
             **self.summary(),
             "anchor": "/".join(self.anchor_key),
@@ -742,7 +857,7 @@ class TenantRegistry:
             "resident_tenants": resident,
             "experiments": {
                 app: exp.snapshot()
-                for app, exp in self._experiments.items()
+                for app, exp in experiments.items()
             },
             "onlineEval": self.online.snapshot(),
         }
@@ -788,7 +903,10 @@ def load_tenant_manifest(path) -> tuple[list[TenantSpec], dict]:
     so it must equal what was passed to ``pio-tpu train`` — exactly
     the single-tenant ``--engine-json`` contract.  Relative paths
     therefore resolve against the deploy cwd, like every other CLI
-    engine.json."""
+    engine.json.  A tenant may instead carry ``"engine": "<name>"``
+    naming a pio-forge REGISTERED engine (``pio-tpu engines list``);
+    its instance resolves under the ``engine:<name>`` variant key
+    (`train --engine <name>`)."""
     p = Path(path)
     doc = json.loads(p.read_text())
     tenants = doc.get("tenants")
@@ -801,6 +919,7 @@ def load_tenant_manifest(path) -> tuple[list[TenantSpec], dict]:
             app=t.get("app", ""),
             variant=t.get("variant", "default"),
             engine_json=ej,
+            engine_name=t.get("engine"),
             instance_id=t.get("engineInstanceId"),
             access_key=t.get("accessKey"),
             weight=float(t.get("weight", 1.0)),

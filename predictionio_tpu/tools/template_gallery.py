@@ -52,99 +52,77 @@ class TemplateMeta:
     query_example: dict = field(default_factory=dict)
 
 
-GALLERY: dict[str, TemplateMeta] = {
-    "recommendation": TemplateMeta(
-        name="recommendation",
-        description=(
-            "Personalized recommendation via block-ALS on TPU "
-            "(scala-parallel-recommendation analogue)"
-        ),
-        factory="predictionio_tpu.templates.recommendation"
-        ".recommendation_engine",
-        engine_params={
-            "datasource": {
-                "params": {"appName": "MyApp", "eventNames": ["rate", "buy"]}
-            },
-            "algorithms": [
-                {
-                    "name": "als",
-                    "params": {
-                        "rank": 10,
-                        "numIterations": 20,
-                        "lambda": 0.01,
-                        "seed": 3,
-                    },
-                }
-            ],
-        },
-        evaluation="predictionio_tpu.templates.recommendation"
-        ".recommendation_evaluation",
-        query_example={"user": "1", "num": 4},
-    ),
-    "similarproduct": TemplateMeta(
-        name="similarproduct",
-        description=(
-            "Similar-product ranking from item factors "
-            "(scala-parallel-similarproduct analogue)"
-        ),
-        factory="predictionio_tpu.templates.similarproduct"
-        ".similarproduct_engine",
-        engine_params={
-            "datasource": {"params": {"appName": "MyApp"}},
-            "algorithms": [
-                {
-                    "name": "als",
-                    "params": {"rank": 10, "numIterations": 20,
-                               "lambda": 0.01, "seed": 3},
-                }
-            ],
-        },
-        query_example={"items": ["1"], "num": 4},
-    ),
-    "classification": TemplateMeta(
-        name="classification",
-        description=(
-            "Attribute classification: naive bayes / TPU logistic "
-            "(scala-parallel-classification analogue)"
-        ),
-        factory="predictionio_tpu.templates.classification"
-        ".classification_engine",
-        engine_params={
-            "datasource": {"params": {"appName": "MyApp"}},
-            "algorithms": [{"name": "naive", "params": {"lambda": 1.0}}],
-        },
-        query_example={"features": [2.0, 0.0, 0.0]},
-    ),
-    "ecommercerecommendation": TemplateMeta(
-        name="ecommercerecommendation",
-        description=(
-            "E-commerce recommendation with serving-time event filtering "
-            "(scala-parallel-ecommercerecommendation analogue)"
-        ),
-        factory="predictionio_tpu.templates.ecommerce.ecommerce_engine",
-        engine_params={
-            "datasource": {"params": {"appName": "MyApp"}},
-            "algorithms": [
-                {
-                    "name": "ecomm",
-                    "params": {
-                        "appName": "MyApp",
-                        "unseenOnly": True,
-                        "seenEvents": ["buy", "view"],
-                        "rank": 10,
-                        "numIterations": 20,
-                        "lambda": 0.01,
-                        "seed": 3,
-                    },
-                }
-            ],
-        },
-        query_example={"user": "u1", "num": 4},
-    ),
-}
+class _Gallery(dict):
+    """The template gallery IS a view of the pio-forge engine registry:
+    one :class:`~predictionio_tpu.engines.EngineSpec` declaration per
+    engine feeds both ``pio-tpu engines list`` and ``template
+    list/get`` — the per-template metadata dicts that used to live here
+    (and drift from the templates) are gone.
+
+    Built lazily on first access so importing this module doesn't pull
+    the template modules (and their jax imports) for commands that
+    never touch the gallery; refreshed from the registry on every build
+    so engines registered later (``PIO_TPU_ENGINE_PATH``) appear."""
+
+    _built = False
+
+    def _build(self) -> None:
+        from ..engines import list_engine_specs
+
+        self.clear()
+        for spec in list_engine_specs():
+            self[spec.name] = TemplateMeta(
+                name=spec.name,
+                description=spec.description,
+                factory=spec.factory_path,
+                engine_params=dict(spec.default_params),
+                evaluation=spec.evaluation_path,
+                query_example=dict(spec.query_example),
+            )
+        self._built = True
+
+    def _ensure(self) -> None:
+        if not self._built:
+            self._build()
+
+    def __getitem__(self, k):
+        self._ensure()
+        return super().__getitem__(k)
+
+    def get(self, k, default=None):
+        self._ensure()
+        return super().get(k, default)
+
+    def __iter__(self):
+        self._ensure()
+        return super().__iter__()
+
+    def __len__(self) -> int:
+        self._ensure()
+        return super().__len__()
+
+    def __contains__(self, k) -> bool:
+        self._ensure()
+        return super().__contains__(k)
+
+    def values(self):
+        self._ensure()
+        return super().values()
+
+    def keys(self):
+        self._ensure()
+        return super().keys()
+
+    def items(self):
+        self._ensure()
+        return super().items()
+
+
+GALLERY: dict[str, TemplateMeta] = _Gallery()
 
 
 def list_templates() -> list[TemplateMeta]:
+    GALLERY._build()  # refresh: late registrations must appear
     return list(GALLERY.values())
 
 

@@ -142,11 +142,16 @@ def run_train(
     # every worker publishes registry snapshots into the coordination
     # dir (PIO_TPU_COORD_DIR — the multihost harness's rendezvous dir)
     # and the chief merges them into its /metrics and the manifest
+    from ..engines import engine_label_of
+
     session = tower.TowerSession(
         instance_id,
         kind="train",
         meta={
             "engineId": engine_id,
+            # pio-forge: the registered spec name rides every train
+            # manifest so runlog list/diff can group runs by engine
+            "engine": engine_label_of(engine, fallback=engine_id),
             "engineVariant": engine_variant,
             "batch": wp.batch,
             "nDevices": ctx.n_devices,

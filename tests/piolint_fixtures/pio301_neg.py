@@ -1,0 +1,26 @@
+"""PIO301 negative fixture: the imports an engine file legitimately
+makes — controller contracts, shared template helpers, obs counters,
+models — plus lookalike names that must not trip the matcher."""
+
+import predictionio_tpu.models.als
+
+from predictionio_tpu.controller import Algorithm
+
+from ..obs import RESILIENCE_TOTAL
+
+from ._common import filter_bias_mask
+
+from .recommendation import PredictedResult
+
+# lookalikes: a module merely NAMED server-ish is not the server pkg
+import http.server
+
+from myproject.server_utils import helper
+
+from ..serverless import thing
+
+
+__all__ = [
+    "predictionio_tpu", "Algorithm", "RESILIENCE_TOTAL",
+    "filter_bias_mask", "PredictedResult", "http", "helper", "thing",
+]

@@ -1,0 +1,23 @@
+"""PIO301 positive fixture: an engine template file importing server
+internals in every form the rule catches."""
+
+import predictionio_tpu.server.microbatch  # EXPECT: PIO301
+
+from predictionio_tpu.server import serving  # EXPECT: PIO301
+
+from ..server.microbatch import MicroBatcher  # EXPECT: PIO301
+
+from .. import server  # EXPECT: PIO301
+
+
+def lazy_coupling():
+    # deferring the import defers the coupling, it doesn't remove it
+    from ..server import eventloop  # EXPECT: PIO301
+
+    return eventloop
+
+
+__all__ = [
+    "predictionio_tpu", "serving", "MicroBatcher", "server",
+    "lazy_coupling",
+]

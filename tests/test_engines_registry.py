@@ -1,0 +1,268 @@
+"""pio-forge registry unit suite: spec declaration/registration,
+discovery (built-in + PIO_TPU_ENGINE_PATH user dirs), CLI dispatch
+(`engines list/describe`, `--engine` resolution, engine.json's
+``engine`` key), the gallery derivation, and the tenancy manifest's
+engine-name entries."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+
+import pytest
+
+from predictionio_tpu import engines
+from predictionio_tpu.engines import (
+    EngineSpec,
+    clear_registry,
+    engine_spec,
+    get_engine_spec,
+    list_engine_specs,
+    spec_name_of,
+)
+
+BUILTIN = {"recommendation", "similarproduct", "classification",
+           "ecommercerecommendation", "trending", "itemsimilarity"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_user_registrations():
+    yield
+    clear_registry(keep_builtin=True)
+
+
+# ---------------------------------------------------------------------------
+# registry basics
+# ---------------------------------------------------------------------------
+
+
+def test_builtin_engines_all_registered():
+    names = {s.name for s in list_engine_specs()}
+    assert BUILTIN <= names
+    assert len(names) >= 6  # the acceptance floor
+
+
+def test_unknown_engine_names_known_ones():
+    with pytest.raises(KeyError) as ei:
+        get_engine_spec("nope-not-an-engine")
+    msg = str(ei.value)
+    assert "nope-not-an-engine" in msg
+    assert "recommendation" in msg  # the operator sees what IS there
+
+
+def test_spec_stamping_both_paths():
+    spec = get_engine_spec("recommendation")
+    assert spec_name_of(spec.build()) == "recommendation"
+    # direct factory calls (examples, tests) are stamped too
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+
+    assert spec_name_of(recommendation_engine()) == "recommendation"
+    assert spec_name_of(object()) is None
+
+
+def test_name_collision_refuses():
+    def fake_factory():
+        raise AssertionError("never built")
+
+    fake_factory.__module__ = "elsewhere"
+    fake_factory.__qualname__ = "fake_factory"
+    with pytest.raises(ValueError, match="already registered"):
+        engine_spec("recommendation")(fake_factory)
+
+
+def test_reregistration_same_factory_is_idempotent():
+    # re-importing a template module re-runs its decorator; same
+    # (name, factory_path) must not explode
+    spec = get_engine_spec("trending")
+    engines.register(spec)
+    assert get_engine_spec("trending") is spec
+
+
+def test_default_variant_and_instance_key():
+    spec = get_engine_spec("trending")
+    v = spec.default_variant()
+    assert v["engine"] == "trending" and v["id"] == "trending"
+    assert "datasource" in v
+    assert spec.instance_variant_key() == "engine:trending"
+
+
+def test_resolve_builds_params():
+    engine, ep, variant = engines.resolve("similarproduct")
+    assert spec_name_of(engine) == "similarproduct"
+    assert ep.algorithms[0][0] == "als"
+
+
+def test_resolve_with_component_overrides():
+    _, ep, variant = engines.resolve("similarproduct", {
+        "algorithms": [{"name": "als", "params": {"rank": 4}}],
+    })
+    assert ep.algorithms[0][1].rank == 4
+    # non-overridden components keep spec defaults
+    assert variant["datasource"]["params"]["appName"] == "MyApp"
+
+
+# ---------------------------------------------------------------------------
+# user-dir discovery
+# ---------------------------------------------------------------------------
+
+USER_ENGINE = '''\
+from dataclasses import dataclass
+from predictionio_tpu.controller import (
+    Algorithm, DataSource, Engine, FirstServing, IdentityPreparator,
+)
+from predictionio_tpu.engines import engine_spec
+
+
+class DS(DataSource):
+    def read_training(self, ctx):
+        return {"n": 1}
+
+
+class Algo(Algorithm):
+    def train(self, ctx, data):
+        return data
+
+    def predict(self, model, query):
+        return {"echo": model["n"]}
+
+
+@engine_spec("userdir-echo", description="one-file user engine")
+def userdir_engine():
+    return Engine(DS, IdentityPreparator, {"": Algo}, FirstServing)
+'''
+
+
+def _write_user_dir(tmp_path, module="engine",
+                    variant=None) -> None:
+    (tmp_path / f"{module}.py").write_text(USER_ENGINE)
+    (tmp_path / "engine.json").write_text(json.dumps(
+        variant or {"engine": "userdir-echo", "engineModule": module}
+    ))
+
+
+def test_user_dir_discovery(tmp_path, monkeypatch):
+    _write_user_dir(tmp_path)
+    monkeypatch.setenv("PIO_TPU_ENGINE_PATH", str(tmp_path))
+    engines.discover(refresh=True)
+    spec = get_engine_spec("userdir-echo")
+    assert spec.source != "builtin"
+    assert spec_name_of(spec.build()) == "userdir-echo"
+
+
+def test_user_dir_broken_entry_skipped(tmp_path, monkeypatch, caplog):
+    good = tmp_path / "good"
+    good.mkdir()
+    _write_user_dir(good)
+    broken = tmp_path / "broken"
+    broken.mkdir()
+    (broken / "engine.json").write_text("{not json")
+    import os
+
+    monkeypatch.setenv(
+        "PIO_TPU_ENGINE_PATH",
+        os.pathsep.join([str(broken), str(good)]),
+    )
+    # one bad dir must not take down discovery of the good one
+    engines.discover(refresh=True)
+    assert get_engine_spec("userdir-echo") is not None
+
+
+def test_engine_json_engine_key_dispatch(tmp_path, monkeypatch):
+    """`--engine-json <dir>/engine.json` with an `engine` key loads the
+    dir's module even without PIO_TPU_ENGINE_PATH."""
+    _write_user_dir(tmp_path)
+    monkeypatch.delenv("PIO_TPU_ENGINE_PATH", raising=False)
+    from predictionio_tpu.cli.main import load_engine_from_variant
+
+    engine, ep, variant = load_engine_from_variant(
+        tmp_path / "engine.json"
+    )
+    assert spec_name_of(engine) == "userdir-echo"
+    assert variant["engine"] == "userdir-echo"
+
+
+# ---------------------------------------------------------------------------
+# CLI + gallery + tenancy surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_cli_engines_list_and_describe(storage_memory):
+    from predictionio_tpu.cli.main import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["engines", "list"], storage=storage_memory)
+    out = buf.getvalue()
+    assert rc == 0
+    for name in BUILTIN:
+        assert name in out
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["engines", "describe", "itemsimilarity"],
+                      storage=storage_memory)
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert doc["factory"].endswith("itemsimilarity_engine")
+    assert doc["conformance"] is True
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["engines", "describe", "zzz"],
+                      storage=storage_memory)
+    assert rc == 1
+
+
+def test_gallery_is_registry_view():
+    from predictionio_tpu.tools.template_gallery import (
+        GALLERY, list_templates,
+    )
+
+    names = {t.name for t in list_templates()}
+    assert BUILTIN <= names
+    meta = GALLERY["trending"]
+    spec = get_engine_spec("trending")
+    assert meta.factory == spec.factory_path
+    assert meta.engine_params == dict(spec.default_params)
+
+
+def test_template_scaffold_of_new_engine(tmp_path):
+    """`template get trending` must scaffold a runnable dir — the
+    gallery entries derived from specs keep the scaffold contract."""
+    from predictionio_tpu.tools.template_gallery import scaffold
+
+    target = scaffold("trending", tmp_path / "eng")
+    variant = json.loads((target / "engine.json").read_text())
+    assert variant["engineFactory"] == "engine.engine_factory"
+    assert "datasource" in variant
+
+
+def test_tenant_manifest_engine_name(tmp_path):
+    from predictionio_tpu.tenancy import load_tenant_manifest
+
+    doc = {
+        "tenants": [
+            {"app": "shop", "variant": "control",
+             "engine": "recommendation"},
+            {"app": "shop", "variant": "fresh", "engine": "trending"},
+        ],
+    }
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps(doc))
+    specs, opts = load_tenant_manifest(path)
+    assert specs[0].engine_name == "recommendation"
+    assert specs[1].engine_name == "trending"
+    assert specs[0].engine_json is None
+
+
+def test_tenant_spec_requires_some_engine():
+    from predictionio_tpu.tenancy import TenantSpec
+
+    with pytest.raises(ValueError):
+        TenantSpec("a", "v")
+    TenantSpec("a", "v", engine_name="trending")  # ok
+
+
+def test_engine_label_of_fallback():
+    assert engines.engine_label_of(object(), fallback="eng-7") == "eng-7"

@@ -37,6 +37,7 @@ from predictionio_tpu.analysis.cli import (
 from predictionio_tpu.analysis.asynclint import AsyncEngine
 from predictionio_tpu.analysis.jaxlint import JaxEngine
 from predictionio_tpu.analysis.locklint import LockEngine
+from predictionio_tpu.analysis.enginelint import EngineImportEngine
 from predictionio_tpu.analysis.timelint import TimeEngine
 
 FIXTURES = Path(__file__).parent / "piolint_fixtures"
@@ -49,14 +50,15 @@ FIXTURE_RULES = sorted(set(RULES) - {"PIO100"})
 
 
 def run_fixture(path: Path):
-    """All three engines, bench + package scopes forced on (so the
-    PIO108 and PIO109 fixtures work without living at their real
-    scope paths)."""
+    """Every engine, bench + package + engine scopes forced on (so the
+    PIO108, PIO109 and PIO301 fixtures work without living at their
+    real scope paths)."""
     src = SourceFile.load(path, path.parent)
     return (JaxEngine(src, bench_scope=True).run()
             + LockEngine(src).run()
             + TimeEngine(src).run()
-            + AsyncEngine(src).run())
+            + AsyncEngine(src).run()
+            + EngineImportEngine(src).run())
 
 
 def expected_findings(path: Path) -> set[tuple[str, int]]:

@@ -613,3 +613,152 @@ def test_server_status_and_debug_payloads(hive_server):
     assert dbg["anchor"] == "app0/main"
     assert {s["app"] for s in dbg["specs"]} == {"app0", "app1"}
     assert "experiments" in dbg and "onlineEval" in dbg
+
+
+# ---------------------------------------------------------------------------
+# tenant lifecycle admin (ROADMAP 5d: add/remove without redeploy)
+# ---------------------------------------------------------------------------
+
+
+def _admin_registry():
+    """Registry whose fake loader can load ANY key (lifecycle tests
+    add tenants the boot manifest never named)."""
+    specs = [
+        TenantSpec("app0", "main", engine_json="x.json"),
+        TenantSpec("app0", "b", engine_json="x.json", weight=1.0),
+        TenantSpec("app1", "main", engine_json="y.json"),
+    ]
+
+    class AnySizes(dict):
+        def __missing__(self, key):
+            return 100
+
+    return TenantRegistry(specs, salt="t",
+                          loader=_fake_loader(AnySizes()))
+
+
+def test_admin_add_tenant_routes_and_loads_lazily():
+    reg = _admin_registry()
+    assert reg.resident_keys() == []
+    out = reg.add_tenant(
+        TenantSpec("app1", "exp", engine_json="z.json", weight=3.0)
+    )
+    assert out["added"] == "app1/exp"
+    assert out["weights"] == {"main": 1.0, "exp": 3.0}
+    # still nothing resident: the model loads on FIRST QUERY
+    assert reg.resident_keys() == []
+    lease = reg.resolve({"app": "app1", "variant": "exp", "user": "u"})
+    assert lease.runtime.key == ("app1", "exp")
+    lease.complete("ok")
+    assert ("app1", "exp") in reg.resident_keys()
+    # duplicate add refuses
+    with pytest.raises(ValueError, match="already exists"):
+        reg.add_tenant(TenantSpec("app1", "exp", engine_json="z.json"))
+
+
+def test_admin_add_whole_new_app():
+    reg = _admin_registry()
+    reg.add_tenant(TenantSpec("app9", "main", engine_json="n.json"))
+    lease = reg.resolve({"app": "app9", "user": "u"})
+    assert lease.runtime.key == ("app9", "main")
+    lease.complete("ok")
+
+
+def test_admin_remove_tenant_stops_routing_and_unloads():
+    reg = _admin_registry()
+    lease = reg.resolve({"app": "app0", "variant": "b", "user": "u"})
+    lease.complete("ok")
+    assert ("app0", "b") in reg.resident_keys()
+    out = reg.remove_tenant(("app0", "b"))
+    assert out == {"removed": "app0/b", "drained": True,
+                   "wasResident": True}
+    assert ("app0", "b") not in reg.resident_keys()
+    # explicit resolves for the removed variant are client errors now
+    with pytest.raises(UnknownTenant):
+        reg.resolve({"app": "app0", "variant": "b", "user": "u"})
+    # assignment only hands out the surviving variant
+    for u in range(20):
+        lease = reg.resolve({"app": "app0", "user": f"u{u}"})
+        assert lease.variant == "main"
+        lease.complete("ok")
+
+
+def test_admin_remove_last_variant_removes_app():
+    reg = _admin_registry()
+    reg.remove_tenant(("app1", "main"))
+    with pytest.raises(UnknownTenant):
+        reg.resolve({"app": "app1", "user": "u"})
+
+
+def test_admin_remove_refuses_anchor_and_unknown():
+    reg = _admin_registry()
+    with pytest.raises(ValueError, match="anchor"):
+        reg.remove_tenant(("app0", "main"))
+    with pytest.raises(UnknownTenant):
+        reg.remove_tenant(("ghost", "main"))
+
+
+def test_admin_remove_drains_in_flight_leases():
+    """The in-flight safety contract, made blocking: removal waits for
+    open leases before unload (and reports drained=False only past the
+    timeout)."""
+    reg = _admin_registry()
+    lease = reg.resolve({"app": "app0", "variant": "b", "user": "u"})
+    done = {}
+
+    def remover():
+        done["out"] = reg.remove_tenant(("app0", "b"),
+                                        drain_timeout_s=5.0)
+
+    t = threading.Thread(target=remover)
+    t.start()
+    time.sleep(0.15)
+    # removal is parked on the lease; the runtime is still resident
+    assert t.is_alive()
+    assert ("app0", "b") in reg.resident_keys()
+    lease.complete("ok")
+    t.join(timeout=5.0)
+    assert done["out"]["drained"] is True
+    assert ("app0", "b") not in reg.resident_keys()
+
+
+def test_admin_remove_timeout_reports_undrained():
+    reg = _admin_registry()
+    lease = reg.resolve({"app": "app0", "variant": "b", "user": "u"})
+    out = reg.remove_tenant(("app0", "b"), drain_timeout_s=0.05)
+    assert out["drained"] is False
+    lease.complete("ok")  # late completion must not explode
+
+
+def test_server_admin_tenants_route(hive_server):
+    """The guarded POST /admin/tenants surface on a REAL multi-tenant
+    server: add answers 200 and routes, remove drains + unloads,
+    anchor removal answers 400, bad bodies answer 400."""
+    srv, reg = hive_server
+    code, out, _, _ = srv._blocking_admin_tenants(json.dumps({
+        "action": "remove", "app": "app0", "variant": "main",
+    }).encode())
+    assert code == 400  # anchor is protected
+    code, out, _, _ = srv._blocking_admin_tenants(b"{}")
+    assert code == 400
+    code, out, _, _ = srv._blocking_admin_tenants(json.dumps({
+        "action": "remove", "app": "ghost",
+    }).encode())
+    assert code == 404
+    # add a spec referencing the OTHER tenant's prebuilt components
+    # via engineInstanceId is not possible over the wire; a registered
+    # engine name is — but loading it would train ALS.  The wire
+    # contract (parse -> registry call -> structured reply) is what
+    # this test pins; registry-level lifecycle is covered above.
+    code, out, _, _ = srv._blocking_admin_tenants(json.dumps({
+        "action": "add",
+        "tenant": {"app": "app1", "variant": "exp",
+                   "engine": "recommendation", "weight": 2.0},
+    }).encode())
+    assert code == 200 and out["added"] == "app1/exp"
+    assert out["weights"]["exp"] == 2.0
+    # and remove it again (never loaded -> wasResident False)
+    code, out, _, _ = srv._blocking_admin_tenants(json.dumps({
+        "action": "remove", "app": "app1", "variant": "exp",
+    }).encode())
+    assert code == 200 and out["wasResident"] is False

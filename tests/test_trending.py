@@ -1,0 +1,234 @@
+"""Trending-now engine unit suite: decay math against a NumPy
+reference, cursor-incremental refresh, the sharded store's parallel
+scan (bitwise vs sequential), reference-epoch rebase, blacklist/top-k
+semantics, persistence round-trip, and stale-serve chaos degradation."""
+
+from __future__ import annotations
+
+import datetime as dt
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.storage import Event, ShardedSQLiteEventStore
+from predictionio_tpu.storage.sqlite_events import SQLiteEventStore
+from predictionio_tpu.templates.trending import (
+    Query,
+    TrendingDataSourceParams,
+    TrendingModel,
+    scan_decayed,
+)
+
+UTC = dt.timezone.utc
+HL = 3600.0
+
+
+def _view(u, i, t):
+    return Event(event="view", entity_type="user", entity_id=u,
+                 target_entity_type="item", target_entity_id=i,
+                 event_time=t)
+
+
+def _seed(es, app_id, t0):
+    evs = []
+    # hot: 6 recent views; warm: 3 older; cold: 2 much older
+    for n in range(6):
+        evs.append(_view(f"u{n}", "hot", t0 - dt.timedelta(seconds=60)))
+    for n in range(3):
+        evs.append(_view(f"u{n}", "warm",
+                         t0 - dt.timedelta(seconds=1800)))
+    for n in range(2):
+        evs.append(_view(f"u{n}", "cold",
+                         t0 - dt.timedelta(seconds=7200)))
+    es.insert_batch(evs, app_id=app_id)
+
+
+def test_scan_decayed_matches_reference(tmp_path):
+    es = SQLiteEventStore(tmp_path / "e.db")
+    es.init_channel(1)
+    now = dt.datetime.now(UTC)
+    _seed(es, 1, now)
+    t0 = now.timestamp()
+    weights, cursor, n = scan_decayed(
+        es, 1, 0, 0, ("view",), HL, t0
+    )
+    assert n == 11
+    # reference: sum of 2**((te - t0)/hl) per item
+    ref = {
+        "hot": 6 * 2 ** (-60 / HL),
+        "warm": 3 * 2 ** (-1800 / HL),
+        "cold": 2 * 2 ** (-7200 / HL),
+    }
+    for item, w in ref.items():
+        # event times round-trip through millisecond storage columns
+        assert weights[item] == pytest.approx(w, rel=1e-5)
+    # ranking: recency beats raw count appropriately
+    assert weights["hot"] > weights["warm"] > weights["cold"]
+
+
+def test_incremental_refresh_scans_only_new_events(tmp_path):
+    es = SQLiteEventStore(tmp_path / "e.db")
+    es.init_channel(1)
+    now = dt.datetime.now(UTC)
+    _seed(es, 1, now)
+    t0 = now.timestamp()
+    weights, cursor, _ = scan_decayed(es, 1, 0, 0, ("view",), HL, t0)
+    m = TrendingModel(sorted(weights),
+                      np.asarray([weights[k] for k in sorted(weights)]),
+                      t0, cursor, 1, 0, ("view",), HL, refresh_s=0.0)
+    # a burst on "cold" lands past the cursor
+    es.insert_batch(
+        [_view(f"x{k}", "cold", now) for k in range(20)], app_id=1
+    )
+    n = m.refresh(es, force=True)
+    assert n == 20
+    assert m.events_folded == 20
+    top = m.top(3)
+    assert top[0][0] == "cold"
+    # refresh again: nothing new — cursor did its job
+    assert m.refresh(es, force=True) == 0
+
+
+def test_sharded_parallel_scan_bitwise_equals_sequential(tmp_path):
+    es = ShardedSQLiteEventStore(tmp_path / "sh", n_shards=4)
+    es.init_channel(1)
+    now = dt.datetime.now(UTC)
+    evs = [
+        _view(f"u{k % 17}", f"i{k % 7}",
+              now - dt.timedelta(seconds=k))
+        for k in range(300)
+    ]
+    es.insert_batch(evs, app_id=1)
+    rows_seq, cur_seq = es.find_rows_since(1, 0, cursor=0,
+                                           event_names=["view"])
+    rows_par, cur_par = es.find_rows_since(1, 0, cursor=0,
+                                           event_names=["view"],
+                                           parallel=True)
+    assert rows_par == rows_seq
+    assert cur_par == cur_seq
+    # the engine's aggregation rides it: supports_parallel_scan set
+    assert es.supports_parallel_scan is True
+    w_seq, c1, n1 = scan_decayed(
+        SQLiteShim(es, parallel=False), 1, 0, 0, ("view",), HL,
+        now.timestamp()
+    )
+    w_par, c2, n2 = scan_decayed(es, 1, 0, 0, ("view",), HL,
+                                 now.timestamp())
+    assert n1 == n2 == 300
+    assert w_seq == w_par
+
+
+class SQLiteShim:
+    """Presents a sharded store WITHOUT the parallel capability so
+    scan_decayed exercises its paged fallback."""
+
+    def __init__(self, es, parallel: bool):
+        self._es = es
+
+    def find_rows_since(self, *a, **kw):
+        kw.pop("parallel", None)
+        return self._es.find_rows_since(*a, **kw)
+
+
+def test_paged_fallback_pages_through_backlog(tmp_path):
+    es = SQLiteEventStore(tmp_path / "e.db")
+    es.init_channel(1)
+    now = dt.datetime.now(UTC)
+    es.insert_batch(
+        [_view(f"u{k}", f"i{k % 3}", now) for k in range(57)], app_id=1
+    )
+    weights, cursor, n = scan_decayed(
+        es, 1, 0, 0, ("view",), HL, now.timestamp(), page=10
+    )
+    assert n == 57
+    assert sum(1 for _ in weights) == 3
+
+
+def test_rebase_preserves_ranking_and_bounds_exponent(tmp_path):
+    """A model whose reference epoch is ~700 half-lives old (long
+    always-on deployment, short half-life) rebases on merge: the new
+    events' reference-space exponents (~2**700) scale back down to O(1)
+    and ranking survives."""
+    import time as _time
+
+    hl = 10.0
+    now = _time.time()
+    t0 = now - 700 * hl
+    m = TrendingModel(
+        ["a", "b"], np.asarray([4.0, 1.0]), t0, 0, 1, 0, ("view",),
+        half_life_s=hl, refresh_s=-1.0,
+    )
+    # weights of events happening NOW, expressed in the stale
+    # reference space: 2**((now - t0)/hl) ≈ 2**700
+    m._merge_locked({"a": 2.0 ** 699, "c": 2.0 ** 700}, cursor=5)
+    assert m.t0 > t0  # rebased
+    assert math.log2(float(m.weights.max()) + 1e-300) < 65
+    order = [i for i, _ in m.top(3)]
+    assert order[0] == "c" and order[1] == "a"
+    assert m.cursor == 5
+
+
+def test_top_blacklist_and_k(tmp_path):
+    m = TrendingModel(
+        ["a", "b", "c"], np.asarray([3.0, 2.0, 1.0]),
+        1000.0, 0, 1, 0, ("view",), HL, refresh_s=-1.0,
+    )
+    assert [i for i, _ in m.top(2)] == ["a", "b"]
+    assert [i for i, _ in m.top(5)] == ["a", "b", "c"]
+    assert [i for i, _ in m.top(2, blacklist=("a",))] == ["b", "c"]
+    assert m.top(2, blacklist=("a", "b", "c")) == []
+    assert m.top(0) == []
+
+
+def test_query_wire_format():
+    q = Query.from_json({"num": 5, "blackList": ["x"]})
+    assert q.num == 5 and q.blacklist == ("x",)
+    assert Query.from_json({}).num == 10
+
+
+def test_params_validation():
+    with pytest.raises(ValueError):
+        TrendingDataSourceParams(half_life_s=0.0)
+
+
+def test_model_persistence_round_trip(tmp_path):
+    from predictionio_tpu.templates.trending import TrendingAlgorithm
+
+    algo = TrendingAlgorithm()
+    m = TrendingModel(
+        ["a", "b"], np.asarray([2.5, 1.5]), 123.0,
+        '{"0":4,"1":7}', 9, 2, ("view", "buy"), HL, refresh_s=3.0,
+    )
+    manifest = algo.save_model(None, "m1", m, tmp_path)
+    m2 = algo.load_model(None, "m1", manifest, tmp_path)
+    assert m2.item_ids == ["a", "b"]
+    assert np.array_equal(m2.weights, m.weights)
+    assert m2.cursor == m.cursor and m2.t0 == m.t0
+    assert m2.event_names == ("view", "buy")
+    assert m2.half_life_s == HL and m2.refresh_s == 3.0
+    assert m2.app_id == 9 and m2.channel_id == 2
+
+
+def test_stale_serve_on_storage_fault(tmp_path):
+    from predictionio_tpu.resilience import faults
+
+    es = SQLiteEventStore(tmp_path / "e.db")
+    es.init_channel(1)
+    now = dt.datetime.now(UTC)
+    es.insert_batch([_view("u", "a", now)], app_id=1)
+    t0 = now.timestamp()
+    w, cur, _ = scan_decayed(es, 1, 0, 0, ("view",), HL, t0)
+    m = TrendingModel(["a"], np.asarray([w["a"]]), t0, cur, 1, 0,
+                      ("view",), HL, refresh_s=0.0)
+    faults.arm("storage.read")
+    try:
+        assert m.refresh(es, force=True) == 0
+        assert m.stale is True
+        # the stale list still answers
+        assert m.top(1)[0][0] == "a"
+    finally:
+        faults.disarm()
+    # recovery clears the flag
+    m.refresh(es, force=True)
+    assert m.stale is False

@@ -1,0 +1,332 @@
+"""pio-forge proof-engine benches: fenced records for trending-now and
+item-similarity.
+
+Two modes, each emitting canonical bench_gate records (one JSON line
+per record; ``--append-history`` writes them to BENCH_HISTORY.jsonl and
+nests a summary into BENCH_PR<k>.json):
+
+``--trending``
+    End-to-end: a REAL trending deployment (sharded sqlite store,
+    registry-dispatched engine, EngineServer HTTP) under sequential
+    load.  Records ``trending_e2e_p50_ms`` (direction down) and
+    ``trending_freshness_ms`` — wall time from a view burst hitting the
+    STORE to the item leading the served trending list (the re-scan
+    freshness path; no fold-in, no factor model — asserted, not
+    assumed).  Host-only engine: wall time is complete by construction.
+
+``--itemsim``
+    Catalog-scale cosine A/B on a clustered synthetic catalog
+    (mixture-of-Gaussians, the honest-for-IVF generator bench_ann.py
+    established): exact normalized-table scan vs the two-stage IVF
+    path, same queries.  Records ``itemsim_exact_p50_ms`` /
+    ``itemsim_ivf_p50_ms`` (down) and ``itemsim_recall_at_10`` (up) —
+    the recall gate the acceptance pins at >= 0.95.  Predict results
+    are host-materialized per query (device-complete timings).
+
+Usage::
+
+    python tools/bench_engines.py --itemsim --items 100000 \
+        --append-history
+    python tools/bench_engines.py --trending --events 100000 \
+        --append-history
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def _emit(rec: dict, append: bool) -> dict:
+    import bench_gate
+
+    print(json.dumps(rec), flush=True)
+    if append:
+        bench_gate.append_history(bench_gate.DEFAULT_HISTORY, rec)
+    return rec
+
+
+def _p50(samples_s) -> float:
+    return statistics.median(samples_s) * 1e3
+
+
+# ---------------------------------------------------------------------------
+# itemsim: exact vs two-stage IVF cosine A/B + recall gate
+# ---------------------------------------------------------------------------
+
+
+def bench_itemsim(args) -> list[dict]:
+    import numpy as np
+
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.itemsimilarity import (
+        ItemSimilarityAlgorithm,
+        ItemSimilarityModel,
+        ItemSimilarityParams,
+        normalize_rows,
+    )
+    from predictionio_tpu.templates.similarproduct import Query
+
+    rng = np.random.default_rng(args.seed)
+    n, rank = args.items, args.rank
+    clusters = max(int(np.sqrt(n)), 8)
+    centers = rng.normal(size=(clusters, rank)).astype(np.float32)
+    assign = rng.integers(0, clusters, size=n)
+    table = centers[assign] + 0.2 * rng.normal(
+        size=(n, rank)
+    ).astype(np.float32)
+    model = ItemSimilarityModel(
+        item_factors=normalize_rows(table),
+        items=StringIndex([f"i{k}" for k in range(n)]),
+        item_props={},
+    )
+
+    def algo(mode):
+        a = ItemSimilarityAlgorithm()
+        a.params = ItemSimilarityParams(
+            retrieval=mode, candidate_factor=args.candidate_factor,
+            nprobe=args.nprobe,
+        )
+        return a
+
+    exact, ivf = algo("exact"), algo("ivf")
+    t_build0 = time.perf_counter()
+    ivf.warmup(model, max_batch=0)
+    build_s = time.perf_counter() - t_build0
+    exact.warmup(model, max_batch=0)
+
+    qitems = rng.integers(0, n, size=args.queries)
+    queries = [Query(items=(f"i{int(q)}",), num=10) for q in qitems]
+    results = {}
+    times = {}
+    # interleave A/B halves to keep thermal/cache drift symmetric
+    for mode, a in (("exact", exact), ("ivf", ivf)):
+        for q in queries[:5]:
+            a.predict(model, q)  # warm
+        samples = []
+        outs = []
+        for q in queries:
+            t0 = time.perf_counter()
+            outs.append(a.predict(model, q))
+            samples.append(time.perf_counter() - t0)
+        times[mode] = samples
+        results[mode] = outs
+    hits = total = 0
+    for re_, ra in zip(results["exact"], results["ivf"]):
+        truth = {s.item for s in re_.item_scores}
+        approx = {s.item for s in ra.item_scores}
+        hits += len(truth & approx)
+        total += len(truth)
+    recall = hits / max(total, 1)
+    common = {
+        "unit": "ms", "platform": "cpu", "scale": float(n),
+        "fenced": True, "items": n, "rank": rank,
+        "candidate_factor": args.candidate_factor,
+        "nprobe": args.nprobe, "clusters": clusters,
+        "queries": args.queries, "generator": "clustered-gaussian",
+        "seed": args.seed, "engine": "itemsimilarity",
+    }
+    recs = [
+        {"metric": "itemsim_exact_p50_ms",
+         "value": round(_p50(times["exact"]), 3),
+         "direction": "down", **common},
+        {"metric": "itemsim_ivf_p50_ms",
+         "value": round(_p50(times["ivf"]), 3),
+         "direction": "down",
+         "index_build_s": round(build_s, 2), **common},
+        {"metric": "itemsim_recall_at_10", "value": round(recall, 4),
+         "direction": "up", **{**common, "unit": "recall"}},
+    ]
+    return [_emit(r, args.append_history) for r in recs]
+
+
+# ---------------------------------------------------------------------------
+# trending: end-to-end deployment + freshness
+# ---------------------------------------------------------------------------
+
+
+def bench_trending(args) -> list[dict]:
+    import numpy as np
+
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.engines import resolve
+    from predictionio_tpu.server.serving import (
+        EngineServer, ServerConfig,
+    )
+    from predictionio_tpu.storage import Storage, reset_storage
+    from predictionio_tpu.storage.event import new_event_ids
+    from predictionio_tpu.workflow import run_train
+
+    home = tempfile.mkdtemp(prefix="pio_bench_trending_")
+    storage = Storage({
+        "PIO_TPU_HOME": home,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SHARDED",
+        "PIO_STORAGE_SOURCES_SHARDED_TYPE": "sqlite-sharded",
+        "PIO_STORAGE_SOURCES_SHARDED_PATH": str(
+            Path(home) / "events-sharded"
+        ),
+        "PIO_STORAGE_SOURCES_SHARDED_SHARDS": str(args.shards),
+    })
+    reset_storage(storage)
+    srv = None
+    try:
+        md = storage.get_metadata()
+        app = md.app_insert("bench-trending")
+        es = storage.get_event_store()
+        es.init_channel(app.id)
+        # seed: zipf-ish skew over the catalog, written via the raw-row
+        # bulk path (the ingest bench owns REST-path numbers)
+        rng = np.random.default_rng(args.seed)
+        items = rng.zipf(1.3, size=args.events) % args.catalog
+        now_ms = int(time.time() * 1000)
+        rows = []
+        ids = new_event_ids(args.events)
+        for j in range(args.events):
+            age_ms = int(rng.integers(0, 6 * 3600 * 1000))
+            rows.append((
+                ids[j], "view", "user", f"u{j % 9973}", "item",
+                f"i{int(items[j])}", "{}", now_ms - age_ms, "[]",
+                None, now_ms,
+            ))
+        es.insert_raw_rows(rows, app_id=app.id)
+
+        engine, ep, _variant = resolve("trending", {
+            "datasource": {"params": {
+                "appName": "bench-trending",
+                "eventNames": ["view"],
+                "refreshSec": args.refresh_s,
+            }},
+        })
+        t0 = time.perf_counter()
+        ctx = WorkflowContext(storage=storage)
+        iid = run_train(engine, ep, ctx=ctx, engine_id="trending",
+                        engine_variant="engine:trending")
+        train_s = time.perf_counter() - t0
+        srv = EngineServer(
+            engine, ep, iid, ctx=ctx,
+            config=ServerConfig(port=0, microbatch="off"),
+            engine_id="trending", engine_variant="engine:trending",
+        )
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+        # the no-factor-model pin (the record carries the proof)
+        with srv._lock:
+            models = srv.models
+        assert all(not hasattr(m, "item_factors") for m in models)
+
+        def query(num=10):
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=json.dumps({"num": num}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read().decode())
+
+        for _ in range(10):
+            query()
+        samples = []
+        for _ in range(args.queries):
+            t0 = time.perf_counter()
+            query()
+            samples.append(time.perf_counter() - t0)
+
+        # freshness: a burst on a brand-new item -> time until it LEADS
+        # the served list (store write -> cursor re-scan -> top-1).
+        # Sized off the CURRENT leader's decayed score: fresh events
+        # score ~1.0 each, so leader_score * 1.2 views must win
+        leader = query(1)["itemScores"][0]["score"]
+        burst_n = int(leader * 1.2) + 50
+        ids2 = new_event_ids(burst_n)
+        now_ms = int(time.time() * 1000)
+        rows2 = [
+            (ids2[j], "view", "user", f"b{j}", "item", "fresh-item",
+             "{}", now_ms, "[]", None, now_ms)
+            for j in range(burst_n)
+        ]
+        t0 = time.perf_counter()
+        es.insert_raw_rows(rows2, app_id=app.id)
+        fresh_s = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            out = query(1)
+            if (out.get("itemScores")
+                    and out["itemScores"][0]["item"] == "fresh-item"):
+                fresh_s = time.perf_counter() - t0
+                break
+            time.sleep(0.02)
+        common = {
+            "unit": "ms", "platform": "cpu",
+            "scale": float(args.events), "fenced": True,
+            "events": args.events, "catalog": args.catalog,
+            "shards": args.shards, "refresh_s": args.refresh_s,
+            "seed": args.seed, "engine": "trending",
+            "factor_model": False, "train_s": round(train_s, 3),
+        }
+        recs = [
+            {"metric": "trending_e2e_p50_ms",
+             "value": round(_p50(samples), 3),
+             "direction": "down", "queries": args.queries, **common},
+        ]
+        if fresh_s is not None:
+            recs.append({
+                "metric": "trending_freshness_ms",
+                "value": round(fresh_s * 1e3, 1),
+                "direction": "down", "burst": burst_n, **common,
+            })
+        else:
+            print(json.dumps({"warning": "freshness burst never led "
+                              "the list within 30s; no freshness "
+                              "record emitted"}), flush=True)
+        return [_emit(r, args.append_history) for r in recs]
+    finally:
+        if srv is not None:
+            srv.stop()
+        reset_storage(None)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trending", action="store_true")
+    ap.add_argument("--itemsim", action="store_true")
+    ap.add_argument("--append-history", action="store_true")
+    ap.add_argument("--seed", type=int, default=7)
+    # itemsim knobs
+    ap.add_argument("--items", type=int, default=100_000)
+    ap.add_argument("--rank", type=int, default=64)
+    ap.add_argument("--candidate-factor", type=int, default=10)
+    ap.add_argument("--nprobe", type=int, default=8)
+    ap.add_argument("--queries", type=int, default=100)
+    # trending knobs
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--catalog", type=int, default=5000)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--refresh-s", type=float, default=0.2)
+    args = ap.parse_args()
+    if not (args.trending or args.itemsim):
+        ap.error("pick --trending and/or --itemsim")
+    recs = []
+    if args.itemsim:
+        recs += bench_itemsim(args)
+    if args.trending:
+        recs += bench_trending(args)
+    if args.append_history:
+        import bench_gate
+
+        for r in recs:
+            bench_gate.write_pr_summary(r, key=r["metric"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

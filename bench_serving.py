@@ -398,12 +398,13 @@ def _prebuilt_engine(model, algo_params=None):
 
 
 def _boot_server(engine, ep, iid, ctx, microbatch, edge="eventloop",
-                 tenants=None):
+                 tenants=None, slo_ms=None):
     from predictionio_tpu.server.serving import EngineServer, ServerConfig
 
     srv = EngineServer(
         engine, ep, iid, ctx=ctx,
-        config=ServerConfig(port=0, microbatch=microbatch, edge=edge),
+        config=ServerConfig(port=0, microbatch=microbatch, edge=edge,
+                            slo_ms=slo_ms),
         engine_variant="bench.json",
         tenants=tenants,
     )
@@ -586,8 +587,12 @@ def _bench_sweep(args, model, rng) -> None:
         )
     else:
         engine, ep, iid, ctx = _prebuilt_engine(model, algo_params)
+    # pio-lens: the sweep's server runs with the SLO armed, so each
+    # point also reads the error-budget burn rate the fleet alerting
+    # would see (the 1m window covers a sweep point's duration)
     srv = _boot_server(engine, ep, iid, ctx, microbatch="auto",
-                       edge=args.edge, tenants=registry)
+                       edge=args.edge, tenants=registry,
+                       slo_ms=args.slo_ms)
     # fenced-record keying (pio-scout satellite): the catalog size
     # rides the record's ``scale`` field — part of bench_gate's
     # baseline key — so a 1M-item sweep never shares a rolling
@@ -659,6 +664,8 @@ def _bench_sweep(args, model, rng) -> None:
             "truncated": res["truncated"],
             "segments_ms": segments_ms,
         }
+        if srv._burn is not None:
+            point["burn_rate_1m"] = round(srv._burn.rate(60.0), 4)
         points.append(point)
         rec = {
             "metric": f"serving_p99_ms_c{c}{suffix}",

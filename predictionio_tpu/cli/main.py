@@ -513,6 +513,7 @@ def cmd_deploy(args, storage: Storage) -> int:
             foldin_poll_s=args.foldin_poll,
             edge=args.edge,
             max_connections=args.max_connections,
+            slo_ms=getattr(args, "slo_ms", None),
         ),
         engine_id=engine_id,
         engine_variant=variant_key,
@@ -610,6 +611,9 @@ def _deploy_fleet(args) -> int:
         ("--foldin-poll", args.foldin_poll),
         ("--max-connections", args.max_connections),
         ("--memory-budget", getattr(args, "memory_budget", None)),
+        # pio-lens: every replica arms its own burn-rate gauges too —
+        # the router's merged /metrics then shows them per replica
+        ("--slo-ms", getattr(args, "slo_ms", None)),
     ):
         if val is not None:
             extra += [flag, str(val)]
@@ -660,6 +664,7 @@ def _deploy_fleet(args) -> int:
         health_interval_s=args.health_interval,
         max_connections=args.max_connections,
         push_foldin_s=args.push_foldin,
+        slo_ms=getattr(args, "slo_ms", None),
     ), supervisor=supervisor)
     if args.port_file:
         router._bind()
@@ -1202,6 +1207,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="concurrent-connection cap; connection "
                    "attempts past it get a structured 503 and are "
                    "closed (slow-loris guard)")
+    d.add_argument("--slo-ms", type=float, default=None, metavar="MS",
+                   help="pio-lens: latency SLO in milliseconds — arms "
+                   "the pio_slo_burn_rate{window} error-budget gauges "
+                   "on this server's latency histogram (fleet mode: "
+                   "on the router's forward histogram AND every "
+                   "replica's serving histogram)")
     d.add_argument("--replicas", type=int, default=0, metavar="N",
                    help="pio-surge fleet mode: spawn N replica "
                    "processes on ephemeral ports and run a router on "

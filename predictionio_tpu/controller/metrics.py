@@ -9,6 +9,7 @@ land in NumPy and reduce in one shot).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Generic, Optional, Sequence, Tuple, TypeVar
 
 import numpy as np
@@ -19,7 +20,9 @@ R = TypeVar("R")
 
 __all__ = [
     "Metric",
+    "ActualItems",
     "AverageMetric",
+    "MAPatK",
     "OptionAverageMetric",
     "StdevMetric",
     "OptionStdevMetric",
@@ -125,3 +128,68 @@ class ZeroMetric(Metric[EI, Q, P, A, float]):
 
     def calculate(self, ctx, data) -> float:
         return 0.0
+
+
+# -- ranking metrics (pio-lens satellite; ROADMAP 4(b)) ---------------------
+
+
+@dataclass(frozen=True)
+class ActualItems:
+    """Ranking-eval ground truth: the held-out relevant item set for
+    one query (the analogue of ``ActualRating`` for top-k engines)."""
+
+    items: tuple[str, ...]
+
+
+class MAPatK(_PointMetric):
+    """Mean Average Precision at k over ranked predictions.
+
+    Per point: the prediction's ordered ``item_scores`` are cut at k
+    and scored against the actual's relevant item SET with the
+    standard AP@k —
+
+        ``sum_i( precision@i * rel(i) ) / min(k, |relevant|)``
+
+    (reference e2's ranking metrics family; the normalizer caps at k
+    so a query with more relevant items than the cutoff can still
+    score 1.0).  Points with an empty relevant set are skipped
+    (Option semantics — nothing to rank against is not a zero)."""
+
+    def __init__(self, k: int = 10):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"MAP@{self.k}"
+
+    @staticmethod
+    def _ranked_items(predicted) -> list:
+        scores = getattr(predicted, "item_scores", None)
+        if scores is None and isinstance(predicted, dict):
+            scores = predicted.get("itemScores", ())
+        out = []
+        for s in scores or ():
+            item = getattr(s, "item", None)
+            if item is None and isinstance(s, dict):
+                item = s.get("item")
+            out.append(str(item))
+        return out
+
+    def calculate_point(self, query, predicted, actual) -> Optional[float]:
+        relevant = set(getattr(actual, "items", ()) or ())
+        if not relevant:
+            return None
+        ranked = self._ranked_items(predicted)[: self.k]
+        hits = 0
+        ap = 0.0
+        for i, item in enumerate(ranked):
+            if item in relevant:
+                hits += 1
+                ap += hits / (i + 1)
+        return ap / min(self.k, len(relevant))
+
+    def calculate(self, ctx, data) -> float:
+        arr = self._points(data)
+        return float(arr.mean()) if len(arr) else float("nan")

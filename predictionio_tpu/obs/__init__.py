@@ -392,6 +392,32 @@ VARIANT_RATE = _registry.gauge(
     labels=("app", "variant"),
 )
 
+# pio-lens satellite (ROADMAP item 3): per-shard event-store
+# instrumentation on ShardedSQLiteEventStore — write/scan latency and a
+# row-delta gauge per shard, so ingestion skew (one hot shard eating
+# the write path) is visible on /metrics before the partitioned
+# event-server ingestion work lands on top of it.
+STORE_SHARD_WRITE_SECONDS = _registry.histogram(
+    "pio_store_shard_write_seconds",
+    "Sharded event-store write latency per shard (insert / "
+    "insert_batch / insert_raw_rows group commits)",
+    labels=("shard",),
+    buckets=log_buckets(1e-5, 100.0, per_decade=4),
+)
+STORE_SHARD_SCAN_SECONDS = _registry.histogram(
+    "pio_store_shard_scan_seconds",
+    "Sharded event-store find_rows_since scan latency per shard "
+    "(serial and parallel=True fan-out)",
+    labels=("shard",),
+    buckets=log_buckets(1e-5, 100.0, per_decade=4),
+)
+STORE_SHARD_ROWS = _registry.gauge(
+    "pio_store_shard_rows",
+    "Rows written minus deleted per shard by THIS process since the "
+    "store opened — the write-skew indicator, not a table count",
+    labels=("shard",),
+)
+
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every
 # process's first scrape already shows the full (zero-valued) shape
@@ -422,11 +448,12 @@ def phase_span(name: str, attrs: Optional[dict] = None) -> Iterator[dict]:
 # ``from . import ...`` and register their metric families at import,
 # so every process's first scrape carries the full schema.  None
 # imports jax at module level — obs stays jax-free.
-from . import runlog, timeline, tower, xray  # noqa: E402
+from . import fleet, runlog, timeline, tower, xray  # noqa: E402
 from .flight import FlightRecorder, get_flight_recorder  # noqa: E402
 
 __all__ += [
     "FlightRecorder",
+    "fleet",
     "get_flight_recorder",
     "runlog",
     "set_cluster_renderer",

@@ -112,6 +112,19 @@ class FlightRecorder:
             self._admissions += 1
         return True
 
+    def annotate(self, trace_id: str, extra: dict) -> bool:
+        """Merge ``extra`` into an admitted record's attrs after the
+        fact (pio-lens: the router caches a replica's lazily-fetched
+        ``segmentsMs`` into its own worst-N entry so the second
+        ``/debug/fleet`` read costs no replica round trip).  Returns
+        False when the record was never admitted or already evicted."""
+        with self._lock:
+            for _, _, r in self._heap:
+                if r["traceId"] == trace_id:
+                    r.setdefault("attrs", {}).update(extra)
+                    return True
+        return False
+
     # -- reading -----------------------------------------------------------
     def records(self) -> list:
         """Full flight records, slowest first."""

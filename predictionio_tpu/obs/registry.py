@@ -525,12 +525,12 @@ def render_state(state: dict) -> str:
                 # in text format 0.0.4 (parsers skip comments), yet a
                 # ``grep t-xxxx`` on a scrape finds the trace id a slow
                 # bucket points at
-                for le, ex, v, _ts in hist.get("exemplars", ()):
+                for le, ex, v, ts in hist.get("exemplars", ()):
                     lbl = (base + "," if base else "") + f'le="{le}"'
                     lines.append(
                         f"# EXEMPLAR {fam['name']}_bucket{{{lbl}}} "
                         f'trace_id="{_escape_label(str(ex))}" '
-                        f"value={_fmt_value(v)}"
+                        f"value={_fmt_value(v)} ts={_fmt_value(ts)}"
                     )
     return "\n".join(lines) + "\n"
 
@@ -544,7 +544,8 @@ def _sample_line(name: str, label_items: tuple, value) -> str:
     return f"{name} {_fmt_value(value)}"
 
 
-def merge_states(tagged: Sequence[tuple]) -> dict:
+def merge_states(tagged: Sequence[tuple],
+                 gauge_label: str = "worker") -> dict:
     """Merge per-worker registry snapshots into one cluster state.
 
     ``tagged`` is ``[(worker_id, state), ...]``.  Merge semantics (the
@@ -559,8 +560,13 @@ def merge_states(tagged: Sequence[tuple]) -> dict:
       observations, byte-identical to a single process that saw them
       all; per-bucket exemplars keep the newest timestamp;
     * **gauges** are NOT summable (a per-worker queue depth summed is
-      a lie); every gauge child instead gains a ``worker`` label so
-      the cluster view shows each worker's value side by side.
+      a lie); every gauge child instead gains a ``gauge_label`` label
+      (``worker`` for the pio-tower cluster merge, ``replica`` for the
+      pio-lens fleet merge) so the merged view shows each process's
+      value side by side.  A gauge family that ALREADY carries that
+      label name (the router's own ``pio_replica_up{replica=}``) keeps
+      its labels untouched — the attribution it wants is already
+      there, and a duplicate label name would be grammar-invalid.
 
     A kind/label/bucket mismatch raises ``ValueError`` — that is a
     schema drift bug, not a collision to paper over.
@@ -569,6 +575,10 @@ def merge_states(tagged: Sequence[tuple]) -> dict:
     for worker, state in tagged:
         for fam in state["families"]:
             name = fam["name"]
+            tag_gauges = (
+                fam["kind"] == "gauge"
+                and gauge_label not in fam["labelNames"]
+            )
             mine = fams.get(name)
             if mine is None:
                 mine = {
@@ -578,8 +588,10 @@ def merge_states(tagged: Sequence[tuple]) -> dict:
                     "labelNames": list(fam["labelNames"]),
                     "children": {},
                 }
-                if fam["kind"] == "gauge":
-                    mine["labelNames"] = mine["labelNames"] + ["worker"]
+                if tag_gauges:
+                    mine["labelNames"] = (
+                        mine["labelNames"] + [gauge_label]
+                    )
                 fams[name] = mine
             elif mine["kind"] != fam["kind"]:
                 raise ValueError(
@@ -589,7 +601,8 @@ def merge_states(tagged: Sequence[tuple]) -> dict:
             for child in fam["children"]:
                 labels = tuple(tuple(kv) for kv in child["labels"])
                 if fam["kind"] == "gauge":
-                    labels = labels + (("worker", str(worker)),)
+                    if tag_gauges:
+                        labels = labels + ((gauge_label, str(worker)),)
                     mine["children"][labels] = {
                         "labels": [list(kv) for kv in labels],
                         "value": child["value"],

@@ -70,6 +70,7 @@ __all__ = [
     "mark",
     "profiles_dir",
     "profiling_active",
+    "register_segment_family",
     "timeline_scope",
 ]
 
@@ -148,6 +149,17 @@ _SEGMENT_CHILDREN = {
         for s in EVENT_SEGMENTS
     },
 }
+def register_segment_family(family: str, histogram_family,
+                            segments) -> None:
+    """Attach a new timeline family (pio-lens adds ``router``):
+    ``Timeline(family)`` instances booked via :meth:`Timeline.finish`
+    observe into ``histogram_family{segment=...}`` children, cached
+    here once like the serve/events families above."""
+    _SEGMENT_CHILDREN[family] = {
+        s: histogram_family.labels(segment=s) for s in segments
+    }
+
+
 SERVE_INFLIGHT.child()
 MICROBATCH_QUEUE_DEPTH.child()
 MICROBATCH_BATCH_SIZE.child()

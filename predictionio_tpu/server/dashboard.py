@@ -70,6 +70,7 @@ class DashboardServer(HTTPServerBase):
             "<a href='/pulse.html'>pulse</a> &middot; "
             "<a href='/train.html'>training console</a> &middot; "
             "<a href='/tenants.html'>tenants</a> &middot; "
+            "<a href='/fleet.html'>fleet</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
         )
@@ -477,6 +478,123 @@ class DashboardServer(HTTPServerBase):
             "</body></html>"
         )
 
+    def fleet_html(self, router_url: str = "") -> str:
+        """pio-lens fleet console: the per-replica tail table (p50/p99
+        off each replica's scraped latency histogram, breaker/respawn/
+        scrape state) and the router flight recorder's worst-N with
+        per-replica attribution.  Renders the in-process router's
+        payload when one exists (``deploy --replicas`` runs the router
+        in this process in fleet mode tests), else fetches
+        ``?router=http://host:port``'s ``/debug/fleet``.  Machines
+        read ``/debug/fleet`` on the router."""
+        from ..obs import fleet
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        p = fleet.fleet_payload()
+        source = "in-process router"
+        if p is None and router_url:
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                    router_url.rstrip("/") + "/debug/fleet", timeout=5
+                ) as r:
+                    p = json.loads(r.read().decode())
+                source = esc(router_url)
+            except Exception as e:
+                return (
+                    "<html><body><h1>Fleet</h1><p>could not reach "
+                    f"{esc(router_url)}/debug/fleet: {esc(e)}</p>"
+                    "</body></html>"
+                )
+        if p is None:
+            return (
+                "<html><body><h1>Fleet</h1><p>No router in this "
+                "process. Point me at one with "
+                "<code>/fleet.html?router=http://host:port</code> or "
+                "curl the router's <code>/debug/fleet</code>.</p>"
+                "</body></html>"
+            )
+        rows = []
+        for r in p.get("replicas", ()):
+            rows.append(
+                "<tr><td>{n}</td><td>{h}</td><td>{b}</td>"
+                "<td>{p50}</td><td>{p99}</td><td>{q:g}</td>"
+                "<td>{f}</td><td>{rsp:g}</td><td>{se}</td></tr>".format(
+                    n=esc(r.get("name")),
+                    h="up" if r.get("healthy") else "<b>DOWN</b>",
+                    b=esc(r.get("breaker", "?")),
+                    p50=r.get("p50Ms", "-"), p99=r.get("p99Ms", "-"),
+                    q=r.get("queriesTotal", 0.0),
+                    f=r.get("failovers", 0),
+                    rsp=r.get("respawns", 0.0),
+                    se=r.get("scrapeErrors", 0),
+                )
+            )
+        worst_rows = []
+        for w in p.get("worst", ()):
+            attrs = w.get("attrs") or {}
+            segs = "; ".join(
+                f"{k} {v}" for k, v in sorted(
+                    (attrs.get("segmentsMs") or {}).items(),
+                    key=lambda kv: -kv[1])[:4]
+            )
+            rsegs = "; ".join(
+                f"{k} {v}" for k, v in sorted(
+                    (attrs.get("replicaSegmentsMs") or {}).items(),
+                    key=lambda kv: -kv[1])[:4]
+            ) or "-"
+            worst_rows.append(
+                "<tr><td>{t}</td><td>{ms:.1f}</td><td>{r}</td>"
+                "<td>{est}</td><td>{segs}</td><td>{rsegs}</td>"
+                "</tr>".format(
+                    t=esc(w.get("traceId")),
+                    ms=w.get("durationSec", 0.0) * 1e3,
+                    r=esc(attrs.get("replica", "?")),
+                    est=attrs.get("ewmaAtAdmissionSec", "-"),
+                    segs=esc(segs) or "-", rsegs=esc(rsegs),
+                )
+            )
+        burn = p.get("burnRate") or {}
+        burn_html = ""
+        if burn:
+            burn_html = (
+                "<p>SLO {slo} ms — burn rate "
+                + " &middot; ".join(
+                    f"{w}: <b>{burn[w]}</b>" for w in sorted(burn)
+                ) + "</p>"
+            ).format(slo=esc(p.get("sloMs")))
+        return (
+            "<html><head><title>fleet</title>"
+            "<meta http-equiv='refresh' content='5'>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td{font-family:monospace;padding:2px 8px}</style></head>"
+            "<body><h1>Fleet (pio-lens)</h1>"
+            f"<p>source: {source} &middot; healthy "
+            f"{p.get('healthyReplicas')}/{len(p.get('replicas', ()))} "
+            "&middot; EWMA forward "
+            f"{p.get('ewmaForwardSec', 0.0) * 1e3:.2f} ms &middot; "
+            f"unroutable {p.get('unroutable', 0)} &middot; "
+            f"scrape errors {p.get('scrapeErrors', 0)}</p>"
+            + burn_html +
+            "<h2>Per-replica tail</h2>"
+            "<table border='1'><tr><th>replica</th><th>health</th>"
+            "<th>breaker</th><th>p50 ms</th><th>p99 ms</th>"
+            "<th>queries</th><th>failovers</th><th>respawns</th>"
+            "<th>scrape errs</th></tr>" + "\n".join(rows) + "</table>"
+            "<h2>Worst requests (router flight recorder)</h2>"
+            "<table border='1'><tr><th>trace</th><th>ms</th>"
+            "<th>replica</th><th>EWMA@admit s</th>"
+            "<th>router segments ms</th><th>replica segments ms</th>"
+            "</tr>" + "\n".join(worst_rows) + "</table>"
+            "<p>Stitch one trace across processes: "
+            "<code>python tools/tracecat.py &lt;trace-id&gt;</code>. "
+            "JSON at the router's <code>/debug/fleet</code>; merged "
+            "exposition at its <code>/metrics</code>.</p>"
+            "<p><a href='/'>index</a></p></body></html>"
+        )
+
     def train_html(self) -> str:
         """pio-tower training console: the live run (if any — this
         process, or another process's manifest still growing on disk)
@@ -617,6 +735,18 @@ class DashboardServer(HTTPServerBase):
                 if path == "/tenants.html":
                     self._reply(200, server.tenants_html().encode(),
                                 "text/html")
+                    return
+                if path == "/fleet.html":
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    self._reply(
+                        200,
+                        server.fleet_html(
+                            q.get("router", [""])[0]
+                        ).encode(),
+                        "text/html",
+                    )
                     return
                 parts = [x for x in path.split("/") if x]
                 if len(parts) == 2 and parts[0] == "engine_instances":

@@ -79,7 +79,8 @@ class CappedThreadingHTTPServer(ThreadingHTTPServer):
         self.shutdown_request(request)
 
 
-OBS_PATHS = ("/metrics", "/debug/xray", "/debug/train", "/debug/profile")
+OBS_PATHS = ("/metrics", "/debug/xray", "/debug/train", "/debug/profile",
+             "/debug/flight", "/debug/fleet")
 
 
 def observability_response(path: str, query: str = ""):
@@ -100,6 +101,30 @@ def observability_response(path: str, query: str = ""):
         from ..obs.tower import train_payload
 
         return 200, train_payload(), None
+    if path == "/debug/flight":
+        # pio-lens: the process flight recorder, addressable by trace
+        # id — the router's /debug/fleet lazily joins a worst-N entry
+        # with the serving replica's own record through this mount
+        from ..obs import get_flight_recorder
+
+        qs = urllib.parse.parse_qs(query)
+        trace = qs.get("trace", [None])[0]
+        fr = get_flight_recorder()
+        if trace:
+            return 200, {"record": fr.record_for(trace)}, None
+        spans = qs.get("spans", ["0"])[0] not in ("0", "", "false")
+        return 200, fr.summary(spans=spans), None
+    if path == "/debug/fleet":
+        # answered for real by a RouterServer (its own handler builds
+        # the payload); on other servers this mount reports whether a
+        # router lives in-process (the dashboard's fleet.html reads it)
+        from ..obs import fleet
+
+        payload = fleet.fleet_payload()
+        if payload is None:
+            return 404, {"message": "no router in this process "
+                         "(curl the router's /debug/fleet)"}, None
+        return 200, payload, None
     if path == "/debug/profile":
         from ..obs import timeline
 

@@ -54,10 +54,21 @@ from ..obs import (
     REPLICA_UP,
     ROUTER_ADMISSION_TOTAL,
     TRACE_HEADER,
+    FlightRecorder,
+    fleet,
+    get_registry,
+    get_tracer,
+    metrics_enabled,
+    new_trace_id,
+    timeline,
 )
 from ..resilience.policy import CircuitBreaker
 from .eventloop import EventLoopHTTPServer, callback_scope
-from .http_base import HTTPServerBase, observability_response
+from .http_base import (
+    HTTPServerBase,
+    PROMETHEUS_CTYPE,
+    observability_response,
+)
 from .microbatch import EwmaEstimator
 
 __all__ = [
@@ -81,7 +92,9 @@ class RouterConfig:
                  breaker_reset_s: float = 2.0,
                  max_connections: int = 1024,
                  workers: int = 16,
-                 push_foldin_s: Optional[float] = None):
+                 push_foldin_s: Optional[float] = None,
+                 scrape_metrics: bool = True,
+                 slo_ms: Optional[float] = None):
         self.host = host
         self.port = port
         self.health_interval_s = health_interval_s
@@ -96,6 +109,14 @@ class RouterConfig:
         # optional timer driving the rolling fold-in push (the same
         # walk POST /admin/push-foldin triggers on demand)
         self.push_foldin_s = push_foldin_s
+        # pio-lens: the health loop also pulls each replica's /metrics
+        # and merges the parsed states into the router's own GET
+        # /metrics (Prometheus-federation style — ONE scrape answers
+        # for the fleet); slo_ms additionally arms the router-side
+        # pio_slo_burn_rate{window} gauges on the forward round-trip
+        # histogram
+        self.scrape_metrics = scrape_metrics
+        self.slo_ms = slo_ms
 
 
 class Replica:
@@ -124,6 +145,19 @@ class Replica:
         self.forwarded = 0
         self.errors = 0
         self.failovers = 0
+        # pio-lens: the replica's last successfully scraped + parsed
+        # /metrics state (a dump_state()-shaped dict).  Rebound whole
+        # on every good scrape, never mutated — readers (the merged
+        # exposition, the fleet tail table) see the old snapshot or
+        # the new one, and a replica that dies mid-scrape keeps its
+        # last good snapshot standing (cumulative values, so the
+        # merged counters stay monotone).
+        self.metrics_state: Optional[dict] = None
+        self.scrape_errors = 0
+        self.last_scrape_at: Optional[float] = None
+        self.last_scrape_error: Optional[str] = None
+        self._m_scrape_err = fleet.REPLICA_SCRAPE_ERRORS.labels(
+            replica=name)
         self._m_up = REPLICA_UP.labels(replica=name)
         self._m_fresh = REPLICA_MODEL_FRESHNESS.labels(replica=name)
         self._m_ok = REPLICA_REQUESTS_TOTAL.labels(
@@ -138,9 +172,17 @@ class Replica:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
-    def _connect(self) -> http.client.HTTPConnection:
+    def _connect(self, timeout_s: Optional[float] = None
+                 ) -> http.client.HTTPConnection:
+        # fresh connections honor the CALLER's timeout (pio-lens fix):
+        # a SIGSTOPped replica accepts the TCP handshake from its
+        # kernel backlog and then never answers — with the default 30s
+        # here, one stalled replica used to wedge every health sweep
+        # (and the metrics scrape behind it) for 30s per tick
         c = http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout_s
+            self.host, self.port,
+            timeout=timeout_s if timeout_s is not None
+            else self.timeout_s,
         )
         c.connect()
         c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -148,15 +190,21 @@ class Replica:
 
     def request(self, method: str, path: str, body: Optional[bytes],
                 headers: Optional[dict] = None,
-                timeout_s: Optional[float] = None) -> tuple[int, bytes, str]:
+                timeout_s: Optional[float] = None,
+                tl=None) -> tuple[int, bytes, str]:
         """One upstream round trip on a pooled keep-alive connection.
         Transport trouble raises OSError/http.client exceptions — the
         router's failover signal; HTTP error statuses return normally
-        (an application 4xx/5xx is the replica's answer, not a death)."""
+        (an application 4xx/5xx is the replica's answer, not a death).
+
+        ``tl`` (a pulse Timeline, pio-lens) books the round trip's
+        interior split: ``forward`` = pool/connect + request send,
+        ``replica`` = waiting on the replica's response head (its
+        serve time), ``read`` = draining the body."""
         with self._lock:
             conn = self._pool.pop() if self._pool else None
         if conn is None:
-            conn = self._connect()
+            conn = self._connect(timeout_s)
         elif timeout_s is not None and conn.sock is not None:
             conn.sock.settimeout(timeout_s)
         try:
@@ -164,8 +212,14 @@ class Replica:
             if headers:
                 hdrs.update(headers)
             conn.request(method, path, body, headers=hdrs)
+            if tl is not None:
+                tl.mark("forward")
             r = conn.getresponse()
+            if tl is not None:
+                tl.mark("replica")
             data = r.read()
+            if tl is not None:
+                tl.mark("read")
             ctype = r.getheader("Content-Type",
                                 "application/json") or "application/json"
             status = r.status
@@ -209,6 +263,29 @@ class Replica:
         if fresh is not None:
             self._m_fresh.set(float(fresh))
 
+    def scrape(self, timeout_s: float) -> bool:
+        """Pull + parse this replica's ``/metrics`` into
+        :attr:`metrics_state` (pio-lens).  Any failure — transport,
+        HTTP status, exposition grammar — books a scrape error and
+        leaves the previous snapshot standing; health marking is the
+        health check's job, not the scrape's."""
+        try:
+            status, data, _ = self.request(
+                "GET", "/metrics", None, timeout_s=timeout_s,
+            )
+            if status != 200:
+                raise RuntimeError(f"/metrics answered {status}")
+            state = fleet.parse_prometheus(data.decode())
+        except Exception as e:
+            self.scrape_errors += 1
+            self.last_scrape_error = f"{type(e).__name__}: {e}"
+            self._m_scrape_err.inc()
+            return False
+        self.metrics_state = state
+        self.last_scrape_at = time.time()
+        self.last_scrape_error = None
+        return True
+
     def snapshot(self) -> dict:
         out = {
             "name": self.name,
@@ -219,6 +296,8 @@ class Replica:
             "errors": self.errors,
             "failovers": self.failovers,
         }
+        if self.scrape_errors:
+            out["scrapeErrors"] = self.scrape_errors
         if self.last_error:
             out["lastError"] = self.last_error
         st = self.last_status
@@ -385,6 +464,20 @@ class RouterServer(HTTPServerBase):
         self._m_adm_ok = ROUTER_ADMISSION_TOTAL.labels(outcome="admitted")
         self._m_adm_rej = ROUTER_ADMISSION_TOTAL.labels(
             outcome="rejected")
+        # pio-lens: the router's own flight recorder — worst-N proxied
+        # requests with per-replica attribution (which replica served,
+        # its round trip vs its self-reported segment split, the EWMA
+        # estimate at admission time).  A separate instance from the
+        # process-global recorder so an in-process replica's serve.query
+        # offers never crowd out the fleet view.
+        self.flight = FlightRecorder()
+        self._m_forward = fleet.ROUTER_FORWARD_SECONDS.child()
+        self._burn = None
+        if self.config.slo_ms:
+            self._burn = fleet.install_burn_rate(
+                self._m_forward, self.config.slo_ms / 1e3
+            )
+        fleet.set_fleet_provider(self.fleet_payload)
         self._health_thread: Optional[threading.Thread] = None
         self._push_thread: Optional[threading.Thread] = None
 
@@ -435,6 +528,10 @@ class RouterServer(HTTPServerBase):
     def stop(self) -> None:
         super().stop()
         self._stop_event.set()
+        # clear the provider only if WE are still the installed one (a
+        # second router in the same process may have replaced it)
+        if getattr(fleet, "_fleet_provider", None) == self.fleet_payload:
+            fleet.set_fleet_provider(None)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
@@ -459,12 +556,28 @@ class RouterServer(HTTPServerBase):
         for r in self.replicas:
             self.check_replica(r)
 
+    def scrape_all(self) -> None:
+        """pio-lens: pull every replica's /metrics on the pooled
+        keep-alive connections.  A dead replica's scrape fails fast
+        (connection refused — one attempt per sweep, same cost as its
+        health probe), books ``pio_replica_scrape_errors_total`` and
+        leaves its last good snapshot standing in the merged
+        exposition — cumulative values, so the fleet counters stay
+        monotone through the death."""
+        for r in self.replicas:
+            r.scrape(self.config.health_timeout_s)
+
     def _health_loop(self) -> None:
         while not self._stop_event.wait(self.config.health_interval_s):
             try:
                 self.check_all()
             except Exception:
                 logger.exception("router health sweep failed")
+            if self.config.scrape_metrics:
+                try:
+                    self.scrape_all()
+                except Exception:
+                    logger.exception("router metrics scrape failed")
             if self.supervisor is not None:
                 try:
                     self.supervisor.tick(self.replicas)
@@ -579,19 +692,32 @@ class RouterServer(HTTPServerBase):
             respond(503, {"message": "router is stopping"})
 
     def _forward_query(self, path_qs: str, body: bytes,
-                       trace_id: Optional[str], respond) -> None:
+                       trace_id: Optional[str], respond,
+                       tl=None, est_at_admission: float = 0.0) -> None:
         """Worker-pool half of the hot path: try candidates in order
         until one answers; transport failures fail over with the
-        replica marked down."""
+        replica marked down.
+
+        pio-lens: the request's Timeline accumulates the
+        ``forward/replica/read`` split (inside ``Replica.request``),
+        the successful round trip feeds the forward histogram (with
+        the trace id as its bucket exemplar) and a ``router.forward``
+        span, and the finished request is offered to the router's
+        flight recorder with the serving replica's name + the EWMA
+        estimate admission saw — the per-replica tail attribution
+        ROADMAP 1(c) asks for."""
         headers = {TRACE_HEADER: trace_id} if trace_id else None
+        hdrs_out = [(TRACE_HEADER, trace_id)] if trace_id else []
         candidates = self._candidates()
         last_err = "no replicas configured"
+        failed: list[str] = []
         for i, replica in enumerate(candidates):
             t0 = time.perf_counter()
+            wall0 = time.time()
             try:
                 status, data, ctype = replica.request(
                     "POST", path_qs, body, headers=headers,
-                    timeout_s=self.config.forward_timeout_s,
+                    timeout_s=self.config.forward_timeout_s, tl=tl,
                 )
             except Exception as e:
                 last_err = f"{replica.name}: {type(e).__name__}: {e}"
@@ -599,18 +725,54 @@ class RouterServer(HTTPServerBase):
                 replica._m_fail.inc()
                 replica.failovers += 1
                 replica.mark_down(last_err)
+                failed.append(replica.name)
                 continue
             if not replica.healthy:
                 replica.mark_up(replica.last_status)
             replica.forwarded += 1
+            rt = time.perf_counter() - t0
             # feed the admission estimator with the fleet's actual
             # round-trip time (success paths only: a failover's
             # timeout would teach the estimator to shed everything)
             with self._ewma_lock:
-                self._ewma_forward.observe(time.perf_counter() - t0)
+                self._ewma_forward.observe(rt)
+            self._m_forward.observe(rt, exemplar=trace_id)
             (replica._m_ok if status < 500 else replica._m_err).inc()
+            tracer = get_tracer()
+            tracer.record(
+                "router.forward", rt, trace_id=trace_id,
+                attrs={"replica": replica.name, "status": status},
+                start=wall0,
+            )
+            if tl is not None:
+                total = tl.elapsed()
+                attrs = {
+                    "replica": replica.name,
+                    "status": status,
+                    "ewmaAtAdmissionSec": round(est_at_admission, 6),
+                    "roundTripSec": round(rt, 6),
+                    "segmentsMs": tl.snapshot_ms(),
+                }
+                if failed:
+                    # the tail-attribution fix for failover: a request
+                    # that waited out a stalled replica's timeout and
+                    # then succeeded elsewhere names the replica that
+                    # ATE the time, not just the one that answered
+                    attrs["failedReplicas"] = failed
+                if i:
+                    attrs["failovers"] = i
+                tracer.record(
+                    "router.request", total, trace_id=trace_id,
+                    attrs=attrs, start=time.time() - total,
+                )
+                # offer AFTER the spans land so an admitted record's
+                # captured tree holds them
+                self.flight.offer(
+                    trace_id, total, name="router.request", attrs=attrs,
+                )
             try:
-                respond(status, data, ctype=ctype)
+                respond(status, data, ctype=ctype,
+                        extra_headers=hdrs_out, tl=tl)
             except RuntimeError:
                 pass
             return
@@ -619,9 +781,133 @@ class RouterServer(HTTPServerBase):
             respond(503, {
                 "message": f"no replica available ({last_err})",
                 "error": "NoReplicaAvailable",
-            }, extra_headers=[("Retry-After", "1")])
+            }, extra_headers=hdrs_out + [("Retry-After", "1")])
         except RuntimeError:
             pass
+
+    # -- pio-lens: merged exposition + fleet tail view ---------------------
+    def render_fleet_metrics(self) -> bytes:
+        """The router's ``GET /metrics`` body: local registry state
+        merged with every replica's last scraped snapshot via
+        ``registry.merge_states`` (counters/histograms sum exactly,
+        gauges gain ``{replica}`` labels) and rendered through the ONE
+        shared renderer — so ``pio_queries_total`` on the router equals
+        the sum of the replicas' and percentile re-derivation over the
+        merged buckets is exact.  A schema drift between replicas
+        degrades to the local exposition LOUDLY rather than 500ing the
+        scrape."""
+        tagged = [("router", get_registry().dump_state())]
+        for r in self.replicas:
+            state = r.metrics_state
+            if state is not None:
+                tagged.append((r.name, state))
+        try:
+            return fleet.render_fleet(tagged).encode()
+        except ValueError as e:
+            logger.warning(
+                "fleet metrics merge failed (%s); serving the "
+                "router-local exposition", e,
+            )
+            return get_registry().render_prometheus().encode()
+
+    def _replica_tail_entry(self, r: Replica) -> dict:
+        entry = r.snapshot()
+        entry["respawns"] = REPLICA_RESPAWNS_TOTAL.labels(
+            replica=r.name).value()
+        state = r.metrics_state
+        if state is not None:
+            hist = fleet.state_histogram(
+                state, "pio_query_latency_seconds")
+            if hist and hist["count"]:
+                entry["p50Ms"] = round(
+                    fleet.hist_quantile(hist, 50) * 1e3, 3)
+                entry["p99Ms"] = round(
+                    fleet.hist_quantile(hist, 99) * 1e3, 3)
+                entry["latencyCount"] = hist["count"]
+            entry["queriesTotal"] = fleet.state_counter_total(
+                state, "pio_queries_total")
+            if r.last_scrape_at is not None:
+                entry["scrapeAgeSec"] = round(
+                    max(time.time() - r.last_scrape_at, 0.0), 3)
+        if r.last_scrape_error:
+            entry["lastScrapeError"] = r.last_scrape_error
+        return entry
+
+    def _enrich_worst(self, worst: list) -> list:
+        """Lazily join each worst-N record with the serving replica's
+        OWN view of that trace: ``GET /debug/flight?trace=<id>`` on
+        the replica answers its flight record, whose ``segmentsMs``
+        decomposition sits next to the router's round trip — the
+        queue-vs-device split of a fleet tail entry without shipping
+        every span through the router.  Fetched once per record and
+        cached back into the router's flight attrs."""
+        by_name = {r.name: r for r in self.replicas}
+        for w in worst[:8]:
+            attrs = w.get("attrs") or {}
+            if "replicaSegmentsMs" in attrs or "replica" not in attrs:
+                continue
+            replica = by_name.get(attrs["replica"])
+            if replica is None or not replica.healthy:
+                continue
+            try:
+                status, data, _ = replica.request(
+                    "GET",
+                    f"/debug/flight?trace="
+                    f"{urllib.parse.quote(w['traceId'])}",
+                    None, timeout_s=self.config.health_timeout_s,
+                )
+                if status != 200:
+                    continue
+                rec = json.loads(data.decode()).get("record")
+            except Exception:
+                continue
+            if not rec:
+                continue
+            extra = {
+                "replicaDurationSec": rec.get("durationSec"),
+                "replicaSegmentsMs": (rec.get("attrs") or {}).get(
+                    "segmentsMs"),
+            }
+            self.flight.annotate(w["traceId"], extra)
+            attrs.update(extra)
+            w["attrs"] = attrs
+        return worst
+
+    def fleet_payload(self) -> dict:
+        """``GET /debug/fleet``: how is the fleet doing and who is
+        slow — per-replica tail table (scrape-derived p50/p99, breaker
+        + respawn state) plus the router flight recorder's worst-N
+        with per-replica attribution and lazily fetched replica
+        segment splits."""
+        summary = self.flight.summary()
+        out = {
+            "role": "router",
+            "replicas": [
+                self._replica_tail_entry(r) for r in self.replicas
+            ],
+            "healthyReplicas": sum(r.healthy for r in self.replicas),
+            "requestCount": self.request_count,
+            "unroutable": self.unroutable,
+            "admissionRejected": self.admission_rejected,
+            "ewmaForwardSec": self._ewma_forward.value,
+            "scrapeErrors": sum(r.scrape_errors for r in self.replicas),
+            "flight": {
+                "capacity": summary["capacity"],
+                "offers": summary["offers"],
+                "admissions": summary["admissions"],
+            },
+            "worst": self._enrich_worst(summary["worst"]),
+        }
+        if self.config.slo_ms:
+            out["sloMs"] = self.config.slo_ms
+            if self._burn is not None:
+                out["burnRate"] = {
+                    name: round(self._burn.rate(secs), 4)
+                    for name, secs in fleet.BURN_WINDOWS
+                }
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.summary()
+        return out
 
     # -- http --------------------------------------------------------------
     def status_json(self) -> dict:
@@ -647,20 +933,25 @@ class RouterServer(HTTPServerBase):
         path = u.path
         if req.method == "POST" and path == "/queries.json":
             self.request_count += 1  # loop-thread only: no lock needed
-            tid = (req.header(TRACE_HEADER) or "").strip() or None
+            # pio-lens: the router MINTS a trace id when the client
+            # didn't bring one — every proxied request is stitchable
+            # across router + replica journals (tools/tracecat.py)
+            tid = (req.header(TRACE_HEADER) or "").strip() \
+                or new_trace_id()
             body = req.body
+            tl = timeline.Timeline("router")
             # router-level deadline admission: a ?timeout= request the
             # EWMA forward estimate already exceeds is a doomed
             # round-trip — answer the structured 503 the replica edge
             # would have, one hop earlier and without spending a
             # replica on it.  No timeout (or a cold estimator) admits.
+            est = self._ewma_forward.value
             tv = urllib.parse.parse_qs(u.query).get("timeout")
             if tv:
                 try:
                     budget = float(tv[0])
                 except ValueError:
                     budget = None
-                est = self._ewma_forward.value
                 if budget is not None and est > 0.0 and (
                     budget <= 0.0 or est > budget
                 ):
@@ -673,16 +964,19 @@ class RouterServer(HTTPServerBase):
                             f"{budget * 1e3:.1f}ms request budget"
                         ),
                         "error": "AdmissionRejected",
-                    }, extra_headers=[("Retry-After", "1")])
+                    }, extra_headers=[("Retry-After", "1"),
+                                      (TRACE_HEADER, tid)])
                     return
                 self._m_adm_ok.inc()
+            tl.mark("admission")
             pool = self._pool
             if pool is None:
                 respond(503, {"message": "router is stopping"})
                 return
             try:
                 pool.submit(
-                    self._forward_query, req.path, body, tid, respond
+                    self._forward_query, req.path, body, tid, respond,
+                    tl, est,
                 )
             except RuntimeError:
                 respond(503, {"message": "router is stopping"})
@@ -760,6 +1054,58 @@ class RouterServer(HTTPServerBase):
         if req.method == "POST" and path == "/stop":
             respond(200, {"message": "stopping"})
             threading.Thread(target=self.stop, daemon=True).start()
+            return
+        if req.method == "GET" and path == "/metrics":
+            # pio-lens: the router's exposition is the FLEET's — local
+            # registry state merged with every replica's last scraped
+            # snapshot (counters/histograms sum, gauges labeled
+            # {replica}); render on the pool, not the loop
+            if not metrics_enabled():
+                respond(404, {"message":
+                              "metrics disabled (--no-metrics)"})
+                return
+            pool = self._pool
+            if pool is None:
+                respond(503, {"message": "router is stopping"})
+                return
+
+            def metrics():
+                try:
+                    respond(200, self.render_fleet_metrics(),
+                            ctype=PROMETHEUS_CTYPE)
+                except RuntimeError:
+                    pass
+
+            try:
+                pool.submit(metrics)
+            except RuntimeError:
+                respond(503, {"message": "router is stopping"})
+            return
+        if req.method == "GET" and path == "/debug/fleet":
+            # the fleet tail view: per-replica p50/p99 + worst-N with
+            # replica attribution; lazy replica /debug/flight fetches
+            # block, so pool it
+            pool = self._pool
+            if pool is None:
+                respond(503, {"message": "router is stopping"})
+                return
+
+            def dbg():
+                try:
+                    respond(200, self.fleet_payload())
+                except RuntimeError:
+                    pass
+                except Exception as e:
+                    logger.exception("/debug/fleet failed")
+                    try:
+                        respond(500, {"message": str(e)})
+                    except RuntimeError:
+                        pass
+
+            try:
+                pool.submit(dbg)
+            except RuntimeError:
+                respond(503, {"message": "router is stopping"})
             return
         if req.method == "GET":
             ans = observability_response(path, u.query)

@@ -98,7 +98,8 @@ class ServerConfig:
                  retry_seed: Optional[int] = None,
                  foldin_poll_s: Optional[float] = None,
                  edge: str = "eventloop",
-                 max_connections: int = 512):
+                 max_connections: int = 512,
+                 slo_ms: Optional[float] = None):
         self.host = host
         self.port = port
         # pio-surge: which HTTP front end answers the port.
@@ -145,6 +146,10 @@ class ServerConfig:
         # stop-the-world reload).  None = off; deltas already on disk
         # at (re)load time are still caught up once.
         self.foldin_poll_s = foldin_poll_s
+        # pio-lens: latency SLO in milliseconds — arms the
+        # pio_slo_burn_rate{window} gauges on this server's end-to-end
+        # latency histogram (None = no SLO, gauges stay absent)
+        self.slo_ms = slo_ms
 
 
 class _QueryCtx:
@@ -405,6 +410,17 @@ class EngineServer(HTTPServerBase):
                       "rejected", "quota", "shed")
         }
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # pio-lens: --slo-ms arms the error-budget burn-rate gauges on
+        # the process-wide latency histogram (the replica-side half of
+        # the fleet's alert-ready signal; the router arms its own on
+        # the forward round-trip histogram)
+        self._burn = None
+        if self.config.slo_ms:
+            from ..obs import fleet
+
+            self._burn = fleet.install_burn_rate(
+                self._m_latency, self.config.slo_ms / 1e3
+            )
         # pio-xray: compile/cache events during warmup+serving book into
         # /metrics, and the daemon device sampler keeps the per-device
         # memory gauges fresh (registered like the breaker gauges above)
@@ -943,7 +959,12 @@ class EngineServer(HTTPServerBase):
             self.tenants.online.impression(
                 lease.runtime.spec.app, lease.variant
             )
-        get_tracer().record("serve.query", dt, attrs=attrs)
+        # start is back-dated to the request's beginning (pio-lens):
+        # tracecat nests spans by interval containment across
+        # processes, so serve.query must COVER its measured window,
+        # not sit at its end
+        get_tracer().record("serve.query", dt, attrs=attrs,
+                            start=time.time() - dt)
         get_flight_recorder().offer(
             tid, dt, name="serve.query", attrs=attrs
         )

@@ -48,6 +48,11 @@ from typing import Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from ..obs import (
+    STORE_SHARD_ROWS,
+    STORE_SHARD_SCAN_SECONDS,
+    STORE_SHARD_WRITE_SECONDS,
+)
 from .columnar import EventFrame
 from .event import Event
 from .levents import EventStore, TargetFilter
@@ -116,6 +121,23 @@ class ShardedSQLiteEventStore(EventStore):
             SQLiteEventStore(self._dir / f"shard-{i}.db")
             for i in range(n_shards)
         ]
+        # pio-lens satellite: per-shard instrumentation, children
+        # resolved once (labels() is too hot for the write path).  The
+        # row gauge tracks THIS process's write-minus-delete delta —
+        # the ingestion-skew signal ROADMAP item 3's partitioned write
+        # path will be judged by, not a table count.
+        self._m_write = [
+            STORE_SHARD_WRITE_SECONDS.labels(shard=str(i))
+            for i in range(n_shards)
+        ]
+        self._m_scan = [
+            STORE_SHARD_SCAN_SECONDS.labels(shard=str(i))
+            for i in range(n_shards)
+        ]
+        self._m_rows = [
+            STORE_SHARD_ROWS.labels(shard=str(i))
+            for i in range(n_shards)
+        ]
 
     # -- routing ----------------------------------------------------------
     def _shard(self, entity_type: str, entity_id: str) -> SQLiteEventStore:
@@ -145,9 +167,15 @@ class ShardedSQLiteEventStore(EventStore):
     # -- writes -----------------------------------------------------------
     def insert(self, event: Event, app_id: int, channel_id: int = 0,
                validate: bool = True) -> str:
-        return self._shard(event.entity_type, event.entity_id).insert(
+        six = _shard_ix(event.entity_type, event.entity_id,
+                        self.n_shards)
+        t0 = time.perf_counter()
+        eid = self.shards[six].insert(
             event, app_id, channel_id, validate=validate
         )
+        self._m_write[six].observe(time.perf_counter() - t0)
+        self._m_rows[six].inc()
+        return eid
 
     def insert_batch(
         self, events, app_id: int, channel_id: int = 0,
@@ -177,10 +205,13 @@ class ShardedSQLiteEventStore(EventStore):
         # wins).
         with self.bulk(defer_indexes=False):
             for six, positions in groups.items():
+                t0 = time.perf_counter()
                 got = self.shards[six].insert_batch(
                     [events[p] for p in positions], app_id, channel_id,
                     validate=False,
                 )
+                self._m_write[six].observe(time.perf_counter() - t0)
+                self._m_rows[six].inc(len(positions))
                 for p, eid in zip(positions, got):
                     ids[p] = eid
         return ids  # aligned with the input order
@@ -198,7 +229,10 @@ class ShardedSQLiteEventStore(EventStore):
         # for defer_indexes=False: the importer's outer scope defers)
         with self.bulk(defer_indexes=False):
             for six, grp in groups.items():
+                t0 = time.perf_counter()
                 self.shards[six].insert_raw_rows(grp, app_id, channel_id)
+                self._m_write[six].observe(time.perf_counter() - t0)
+                self._m_rows[six].inc(len(grp))
 
     @contextlib.contextmanager
     def bulk(self, defer_indexes: bool = True):
@@ -223,17 +257,25 @@ class ShardedSQLiteEventStore(EventStore):
         # by entity, so cross-shard OR-REPLACE cannot dedup them — a
         # documented semantic drift from the single store); delete must
         # remove every copy, not the first one found
-        return any([
+        removed = [
             s.delete(event_id, app_id, channel_id) for s in self.shards
-        ])
+        ]
+        for i, ok in enumerate(removed):
+            if ok:
+                self._m_rows[i].dec()
+        return any(removed)
 
     def delete_batch(
         self, event_ids: Iterable[str], app_id: int, channel_id: int = 0
     ) -> int:
         ids = list(event_ids)
-        return sum(
-            s.delete_batch(ids, app_id, channel_id) for s in self.shards
-        )
+        total = 0
+        for i, s in enumerate(self.shards):
+            n = s.delete_batch(ids, app_id, channel_id)
+            if n:
+                self._m_rows[i].dec(n)
+            total += n
+        return total
 
     # -- scans ------------------------------------------------------------
     def find(
@@ -434,10 +476,13 @@ class ShardedSQLiteEventStore(EventStore):
             import concurrent.futures
 
             def scan(i):
-                return self.shards[i].find_rows_since(
+                t0 = time.perf_counter()
+                out = self.shards[i].find_rows_since(
                     app_id, channel_id, cursor=per_shard[i],
                     event_names=event_names, newest_first=newest_first,
                 )
+                self._m_scan[i].observe(time.perf_counter() - t0)
+                return out
 
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(self.n_shards, 8),
@@ -454,11 +499,13 @@ class ShardedSQLiteEventStore(EventStore):
         for i, shard in enumerate(self.shards):
             if remaining is not None and remaining <= 0:
                 break
+            t0 = time.perf_counter()
             rows, nc = shard.find_rows_since(
                 app_id, channel_id, cursor=per_shard[i],
                 limit=remaining, event_names=event_names,
                 newest_first=newest_first,
             )
+            self._m_scan[i].observe(time.perf_counter() - t0)
             out_rows.extend(rows)
             new_cursor[i] = int(nc)
             if remaining is not None:

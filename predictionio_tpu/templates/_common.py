@@ -6,8 +6,18 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["DeviceTableMixin", "filter_bias_mask", "pow2_ladder",
-           "warm_batched_topk"]
+__all__ = ["DeviceTableMixin", "filter_bias_mask", "normalize_rows",
+           "pow2_ladder", "warm_batched_topk"]
+
+
+def normalize_rows(table: np.ndarray) -> np.ndarray:
+    """Row-normalize a factor table in f32 — the shared train-time
+    step of the normalized-table cosine path (itemsimilarity and,
+    since pio-lens, similarproduct): inner product over the stored
+    table IS cosine, so the exact scorer and the two-stage int8/IVF
+    retriever serve cosine with no per-query normalization."""
+    t = np.asarray(table, np.float32)
+    return t / (np.linalg.norm(t, axis=-1, keepdims=True) + 1e-9)
 
 
 class DeviceTableMixin:

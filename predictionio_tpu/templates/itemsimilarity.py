@@ -37,8 +37,8 @@ from ..controller import (
 )
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
-from ._common import DeviceTableMixin, filter_bias_mask, pow2_ladder, \
-    warm_batched_topk
+from ._common import DeviceTableMixin, filter_bias_mask, \
+    normalize_rows, pow2_ladder, warm_batched_topk
 from .recommendation import (
     ItemScore,
     PredictedResult,
@@ -99,11 +99,6 @@ class ItemSimilarityModel(DeviceTableMixin):
     def sanity_check(self) -> None:
         if not np.isfinite(self.item_factors).all():
             raise ValueError("item factors contain non-finite values")
-
-
-def normalize_rows(table: np.ndarray) -> np.ndarray:
-    t = np.asarray(table, np.float32)
-    return t / (np.linalg.norm(t, axis=-1, keepdims=True) + 1e-9)
 
 
 class ItemSimilarityAlgorithm(Algorithm):
@@ -293,6 +288,32 @@ def itemsimilarity_engine() -> Engine:
     )
 
 
+def itemsimilarity_evaluation(app_name: str = "MyApp", k: int = 10,
+                              holdout: float = 0.3):
+    """MAP@k evaluation binding (ROADMAP 4(b)): `pio-tpu eval --engine
+    itemsimilarity` sweeps the exact scorer against the two-stage IVF
+    retriever on a leave-some-out co-view split — the eval leg's
+    answer to "does the ANN path cost ranking quality here"."""
+    from ..controller import Evaluation
+    from ..controller.metrics import MAPatK
+
+    engine = itemsimilarity_engine()
+    eps = []
+    for retrieval in ("exact", "ivf"):
+        eps.append(engine.params_from_variant({
+            "datasource": {"params": {
+                "appName": app_name,
+                "evalHoldout": holdout, "evalNum": k,
+            }},
+            "algorithms": [{"name": "cosine", "params": {
+                "rank": 8, "numIterations": 5, "lambda": 0.05,
+                "alpha": 2.0, "seed": 3, "retrieval": retrieval,
+                "candidateFactor": 10, "nprobe": 8,
+            }}],
+        }))
+    return Evaluation(engine, MAPatK(k), engine_params_list=eps)
+
+
 # -- pio-forge registration -------------------------------------------------
 
 
@@ -323,6 +344,7 @@ itemsimilarity_engine = engine_spec(
         ],
     },
     query_example={"items": ["1"], "num": 4},
+    evaluation=itemsimilarity_evaluation,
     conformance=ConformanceFixture(
         app_name="forge-conf",
         seed_events=_conformance_events,

@@ -31,7 +31,8 @@ from ..controller import (
 from ..models.als import ALSConfig, train_als
 from ..ops.topk import batch_topk_scores, pow2_ceil, topk_scores
 
-from ._common import DeviceTableMixin, filter_bias_mask, warm_batched_topk
+from ._common import DeviceTableMixin, filter_bias_mask, \
+    normalize_rows, warm_batched_topk
 from .recommendation import (
     PredictedResult,
     _resolve_app_id,
@@ -66,6 +67,18 @@ class SimilarDataSourceParams(Params):
     app_name: str = ""
     app_id: int = -1
     view_events: tuple[str, ...] = ("view",)
+    # ranking eval (pio-lens satellite; ROADMAP 4(b)): hold out a
+    # seeded evalHoldout fraction of each user's co-viewed items, query
+    # with one kept item, score MAP@evalNum against the held-out set
+    eval_holdout: float = 0.0
+    eval_num: int = 10
+    eval_seed: int = 7
+
+    def __post_init__(self) -> None:
+        if not 0.0 <= self.eval_holdout < 1.0:
+            raise ValueError(
+                f"evalHoldout must be in [0, 1), got {self.eval_holdout}"
+            )
 
 
 @dataclass
@@ -107,6 +120,62 @@ class SimilarProductDataSource(DataSource):
         }
         return SimilarTrainingData(ratings=ratings, items=items)
 
+    def read_eval(self, ctx: WorkflowContext):
+        """Leave-some-out co-view split: per user with >= 2 distinct
+        items, a seeded ``evalHoldout`` fraction of their (user, item)
+        pairs is held out of training; the query anchors on one KEPT
+        item and the held-out items are the relevant set MAP@k scores
+        against.  Shared by the similarproduct and itemsimilarity
+        engines (same DataSource)."""
+        p: SimilarDataSourceParams = self.params
+        if p.eval_holdout <= 0:
+            return []
+        from ..controller.metrics import ActualItems
+        from ..storage.columnar import Ratings
+
+        data = self.read_training(ctx)
+        ratings = data.ratings
+        rng = np.random.default_rng(p.eval_seed)
+        hold_mask = np.zeros(len(ratings), bool)
+        by_user: dict[int, list[int]] = {}
+        for pos, u in enumerate(ratings.user_ix):
+            by_user.setdefault(int(u), []).append(pos)
+        qa = []
+        for _u, positions in sorted(by_user.items()):
+            if len(positions) < 2:
+                continue
+            k_hold = min(
+                max(int(round(len(positions) * p.eval_holdout)), 1),
+                len(positions) - 1,
+            )
+            perm = rng.permutation(len(positions))
+            held = [positions[i] for i in perm[:k_hold]]
+            kept = [positions[i] for i in perm[k_hold:]]
+            hold_mask[held] = True
+            anchor = str(ratings.items.id_of(
+                int(ratings.item_ix[kept[0]])
+            ))
+            actual = tuple(sorted(
+                str(ratings.items.id_of(int(ratings.item_ix[h])))
+                for h in held
+            ))
+            qa.append((
+                Query(items=(anchor,), num=p.eval_num),
+                ActualItems(items=actual),
+            ))
+        if not qa:
+            return []
+        keep = ~hold_mask
+        train = Ratings(
+            user_ix=ratings.user_ix[keep],
+            item_ix=ratings.item_ix[keep],
+            rating=ratings.rating[keep],
+            users=ratings.users,
+            items=ratings.items,
+        )
+        td = SimilarTrainingData(ratings=train, items=data.items)
+        return [(td, {"holdout": p.eval_holdout, "users": len(qa)}, qa)]
+
 
 @dataclass(frozen=True)
 class SimilarALSParams(Params):
@@ -133,6 +202,14 @@ class SimilarALSParams(Params):
 
 @dataclass
 class SimilarALSModel(DeviceTableMixin):
+    """``item_factors`` is row-NORMALIZED at train time (the
+    normalized-table path itemsimilarity proved out, migrated here per
+    ROADMAP 2(d)): inner product over the stored table IS cosine, so
+    scoring needs no per-query table normalization and the table is
+    directly servable by the two-stage int8/IVF retriever.  Legacy
+    ``.npz`` models saved by the pre-migration template (raw factors)
+    are normalized once at load."""
+
     item_factors: np.ndarray
     items: Any  # StringIndex
     item_props: dict[str, dict]
@@ -162,7 +239,7 @@ class SimilarProductAlgorithm(Algorithm):
             mesh=ctx.mesh,
         )
         return SimilarALSModel(
-            item_factors=factors.item_factors,
+            item_factors=normalize_rows(factors.item_factors),
             items=data.ratings.items,
             item_props=data.items,
         )
@@ -175,6 +252,10 @@ class SimilarProductAlgorithm(Algorithm):
             path,
             item_factors=model.item_factors,
             item_ids=model.items.ids.astype(str),
+            # normalized-table marker: load_model normalizes legacy
+            # files (saved raw by the pre-migration template) exactly
+            # once, and leaves stamped files alone
+            normalized=np.array(True),
         )
         import json as _json
 
@@ -189,21 +270,25 @@ class SimilarProductAlgorithm(Algorithm):
 
         data = np.load(base_dir / manifest["npz"], allow_pickle=False)
         props = _json.loads((base_dir / manifest["props"]).read_text())
+        factors = data["item_factors"]
+        if "normalized" not in data.files or not bool(data["normalized"]):
+            factors = normalize_rows(factors)
         return SimilarALSModel(
-            item_factors=data["item_factors"],
+            item_factors=factors,
             items=StringIndex(list(data["item_ids"])),
             item_props=props,
         )
 
     # -- serving -----------------------------------------------------------
     def warmup(self, model: SimilarALSModel, max_batch: int = 64) -> None:
-        """Pre-compile the cosine top-k scorer (and pre-normalize the
-        device table) for the common ``num`` values — single-query AND
-        the pow2 batched shapes the serving micro-batcher dispatches."""
+        """Pre-compile the cosine top-k scorer for the common ``num``
+        values — single-query AND the pow2 batched shapes the serving
+        micro-batcher dispatches.  The table is train-time normalized,
+        so the plain device table serves cosine directly."""
         n = len(model.items)
         if n == 0:
             return
-        tn = model.device_item_factors_normalized()
+        tn = model.device_item_factors()
         rank = model.item_factors.shape[1]
         vec = np.zeros(rank, np.float32)
         bias = np.zeros(n, np.float32)
@@ -213,7 +298,9 @@ class SimilarProductAlgorithm(Algorithm):
 
     def _query_vec_and_mask(self, model: SimilarALSModel, query: Query):
         """Per-query host work shared by predict/batch_predict: mean of
-        the known query-item factors (normalized) + the filter mask.
+        the known query-item rows (already unit-norm — the mean of
+        normalized rows is itemsimilarity's query semantics, which this
+        template now shares) re-normalized, + the filter mask.
         Returns (None, None) for unanswerable queries."""
         known = [model.items.get(i) for i in query.items]
         known = [i for i in known if i >= 0]
@@ -234,9 +321,9 @@ class SimilarProductAlgorithm(Algorithm):
         if qn is None:
             return PredictedResult(item_scores=())
         k = min(query.num, len(model.items))
-        # cosine: both sides normalized; the table normalization is cached
-        # on the model (computed once, reused every request)
-        tn = model.device_item_factors_normalized()
+        # cosine: both sides normalized — the table at train time, the
+        # query vector per request
+        tn = model.device_item_factors()
         vals, ixs = topk_scores(qn, tn, k, bias=mask)
         return PredictedResult(
             item_scores=decode_item_scores(model.items, vals, ixs)
@@ -268,7 +355,7 @@ class SimilarProductAlgorithm(Algorithm):
         k = min(
             pow2_ceil(max(q.num for q, v in zip(queries, valid) if v)), n
         )
-        tn = model.device_item_factors_normalized()
+        tn = model.device_item_factors()
         vals, ixs = batch_topk_scores(qvecs, tn, k, mask=masks)
         decoded = decode_batch_item_scores(
             model.items, vals, ixs, [q.num for q in queries], valid, k

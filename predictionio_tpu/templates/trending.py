@@ -94,11 +94,21 @@ class TrendingDataSourceParams(Params):
     refresh_s: float = 2.0
     # page size for stores without a parallel scan
     scan_page: int = 50000
+    # ranking eval (pio-lens satellite; ROADMAP 4(b)): hold out the
+    # most recent evalHoldout fraction of the event stream (a TIME
+    # split — trending forecasts the near future, so shuffling would
+    # leak), rank MAP@evalNum against each holdout user's future items
+    eval_holdout: float = 0.0
+    eval_num: int = 10
 
     def __post_init__(self) -> None:
         if self.half_life_s <= 0:
             raise ValueError(
                 f"halfLifeSec must be > 0, got {self.half_life_s}"
+            )
+        if not 0.0 <= self.eval_holdout < 1.0:
+            raise ValueError(
+                f"evalHoldout must be in [0, 1), got {self.eval_holdout}"
             )
 
 
@@ -180,6 +190,60 @@ class TrendingDataSource(DataSource):
             weights=weights, t0=t0, cursor=cursor, app_id=app_id,
             n_events=n,
         )
+
+    def read_eval(self, ctx: WorkflowContext):
+        """Time-split ranking eval: train on the oldest
+        ``1 - evalHoldout`` of the stream, score the trending list's
+        MAP@k against each holdout user's FUTURE items.  One eval set;
+        the trained model never refreshes during eval (the algorithms
+        carry no serving context there), so the holdout cannot leak
+        through the cursor re-scan."""
+        p: TrendingDataSourceParams = self.params
+        if p.eval_holdout <= 0:
+            return []
+        from ..controller.metrics import ActualItems
+
+        app_id = _resolve_app_id(ctx, p)
+        es = ctx.storage.get_event_store()
+        evs = [
+            e for e in es.find(
+                app_id=app_id, channel_id=p.channel_id,
+                event_names=list(p.event_names),
+            )
+            if e.target_entity_id
+        ]
+        evs.sort(key=lambda e: e.event_time)
+        if len(evs) < 4:
+            return []
+        cut = min(
+            max(int(len(evs) * (1.0 - p.eval_holdout)), 1),
+            len(evs) - 1,
+        )
+        train, held = evs[:cut], evs[cut:]
+        t0 = time.time()
+        weights: dict[str, float] = {}
+        for e in train:
+            w = 2.0 ** (
+                (e.event_time.timestamp() - t0) / p.half_life_s
+            )
+            weights[e.target_entity_id] = (
+                weights.get(e.target_entity_id, 0.0) + w
+            )
+        td = TrendingTrainingData(
+            weights=weights, t0=t0, cursor=0, app_id=app_id,
+            n_events=len(train),
+        )
+        by_user: dict[str, set] = {}
+        for e in held:
+            by_user.setdefault(e.entity_id, set()).add(
+                e.target_entity_id
+            )
+        qa = [
+            (Query(num=p.eval_num),
+             ActualItems(items=tuple(sorted(items))))
+            for _user, items in sorted(by_user.items())
+        ]
+        return [(td, {"holdout": p.eval_holdout, "users": len(qa)}, qa)]
 
 
 class TrendingModel:
@@ -423,6 +487,26 @@ def trending_engine() -> Engine:
     )
 
 
+def trending_evaluation(app_name: str = "MyApp", k: int = 10,
+                        holdout: float = 0.2):
+    """MAP@k evaluation binding (ROADMAP 4(b)): `pio-tpu eval --engine
+    trending` scores the trending list against each holdout user's
+    future items on a time split.  ``refreshSec=-1`` pins the eval
+    model to its training window."""
+    from ..controller import Evaluation
+    from ..controller.metrics import MAPatK
+
+    engine = trending_engine()
+    ep = engine.params_from_variant({
+        "datasource": {"params": {
+            "appName": app_name, "refreshSec": -1.0,
+            "evalHoldout": holdout, "evalNum": k,
+        }},
+        "algorithms": [{"name": "trending", "params": {}}],
+    })
+    return Evaluation(engine, MAPatK(k), engine_params_list=[ep])
+
+
 # -- pio-forge registration -------------------------------------------------
 
 
@@ -462,6 +546,7 @@ trending_engine = engine_spec(
         "algorithms": [{"name": "trending", "params": {}}],
     },
     query_example={"num": 10},
+    evaluation=trending_evaluation,
     conformance=ConformanceFixture(
         app_name="forge-conf",
         seed_events=_conformance_events,

@@ -1109,3 +1109,78 @@ def test_sharded_per_entity_order_is_exact(sharded_cursor_store):
 
     batch = scan_new_ratings(s, 1, cursor=0)
     assert batch.values.tolist() == [3.0]  # last write won
+
+
+def test_sharded_per_shard_metrics(tmp_path):
+    """pio-lens satellite: the sharded store books per-shard write and
+    scan latency histograms plus a row-delta gauge, so write skew and
+    hot-shard scans are visible on /metrics."""
+    from predictionio_tpu.obs import (
+        STORE_SHARD_ROWS,
+        STORE_SHARD_SCAN_SECONDS,
+        STORE_SHARD_WRITE_SECONDS,
+    )
+    from predictionio_tpu.storage import ShardedSQLiteEventStore
+    from predictionio_tpu.storage.sharded_events import _shard_ix
+
+    n = 3
+
+    def snap(fam):
+        return {
+            i: fam.labels(shard=str(i)).snapshot()["count"]
+            for i in range(n)
+        }
+
+    def rows_gauge():
+        return {
+            i: STORE_SHARD_ROWS.labels(shard=str(i)).value()
+            for i in range(n)
+        }
+
+    w0, s0, r0 = (snap(STORE_SHARD_WRITE_SECONDS),
+                  snap(STORE_SHARD_SCAN_SECONDS), rows_gauge())
+    s = ShardedSQLiteEventStore(tmp_path / "sh", n_shards=n)
+    s.init_channel(1)
+    evs = [
+        Event(event="rate", entity_type="user", entity_id=f"u{k}",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 1.0}), event_time=_t(k % 50))
+        for k in range(24)
+    ]
+    ids = s.insert_batch(evs, app_id=1)
+    touched = {_shard_ix("user", f"u{k}", n) for k in range(24)}
+    per_shard_written = {
+        i: sum(1 for k in range(24) if _shard_ix("user", f"u{k}", n) == i)
+        for i in range(n)
+    }
+    w1, r1 = snap(STORE_SHARD_WRITE_SECONDS), rows_gauge()
+    # one batched write observation per TOUCHED shard
+    for i in range(n):
+        assert w1[i] - w0[i] == (1 if i in touched else 0)
+        assert r1[i] - r0[i] == per_shard_written[i]
+    # single insert books its one shard
+    extra = Event(event="rate", entity_type="user", entity_id="solo",
+                  target_entity_type="item", target_entity_id="i1",
+                  properties=DataMap({"rating": 5.0}), event_time=_t(55))
+    s.insert(extra, app_id=1)
+    six = _shard_ix("user", "solo", n)
+    assert snap(STORE_SHARD_WRITE_SECONDS)[six] - w0[six] \
+        == (1 if six in touched else 0) + 1
+    assert rows_gauge()[six] - r0[six] == per_shard_written[six] + 1
+    # serial scan: every shard observed once
+    s.find_rows_since(1, cursor=0)
+    s1 = snap(STORE_SHARD_SCAN_SECONDS)
+    assert all(s1[i] - s0[i] == 1 for i in range(n))
+    # parallel scan: every shard observed again
+    s.find_rows_since(1, cursor=0, parallel=True)
+    s2 = snap(STORE_SHARD_SCAN_SECONDS)
+    assert all(s2[i] - s0[i] == 2 for i in range(n))
+    # deletes walk the gauge back down
+    pre_delete = rows_gauge()
+    assert s.delete(ids[0], app_id=1)
+    i0 = _shard_ix("user", "u0", n)
+    assert rows_gauge()[i0] - pre_delete[i0] == -1
+    assert s.delete_batch(ids[1:3], app_id=1) == 2
+    total_delta = sum(rows_gauge().values()) - sum(r0.values())
+    assert total_delta == 24 + 1 - 3
+    s.close()

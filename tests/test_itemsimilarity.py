@@ -182,3 +182,68 @@ def test_retrieval_config_none_for_exact():
     assert _algo(retrieval="exact")._retrieval_config() is None
     cfg = _algo(retrieval="ivf", nprobe=3)._retrieval_config()
     assert cfg.mode == "ivf" and cfg.nprobe == 3
+
+
+# ---------------------------------------------------------------------------
+# MAP@k evaluation binding (pio-lens satellite; ROADMAP 4(b))
+# ---------------------------------------------------------------------------
+
+
+def test_itemsimilarity_eval_binding_sweeps_exact_vs_ivf(
+    storage_memory, tmp_path, monkeypatch
+):
+    """`eval --engine itemsimilarity` sweeps the exact scorer against
+    the IVF retriever under MAP@k on a leave-some-out co-view split;
+    both candidates score positive on clustered co-views and land as
+    candidate records in the tower eval manifest."""
+    import datetime as dt
+
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    from predictionio_tpu import engines
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.obs.runlog import list_runs
+    from predictionio_tpu.storage import Event
+    from predictionio_tpu.templates.itemsimilarity import (
+        itemsimilarity_evaluation,
+    )
+    from predictionio_tpu.workflow.evaluate import run_evaluation
+
+    md = storage_memory.get_metadata()
+    app = md.app_insert("itemsim-eval")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    evs = []
+    # two co-view clusters: even users view even items, odd view odd
+    for u in range(16):
+        cluster = u % 2
+        for j in range(6):
+            evs.append(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=f"i{2 * j + cluster}",
+                event_time=t0 + dt.timedelta(minutes=u * 10 + j),
+            ))
+    es.insert_batch(evs, app_id=app.id)
+
+    assert engines.get_engine_spec("itemsimilarity").evaluation \
+        is itemsimilarity_evaluation
+
+    evaluation = itemsimilarity_evaluation(
+        app_name="itemsim-eval", k=5, holdout=0.34
+    )
+    evaluation.output_path = str(tmp_path / "best.json")
+    assert len(evaluation.engine_params_list) == 2  # exact + ivf
+    ctx = WorkflowContext(storage=storage_memory, mode="Evaluation")
+    eval_id, result = run_evaluation(evaluation, None, ctx=ctx)
+    assert result.metric_header == "MAP@5"
+    # clustered co-views make held-out same-cluster items findable
+    assert 0.0 < result.best_score <= 1.0
+    for _ep, score, _other in result.results:
+        assert 0.0 < score <= 1.0
+    runs = {
+        v["header"]["instanceId"]: v for v in list_runs()
+    }
+    candidates = runs[eval_id]["candidates"]
+    assert len(candidates) == 2
+    assert all(c["metric"] == "MAP@5" for c in candidates)

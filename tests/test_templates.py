@@ -537,3 +537,113 @@ def test_warmup_ladder_covers_batcher_padding():
 
     assert not _takes_max_batch(OldStyle().warmup)
     assert _takes_max_batch(NewStyle().warmup)
+
+
+# ---------------------------------------------------------------------------
+# similarproduct normalized-table migration (pio-lens satellite,
+# ROADMAP 2(d))
+# ---------------------------------------------------------------------------
+
+
+def test_similarproduct_normalized_table_score_parity():
+    """The migrated scorer (train-time normalized table, inner-product
+    scoring) must agree with the OLD path (raw table + query-time
+    normalization) wherever the two are mathematically identical:
+
+    * the stored table rows are exactly the old path's normalized rows;
+    * single-item queries score IDENTICALLY (one row's direction does
+      not depend on when it was normalized);
+    * multi-item queries over equal-norm rows score identically (the
+      mean of equal-norm rows points where the mean of their unit rows
+      does — the general unequal-norm case is the documented semantic
+      refinement to itemsimilarity's query-vector convention).
+    """
+    import jax.numpy as jnp
+
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates.similarproduct import (
+        Query,
+        SimilarALSModel,
+        SimilarProductAlgorithm,
+    )
+
+    rng = np.random.default_rng(11)
+    raw = rng.normal(size=(12, 6)).astype(np.float32)
+    # rows 0 and 1 share a norm so their mean direction is invariant
+    raw[1] *= np.linalg.norm(raw[0]) / np.linalg.norm(raw[1])
+    ids = [f"i{j}" for j in range(12)]
+
+    def old_path_scores(query_items):
+        # the pre-migration formula verbatim: mean of RAW rows,
+        # normalized, against the query-time-normalized table
+        known = [ids.index(i) for i in query_items]
+        qvec = raw[known].mean(axis=0)
+        qn = qvec / (np.linalg.norm(qvec) + 1e-9)
+        tbl = jnp.asarray(raw)
+        tn = np.asarray(
+            tbl / (jnp.linalg.norm(tbl, axis=-1, keepdims=True) + 1e-9)
+        )
+        return tn @ qn
+
+    from predictionio_tpu.templates._common import normalize_rows
+
+    model = SimilarALSModel(
+        item_factors=normalize_rows(raw),
+        items=StringIndex(ids),
+        item_props={},
+    )
+    # the stored table IS the old path's normalized table
+    tbl = jnp.asarray(raw)
+    old_tn = np.asarray(
+        tbl / (jnp.linalg.norm(tbl, axis=-1, keepdims=True) + 1e-9)
+    )
+    np.testing.assert_allclose(model.item_factors, old_tn, atol=1e-6)
+
+    algo = SimilarProductAlgorithm.__new__(SimilarProductAlgorithm)
+    for query_items in (("i3",), ("i0", "i1")):
+        res = algo.predict(model, Query(items=query_items, num=12))
+        got = {s.item: s.score for s in res.item_scores}
+        want = old_path_scores(query_items)
+        for j, item in enumerate(ids):
+            if item in query_items:
+                continue  # excluded from results by design (both paths)
+            assert item in got
+            np.testing.assert_allclose(got[item], want[j], atol=1e-5)
+
+
+def test_similarproduct_legacy_npz_normalized_on_load(tmp_path):
+    """A pre-migration .npz (raw factors, no 'normalized' stamp) loads
+    with its rows normalized exactly once; a stamped file is left
+    alone (no double normalization — unit rows are a fixpoint, but the
+    stamp proves the branch)."""
+    from predictionio_tpu.storage.bimap import StringIndex
+    from predictionio_tpu.templates._common import normalize_rows
+    from predictionio_tpu.templates.similarproduct import (
+        SimilarALSModel,
+        SimilarProductAlgorithm,
+    )
+
+    rng = np.random.default_rng(5)
+    raw = (rng.normal(size=(6, 4)) * 3.0).astype(np.float32)
+    ids = np.array([f"i{j}" for j in range(6)], dtype=str)
+    legacy = tmp_path / "m-similar.npz"
+    np.savez_compressed(legacy, item_factors=raw, item_ids=ids)
+    (tmp_path / "m-props.json").write_text("{}")
+    algo = SimilarProductAlgorithm.__new__(SimilarProductAlgorithm)
+    manifest = {"npz": "m-similar.npz", "props": "m-props.json"}
+    m = algo.load_model(None, "m", manifest, tmp_path)
+    np.testing.assert_allclose(
+        np.linalg.norm(m.item_factors, axis=1), 1.0, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        m.item_factors, normalize_rows(raw), atol=1e-6
+    )
+    # save_model stamps; loading the stamped file keeps rows bitwise
+    model = SimilarALSModel(
+        item_factors=normalize_rows(raw),
+        items=StringIndex(list(ids)), item_props={},
+    )
+    out_dir = tmp_path / "stamped"
+    manifest2 = algo.save_model(None, "m2", model, out_dir)
+    m2 = algo.load_model(None, "m2", manifest2, out_dir)
+    np.testing.assert_array_equal(m2.item_factors, model.item_factors)

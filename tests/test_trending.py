@@ -232,3 +232,89 @@ def test_stale_serve_on_storage_fault(tmp_path):
     # recovery clears the flag
     m.refresh(es, force=True)
     assert m.stale is False
+
+
+# ---------------------------------------------------------------------------
+# MAP@k evaluation binding (pio-lens satellite; ROADMAP 4(b))
+# ---------------------------------------------------------------------------
+
+
+def test_mapatk_metric_math():
+    from predictionio_tpu.controller.metrics import ActualItems, MAPatK
+    from predictionio_tpu.templates.recommendation import (
+        ItemScore, PredictedResult,
+    )
+
+    m = MAPatK(3)
+    pred = PredictedResult(item_scores=(
+        ItemScore("a", 3.0), ItemScore("b", 2.0), ItemScore("c", 1.0),
+    ))
+    # relevant {a, c}: AP@3 = (1/1 + 2/3) / min(3, 2)
+    got = m.calculate_point(None, pred, ActualItems(items=("a", "c")))
+    assert got == pytest.approx((1.0 + 2.0 / 3.0) / 2.0)
+    # nothing relevant ranked -> 0; empty relevant set -> skipped (None)
+    assert m.calculate_point(
+        None, pred, ActualItems(items=("z",))
+    ) == 0.0
+    assert m.calculate_point(None, pred, ActualItems(items=())) is None
+    # normalizer caps at k: 3 hits over 5 relevant can still reach 1.0
+    got = m.calculate_point(
+        None, pred, ActualItems(items=("a", "b", "c", "d", "e"))
+    )
+    assert got == pytest.approx(1.0)
+    assert m.header == "MAP@3"
+    with pytest.raises(ValueError):
+        MAPatK(0)
+
+
+def test_trending_eval_binding_lands_in_manifest(
+    storage_memory, tmp_path, monkeypatch
+):
+    """`eval --engine trending` end to end: the time-split read_eval
+    produces a positive MAP@k for a catalog whose hot item stays hot,
+    and the score lands in the pio-tower eval-run manifest."""
+    monkeypatch.setenv("PIO_TPU_HOME", str(tmp_path))
+    from predictionio_tpu import engines
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.obs.runlog import list_runs
+    from predictionio_tpu.templates.trending import trending_evaluation
+    from predictionio_tpu.workflow.evaluate import run_evaluation
+
+    md = storage_memory.get_metadata()
+    app = md.app_insert("trend-eval")
+    es = storage_memory.get_event_store()
+    es.init_channel(app.id)
+    now = dt.datetime.now(UTC)
+    evs = []
+    # train window: hot dominates, colds trail
+    for n in range(12):
+        evs.append(_view(f"u{n % 4}", "hot",
+                         now - dt.timedelta(seconds=600 - n)))
+    for j in range(3):
+        evs.append(_view(f"u{j}", f"cold{j}",
+                         now - dt.timedelta(seconds=500 - j)))
+    # holdout window (most recent 20%): users keep viewing hot
+    for n in range(4):
+        evs.append(_view(f"hu{n}", "hot",
+                         now - dt.timedelta(seconds=10 - n)))
+    es.insert_batch(evs, app_id=app.id)
+
+    # the registered spec declares this binding
+    assert engines.get_engine_spec("trending").evaluation \
+        is trending_evaluation
+
+    evaluation = trending_evaluation(app_name="trend-eval", k=5)
+    evaluation.output_path = str(tmp_path / "best.json")
+    ctx = WorkflowContext(storage=storage_memory, mode="Evaluation")
+    eval_id, result = run_evaluation(evaluation, None, ctx=ctx)
+    assert result.metric_header == "MAP@5"
+    assert 0.0 < result.best_score <= 1.0
+    # the metric landed in the tower run manifest
+    runs = {
+        v["header"]["instanceId"]: v for v in list_runs()
+    }
+    assert eval_id in runs
+    candidates = runs[eval_id]["candidates"]
+    assert candidates, "no candidate record in the eval manifest"
+    assert candidates[0]["metric"] == "MAP@5"
+    assert candidates[0]["score"] == pytest.approx(result.best_score)

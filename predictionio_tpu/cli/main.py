@@ -786,17 +786,120 @@ def cmd_eval(args, storage: Storage) -> int:
 
 
 def cmd_eventserver(args, storage: Storage) -> int:
+    if getattr(args, "workers", 0) and args.workers > 1:
+        return _eventserver_fleet(args, storage)
     from ..server.event_server import EventServer, EventServerConfig
 
+    owned = None
+    if getattr(args, "owned_shards", None):
+        owned = [int(s) for s in args.owned_shards.split(",") if s != ""]
+    elif getattr(args, "worker_index", None) is not None:
+        # shard-owner worker (pio-levee): stripe ownership by index
+        from ..server.ingest_router import shards_for_worker
+
+        es = storage.get_event_store()
+        owned = shards_for_worker(
+            args.worker_index, args.worker_count,
+            getattr(es, "n_shards", 1),
+        )
     server = EventServer(
-        storage, EventServerConfig(host=args.ip, port=args.port,
-                                   stats=args.stats,
-                                   write_retries=args.write_retries,
-                                   write_backoff_s=args.write_backoff,
-                                   max_connections=args.max_connections)
+        storage, EventServerConfig(
+            host=args.ip, port=args.port,
+            stats=args.stats,
+            write_retries=args.write_retries,
+            write_backoff_s=args.write_backoff,
+            max_connections=args.max_connections,
+            wal_dir=getattr(args, "wal_dir", None),
+            wal_fsync=not getattr(args, "no_wal_fsync", False),
+            owned_shards=owned,
+            ttl_s=getattr(args, "ttl", None),
+            compact_interval_s=getattr(args, "compact_interval", None),
+        )
     )
-    _out(f"Event server running on {args.ip}:{args.port}")
+    if getattr(args, "port_file", None):
+        # bind first so the announced port is real (--port 0 =
+        # ephemeral); the ingest-router spawner reads this file
+        server._bind()
+        pf = Path(args.port_file)
+        pf.parent.mkdir(parents=True, exist_ok=True)
+        pf.write_text(f"{server.port}\n")
+    role = f" (shard owner: {owned})" if owned is not None else ""
+    _out(f"Event server running on {args.ip}:{server.port}{role}")
     server.serve_forever()
+    return 0
+
+
+def _eventserver_fleet(args, storage: Storage) -> int:
+    """pio-levee: ``eventserver --workers N`` — spawn N shard-owner
+    worker processes (each owning ``shard % N == index`` of the sharded
+    store, each with its own ingest WAL) and run the ingest router in
+    THIS process on the requested port."""
+    import atexit
+    import tempfile
+
+    from ..server.ingest_router import (
+        IngestRouterConfig, boot_ingest_fleet,
+    )
+
+    es = storage.get_event_store()
+    n_shards = getattr(es, "n_shards", 1)
+    if args.workers > n_shards:
+        _out(f"error: --workers {args.workers} exceeds the store's "
+             f"{n_shards} shards; extra workers would own nothing")
+        return 1
+    coord_dir = Path(tempfile.mkdtemp(prefix="pio-levee-fleet-"))
+    wal_root = Path(args.wal_dir) if getattr(args, "wal_dir", None) \
+        else coord_dir / "wal"
+    extra = []
+    for flag, val in (
+        ("--write-retries", args.write_retries),
+        ("--write-backoff", args.write_backoff),
+        ("--max-connections", args.max_connections),
+        ("--ttl", getattr(args, "ttl", None)),
+        ("--compact-interval", getattr(args, "compact_interval", None)),
+    ):
+        if val is not None:
+            extra += [flag, str(val)]
+    if getattr(args, "no_wal_fsync", False):
+        extra.append("--no-wal-fsync")
+    router, spawned = boot_ingest_fleet(
+        args.workers, n_shards, coord_dir,
+        config=IngestRouterConfig(
+            host=args.ip, port=args.port,
+            max_connections=args.max_connections,
+        ),
+        wal_root=wal_root, extra_args=extra,
+        respawn=not getattr(args, "no_respawn", False),
+    )
+    for w, s in zip(router.workers, spawned):
+        _out(f"Ingest worker {w.index} up on 127.0.0.1:{w.port} "
+             f"owning shards {w.shards} (log: {s['log_path']})")
+
+    def reap():
+        procs = [s["proc"] for s in spawned]
+        if router.supervisor is not None:
+            procs += router.supervisor.live_procs()
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+    atexit.register(reap)
+    if getattr(args, "port_file", None):
+        router._bind()
+        pf = Path(args.port_file)
+        pf.parent.mkdir(parents=True, exist_ok=True)
+        pf.write_text(f"{router.port}\n")
+    _out(f"Ingest router fronting {args.workers} shard-owner workers "
+         f"({n_shards} shards) on {args.ip}:{args.port}")
+    try:
+        router.serve_forever()
+    finally:
+        reap()
     return 0
 
 
@@ -1314,6 +1417,41 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--max-connections", type=int, default=512,
                     help="concurrent-connection cap; attempts past it "
                     "get a structured 503 and are closed")
+    # pio-levee: fault-isolated multi-process ingest
+    ev.add_argument("--workers", type=int, default=0, metavar="N",
+                    help="boot N shard-owner worker processes (each "
+                    "owning shard %% N == index of the sharded store, "
+                    "each with its own ingest WAL) behind an ingest "
+                    "router in this process; 0/1 = single process")
+    ev.add_argument("--wal-dir", metavar="DIR",
+                    help="group-commit ingest WAL root: events are "
+                    "fsynced here before the 2xx and drained to sqlite "
+                    "in the background; a crash replays the tail on "
+                    "next boot (off by default: ack = sqlite commit)")
+    ev.add_argument("--no-wal-fsync", action="store_true",
+                    help="skip the per-group fsync (faster, but a HOST "
+                    "crash may lose the last commit interval; a mere "
+                    "process crash still replays everything)")
+    ev.add_argument("--ttl", type=float, metavar="SEC",
+                    help="purge events older than SEC on a maintenance "
+                    "timer (bounded live window)")
+    ev.add_argument("--compact-interval", type=float, metavar="SEC",
+                    help="VACUUM owned shard files every SEC (reclaims "
+                    "TTL-purged space; off by default)")
+    ev.add_argument("--owned-shards", metavar="CSV",
+                    help="restrict writes to these shard indexes "
+                    "(shard-owner worker mode; e.g. 0,2,4)")
+    ev.add_argument("--worker-index", type=int, metavar="I",
+                    help="this worker's index in a --workers fleet "
+                    "(stripes ownership: shard %% count == I)")
+    ev.add_argument("--worker-count", type=int, default=1, metavar="N",
+                    help="fleet size for --worker-index striping")
+    ev.add_argument("--port-file", metavar="PATH",
+                    help="write the bound port here after bind "
+                    "(--port 0 = ephemeral; the fleet spawner reads it)")
+    ev.add_argument("--no-respawn", action="store_true",
+                    help="with --workers: do not respawn dead workers "
+                    "(the chaos suite wants corpses to stay dead)")
 
     ad = sub.add_parser("adminserver", help="run the admin API server")
     _add_obs_args(ad)

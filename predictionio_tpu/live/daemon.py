@@ -137,6 +137,12 @@ class FoldInRunner:
                 "SQLite-backed store (single-file or sharded)"
             )
         self.es = es
+        # pio-levee: under a sharded store, one dead shard owner must
+        # stall ONLY its vector-cursor component — the scan tolerates
+        # the unavailable shard and the fold-in keeps advancing on the
+        # healthy ones, resuming the frozen component without loss when
+        # the owner returns
+        self.tolerate_unavailable = hasattr(es, "shards")
 
         algos = engine._algorithms(engine_params)
         names = [n for n, _ in engine_params.algorithms]
@@ -272,6 +278,7 @@ class FoldInRunner:
                 rating_property=self.rating_property,
                 entity_type=self.entity_type,
                 limit=limit,
+                tolerate_unavailable=self.tolerate_unavailable,
             )
         if scan.n_events == 0:
             return None

@@ -201,6 +201,7 @@ def scan_new_ratings(
     rating_property: Optional[str] = "rating",
     entity_type: Optional[str] = "user",
     limit: Optional[int] = None,
+    tolerate_unavailable: bool = False,
 ) -> ScanBatch:
     """Rows past the watermark -> rating triples, matching the training
     read's semantics: explicit mode (``rating_property`` set) keeps the
@@ -212,10 +213,21 @@ def scan_new_ratings(
 
     Requires a store exposing :meth:`find_rows_since` (the SQLite
     backend); callers feature-test with ``hasattr``.
+
+    ``tolerate_unavailable`` (sharded stores only, pio-levee): a shard
+    whose owner is down contributes no rows and keeps its vector-cursor
+    component FROZEN — the fold-in stalls on exactly that component and
+    resumes without loss when the owner returns, while healthy shards'
+    components keep advancing.
     """
+    kw = {}
+    if tolerate_unavailable:
+        # sharded-store-only kwarg; single-file stores have no shard
+        # to lose, so the flag is simply not passed
+        kw["tolerate_unavailable"] = True
     rows, new_cursor = es.find_rows_since(
         app_id, channel_id, cursor=cursor, limit=limit,
-        event_names=list(event_names),
+        event_names=list(event_names), **kw,
     )
     implicit = rating_property is None
     # key -> running value; rowid order means "last wins" is insertion

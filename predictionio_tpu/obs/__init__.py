@@ -418,6 +418,50 @@ STORE_SHARD_ROWS = _registry.gauge(
     labels=("shard",),
 )
 
+# pio-levee: the fault-isolated multi-process ingest edge — per-shard
+# group-commit WAL (append + fsync before 2xx, batched sqlite commits
+# off the request path) plus the router's worker-health view.
+WAL_FSYNC_SECONDS = _registry.histogram(
+    "pio_wal_fsync_seconds",
+    "Ingest WAL group-commit flush latency (serialize + append + "
+    "fsync for one leader's group, all touched shard logs)",
+    buckets=log_buckets(1e-5, 10.0, per_decade=4),
+)
+WAL_COMMIT_ROWS = _registry.histogram(
+    "pio_wal_commit_rows",
+    "Rows per batched sqlite commit drained from the ingest WAL "
+    "(bigger batches = the amortization the WAL exists for)",
+    buckets=(1, 10, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+             25000, 50000),
+)
+WAL_BACKLOG_ROWS = _registry.gauge(
+    "pio_wal_backlog_rows",
+    "Acknowledged (fsynced) rows not yet committed into sqlite — the "
+    "crash-replay exposure window, bounded by the commit interval",
+)
+WAL_REPLAYED_TOTAL = _registry.counter(
+    "pio_wal_replayed_total",
+    "WAL records replayed into sqlite at startup per shard "
+    "(at-least-once: INSERT OR REPLACE dedups by event id)",
+    labels=("shard",),
+)
+INGEST_WORKER_UP = _registry.gauge(
+    "pio_ingest_worker_up",
+    "Ingest-router view of one shard-owner worker (1 healthy, 0 down)",
+    labels=("worker",),
+)
+INGEST_FORWARD_SECONDS = _registry.histogram(
+    "pio_ingest_forward_seconds",
+    "Ingest-router forward round trip to a shard-owner worker",
+    buckets=log_buckets(1e-4, 60.0, per_decade=4),
+)
+INGEST_SHARD_UNAVAILABLE_TOTAL = _registry.counter(
+    "pio_ingest_shard_unavailable_total",
+    "Writes refused with a structured 503 because the owning shard "
+    "was down (per shard — the one-shard-down blast-radius meter)",
+    labels=("shard",),
+)
+
 # materialize the unlabeled children now: a histogram family without a
 # child renders no bucket ladder, and the schema contract is that every
 # process's first scrape already shows the full (zero-valued) shape
@@ -426,6 +470,8 @@ EVENT_WRITE_LATENCY.child()
 FOLDIN_EVENTS_TOTAL.child()
 MODEL_FRESHNESS_SECONDS.child()
 FOLDIN_WATERMARK_LAG.child()
+WAL_FSYNC_SECONDS.child()
+WAL_COMMIT_ROWS.child()
 
 
 @contextlib.contextmanager

@@ -33,6 +33,17 @@ Injection points instrumented in this codebase::
                        :func:`check_tenant` — a ``tenant=app/variant``
                        option scopes the rule to ONE tenant, the
                        isolation-chaos selector)
+    store.shard_down   one event-store shard is unreachable (pio-levee;
+                       consulted via :func:`check_shard` with a
+                       ``shard=I`` selector — writes to that shard get
+                       a structured 503, scans stall only that cursor
+                       component; other shards don't even count calls)
+    wal.torn           the ingest WAL append tears mid-record (the
+                       group-commit leader dies between write and
+                       fsync; consulted via :func:`check_shard` —
+                       ``shard=I`` scopes the tear to one shard's log;
+                       replay on restart must drop exactly the torn
+                       tail)
 
 Plan grammar (``;``-separated rules, ``,``-separated options)::
 
@@ -78,8 +89,8 @@ import urllib.error
 from typing import Optional
 
 __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "POINTS",
-           "arm", "disarm", "armed", "check", "check_tenant", "fired",
-           "fired_shard"]
+           "arm", "disarm", "armed", "check", "check_shard",
+           "check_tenant", "fired", "fired_shard"]
 
 POINTS = (
     "storage.write",
@@ -94,6 +105,8 @@ POINTS = (
     "dist.exchange_torn",
     "train.nan",
     "tenant.dispatch",
+    "store.shard_down",
+    "wal.torn",
 )
 
 
@@ -235,7 +248,8 @@ class FaultPlan:
             rules.append(FaultRule(point.strip(), **kw))
         return cls(rules)
 
-    def hit(self, point: str, tenant: Optional[str] = None) -> None:
+    def hit(self, point: str, tenant: Optional[str] = None,
+            shard: Optional[int] = None) -> None:
         rule = self._rules.get(point)
         if rule is None:
             return
@@ -244,6 +258,13 @@ class FaultPlan:
             # (not even counted: nth/times describe the TARGET tenant's
             # call sequence, which is what makes isolation plans
             # deterministic under interleaved multi-tenant traffic)
+            return
+        if rule.shard is not None and shard is not None \
+                and shard != rule.shard:
+            # same scoping for shard-addressed boundaries (pio-levee
+            # ``store.shard_down`` / ``wal.torn``): a ``shard=I`` rule
+            # only counts the TARGET shard's calls, so nth/times stay
+            # deterministic while other shards' traffic interleaves
             return
         with self._lock:
             fired, exc = rule.hit()
@@ -334,6 +355,19 @@ def fired_shard(point: str,
     if plan is None:
         return None
     return plan.hit_shard(point, max_wait=max_wait)
+
+
+def check_shard(point: str, shard: int) -> None:
+    """Shard-scoped instrumented boundary (``store.shard_down`` /
+    ``wal.torn``): a rule carrying ``shard=I`` fires only for calls
+    addressing that shard — how a chaos plan takes down ONE shard of
+    the sharded event store while its siblings keep accepting.  A rule
+    without the option behaves like :func:`check`.  No plan armed =>
+    one global load."""
+    plan = _plan
+    if plan is None:
+        return
+    plan.hit(point, shard=shard)
 
 
 def check_tenant(point: str, tenant: str) -> None:

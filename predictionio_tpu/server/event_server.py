@@ -24,16 +24,31 @@ from __future__ import annotations
 import json
 import logging
 import sqlite3
+import threading
 import time
 import urllib.parse
 from typing import Any, Optional
 
-from ..obs import EVENT_WRITE_LATENCY, get_tracer, timeline, trace_scope
+from ..obs import (
+    EVENT_WRITE_LATENCY,
+    INGEST_SHARD_UNAVAILABLE_TOTAL,
+    get_tracer,
+    timeline,
+    trace_scope,
+)
 from ..resilience import faults
 from ..resilience.policy import RetryPolicy
-from ..storage.event import Event, EventValidationError, parse_time
-from ..storage.levents import NO_TARGET
+from ..storage.event import (
+    Event,
+    EventValidationError,
+    new_event_id,
+    new_event_ids,
+    parse_time,
+)
+from ..storage.levents import NO_TARGET, ShardUnavailableError
 from ..storage.registry import Storage, get_storage
+from ..storage.sqlite_events import event_to_row
+from ..storage.wal import GroupCommitWAL
 from .http_base import HTTPServerBase, JsonRequestHandler
 from .stats import StatsCollector
 from .webhooks import (
@@ -53,7 +68,14 @@ class EventServerConfig:
                  stats: bool = True, write_retries: int = 3,
                  write_backoff_s: float = 0.05,
                  retry_seed: Optional[int] = None,
-                 max_connections: int = 512):
+                 max_connections: int = 512,
+                 wal_dir: Optional[str] = None,
+                 wal_commit_interval_s: float = 0.02,
+                 wal_fsync: bool = True,
+                 owned_shards: Optional[list[int]] = None,
+                 ttl_s: Optional[float] = None,
+                 compact_interval_s: Optional[float] = None,
+                 maintenance_interval_s: float = 30.0):
         self.host = host
         self.port = port
         self.stats = stats
@@ -66,6 +88,21 @@ class EventServerConfig:
         self.write_retries = write_retries
         self.write_backoff_s = write_backoff_s
         self.retry_seed = retry_seed
+        # pio-levee ingest WAL: when set, writes group-commit through
+        # `storage.wal.GroupCommitWAL` (ack = WAL fsync, sqlite commits
+        # drain in the background; crash replay on next boot)
+        self.wal_dir = wal_dir
+        self.wal_commit_interval_s = wal_commit_interval_s
+        self.wal_fsync = wal_fsync
+        # shard-owner worker mode: restrict writes (and WAL files) to a
+        # fixed shard subset; None = own everything (single process)
+        self.owned_shards = owned_shards
+        # bounded live window: purge events older than ttl_s, compact
+        # the owned shard files every compact_interval_s (both off by
+        # default; the maintenance thread only runs when one is set)
+        self.ttl_s = ttl_s
+        self.compact_interval_s = compact_interval_s
+        self.maintenance_interval_s = maintenance_interval_s
 
 
 class AuthError(Exception):
@@ -91,6 +128,30 @@ class EventServer(HTTPServerBase):
             cap_s=max(1.0, self.config.write_backoff_s * 10),
             seed=self.config.retry_seed,
         )
+        es = self.storage.get_event_store()
+        if (self.config.owned_shards is not None
+                and hasattr(es, "set_owned_shards")):
+            es.set_owned_shards(self.config.owned_shards)
+        self.wal: Optional[GroupCommitWAL] = None
+        if self.config.wal_dir:
+            self.wal = GroupCommitWAL(
+                es, self.config.wal_dir,
+                owned_shards=self.config.owned_shards,
+                commit_interval_s=self.config.wal_commit_interval_s,
+                fsync=self.config.wal_fsync,
+            )
+        # channels this process has written — the TTL/compaction
+        # maintenance scope (a set mutated under the GIL only; readers
+        # snapshot with list())
+        self._seen_channels: set[tuple[int, int]] = set()
+        self._maint_stop = threading.Event()
+        self._maint_thread: Optional[threading.Thread] = None
+        if self.config.ttl_s or self.config.compact_interval_s:
+            self._maint_thread = threading.Thread(
+                target=self._maintenance_loop,
+                name="events-maintenance", daemon=True,
+            )
+            self._maint_thread.start()
 
     def _note_retry(self, kind: str):
         def on_retry(attempt: int, exc: BaseException) -> None:
@@ -98,6 +159,59 @@ class EventServer(HTTPServerBase):
             if self.stats is not None:
                 self.stats.note(f"{kind}.retry")
         return on_retry
+
+    def barrier(self) -> None:
+        """Read-your-writes: drain the ingest WAL's commit backlog so a
+        201 is visible to this server's own GET routes.  No-op without
+        a WAL; a stuck drain raises the transient-storage surface."""
+        if self.wal is not None:
+            self.wal.barrier()
+
+    def stop(self) -> None:
+        super().stop()
+        self._maint_stop.set()
+        if self._maint_thread is not None:
+            self._maint_thread.join(timeout=5.0)
+            self._maint_thread = None
+        if self.wal is not None:
+            self.wal.close()
+            self.wal = None
+
+    def _maintenance_loop(self) -> None:
+        """Time-windowed retention: TTL purge each tick, compaction on
+        its own (longer) cadence — both scoped to owned shards so a
+        worker never takes a sibling's writer lock."""
+        next_compact = time.monotonic() + (
+            self.config.compact_interval_s or float("inf")
+        )
+        while not self._maint_stop.wait(self.config.maintenance_interval_s):
+            es = self.storage.get_event_store()
+            try:
+                if self.config.ttl_s and hasattr(es, "purge_older_than"):
+                    cutoff = int((time.time() - self.config.ttl_s) * 1000)
+                    for app_id, ch in list(self._seen_channels):
+                        n = es.purge_older_than(cutoff, app_id, ch)
+                        if n:
+                            logger.info(
+                                "TTL purge: %d events older than %ss "
+                                "(app %d, channel %d)",
+                                n, self.config.ttl_s, app_id, ch,
+                            )
+                            if self.stats is not None:
+                                self.stats.note("ttl.purged", n)
+                if (self.config.compact_interval_s
+                        and time.monotonic() >= next_compact):
+                    next_compact = (time.monotonic()
+                                    + self.config.compact_interval_s)
+                    # drain first: VACUUM wants the writer lock the WAL
+                    # committer would otherwise be using
+                    self.barrier()
+                    es.compact()
+                    logger.info("compacted event store")
+            except Exception:
+                # retention is advisory; a failed pass must not kill
+                # the thread (the next tick retries)
+                logger.exception("event-store maintenance pass failed")
 
     @property
     def host(self) -> str:
@@ -150,10 +264,24 @@ class EventServer(HTTPServerBase):
         self.check_allowed(event, allowed)
         es = self.storage.get_event_store()
         es.init_channel(app_id, channel_id)
+        self._seen_channels.add((app_id, channel_id))
 
-        def put():
-            faults.check("storage.write")
-            return es.insert(event, app_id, channel_id)
+        if self.wal is not None:
+            # group-commit path: ack = WAL fsync; the sqlite commit
+            # drains in the background.  ShardUnavailableError is NOT
+            # transient (sticky until restart/recovery) so the retry
+            # policy passes it straight through to the 503 route.
+            def put():
+                faults.check("storage.write")
+                eid = event.event_id or new_event_id()
+                self.wal.submit(
+                    app_id, channel_id, [event_to_row(event, eid)]
+                )
+                return eid
+        else:
+            def put():
+                faults.check("storage.write")
+                return es.insert(event, app_id, channel_id)
 
         # span + histogram cover the whole retried write: the client's
         # view of how long ingestion held their request
@@ -225,6 +353,22 @@ class EventServer(HTTPServerBase):
                     "error": "StorageUnavailable",
                 })
 
+            def _reply_503_shard(self, e: ShardUnavailableError):
+                """One shard is down, the fleet is not: a structured
+                503 naming the shard, with a Retry-After sized for a
+                worker respawn rather than a lock blip.  Clients
+                (loadgen, bench) book this as throttled-and-retry, not
+                as an error."""
+                INGEST_SHARD_UNAVAILABLE_TOTAL.labels(
+                    shard=str(e.shard)
+                ).inc()
+                self.extra_headers = [("Retry-After", "2")]
+                self._reply(503, {
+                    "message": str(e),
+                    "error": "ShardUnavailable",
+                    "shard": e.shard,
+                })
+
             # ---- POST ----
             def do_POST(self):
                 path = self._route()
@@ -249,6 +393,8 @@ class EventServer(HTTPServerBase):
                 except (EventValidationError, ConnectorError,
                         json.JSONDecodeError, ValueError) as e:
                     self._reply(400, {"message": str(e)})
+                except ShardUnavailableError as e:
+                    self._reply_503_shard(e)
                 except TRANSIENT_STORAGE_ERRORS as e:
                     self._reply_503(e)
                 except Exception as e:
@@ -276,6 +422,10 @@ class EventServer(HTTPServerBase):
                 except AuthError as e:
                     self._book(app_id, 401)
                     self._reply(401, {"message": str(e)})
+                    return
+                except ShardUnavailableError as e:
+                    self._book(app_id, 503)
+                    self._reply_503_shard(e)
                     return
                 except TRANSIENT_STORAGE_ERRORS as e:
                     self._book(app_id, 503)
@@ -334,12 +484,26 @@ class EventServer(HTTPServerBase):
                     except (EventValidationError, ValueError) as e:
                         self._book(app_id, 400)
                         results[k] = {"status": 400, "message": str(e)}
-                def put_batch():
-                    faults.check("storage.write")
-                    return es.insert_batch(
-                        [e for _, e in valid], app_id, channel_id,
-                        validate=False,
-                    )
+                if server.wal is not None:
+                    server._seen_channels.add((app_id, channel_id))
+                    fresh = iter(new_event_ids(len(valid)))
+                    vids = [e.event_id or next(fresh) for _, e in valid]
+
+                    def put_batch():
+                        faults.check("storage.write")
+                        server.wal.submit(
+                            app_id, channel_id,
+                            [event_to_row(e, eid)
+                             for (_, e), eid in zip(valid, vids)],
+                        )
+                        return vids
+                else:
+                    def put_batch():
+                        faults.check("storage.write")
+                        return es.insert_batch(
+                            [e for _, e in valid], app_id, channel_id,
+                            validate=False,
+                        )
 
                 def timed_put_batch():
                     t0 = time.perf_counter()
@@ -357,6 +521,16 @@ class EventServer(HTTPServerBase):
 
                 try:
                     ids = timed_put_batch() if valid else []
+                except ShardUnavailableError:
+                    # one shard refused the whole-batch submit (which
+                    # guards every row before logging any, so nothing
+                    # was acknowledged).  Fall back to per-shard
+                    # groups: healthy shards accept, only the dead
+                    # shard's events answer 503 — the one-shard-down
+                    # contract at batch granularity.
+                    self._post_batch_degraded(app_id, channel_id,
+                                              valid, results)
+                    return
                 except TRANSIENT_STORAGE_ERRORS as e:
                     # the batch contract is per-event statuses even when
                     # the store is down: valid events answer 503 (come
@@ -373,6 +547,48 @@ class EventServer(HTTPServerBase):
                 for (k, event), eid in zip(valid, ids):
                     self._book(app_id, 201, event)
                     results[k] = {"status": 201, "eventId": eid}
+                self._reply(200, results)
+
+            def _post_batch_degraded(self, app_id, channel_id, valid,
+                                     results):
+                """Shard-isolated batch retry: submit per shard group
+                so a dead shard only fails ITS events.  Per-shard
+                all-or-nothing is preserved (each submit guards every
+                row first)."""
+                wal = server.wal
+                groups: dict[int, list[tuple[int, Event]]] = {}
+                for k, e in valid:
+                    six = wal.route(e.entity_type, e.entity_id)
+                    groups.setdefault(six, []).append((k, e))
+                down: list[int] = []
+                for six, group in sorted(groups.items()):
+                    fresh = iter(new_event_ids(len(group)))
+                    gids = [e.event_id or next(fresh) for _, e in group]
+                    try:
+                        wal.submit(
+                            app_id, channel_id,
+                            [event_to_row(e, eid)
+                             for (_, e), eid in zip(group, gids)],
+                        )
+                    except ShardUnavailableError as e2:
+                        down.append(six)
+                        INGEST_SHARD_UNAVAILABLE_TOTAL.labels(
+                            shard=str(six)
+                        ).inc(len(group))
+                        for k, _ in group:
+                            self._book(app_id, 503)
+                            results[k] = {
+                                "status": 503,
+                                "message": str(e2),
+                                "error": "ShardUnavailable",
+                                "shard": six,
+                            }
+                        continue
+                    for (k, event), eid in zip(group, gids):
+                        self._book(app_id, 201, event)
+                        results[k] = {"status": 201, "eventId": eid}
+                if down:
+                    self.extra_headers = [("Retry-After", "2")]
                 self._reply(200, results)
 
             def _post_webhook(self, path: str):
@@ -438,6 +654,8 @@ class EventServer(HTTPServerBase):
                     self._reply(401, {"message": str(e)})
                 except ValueError as e:
                     self._reply(400, {"message": str(e)})
+                except ShardUnavailableError as e:
+                    self._reply_503_shard(e)
                 except TRANSIENT_STORAGE_ERRORS as e:
                     self._reply_503(e)
                 except Exception as e:
@@ -449,6 +667,10 @@ class EventServer(HTTPServerBase):
                 the transient-error retry policy."""
                 def read():
                     faults.check("storage.read")
+                    # read-your-writes under the WAL: a 201 means
+                    # "fsynced", not "committed" — drain before scanning
+                    # so this server's own GETs see their POSTs
+                    server.barrier()
                     return fn()
 
                 try:
@@ -502,6 +724,9 @@ class EventServer(HTTPServerBase):
                         eid = path[len("/events/"):-len(".json")]
                         es = server.storage.get_event_store()
                         es.init_channel(app_id, channel_id)
+                        # a delete must see (and remove) the caller's
+                        # own just-acknowledged writes
+                        server.barrier()
                         if es.delete(eid, app_id, channel_id):
                             self._reply(200, {"message": "Found"})
                         else:
@@ -510,6 +735,10 @@ class EventServer(HTTPServerBase):
                         self._reply(404, {"message": "not found"})
                 except AuthError as e:
                     self._reply(401, {"message": str(e)})
+                except ShardUnavailableError as e:
+                    self._reply_503_shard(e)
+                except TRANSIENT_STORAGE_ERRORS as e:
+                    self._reply_503(e)
                 except Exception as e:
                     logger.exception("event server error")
                     self._reply(500, {"message": str(e)})

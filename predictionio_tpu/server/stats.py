@@ -16,7 +16,63 @@ from typing import Optional
 
 from ..obs import EVENTS_TOTAL, RESILIENCE_TOTAL
 
-__all__ = ["Stats", "StatsCollector", "KindedEvent"]
+__all__ = ["Stats", "StatsCollector", "KindedEvent",
+           "merge_stats_payloads"]
+
+
+def _merge_stats_json(parts: list[dict]) -> dict:
+    counts: Counter = Counter()
+    kinds: Counter = Counter()
+    start = None
+    for p in parts:
+        st = p.get("startTime")
+        if st is not None:
+            start = st if start is None else min(start, st)
+        for row in p.get("statusCount", ()):
+            counts[(row["appId"], row["status"])] += row["count"]
+        for row in p.get("eventCount", ()):
+            key = (row["appId"], row["event"], row["entityType"],
+                   row.get("targetEntityType"))
+            kinds[key] += row["count"]
+    return {
+        "startTime": start if start is not None else time.time(),
+        "statusCount": [
+            {"appId": a, "status": s, "count": c}
+            for (a, s), c in sorted(counts.items())
+        ],
+        "eventCount": [
+            {"appId": a, "event": e, "entityType": et,
+             "targetEntityType": tet, "count": c}
+            for (a, e, et, tet), c in sorted(
+                kinds.items(), key=lambda kv: (kv[0][0], kv[0][1])
+            )
+        ],
+    }
+
+
+def merge_stats_payloads(payloads: list[dict]) -> dict:
+    """Federate per-worker ``/stats.json`` payloads into one fleet
+    view (pio-levee satellite): counters sum by key, ``startTime`` is
+    the fleet's earliest boot.  Same monotone-through-death discipline
+    as the ``/metrics`` federation — feed a dead worker's LAST GOOD
+    payload and the merged counts never step backward; they resume
+    climbing when its replacement reports in (counts restart at zero
+    per process, so the merged total dips only if the caller DROPS the
+    dead worker's snapshot instead of keeping it standing)."""
+    out: dict = {}
+    for window in ("lifetime", "currentHour"):
+        out[window] = _merge_stats_json(
+            [p.get(window) or {} for p in payloads]
+        )
+    prevs = [p["previousHour"] for p in payloads
+             if p.get("previousHour")]
+    out["previousHour"] = _merge_stats_json(prevs) if prevs else None
+    res: Counter = Counter()
+    for p in payloads:
+        for k, v in (p.get("resilience") or {}).items():
+            res[k] += v
+    out["resilience"] = dict(sorted(res.items()))
+    return out
 
 
 @dataclass(frozen=True)

@@ -25,7 +25,25 @@ from typing import Iterable, Iterator, Optional, Sequence, Union
 from .aggregate import aggregate_properties, aggregate_properties_single
 from .event import Event, PropertyMap, new_event_id, validate_event
 
-__all__ = ["NO_TARGET", "EventStore", "MemoryEventStore"]
+__all__ = ["NO_TARGET", "EventStore", "MemoryEventStore",
+           "ShardUnavailableError"]
+
+
+class ShardUnavailableError(Exception):
+    """One shard of a sharded event store cannot serve right now
+    (owner worker dead, injected ``store.shard_down``, broken WAL).
+
+    Deliberately NOT a ``sqlite3.OperationalError``: the condition is
+    sticky until the owner recovers, so the ingest edge must answer a
+    structured 503 + Retry-After immediately instead of burning its
+    transient-error retry budget.  ``shard`` names the component a
+    degradation-aware caller (vector-cursor scans, the ingest router)
+    should stall or reject — never the whole store."""
+
+    def __init__(self, shard: int, reason: str = "shard unavailable"):
+        super().__init__(f"shard {shard} unavailable: {reason}")
+        self.shard = int(shard)
+        self.reason = reason
 
 
 class _NoTarget:

@@ -53,9 +53,10 @@ from ..obs import (
     STORE_SHARD_SCAN_SECONDS,
     STORE_SHARD_WRITE_SECONDS,
 )
+from ..resilience import faults
 from .columnar import EventFrame
 from .event import Event
-from .levents import EventStore, TargetFilter
+from .levents import EventStore, ShardUnavailableError, TargetFilter
 from .sqlite_events import SQLiteEventStore
 
 __all__ = ["ShardedSQLiteEventStore"]
@@ -139,10 +140,55 @@ class ShardedSQLiteEventStore(EventStore):
             for i in range(n_shards)
         ]
 
+    # pio-levee: a shard-owner worker process restricts this to its
+    # fixed subset post-construction; None = every shard (the
+    # single-process default).  Ownership gates WRITES only — sqlite
+    # files accept cross-process READERS safely, and cursor scans must
+    # see the whole keyspace regardless of who owns the writer lock.
+    owned_shards: Optional[frozenset[int]] = None
+
+    def set_owned_shards(self, shards: Optional[Iterable[int]]) -> None:
+        if shards is None:
+            self.owned_shards = None
+            return
+        owned = frozenset(int(s) for s in shards)
+        bad = sorted(s for s in owned if not 0 <= s < self.n_shards)
+        if bad:
+            raise ValueError(
+                f"owned shards {bad} out of range for "
+                f"{self.n_shards}-shard store"
+            )
+        self.owned_shards = owned
+
     # -- routing ----------------------------------------------------------
     def _shard(self, entity_type: str, entity_id: str) -> SQLiteEventStore:
         return self.shards[_shard_ix(entity_type, entity_id,
                                      self.n_shards)]
+
+    def shard_of(self, entity_type: str, entity_id: str) -> int:
+        """The shard index an entity routes to — the routing table the
+        ingest router and chaos tooling share with the store."""
+        return _shard_ix(entity_type, entity_id, self.n_shards)
+
+    def _check_shard_up(self, six: int) -> None:
+        """``store.shard_down`` consultation (shard-scoped, see
+        `resilience.faults.check_shard`); any injected error surfaces
+        as the sticky `ShardUnavailableError`, never a transient."""
+        try:
+            faults.check_shard("store.shard_down", six)
+        except ShardUnavailableError:
+            raise
+        except BaseException as e:
+            raise ShardUnavailableError(six, str(e)) from e
+
+    def _check_writable(self, six: int) -> None:
+        if self.owned_shards is not None and six not in self.owned_shards:
+            raise ShardUnavailableError(
+                six,
+                "shard is not owned by this worker (router misroute or "
+                "stale routing table)",
+            )
+        self._check_shard_up(six)
 
     # -- lifecycle --------------------------------------------------------
     def init_channel(self, app_id: int, channel_id: int = 0) -> bool:
@@ -161,7 +207,11 @@ class ShardedSQLiteEventStore(EventStore):
             s.close()
 
     def compact(self) -> None:
-        for s in self.shards:
+        # owned-shard scoped like purge: VACUUM takes the writer lock,
+        # which belongs to the owning worker in a fleet
+        for i, s in enumerate(self.shards):
+            if self.owned_shards is not None and i not in self.owned_shards:
+                continue
             s.compact()
 
     # -- writes -----------------------------------------------------------
@@ -169,6 +219,7 @@ class ShardedSQLiteEventStore(EventStore):
                validate: bool = True) -> str:
         six = _shard_ix(event.entity_type, event.entity_id,
                         self.n_shards)
+        self._check_writable(six)
         t0 = time.perf_counter()
         eid = self.shards[six].insert(
             event, app_id, channel_id, validate=validate
@@ -194,6 +245,10 @@ class ShardedSQLiteEventStore(EventStore):
             groups.setdefault(
                 _shard_ix(e.entity_type, e.entity_id, self.n_shards), []
             ).append(pos)
+        for six in groups:
+            # refuse BEFORE any shard writes: all-or-nothing semantics
+            # extend to a down/foreign shard in the batch
+            self._check_writable(six)
         ids: list[Optional[str]] = [None] * len(events)
         # one bulk scope spanning every touched shard: a sqlite error
         # on a later group rolls back the earlier groups too (each
@@ -225,6 +280,8 @@ class ShardedSQLiteEventStore(EventStore):
             groups.setdefault(
                 _shard_ix(row[2], row[3], self.n_shards), []
             ).append(row)
+        for six in groups:
+            self._check_writable(six)
         # cross-shard atomicity as in insert_batch (and same reasoning
         # for defer_indexes=False: the importer's outer scope defers)
         with self.bulk(defer_indexes=False):
@@ -233,6 +290,22 @@ class ShardedSQLiteEventStore(EventStore):
                 self.shards[six].insert_raw_rows(grp, app_id, channel_id)
                 self._m_write[six].observe(time.perf_counter() - t0)
                 self._m_rows[six].inc(len(grp))
+
+    def purge_older_than(self, cutoff_millis: int, app_id: int,
+                         channel_id: int = 0) -> int:
+        """TTL fan-out (`sqlite_events.purge_older_than`): bounded live
+        window across every shard this process can write.  Owned-shard
+        scoped — in a worker fleet each owner trims its own files (the
+        others' writer locks belong to their owners)."""
+        total = 0
+        for i, s in enumerate(self.shards):
+            if self.owned_shards is not None and i not in self.owned_shards:
+                continue
+            n = s.purge_older_than(cutoff_millis, app_id, channel_id)
+            if n:
+                self._m_rows[i].dec(n)
+            total += n
+        return total
 
     @contextlib.contextmanager
     def bulk(self, defer_indexes: bool = True):
@@ -446,6 +519,7 @@ class ShardedSQLiteEventStore(EventStore):
         event_names: Optional[Sequence[str]] = None,
         newest_first: bool = False,
         parallel: bool = False,
+        tolerate_unavailable: bool = False,
     ) -> tuple[list[tuple], str]:
         """Rows written after a shard-vector watermark; returns
         ``(rows, new_cursor)`` with ``new_cursor`` the JSON-encoded
@@ -470,44 +544,60 @@ class ShardedSQLiteEventStore(EventStore):
         concatenated in shard-index order, so the output is BITWISE the
         sequential scan's.  Ignored when ``limit`` is set (a bounded
         page consumes shards in order — scanning all of them would read
-        rows the page must then discard) or when there is one shard."""
-        per_shard = self._decode_cursor(cursor)
-        if parallel and limit is None and self.n_shards > 1:
-            import concurrent.futures
+        rows the page must then discard) or when there is one shard.
 
-            def scan(i):
+        ``tolerate_unavailable=True`` is the pio-levee degradation mode
+        for incremental consumers (fold-in, online eval): a shard that
+        answers `ShardUnavailableError` contributes NO rows and its
+        cursor COMPONENT does not advance — the vector stalls on
+        exactly that shard while healthy components keep moving, so
+        resuming from the returned cursor after recovery replays the
+        dead shard's backlog from where it stalled, losing nothing.
+        When False (default) the error propagates — one-shot readers
+        must see the outage loudly, not a silently partial scan."""
+        per_shard = self._decode_cursor(cursor)
+
+        def scan_one(i, lim):
+            """(rows, new_component) for shard i — stalled on outage
+            when tolerated (component pinned at the input cursor)."""
+            try:
+                self._check_shard_up(i)
                 t0 = time.perf_counter()
-                out = self.shards[i].find_rows_since(
+                rows, nc = self.shards[i].find_rows_since(
                     app_id, channel_id, cursor=per_shard[i],
-                    event_names=event_names, newest_first=newest_first,
+                    limit=lim, event_names=event_names,
+                    newest_first=newest_first,
                 )
                 self._m_scan[i].observe(time.perf_counter() - t0)
-                return out
+                return rows, int(nc)
+            except ShardUnavailableError:
+                if not tolerate_unavailable:
+                    raise
+                return [], int(per_shard[i])
+
+        if parallel and limit is None and self.n_shards > 1:
+            import concurrent.futures
 
             with concurrent.futures.ThreadPoolExecutor(
                 max_workers=min(self.n_shards, 8),
                 thread_name_prefix="shard-scan",
             ) as ex:
-                results = list(ex.map(scan, range(self.n_shards)))
+                results = list(ex.map(
+                    lambda i: scan_one(i, None), range(self.n_shards)
+                ))
             out_rows = [r for rows, _ in results for r in rows]
             return out_rows, self._encode_cursor(
-                [int(nc) for _, nc in results]
+                [nc for _, nc in results]
             )
         out_rows: list[tuple] = []
         new_cursor = list(per_shard)
         remaining = limit
-        for i, shard in enumerate(self.shards):
+        for i in range(self.n_shards):
             if remaining is not None and remaining <= 0:
                 break
-            t0 = time.perf_counter()
-            rows, nc = shard.find_rows_since(
-                app_id, channel_id, cursor=per_shard[i],
-                limit=remaining, event_names=event_names,
-                newest_first=newest_first,
-            )
-            self._m_scan[i].observe(time.perf_counter() - t0)
+            rows, nc = scan_one(i, remaining)
             out_rows.extend(rows)
-            new_cursor[i] = int(nc)
+            new_cursor[i] = nc
             if remaining is not None:
                 remaining -= len(rows)
         return out_rows, self._encode_cursor(new_cursor)

@@ -45,7 +45,27 @@ from .event import (
 )
 from .levents import NO_TARGET, EventStore, TargetFilter
 
-__all__ = ["SQLiteEventStore", "SCHEMA_VERSION"]
+__all__ = ["SQLiteEventStore", "SCHEMA_VERSION", "event_to_row"]
+
+
+def event_to_row(event: Event, eid: str) -> tuple:
+    """The 11-column storage row for an event — the schema every raw-row
+    path speaks (`insert_raw_rows`, the native importer, the ingest
+    WAL's logged payloads).  Module-level so the event server can frame
+    rows for `storage.wal` without holding a store reference."""
+    return (
+        eid,
+        event.event,
+        event.entity_type,
+        event.entity_id,
+        event.target_entity_type,
+        event.target_entity_id,
+        json.dumps(event.properties.to_json(), separators=(",", ":")),
+        time_millis(event.event_time),
+        json.dumps(list(event.tags)),
+        event.pr_id,
+        time_millis(event.creation_time),
+    )
 
 logger = logging.getLogger(__name__)
 
@@ -316,19 +336,7 @@ class SQLiteEventStore(EventStore):
 
     # -- writes -----------------------------------------------------------
     def _row(self, event: Event, eid: str) -> tuple:
-        return (
-            eid,
-            event.event,
-            event.entity_type,
-            event.entity_id,
-            event.target_entity_type,
-            event.target_entity_id,
-            json.dumps(event.properties.to_json(), separators=(",", ":")),
-            time_millis(event.event_time),
-            json.dumps(list(event.tags)),
-            event.pr_id,
-            time_millis(event.creation_time),
-        )
+        return event_to_row(event, eid)
 
     def insert(self, event: Event, app_id: int, channel_id: int = 0,
                validate: bool = True) -> str:
@@ -394,6 +402,35 @@ class SQLiteEventStore(EventStore):
             self._bump_version(t)
             if not self._bulk_depth:
                 self._conn.commit()
+
+    def purge_older_than(self, cutoff_millis: int, app_id: int,
+                         channel_id: int = 0) -> int:
+        """TTL enforcement for the live ingest window: delete rows whose
+        EVENT time predates ``cutoff_millis`` and return the count.
+
+        Event time, not creation time — the window the trending
+        re-scans and fold-in deltas reason in.  Watermark cursors stay
+        valid: a purge below the cursor is invisible to the scan, and a
+        cursor below the purge floor simply finds fewer rows — stale
+        events it would have folded in are gone, which is the TTL's
+        contract.  (sqlite only ever reuses a freed MAX rowid, and only
+        when the newest-INSERTED row carries the oldest EVENT time —
+        live ingest never does that; bulk historical imports should
+        purge before cursors are cut.)  Not part of the EventStore ABC
+        — callers feature-test with ``hasattr``.
+        """
+        t = self._ensure_table(app_id, channel_id)
+        with self._lock:
+            cur = self._conn.execute(
+                f"DELETE FROM {t} WHERE event_time < ?",
+                (int(cutoff_millis),),
+            )
+            n = cur.rowcount if cur.rowcount and cur.rowcount > 0 else 0
+            if n:
+                self._bump_version(t)
+            if not self._bulk_depth:
+                self._conn.commit()
+        return n
 
     def iter_raw_rows(self, app_id: int, channel_id: int = 0):
         """Yield raw 11-column storage rows (schema of :meth:`_row`).

@@ -90,9 +90,17 @@ class OnlineEval:
                 break
             with self._lock:
                 cursor = self._cursors.get(app, 0)
+            # pio-levee: on a sharded store, tolerate a down shard —
+            # its vector-cursor component freezes (resuming without
+            # loss when its owner returns) while healthy shards keep
+            # feeding conversions
+            kw = (
+                {"tolerate_unavailable": True}
+                if hasattr(event_store, "shards") else {}
+            )
             try:
                 rows, new_cursor = event_store.find_rows_since(
-                    app_id, 0, cursor=cursor, limit=self.scan_page,
+                    app_id, 0, cursor=cursor, limit=self.scan_page, **kw,
                 )
             except Exception:
                 logger.exception("online-eval scan failed for app %s", app)

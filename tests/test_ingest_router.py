@@ -1,0 +1,321 @@
+"""pio-levee ingest router: striped shard ownership, owner-direct
+forwarding, one-shard-down degradation semantics, and the federated
+stats/metrics views (`server/ingest_router.py`).
+
+Workers here are real EventServers (WAL + owned shards) running
+in-process against one shared sharded store — the subprocess/SIGKILL
+version of the same topology lives in tools/ingest_smoke.py."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from predictionio_tpu.server.event_server import EventServer, EventServerConfig
+from predictionio_tpu.server.ingest_router import (
+    IngestRouterConfig,
+    IngestRouterServer,
+    IngestWorker,
+    shards_for_worker,
+)
+from predictionio_tpu.storage import AccessKey
+from predictionio_tpu.storage.registry import Storage
+from predictionio_tpu.storage.sharded_events import _shard_ix
+
+N_SHARDS = 4
+N_WORKERS = 2
+
+
+def _rate(user, item="i1"):
+    return {
+        "event": "rate", "entityType": "user", "entityId": user,
+        "targetEntityType": "item", "targetEntityId": item,
+        "properties": {"rating": 4.0},
+        "eventTime": "2020-06-01T00:00:00.000Z",
+    }
+
+
+def _owner_ix(user):
+    return _shard_ix("user", user, N_SHARDS) % N_WORKERS
+
+
+def _users_owned_by(worker_ix, n):
+    out = []
+    i = 0
+    while len(out) < n:
+        u = f"u{i}"
+        if _owner_ix(u) == worker_ix:
+            out.append(u)
+        i += 1
+    return out
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def _total(stats):
+    cur = stats.get("currentHour") or {}
+    return sum(r["count"] for r in cur.get("statusCount", []))
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    env = {
+        "PIO_TPU_HOME": str(tmp_path),
+        "PIO_STORAGE_SOURCES_SH_TYPE": "sqlite-sharded",
+        "PIO_STORAGE_SOURCES_SH_PATH": str(tmp_path / "shards"),
+        "PIO_STORAGE_SOURCES_SH_SHARDS": str(N_SHARDS),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SH",
+    }
+    # one Storage per worker: each EventServer restricts ITS event-store
+    # handle to its stripe, exactly like separate processes would
+    storages = [Storage(dict(env)) for _ in range(N_WORKERS)]
+    md = storages[0].get_metadata()
+    app = md.app_insert("levee")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    servers, iworkers = [], []
+    for i in range(N_WORKERS):
+        stripe = shards_for_worker(i, N_WORKERS, N_SHARDS)
+        srv = EventServer(storages[i], EventServerConfig(
+            port=0, wal_dir=str(tmp_path / f"wal-{i}"),
+            owned_shards=stripe, wal_commit_interval_s=0.005,
+        ))
+        srv.start_background()
+        servers.append(srv)
+        iworkers.append(IngestWorker(
+            f"ingest-{i}", "127.0.0.1", srv.config.port,
+            shards=stripe, index=i,
+        ))
+    router = IngestRouterServer(iworkers, IngestRouterConfig(
+        port=0, n_shards=N_SHARDS, health_interval_s=0.2,
+        retry_after_s=2,
+    ))
+    router.start_background()
+    base = f"http://127.0.0.1:{router.port}"
+    yield base, key, router, servers, iworkers
+    router.stop()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    for st in storages:
+        st.close()
+
+
+# -- pure routing-table unit tests -------------------------------------------
+
+
+def test_shards_for_worker_partitions_exactly():
+    for n_workers in (1, 2, 3, 4):
+        for n_shards in (4, 7, 16):
+            stripes = [shards_for_worker(i, n_workers, n_shards)
+                       for i in range(n_workers)]
+            flat = [s for st in stripes for s in st]
+            assert sorted(flat) == list(range(n_shards))
+            assert len(flat) == len(set(flat))
+            # balanced within one shard
+            sizes = [len(st) for st in stripes]
+            assert max(sizes) - min(sizes) <= 1
+
+
+def test_router_rejects_bad_ownership_maps():
+    def w(name, shards, ix):
+        return IngestWorker(name, "127.0.0.1", 1, shards=shards, index=ix)
+
+    with pytest.raises(ValueError, match="claimed by both"):
+        IngestRouterServer(
+            [w("a", [0, 1], 0), w("b", [1, 2, 3], 1)],
+            IngestRouterConfig(n_shards=4),
+        )
+    with pytest.raises(ValueError, match="no owner"):
+        IngestRouterServer(
+            [w("a", [0, 1], 0)], IngestRouterConfig(n_shards=4),
+        )
+    with pytest.raises(ValueError, match="at least one worker"):
+        IngestRouterServer([], IngestRouterConfig(n_shards=4))
+
+
+# -- healthy-fleet routing ---------------------------------------------------
+
+
+def test_single_event_routes_to_owner_and_reads_back(fleet):
+    base, key, router, _, iworkers = fleet
+    fwd0 = [w.forwarded for w in iworkers]
+    users = _users_owned_by(0, 2) + _users_owned_by(1, 2)
+    eids = {}
+    for u in users:
+        st, body, _ = _post(f"{base}/events.json?accessKey={key}",
+                            _rate(u))
+        assert st == 201
+        eids[u] = body["eventId"]
+    # each worker saw exactly its owned entities
+    for i, w in enumerate(iworkers):
+        assert w.forwarded - fwd0[i] == 2
+    # read-your-writes by event id (router picks a healthy worker;
+    # the worker's WAL barrier makes the 201 visible)
+    for u, eid in eids.items():
+        st, got, _ = _get(f"{base}/events/{eid}.json?accessKey={key}")
+        assert st == 200 and got["entityId"] == u
+    # entity-scoped keyspace read goes to the entity's owner
+    u = users[0]
+    st, got, _ = _get(
+        f"{base}/events.json?accessKey={key}"
+        f"&entityType=user&entityId={u}"
+    )
+    assert st == 200 and len(got) == 1
+
+
+def test_batch_positional_merge_across_owners(fleet):
+    base, key, *_ = fleet
+    users = _users_owned_by(0, 3) + _users_owned_by(1, 2)
+    batch = [_rate(u) for u in users]
+    st, body, _ = _post(f"{base}/batch/events.json?accessKey={key}",
+                        batch)
+    assert st == 200
+    assert len(body) == len(users)
+    assert all(r["status"] == 201 and r["eventId"] for r in body)
+    # positions line up with the submitted order: re-read each event
+    for u, r in zip(users, body):
+        st, got, _ = _get(
+            f"{base}/events/{r['eventId']}.json?accessKey={key}")
+        assert st == 200 and got["entityId"] == u
+
+
+def test_batch_rejects_oversize_and_bad_json(fleet):
+    base, key, *_ = fleet
+    st, body, _ = _post(f"{base}/batch/events.json?accessKey={key}",
+                        [_rate(f"u{i}") for i in range(51)])
+    assert st == 400
+    req = urllib.request.Request(
+        f"{base}/batch/events.json?accessKey={key}",
+        data=b"{not json", method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_stats_and_metrics_federation(fleet):
+    base, key, router, _, iworkers = fleet
+    for u in _users_owned_by(0, 2) + _users_owned_by(1, 2):
+        assert _post(f"{base}/events.json?accessKey={key}",
+                     _rate(u))[0] == 201
+    st, stats, _ = _get(f"{base}/stats.json?accessKey={key}")
+    assert st == 200
+    assert _total(stats) >= 4
+    assert stats["workers"]["total"] == N_WORKERS
+    assert stats["workers"]["healthy"] == N_WORKERS
+    assert stats["workers"]["reporting"] == N_WORKERS
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert 'worker="ingest-0"' in text and 'worker="ingest-1"' in text
+    st, status, _ = _get(f"{base}/")
+    assert st == 200
+    assert status["healthyWorkers"] == N_WORKERS
+    assert set(status["shardOwners"]) == {str(s) for s in range(N_SHARDS)}
+
+
+# -- one shard owner down ----------------------------------------------------
+
+
+def test_one_worker_down_degradation_semantics(fleet):
+    base, key, router, servers, iworkers = fleet
+    dead_users = _users_owned_by(0, 3)
+    live_users = _users_owned_by(1, 3)
+    # seed one event per side, then kill worker 0
+    assert _post(f"{base}/events.json?accessKey={key}",
+                 _rate(dead_users[0]))[0] == 201
+    assert _post(f"{base}/events.json?accessKey={key}",
+                 _rate(live_users[0]))[0] == 201
+    st, stats0, _ = _get(f"{base}/stats.json?accessKey={key}")
+    servers[0].stop()
+    # wait for the router's health loop to notice the death (a real
+    # process exit also takes one health interval to detect)
+    deadline = time.monotonic() + 5.0
+    while iworkers[0].healthy and time.monotonic() < deadline:
+        router.check_worker(iworkers[0])
+        time.sleep(0.05)
+    assert not iworkers[0].healthy
+    # healthy shards: zero errors
+    for u in live_users:
+        st, body, _ = _post(f"{base}/events.json?accessKey={key}",
+                            _rate(u))
+        assert st == 201, body
+    # dead shards: structured 503 + Retry-After, never a hang
+    for u in dead_users:
+        st, body, hdrs = _post(f"{base}/events.json?accessKey={key}",
+                               _rate(u))
+        assert st == 503
+        assert body["error"] == "ShardUnavailable"
+        assert body["shard"] == _shard_ix("user", u, N_SHARDS)
+        assert hdrs.get("Retry-After") == "2"
+    # degraded batch: positional merge, healthy positions 201, dead
+    # positions 503, Retry-After on the envelope
+    mixed = [dead_users[1], live_users[1], dead_users[2], live_users[2]]
+    st, body, hdrs = _post(f"{base}/batch/events.json?accessKey={key}",
+                           [_rate(u) for u in mixed])
+    assert st == 200 and hdrs.get("Retry-After") == "2"
+    got = [(r["status"], r.get("error")) for r in body]
+    assert got == [(503, "ShardUnavailable"), (201, None),
+                   (503, "ShardUnavailable"), (201, None)]
+    # entity-scoped read on a dead shard: 503, not a wrong answer
+    st, body, hdrs = _get(
+        f"{base}/events.json?accessKey={key}"
+        f"&entityType=user&entityId={dead_users[0]}"
+    )
+    assert st == 503 and body["error"] == "ShardUnavailable"
+    assert hdrs.get("Retry-After") == "2"
+    # stats stay monotone through the death (last-good cache for the
+    # dead worker) and report the degraded quorum
+    st, stats1, _ = _get(f"{base}/stats.json?accessKey={key}")
+    assert st == 200
+    assert _total(stats1) >= _total(stats0)
+    assert stats1["workers"]["healthy"] == N_WORKERS - 1
+    assert stats1["workers"]["reporting"] == N_WORKERS
+    # status page books the outage
+    st, status, _ = _get(f"{base}/")
+    assert status["healthyWorkers"] == N_WORKERS - 1
+    assert router.shard_unavailable >= len(dead_users) + 2
+
+
+def test_stats_monotone_through_death(fleet):
+    base, key, router, servers, iworkers = fleet
+    for u in _users_owned_by(0, 4) + _users_owned_by(1, 4):
+        assert _post(f"{base}/events.json?accessKey={key}",
+                     _rate(u))[0] == 201
+    st, before, _ = _get(f"{base}/stats.json?accessKey={key}")
+    assert _total(before) >= 8
+    servers[1].stop()
+    deadline = time.monotonic() + 5.0
+    while iworkers[1].healthy and time.monotonic() < deadline:
+        router.check_worker(iworkers[1])
+        time.sleep(0.05)
+    assert not iworkers[1].healthy
+    st, after, _ = _get(f"{base}/stats.json?accessKey={key}")
+    assert st == 200
+    # the dead worker's contribution is served from its last-good
+    # payload: the federated counter never moves backwards
+    assert _total(after) >= _total(before)
+    assert after["workers"]["healthy"] == N_WORKERS - 1
+    assert after["workers"]["reporting"] == N_WORKERS

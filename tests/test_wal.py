@@ -1,0 +1,309 @@
+"""pio-levee group-commit ingest WAL: framing, replay, group commit,
+crash-loss-zero, fail-stop, and the fault points (`storage/wal.py`)."""
+
+import sqlite3
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.resilience import faults
+from predictionio_tpu.storage import ShardedSQLiteEventStore
+from predictionio_tpu.storage.event import new_event_id, now_utc, time_millis
+from predictionio_tpu.storage.levents import ShardUnavailableError
+from predictionio_tpu.storage.wal import (
+    EventWAL,
+    GroupCommitWAL,
+    _encode_record,
+    read_records,
+    replay_wal_dir,
+)
+
+
+def _row(i, user=None):
+    now = time_millis(now_utc())
+    return (new_event_id(), "rate", "user", user or f"u{i}", "item",
+            f"i{i}", '{"rating":4.0}', now + i, "[]", None, now)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ShardedSQLiteEventStore(tmp_path / "shards", n_shards=3)
+    s.init_channel(1)
+    yield s
+    s.close()
+
+
+def _entity_on(wal, shard):
+    return next(f"u{i}" for i in range(1000)
+                if wal.route("user", f"u{i}") == shard)
+
+
+def _entity_off(wal, shard):
+    return next(f"u{i}" for i in range(1000)
+                if wal.route("user", f"u{i}") != shard)
+
+
+# -- framing + replay edges --------------------------------------------------
+
+
+def test_wal_append_read_roundtrip(tmp_path):
+    w = EventWAL(tmp_path / "shard-0.wal", shard_ix=0)
+    w.append_group([_encode_record(1, 0, _row(i)) for i in range(5)])
+    w.close()
+    records, good, torn = read_records(tmp_path / "shard-0.wal")
+    assert not torn
+    assert len(records) == 5
+    assert records[0][0] == 1 and records[0][1] == 0
+    assert records[0][2][3] == "u0"
+    assert good == (tmp_path / "shard-0.wal").stat().st_size
+
+
+def test_torn_tail_is_dropped_not_fatal(tmp_path):
+    """A partial trailing frame (crash mid-append, before the fsync
+    that would have acked it) replays the good prefix and reports
+    torn=True — the torn record was never acknowledged, so dropping
+    it loses nothing a client was promised."""
+    p = tmp_path / "shard-0.wal"
+    w = EventWAL(p, shard_ix=0)
+    w.append_group([_encode_record(1, 0, _row(i)) for i in range(3)])
+    w.close()
+    good_size = p.stat().st_size
+    import struct
+    import zlib
+
+    payload = _encode_record(1, 0, _row(99))
+    frame = struct.pack("<II", zlib.crc32(payload), len(payload)) + payload
+    with open(p, "ab") as f:
+        f.write(frame[: len(frame) // 2])
+    records, good, torn = read_records(p)
+    assert torn and good == good_size
+    assert len(records) == 3
+    # re-opening the log truncates the torn tail so new appends never
+    # land after garbage
+    w2 = EventWAL(p, shard_ix=0)
+    assert w2.size == good_size
+    assert p.stat().st_size == good_size
+    w2.close()
+
+
+def test_corrupt_crc_stops_replay_at_last_good(tmp_path):
+    p = tmp_path / "shard-0.wal"
+    w = EventWAL(p, shard_ix=0)
+    w.append_group([_encode_record(1, 0, _row(0))])
+    w.append_group([_encode_record(1, 0, _row(1))])
+    w.close()
+    raw = bytearray(p.read_bytes())
+    raw[-3] ^= 0xFF  # flip a byte inside the LAST record's payload
+    p.write_bytes(bytes(raw))
+    records, good, torn = read_records(p)
+    assert torn
+    assert len(records) == 1
+    assert records[0][2][3] == "u0"
+
+
+def test_replay_wal_dir_inserts_and_truncates(tmp_path, store):
+    wal_dir = tmp_path / "wal"
+    wal_dir.mkdir()
+    w = EventWAL(wal_dir / "shard-1.wal", shard_ix=1)
+    w.append_group([_encode_record(1, 0, _row(i)) for i in range(4)])
+    w.close()
+    out = replay_wal_dir(wal_dir, store)
+    assert out["replayed"] == 4 and out["torn_shards"] == []
+    rows, _ = store.find_rows_since(1, cursor=0)
+    assert len(rows) == 4
+    # truncated after the committed replay: a second boot replays 0
+    assert replay_wal_dir(wal_dir, store)["replayed"] == 0
+
+
+def test_replay_is_idempotent_at_least_once(tmp_path, store):
+    """The WAL is at-least-once: replaying the SAME log twice (crash
+    after sqlite commit, before truncate) must not duplicate events —
+    INSERT OR REPLACE on the event id makes the second replay a
+    no-op."""
+    wal_dir = tmp_path / "wal"
+    wal_dir.mkdir()
+    rows = [_row(i) for i in range(6)]
+    w = EventWAL(wal_dir / "shard-0.wal", shard_ix=0)
+    w.append_group([_encode_record(1, 0, r) for r in rows])
+    w.close()
+    assert replay_wal_dir(wal_dir, store, truncate=False)["replayed"] == 6
+    assert replay_wal_dir(wal_dir, store, truncate=True)["replayed"] == 6
+    got, _ = store.find_rows_since(1, cursor=0)
+    assert len(got) == 6  # not 12
+
+
+# -- group commit ------------------------------------------------------------
+
+
+def test_group_commit_acks_then_drains(tmp_path, store):
+    wal = GroupCommitWAL(store, tmp_path / "wal",
+                         commit_interval_s=0.01)
+    for i in range(10):
+        six = wal.route("user", f"u{i}")
+        assert 0 <= six < 3
+        wal.submit(1, 0, [_row(i)])
+    wal.barrier()
+    rows, _ = store.find_rows_since(1, cursor=0)
+    assert len(rows) == 10
+    wal.close()
+
+
+def test_crash_simulation_loses_zero_acked_events(tmp_path, store):
+    """kill -9 mid-batch: every submit() that RETURNED is in the WAL
+    (fsynced before ack).  Disabling the committer + close(drain=False)
+    models the crash — the commit queue dies on the floor — and the
+    next boot's replay folds every acked event into sqlite."""
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(GroupCommitWAL, "_commit_loop", lambda self: None)
+        wal = GroupCommitWAL(store, tmp_path / "wal",
+                             commit_interval_s=0.01)
+        for i in range(8):
+            wal.submit(1, 0, [_row(i)])
+        assert wal.pending_rows() == 8
+        wal.close(drain=False)  # SIGKILL
+    rows, _ = store.find_rows_since(1, cursor=0)
+    assert rows == []  # nothing drained — the crash window
+    wal2 = GroupCommitWAL(store, tmp_path / "wal",
+                          commit_interval_s=0.01)
+    assert wal2.replay_report["replayed"] == 8
+    rows, _ = store.find_rows_since(1, cursor=0)
+    assert len(rows) == 8  # boot replay recovered every acked event
+    wal2.close()
+
+
+def test_concurrent_submitters_group_commit(tmp_path, store):
+    wal = GroupCommitWAL(store, tmp_path / "wal",
+                         commit_interval_s=0.005)
+    n_threads, per = 8, 25
+    errs = []
+
+    def hammer(t):
+        try:
+            for j in range(per):
+                wal.submit(1, 0, [_row(t * per + j)])
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=hammer, args=(t,))
+          for t in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    wal.barrier()
+    rows, _ = store.find_rows_since(1, cursor=0)
+    assert len(rows) == n_threads * per
+    wal.close()
+
+
+def test_ownership_refuses_foreign_shard(tmp_path, store):
+    wal = GroupCommitWAL(store, tmp_path / "wal", owned_shards=[0],
+                         commit_interval_s=0.01)
+    owned = _entity_on(wal, 0)
+    foreign = _entity_off(wal, 0)
+    wal.submit(1, 0, [_row(0, user=owned)])
+    with pytest.raises(ShardUnavailableError):
+        wal.submit(1, 0, [_row(1, user=foreign)])
+    wal.barrier()
+    wal.close()
+
+
+def test_shard_down_fault_maps_to_unavailable(tmp_path, store):
+    wal = GroupCommitWAL(store, tmp_path / "wal",
+                         commit_interval_s=0.01)
+    down = 2
+    victim = _entity_on(wal, down)
+    healthy = _entity_off(wal, down)
+    faults.arm(f"store.shard_down:shard={down}")
+    try:
+        with pytest.raises(ShardUnavailableError) as ei:
+            wal.submit(1, 0, [_row(0, user=victim)])
+        assert ei.value.shard == down
+        wal.submit(1, 0, [_row(1, user=healthy)])
+    finally:
+        faults.disarm()
+    wal.barrier()
+    wal.close()
+
+
+def test_wal_torn_fault_fails_stop_per_shard(tmp_path, store):
+    """`wal.torn:shard=I` tears an append mid-frame: that shard's log
+    goes fail-stop (broken), later writes to it answer
+    ShardUnavailable even after the fault lifts, other shards keep
+    accepting, and the next boot replays the good prefix + truncates
+    the torn tail."""
+    wal = GroupCommitWAL(store, tmp_path / "wal",
+                         commit_interval_s=0.01)
+    down = 1
+    victim = _entity_on(wal, down)
+    healthy = _entity_off(wal, down)
+    wal.submit(1, 0, [_row(0, user=victim)])  # good prefix, pre-tear
+    faults.arm(f"wal.torn:shard={down},times=1")
+    try:
+        with pytest.raises(ShardUnavailableError):
+            wal.submit(1, 0, [_row(1, user=victim)])
+    finally:
+        faults.disarm()
+    # fail-stop is sticky even with the fault disarmed
+    with pytest.raises(ShardUnavailableError):
+        wal.submit(1, 0, [_row(2, user=victim)])
+    wal.submit(1, 0, [_row(3, user=healthy)])
+    wal.barrier()
+    wal.close(drain=False)
+    # next boot: replay drops the torn tail, log is whole again
+    wal2 = GroupCommitWAL(store, tmp_path / "wal",
+                          commit_interval_s=0.01)
+    assert down in wal2.replay_report["torn_shards"]
+    wal2.submit(1, 0, [_row(4, user=victim)])  # shard accepts again
+    wal2.barrier()
+    wal2.close()
+    rows, _ = store.find_rows_since(1, cursor=0)
+    users = {r[4] for r in rows}
+    assert victim in users and healthy in users
+    # the torn (never-acked) record from _row(1..2) is NOT there
+    assert len(rows) == 3
+
+
+def test_barrier_timeout_raises_operational(tmp_path, store):
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(GroupCommitWAL, "_commit_loop", lambda self: None)
+        wal = GroupCommitWAL(store, tmp_path / "wal",
+                             commit_interval_s=0.01)
+        wal.submit(1, 0, [_row(0)])
+        with pytest.raises(sqlite3.OperationalError):
+            wal.barrier(timeout_s=0.1)
+        wal.close(drain=False)
+
+
+def test_pending_rows_and_checkpoint(tmp_path, store):
+    wal = GroupCommitWAL(store, tmp_path / "wal",
+                         commit_interval_s=0.01)
+    wal.submit(1, 0, [_row(i) for i in range(5)])
+    wal.barrier()
+    assert wal.pending_rows() == 0
+    # fully drained -> checkpoint truncates every shard log to empty
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and any(
+        p.stat().st_size for p in (tmp_path / "wal").glob("*.wal")
+    ):
+        time.sleep(0.01)
+    assert all(p.stat().st_size == 0
+               for p in (tmp_path / "wal").glob("*.wal"))
+    wal.close()
+
+
+def test_single_file_store_routes_to_shard_zero(tmp_path):
+    from predictionio_tpu.storage import SQLiteEventStore
+
+    s = SQLiteEventStore(tmp_path / "flat.db")
+    s.init_channel(1)
+    wal = GroupCommitWAL(s, tmp_path / "wal", commit_interval_s=0.01)
+    assert wal.route("user", "anything") == 0
+    wal.submit(1, 0, [_row(0)])
+    wal.barrier()
+    rows, _ = s.find_rows_since(1, cursor=0)
+    assert len(rows) == 1
+    wal.close()
+    s.close()

@@ -1,0 +1,326 @@
+#!/usr/bin/env python
+"""pio-levee end-to-end chaos smoke: fault-isolated multi-process
+ingest over real worker processes (`tests/test_ingest_smoke.py` runs
+it inside the gate).
+
+Boots TWO real shard-owner worker subprocesses (full `pio-tpu
+eventserver --worker-index i` with group-commit WAL) behind an
+in-process IngestRouterServer, then proves the one-shard-down
+contract:
+
+* ``steady_all_acked``     — pre-chaos load lands 201 on both owners.
+* ``healthy_zero_errors``  — worker 0 is SIGKILLed mid-load; every
+  event owned by the SURVIVING worker keeps answering 201 — zero
+  errors on healthy shards.
+* ``dead_structured_503``  — events owned by the dead worker answer a
+  structured 503 (`error: ShardUnavailable`, the owning ``shard``, a
+  ``Retry-After`` header) — never a hang, never a generic failure —
+  and a mixed batch degrades POSITIONALLY (healthy positions 201,
+  dead positions 503).
+* ``stats_monotone``       — the federated ``/stats.json`` keeps
+  reporting BOTH workers through the death (last-good cache) and its
+  totals never move backwards.
+* ``zero_acked_loss``      — the dead worker is restarted on its WAL
+  dir; every event id that was EVER acknowledged with a 201 —
+  including those acked milliseconds before the SIGKILL — is readable
+  through the router afterwards.  WAL replay on boot is what makes
+  that true.
+
+Usage::
+
+    python tools/ingest_smoke.py --out ingest_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_SHARDS = 4
+N_WORKERS = 2
+
+
+def _req(url, method="GET", payload=None, timeout=15):
+    req = urllib.request.Request(
+        url,
+        data=(json.dumps(payload).encode()
+              if payload is not None else None),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode())
+        except Exception:
+            body = {}
+        return e.code, body, dict(e.headers)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="ingest_smoke.json")
+    ap.add_argument("--n-steady", type=int, default=60)
+    ap.add_argument("--n-chaos", type=int, default=60)
+    args = ap.parse_args(argv)
+
+    home = tempfile.mkdtemp(prefix="pio_ingest_smoke_")
+    storage_env = {
+        "PIO_TPU_HOME": home,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SH",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITEMD",
+        "PIO_STORAGE_SOURCES_SH_TYPE": "sqlite-sharded",
+        "PIO_STORAGE_SOURCES_SH_PATH": os.path.join(home, "shards"),
+        "PIO_STORAGE_SOURCES_SH_SHARDS": str(N_SHARDS),
+        "PIO_STORAGE_SOURCES_SQLITEMD_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITEMD_PATH": os.path.join(home, "md.db"),
+    }
+
+    from predictionio_tpu.server.ingest_router import (
+        IngestRouterConfig,
+        boot_ingest_fleet,
+        spawn_ingest_worker,
+    )
+    from predictionio_tpu.server.router import wait_for_port_file
+    from predictionio_tpu.storage import AccessKey
+    from predictionio_tpu.storage.registry import Storage
+    from predictionio_tpu.storage.sharded_events import _shard_ix
+
+    stages: dict[str, object] = {}
+    invariants: dict[str, bool] = {}
+
+    def stage(name):
+        class _T:
+            def __enter__(self):
+                self.t0 = time.time()
+
+            def __exit__(self, *exc):
+                stages[name] = round(time.time() - self.t0, 3)
+
+        return _T()
+
+    storage = Storage(env=storage_env)
+    md = storage.get_metadata()
+    app = md.app_insert("ingestsmoke")
+    key = md.access_key_insert(AccessKey(key="", appid=app.id))
+    storage.close()
+
+    def owner_ix(user):
+        return _shard_ix("user", user, N_SHARDS) % N_WORKERS
+
+    def rate(user):
+        return {
+            "event": "rate", "entityType": "user", "entityId": user,
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 4.0},
+            "eventTime": "2020-06-01T00:00:00.000Z",
+        }
+
+    def stats_total(payload):
+        cur = payload.get("currentHour") or {}
+        return sum(r["count"] for r in cur.get("statusCount", []))
+
+    child_env = dict(os.environ)
+    child_env.update(storage_env)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    coord = Path(home) / "fleet"
+    wal_root = Path(home) / "wal"
+
+    router = None
+    spawned = []
+    restarted = None
+    rc = 1
+    acked: list[str] = []  # every event id a client got a 201 for
+    try:
+        with stage("boot_fleet"):
+            router, spawned = boot_ingest_fleet(
+                N_WORKERS, N_SHARDS, coord,
+                config=IngestRouterConfig(
+                    port=0, health_interval_s=0.25,
+                    health_timeout_s=1.0, forward_timeout_s=10.0,
+                ),
+                wal_root=wal_root, env=child_env, respawn=False,
+            )
+            router.start_background()
+            base = f"http://127.0.0.1:{router.port}"
+            deadline = time.time() + 60
+            up = 0
+            while time.time() < deadline:
+                try:
+                    _, snap, _ = _req(base + "/")
+                    up = snap["healthyWorkers"]
+                    if up == N_WORKERS:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert up == N_WORKERS, "workers never became healthy"
+
+        ev_url = f"{base}/events.json?accessKey={key}"
+        batch_url = f"{base}/batch/events.json?accessKey={key}"
+        stats_url = f"{base}/stats.json?accessKey={key}"
+
+        with stage("steady_ingest"):
+            codes = []
+            for i in range(args.n_steady):
+                st, body, _ = _req(ev_url, "POST", rate(f"u{i}"))
+                codes.append(st)
+                if st == 201:
+                    acked.append(body["eventId"])
+            invariants["steady_all_acked"] = (
+                codes == [201] * args.n_steady
+            )
+            _, s0, _ = _req(stats_url)
+            t0 = stats_total(s0)
+
+        with stage("kill_mid_load"):
+            healthy_codes: list[int] = []
+            dead_results: list[tuple[int, dict, dict]] = []
+            victim = spawned[0]["proc"]
+            killed_at = args.n_chaos // 3
+            for i in range(args.n_chaos):
+                if i == killed_at:
+                    # SIGKILL mid-load: no shutdown hook runs; only the
+                    # WAL's fsynced frames survive
+                    os.kill(victim.pid, signal.SIGKILL)
+                u = f"c{i}"
+                st, body, hdrs = _req(ev_url, "POST", rate(u))
+                if owner_ix(u) == 1:
+                    healthy_codes.append(st)
+                    if st == 201:
+                        acked.append(body["eventId"])
+                elif i < killed_at:
+                    # pre-kill acks on the doomed worker count too:
+                    # these are the ones only WAL replay can save
+                    if st == 201:
+                        acked.append(body["eventId"])
+                else:
+                    dead_results.append((st, body, hdrs))
+            invariants["healthy_zero_errors"] = (
+                bool(healthy_codes)
+                and all(c == 201 for c in healthy_codes)
+            )
+            structured = [
+                (st, body, hdrs) for st, body, hdrs in dead_results
+                if st == 503
+                and body.get("error") == "ShardUnavailable"
+                and isinstance(body.get("shard"), int)
+                and hdrs.get("Retry-After")
+            ]
+            # every dead-shard answer is the structured 503 (the kill
+            # happens between requests, so there is no torn in-flight
+            # response to excuse) and at least one was observed
+            invariants["dead_structured_503"] = (
+                bool(dead_results)
+                and len(structured) == len(dead_results)
+            )
+            stages["kill_detail"] = {
+                "healthy": len(healthy_codes),
+                "dead": len(dead_results),
+                "structured": len(structured),
+                "non201Healthy": [c for c in healthy_codes
+                                  if c != 201][:5],
+            }
+
+        with stage("degraded_batch"):
+            users = []
+            want = []
+            i = 0
+            while len(users) < 6:
+                u = f"b{i}"
+                users.append(u)
+                want.append(201 if owner_ix(u) == 1 else 503)
+                i += 1
+            st, body, hdrs = _req(batch_url, "POST",
+                                  [rate(u) for u in users])
+            got = [r.get("status") for r in body] if st == 200 else []
+            for r in (body if st == 200 else []):
+                if r.get("status") == 201:
+                    acked.append(r["eventId"])
+            invariants["degraded_batch_positional"] = (
+                st == 200 and got == want
+                and bool(hdrs.get("Retry-After"))
+            )
+            stages["batch_detail"] = {"want": want, "got": got}
+
+        with stage("stats_through_death"):
+            _, s1, _ = _req(stats_url)
+            t1 = stats_total(s1)
+            invariants["stats_monotone"] = (
+                t1 >= t0 > 0
+                and s1["workers"]["reporting"] == N_WORKERS
+                and s1["workers"]["healthy"] == N_WORKERS - 1
+            )
+
+        with stage("restart_recovery"):
+            restarted = spawn_ingest_worker(
+                0, N_WORKERS, coord, wal_root=wal_root, env=child_env,
+            )
+            port = wait_for_port_file(restarted, timeout_s=120.0)
+            w0 = router.workers[0]
+            w0.port = port
+            deadline = time.time() + 30
+            while time.time() < deadline and not w0.healthy:
+                router.check_worker(w0)
+                time.sleep(0.1)
+            assert w0.healthy, "restarted worker never became healthy"
+            missing = []
+            for eid in acked:
+                st, _, _ = _req(
+                    f"{base}/events/{eid}.json?accessKey={key}")
+                if st != 200:
+                    missing.append(eid)
+            invariants["zero_acked_loss"] = (
+                len(acked) > 0 and not missing
+            )
+            stages["recovery_detail"] = {
+                "acked": len(acked), "missing": len(missing),
+                "missingSample": missing[:5],
+            }
+
+        rc = 0 if all(invariants.values()) and len(invariants) == 6 \
+            else 1
+    finally:
+        try:
+            if router is not None:
+                router.stop()
+        except Exception:
+            pass
+        procs = [s["proc"] for s in spawned]
+        if restarted is not None:
+            procs.append(restarted["proc"])
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        out = {
+            "metric": "ingest_smoke",
+            "workers": N_WORKERS,
+            "shards": N_SHARDS,
+            "stages": stages,
+            "invariants": invariants,
+            "ok": all(invariants.values()) and len(invariants) == 6,
+        }
+        Path(args.out).write_text(json.dumps(out, indent=2) + "\n")
+        print(json.dumps(out, indent=2))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,7 +120,7 @@ class _Conn:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def _roundtrip(self, req: bytes) -> int:
+    def _roundtrip(self, req: bytes) -> tuple:
         s = self._s
         s.sendall(req)
         buf = self._buf
@@ -136,10 +136,18 @@ class _Conn:
         head = bytes(buf[:end]).split(b"\r\n")
         status = int(head[0].split(None, 2)[1])
         clen = 0
+        retry_after = None
         for ln in head[1:]:
             if ln[:15].lower() == b"content-length:":
                 clen = int(ln[15:])
-                break
+            elif ln[:12].lower() == b"retry-after:":
+                # pio-levee: a STRUCTURED degradation answer (dead
+                # shard owner / transient storage), not a failure —
+                # callers book it as backoff-and-retry, separately
+                try:
+                    retry_after = float(ln[12:])
+                except ValueError:
+                    retry_after = 1.0
         need = end + 4 + clen
         while len(buf) < need:
             chunk = s.recv(65536)
@@ -149,9 +157,9 @@ class _Conn:
         # the body must be fully drained before the next request:
         # closed-loop semantics (and keep-alive framing) require it
         del buf[:need]
-        return status
+        return status, retry_after
 
-    def request(self, path: str, body: bytes) -> int:
+    def request(self, path: str, body: bytes) -> tuple:
         req = (
             b"POST " + path.encode() + b" HTTP/1.1\r\n"
             b"Host: " + self.host.encode() + b"\r\n"
@@ -199,6 +207,7 @@ def _worker(wid: int, url: str, payloads, duration_s: float,
         outq.put({
             "worker": wid, "latencies": [], "service": [], "errors": 1,
             "requests": 1, "wall": 0.0, "truncated": False, "missed": 0,
+            "retried": 0,
             "fatal": f"{type(e).__name__}: {e}",
         })
 
@@ -223,6 +232,8 @@ def _worker_inner(wid: int, url: str, payloads, duration_s: float,
     lats: list[float] = []     # what the result's percentiles judge
     service: list[float] = []  # open-loop only: send -> drained
     errors = 0
+    retried = 0  # structured 503 + Retry-After answers (pio-levee):
+    # a degraded shard's backpressure, booked separately — NOT errors
     missed = 0  # open-loop arrivals never attempted (window closed)
     rng = random.Random((seed << 16) ^ wid)
     k = wid  # offset the payload rotation so workers don't march in step
@@ -252,12 +263,18 @@ def _worker_inner(wid: int, url: str, payloads, duration_s: float,
             k += 1
             t0 = time.perf_counter()
             try:
-                status = conn.request(path, body)
+                status, retry_after = conn.request(path, body)
                 done = time.perf_counter()
-                if status == 200:
+                if 200 <= status < 300:
                     if len(lats) < reservoir_cap:
                         lats.append(done - next_t)
                         service.append(done - t0)
+                elif status == 503 and retry_after is not None:
+                    # structured backpressure (dead shard owner /
+                    # transient storage): the schedule owns the
+                    # cadence, so book-and-move-on — a later arrival
+                    # retries the keyspace naturally
+                    retried += 1
                 else:
                     errors += 1
             except Exception:
@@ -272,11 +289,21 @@ def _worker_inner(wid: int, url: str, payloads, duration_s: float,
             k += 1
             t0 = time.perf_counter()
             try:
-                status = conn.request(path, body)
+                status, retry_after = conn.request(path, body)
                 dt = time.perf_counter() - t0
-                if status == 200:
+                if 200 <= status < 300:
                     if len(lats) < reservoir_cap:
                         lats.append(dt)
+                elif status == 503 and retry_after is not None:
+                    # structured backpressure: honor the server's
+                    # Retry-After (clipped to the window) and re-offer
+                    # the SAME body — closed-loop semantics say the
+                    # event must land, and the booking is separate so
+                    # a degraded shard can't poison the error count
+                    retried += 1
+                    k -= 1  # retry this body on the next iteration
+                    time.sleep(min(retry_after,
+                                   max(t_end - time.perf_counter(), 0)))
                 else:
                     errors += 1
             except Exception:
@@ -288,6 +315,7 @@ def _worker_inner(wid: int, url: str, payloads, duration_s: float,
         "latencies": lats,
         "service": service,
         "errors": errors,
+        "retried": retried,
         "requests": len(lats) + errors,
         "wall": wall,
         "missed": missed,
@@ -302,9 +330,15 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
     """Drive ``concurrency`` workers against ``url`` for ``duration_s``
     seconds and return the exactly-merged result::
 
-        {"concurrency", "duration_s", "requests", "errors", "qps",
-         "p50_ms", "p90_ms", "p99_ms", "mean_ms", "max_ms",
+        {"concurrency", "duration_s", "requests", "errors", "retried",
+         "qps", "p50_ms", "p90_ms", "p99_ms", "mean_ms", "max_ms",
          "latencies", "truncated", "workers"}
+
+    ``retried`` books structured 503 + Retry-After answers (a degraded
+    shard's backpressure under pio-levee) separately from ``errors``:
+    closed-loop workers honor the Retry-After and re-offer the same
+    payload; open-loop workers book-and-move-on (the schedule owns the
+    cadence).
 
     ``arrival_rate`` > 0 switches to open-loop Poisson arrivals at that
     aggregate rate (split evenly across workers): latencies are then
@@ -383,6 +417,7 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
     merged: list[float] = []
     merged_service: list[float] = []
     errors = 0
+    retried = 0
     requests = 0
     missed = 0
     max_wall = 0.0
@@ -391,6 +426,7 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
         merged.extend(r["latencies"])
         merged_service.extend(r.get("service", ()))
         errors += r["errors"]
+        retried += r.get("retried", 0)
         requests += r["requests"]
         missed += r.get("missed", 0)
         max_wall = max(max_wall, r["wall"])
@@ -406,6 +442,11 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
         "requests": requests,
         "completed": n,
         "errors": errors,
+        # structured 503 + Retry-After answers, booked apart from
+        # errors: under one-shard-down these are the dead shard's
+        # honest backpressure, and folding them into ``errors`` would
+        # abort the QPS@SLO read for a fleet that is 1/N degraded
+        "retried": retried,
         "qps": (n / max_wall) if max_wall > 0 else 0.0,
         "p50_ms": percentile(merged, 50) * 1e3,
         "p90_ms": percentile(merged, 90) * 1e3,
@@ -417,8 +458,8 @@ def run_load(url: str, payloads, concurrency: int, duration_s: float,
         "fatals": fatals,
         "workers": sorted(
             (
-                {k: r[k] for k in
-                 ("worker", "requests", "errors", "wall")}
+                {k: r.get(k) for k in
+                 ("worker", "requests", "errors", "retried", "wall")}
                 for r in results
             ),
             key=lambda r: r["worker"],

@@ -22,12 +22,23 @@ additionally reports the SERVER's own histogram view
 
 Usage: python bench_serving.py [--items 100000] [--rank 64] [--n 200]
        [--threads 16] [--platform cpu]
+       [--tenants N] [--shared-batcher on|off] [--microbatch-max 64]
+
+The ``--tenants N`` sweep serves N co-resident tenants through the
+pio-confluence shared batcher (suffix ``_mt`` on every record, tenant
+count in ``scale``); tenants are force-loaded and asserted resident
+before measurement, and any mid-sweep eviction stamps the affected
+point ``cold_reload`` so a cold reload can never silently pose as a
+steady-state number.  Fenced records stamp ``nproc`` — bench_gate
+keys rolling baselines on it, so numbers from different box shapes
+never judge each other.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -104,6 +115,18 @@ def main() -> None:
                     "shape trained ALS tables have) instead of pure "
                     "noise; what makes an IVF recall/latency trade "
                     "representative")
+    ap.add_argument("--microbatch-max", type=int, default=64,
+                    help="claim-size cap for the continuous batcher "
+                    "(ServerConfig.microbatch_max).  Smaller caps trade "
+                    "a few %% of batching efficiency for smaller turn "
+                    "quanta — on a 1-core box the p99 tail is turn-"
+                    "aligned, so capping the turn can buy back the SLO")
+    ap.add_argument("--shared-batcher", choices=("on", "off"),
+                    default="on",
+                    help="pio-confluence A/B: on (default) = ONE "
+                    "shared continuous batcher claims all tenants via "
+                    "weighted deficit round-robin; off = the "
+                    "pre-confluence private micro-batcher per tenant")
     ap.add_argument("--tenants", type=int, default=0, metavar="N",
                     help="pio-hive: stage N independent tenant models "
                     "in ONE multi-tenant server and drive the "
@@ -398,13 +421,16 @@ def _prebuilt_engine(model, algo_params=None):
 
 
 def _boot_server(engine, ep, iid, ctx, microbatch, edge="eventloop",
-                 tenants=None, slo_ms=None):
+                 tenants=None, slo_ms=None, shared_batcher=True,
+                 microbatch_max=64):
     from predictionio_tpu.server.serving import EngineServer, ServerConfig
 
     srv = EngineServer(
         engine, ep, iid, ctx=ctx,
         config=ServerConfig(port=0, microbatch=microbatch, edge=edge,
-                            slo_ms=slo_ms),
+                            slo_ms=slo_ms,
+                            shared_batcher=shared_batcher,
+                            microbatch_max=microbatch_max),
         engine_variant="bench.json",
         tenants=tenants,
     )
@@ -592,7 +618,9 @@ def _bench_sweep(args, model, rng) -> None:
     # would see (the 1m window covers a sweep point's duration)
     srv = _boot_server(engine, ep, iid, ctx, microbatch="auto",
                        edge=args.edge, tenants=registry,
-                       slo_ms=args.slo_ms)
+                       slo_ms=args.slo_ms,
+                       shared_batcher=(args.shared_batcher != "off"),
+                       microbatch_max=args.microbatch_max)
     # fenced-record keying (pio-scout satellite): the catalog size
     # rides the record's ``scale`` field — part of bench_gate's
     # baseline key — so a 1M-item sweep never shares a rolling
@@ -623,6 +651,21 @@ def _bench_sweep(args, model, rng) -> None:
                 while bsz <= min(64, max(points_c) * 2):
                     rt.batcher.batch_fn([dq] * bsz)
                     bsz *= 2
+        # an `_mt` record that measured a mid-sweep budget eviction +
+        # lazy reload is a cold-start number wearing a steady-state
+        # label — assert full residency up front and re-check after
+        # every point; a point that raced an eviction is stamped
+        # cold_reload and excluded from the qps_at_slo summary
+        expected_keys = {s.key for s in registry.specs()}
+        missing0 = expected_keys - set(registry.resident_keys())
+        if missing0:
+            print(
+                f"# WARNING: {len(missing0)} tenant(s) not resident "
+                f"after force-load (budget evicted them): "
+                f"{sorted('/'.join(k) for k in missing0)} — _mt "
+                "points will measure lazy reloads",
+                file=sys.stderr,
+            )
     payloads = [
         json.dumps({
             "user": f"u{int(u)}", "num": args.num,
@@ -641,6 +684,7 @@ def _bench_sweep(args, model, rng) -> None:
     points = []
     for c in points_c:
         before = seg_snapshot()
+        ev_before = registry.evictions if registry is not None else 0
         res = loadgen.run_load(
             f"{base}/queries.json", payloads, c, args.duration_s,
             mode=args.loadgen_mode, arrival_rate=args.arrival_rate,
@@ -666,6 +710,22 @@ def _bench_sweep(args, model, rng) -> None:
         }
         if srv._burn is not None:
             point["burn_rate_1m"] = round(srv._burn.rate(60.0), 4)
+        if registry is not None:
+            ev_delta = registry.evictions - ev_before
+            missing = expected_keys - set(registry.resident_keys())
+            if ev_delta or missing:
+                point["cold_reload"] = True
+                point["evictions_during"] = ev_delta
+                point["tenants_missing"] = sorted(
+                    "/".join(k) for k in missing
+                )
+                print(
+                    f"# WARNING: c={c} point raced a budget eviction "
+                    f"({ev_delta} eviction(s), missing: "
+                    f"{point['tenants_missing']}) — measured a lazy "
+                    "reload, excluded from qps_at_slo",
+                    file=sys.stderr,
+                )
         points.append(point)
         rec = {
             "metric": f"serving_p99_ms_c{c}{suffix}",
@@ -674,6 +734,7 @@ def _bench_sweep(args, model, rng) -> None:
             "direction": "down",
             "platform": platform,
             "scale": rec_scale,
+            "nproc": os.cpu_count() or 1,
             "fenced": True,
             "retrieval": args.retrieval,
             "qps": point["qps"],
@@ -689,6 +750,10 @@ def _bench_sweep(args, model, rng) -> None:
             **({"arrival_rate": args.arrival_rate,
                 "service_p99_ms": round(res["service_p99_ms"], 3)}
                if args.arrival_rate else {}),
+            **({"cold_reload": True,
+                "evictions_during": point["evictions_during"],
+                "tenants_missing": point["tenants_missing"]}
+               if point.get("cold_reload") else {}),
         }
         print(json.dumps(rec), flush=True)
         if args.append_history:
@@ -713,6 +778,7 @@ def _bench_sweep(args, model, rng) -> None:
     ok_points = [
         p for p in points
         if p["p99_ms"] <= args.slo_ms and p["errors"] == 0
+        and not p.get("cold_reload")
     ]
     if ok_points:
         best = max(ok_points, key=lambda p: p["qps"])
@@ -725,6 +791,7 @@ def _bench_sweep(args, model, rng) -> None:
             "direction": "up",
             "platform": platform,
             "scale": rec_scale,
+            "nproc": os.cpu_count() or 1,
             "fenced": True,
             "retrieval": args.retrieval,
             "slo_ms": args.slo_ms,

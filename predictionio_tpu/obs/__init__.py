@@ -374,6 +374,14 @@ TENANT_QUOTA_REJECTED = _registry.counter(
     "Queries shed by a tenant's token-bucket quota (structured 429)",
     labels=("app", "variant"),
 )
+TENANT_PLACEMENT_BALANCE = _registry.gauge(
+    "pio_tenant_placement_balance",
+    "Jain fairness index over resident tenants' accounted bytes "
+    "(pio-confluence placement balance): 1.0 = perfectly even "
+    "tenant->memory placement, 1/N = one tenant holds everything, "
+    "0 = nothing resident.  Recomputed on every registry load/evict "
+    "so the fenced _mt sweep can judge balance beside throughput",
+)
 VARIANT_REQUESTS_TOTAL = _registry.counter(
     "pio_variant_requests_total",
     "Online-eval impressions: queries served per (app, variant)",
@@ -472,6 +480,7 @@ MODEL_FRESHNESS_SECONDS.child()
 FOLDIN_WATERMARK_LAG.child()
 WAL_FSYNC_SECONDS.child()
 WAL_COMMIT_ROWS.child()
+TENANT_PLACEMENT_BALANCE.child()
 
 
 @contextlib.contextmanager

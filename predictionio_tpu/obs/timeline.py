@@ -136,6 +136,14 @@ MICROBATCH_ADMISSION_TOTAL = _registry.counter(
     "without ever reaching the device",
     labels=("outcome",),
 )
+MICROBATCH_TENANTS_PER_BATCH = _registry.histogram(
+    "pio_microbatch_tenants_per_batch",
+    "Distinct tenants coalesced into one shared-batcher dispatcher "
+    "claim (pio-confluence): >1 means cross-tenant traffic rode one "
+    "dispatcher turn instead of competing per-tenant device queues — "
+    "the mixing evidence the hive_smoke gate asserts",
+    buckets=(1, 2, 4, 8, 16, 32),
+)
 
 # children cached at import: .labels() is a dict build + lock per call
 # (~1.5 us), too hot for per-request use — and materializing them keeps
@@ -164,6 +172,7 @@ SERVE_INFLIGHT.child()
 MICROBATCH_QUEUE_DEPTH.child()
 MICROBATCH_BATCH_SIZE.child()
 MICROBATCH_WAIT_SECONDS.child()
+MICROBATCH_TENANTS_PER_BATCH.child()
 MICROBATCH_ROLE_TOTAL.labels(role="leader")
 MICROBATCH_ROLE_TOTAL.labels(role="follower")
 MICROBATCH_ROLE_TOTAL.labels(role="dispatched")

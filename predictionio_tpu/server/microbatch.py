@@ -62,6 +62,7 @@ from ..obs.timeline import (
     MICROBATCH_BATCH_SIZE,
     MICROBATCH_QUEUE_DEPTH,
     MICROBATCH_ROLE_TOTAL,
+    MICROBATCH_TENANTS_PER_BATCH,
     MICROBATCH_WAIT_SECONDS,
     annotate,
     current_timeline,
@@ -72,6 +73,8 @@ __all__ = [
     "AdmissionRejected",
     "EwmaEstimator",
     "MicroBatcher",
+    "SharedBatcher",
+    "SharedBatcherView",
     "dispatchable_sizes",
 ]
 
@@ -88,6 +91,7 @@ _m_follower = MICROBATCH_ROLE_TOTAL.labels(role="follower")
 _m_dispatched = MICROBATCH_ROLE_TOTAL.labels(role="dispatched")
 _m_adm_rejected = MICROBATCH_ADMISSION_TOTAL.labels(outcome="rejected")
 _m_adm_expired = MICROBATCH_ADMISSION_TOTAL.labels(outcome="expired")
+_m_tenants_per_batch = MICROBATCH_TENANTS_PER_BATCH.child()
 
 # distinguishes "no result produced" from a legitimate None result —
 # batch_fns whose valid outputs include None must not have them
@@ -163,18 +167,29 @@ class _Entry:
     # thread) and read AFTER ``done`` — the condition variable's
     # release/acquire (blocking path) or the dispatcher's post-batch
     # callback (continuous path) orders the writes before the read
+    # tenant/fn are the pio-confluence fields: which tenant the entry
+    # belongs to (the WDRR claim key) and which batch_fn executes it
+    # (the group key — entries sharing a fn coalesce into ONE device
+    # call; None means the owning batcher's own batch_fn).  An entry
+    # carries its fn for its whole life, so in-flight queries complete
+    # on the model they snapshotted even across a tenant reload.
     __slots__ = ("item", "done", "value", "error", "deadline", "tl",
-                 "on_done", "t_enq", "t_claim", "t_run0", "t_run1")
+                 "on_done", "tenant", "fn", "cb_fired",
+                 "t_enq", "t_claim", "t_run0", "t_run1")
 
     def __init__(self, item, deadline: Optional[Deadline] = None,
-                 tl=None, on_done: Optional[Callable] = None):
+                 tl=None, on_done: Optional[Callable] = None,
+                 tenant=None, fn: Optional[Callable] = None):
         self.item = item
         self.done = False
+        self.cb_fired = False
         self.value = _UNSET
         self.error: Exception | None = None
         self.deadline = deadline
         self.tl = tl
         self.on_done = on_done
+        self.tenant = tenant
+        self.fn = fn
         self.t_enq = time.perf_counter()
         self.t_claim = None
         self.t_run0 = None
@@ -222,6 +237,11 @@ class MicroBatcher:
         # estimator's input.  Seeded 0 (= "no evidence, admit"), so a
         # cold batcher never sheds; mutated only under _cond.
         self._ewma = EwmaEstimator()
+        # full service time of the last dispatcher/leader turn (all
+        # execution groups back-to-back) — what the EWMA observes;
+        # written by _run_batch on the leading thread, read by _lead
+        # on the same thread under the re-acquired lock
+        self._turn_s = 0.0
         # observability: how the batcher is actually coalescing.
         # Mutated only under _cond; read through stats() (bare reads
         # tore under concurrency — serving status JSON and the benches
@@ -298,12 +318,15 @@ class MicroBatcher:
 
     # -- submission paths --------------------------------------------------
     def submit(self, item: Any,
-               deadline: Optional[Deadline] = None) -> Any:
+               deadline: Optional[Deadline] = None,
+               tenant=None, fn: Optional[Callable] = None) -> Any:
         """Blocking submit: returns the result (or raises) on the
         calling thread.  With no dispatcher running, the classic
         leader/follower flow; with one, the caller parks as a follower
-        of the dispatcher's batches."""
-        entry = _Entry(item, deadline=deadline)
+        of the dispatcher's batches.  ``tenant``/``fn`` are the shared-
+        batcher routing fields (see :class:`SharedBatcherView`); plain
+        batchers leave them None."""
+        entry = _Entry(item, deadline=deadline, tenant=tenant, fn=fn)
         led_own = False
         with self._cond:
             self._pending.append(entry)
@@ -341,7 +364,8 @@ class MicroBatcher:
 
     def submit_nowait(self, item: Any, on_done: Callable[["_Entry"], None],
                       deadline: Optional[Deadline] = None,
-                      timeline=None) -> None:
+                      timeline=None, tenant=None,
+                      fn: Optional[Callable] = None) -> None:
         """Continuous (callback) submit: the entry is admitted into the
         pending queue immediately and ``on_done(entry)`` fires — on the
         dispatcher thread, after the entry's timeline is booked — once
@@ -350,7 +374,7 @@ class MicroBatcher:
         up, so arrivals ride the NEXT device call rather than waiting
         out a batch boundary."""
         entry = _Entry(item, deadline=deadline, tl=timeline,
-                       on_done=on_done)
+                       on_done=on_done, tenant=tenant, fn=fn)
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
@@ -507,9 +531,11 @@ class MicroBatcher:
             if live:
                 self.batches += 1
                 self.max_seen = max(self.max_seen, len(live))
-                e0 = live[0]
-                if e0.t_run0 is not None and e0.t_run1 is not None:
-                    self._ewma.observe(max(e0.t_run1 - e0.t_run0, 0.0))
+                # the estimator tracks the FULL turn (every execution
+                # group back-to-back), not just the first group's call
+                if self._turn_s > 0.0:
+                    self._ewma.observe(self._turn_s)
+                    self._turn_s = 0.0
             self.requests += len(batch)
             self.expired += n_expired
             # continuous entries get the third role: the dispatcher ran
@@ -519,30 +545,76 @@ class MicroBatcher:
                 self.dispatched += n_disp
                 _m_dispatched.inc(n_disp)
             self._cond.notify_all()
-            # continuous-path completions: book timelines and fire the
-            # callbacks OUTSIDE the lock (a callback enqueues response
-            # bytes to the event loop / runs serving.serve — neither
-            # may hold the batcher's condition).  Inside the finally so
-            # even a BaseException tearing through the leader still
-            # answers every event-loop request (their entries carry the
-            # leader-abort error by this point).
-            cbs = [e for e in batch if e.on_done is not None]
+            # end-of-turn sweep for continuous-path entries whose
+            # callbacks did NOT fire per-group in _run_batch: claim-time
+            # deadline expiries (never executed) and anything a
+            # BaseException tore past.  Inside the finally so even an
+            # aborted leader still answers every event-loop request
+            # (their entries carry the leader-abort error by now).
+            cbs = [e for e in batch
+                   if e.on_done is not None and not e.cb_fired]
             if cbs:
                 self._cond.release()
                 try:
-                    for e in cbs:
-                        self._book_timeline(e)
-                        try:
-                            e.on_done(e)
-                        except Exception:
-                            logger.exception(
-                                "microbatch completion callback failed"
-                            )
+                    self._fire_callbacks(cbs)
                 finally:
                     self._cond.acquire()
 
+    def _group(self, batch: list[_Entry]) -> list:
+        """Partition one claimed batch into execution groups
+        ``[(batch_fn, entries)]``.  The plain batcher has ONE group —
+        its own ``batch_fn`` — so a claim is one device call exactly as
+        before.  The shared batcher groups by each entry's carried fn
+        (per-tenant model identity): entries sharing a fn coalesce into
+        one device call; distinct models run back-to-back inside the
+        same dispatcher turn."""
+        by_fn: dict = {}
+        order = []
+        for e in batch:
+            k = id(e.fn) if e.fn is not None else 0
+            g = by_fn.get(k)
+            if g is None:
+                g = (e.fn if e.fn is not None else self.batch_fn, [])
+                by_fn[k] = g
+                order.append(k)
+            g[1].append(e)
+        return [by_fn[k] for k in order]
+
     def _run_batch(self, batch: list[_Entry]) -> None:
-        """Execute one batch; on failure, isolate the blast radius.
+        """Execute one claimed batch as its execution groups, measuring
+        the FULL turn (what the admission estimator predicts).
+
+        Each group's continuous-path callbacks fire the moment THAT
+        group's device call returns — before the next group runs.  With
+        end-of-turn firing, a multi-model turn made every group-1
+        client wait out group-2's device time as pure batch_wait, and
+        closed-loop clients locksteped onto whole-turn boundaries
+        (measured: 2-tenant QPS@SLO dropped ~25% and p99 grew by a
+        full group time).  Runs WITHOUT the lock held."""
+        t0 = time.perf_counter()
+        for fn, entries in self._group(batch):
+            self._exec_group(fn, entries)
+            self._fire_callbacks(entries)
+        self._turn_s = max(time.perf_counter() - t0, 0.0)
+
+    def _fire_callbacks(self, entries: list[_Entry]) -> None:
+        """Book timelines and fire continuous-path callbacks for
+        already-executed entries.  Idempotent per entry (``cb_fired``),
+        so the leader's end-of-turn sweep can still answer anything a
+        BaseException left unfired.  Must be called WITHOUT the lock —
+        callbacks enqueue response bytes to the event loop."""
+        for e in entries:
+            if e.on_done is None or e.cb_fired:
+                continue
+            e.cb_fired = True
+            self._book_timeline(e)
+            try:
+                e.on_done(e)
+            except Exception:
+                logger.exception("microbatch completion callback failed")
+
+    def _exec_group(self, fn: Callable, batch: list[_Entry]) -> None:
+        """Run one device call; on failure, isolate the blast radius.
 
         A batched device call is all-or-nothing, so one malformed query
         would otherwise fail every innocent request coalesced with it
@@ -564,7 +636,7 @@ class MicroBatcher:
                 _m_batch_wait.observe(max(t0 - batch[0].t_claim, 0.0))
             _m_batch_size.observe(float(n))
             with annotate(f"pio.device.batch{len(items)}"):
-                results = self.batch_fn(items)
+                results = fn(items)
             t1 = time.perf_counter()
             for e in batch:
                 e.t_run1 = t1
@@ -581,7 +653,291 @@ class MicroBatcher:
                 return
             for e in batch:
                 try:
-                    (r,) = self.batch_fn([e.item])
+                    (r,) = fn([e.item])
                     e.value = r
                 except Exception as solo:  # noqa: BLE001
                     e.error = solo
+
+
+class SharedBatcher(MicroBatcher):
+    """ONE continuous batcher for the whole hive (pio-confluence).
+
+    The pio-hive design gave every tenant a private ``MicroBatcher``:
+    under mixed-tenant load, T tenants mean T dispatcher threads each
+    coalescing only 1/T of the traffic and competing for the single
+    device queue — measured as QPS@SLO(2 tenants) ~1/3 of the
+    single-tenant line on the same box.  This class keeps the exact
+    claim/run core (one pending queue, one lazily-started dispatcher,
+    leader/follower blocking path) and changes WHO gets claimed:
+
+    * **Claim-time weighted deficit round-robin across tenants.**  Each
+      claim walks the tenants with pending entries in rotation order;
+      every round a tenant's deficit grows by its weight (normalized to
+      the largest active weight, floored at ``MIN_SHARE`` so even a
+      zero-weighted tenant drains) and each whole unit of deficit buys
+      one entry into the batch.  A whale tenant flooding the queue
+      therefore claims at most its weighted share per turn while every
+      other tenant keeps its own share — starvation-free by
+      construction, with FIFO order preserved *within* each tenant.
+      A claim with only one tenant pending short-circuits to the plain
+      FIFO claim (the solo path pays nothing for the machinery).
+    * **Group-keyed execution.**  Claimed entries carry their tenant's
+      ``batch_fn``; entries sharing a fn (co-resident same-model
+      tenants, or many queries of one tenant) coalesce into ONE padded
+      device call, distinct models run back-to-back inside the same
+      dispatcher turn — one dispatcher, one device queue walk, no
+      cross-tenant thread competition.
+
+    Per-tenant deadline admission, token-bucket quota, and breaker
+    checks all stay at enqueue (the registry's ``resolve()`` and the
+    serving edge's ``check_admission``) — a query that should shed is
+    answered before it ever touches this shared state.
+
+    Weights are PULLED at claim time via per-tenant ``weight_fn``
+    callbacks (the serving layer points them at the registry's
+    experiment weights), so a hot ``POST /tenants/weights`` update
+    reshapes the very next claim with no push plumbing.
+    """
+
+    # floor on a tenant's relative claim share: even weight-0 tenants
+    # accrue deficit at 1/20 of the heaviest, so nothing queued can be
+    # starved and the WDRR loop is bounded (<= 20 rounds per claim)
+    MIN_SHARE = 0.05
+
+    def __init__(self, max_batch: int = 64, max_wait_s: float = 0.0,
+                 pad_batches: bool = True):
+        # no default batch_fn: every entry must carry its tenant's fn
+        def _no_fn(items):
+            raise RuntimeError(
+                "SharedBatcher entries must carry a batch_fn "
+                "(submit via a SharedBatcherView)"
+            )
+
+        super().__init__(_no_fn, max_batch=max_batch,
+                         max_wait_s=max_wait_s, pad_batches=pad_batches)
+        # all guarded by _cond, like every other mutable field
+        self._weights: dict = {}
+        self._weight_fns: dict = {}
+        self._reg_counts: dict = {}
+        self._deficit: dict = {}
+        self._rr: list = []
+        self.mixed_batches = 0
+        self.tenant_claims: dict = {}
+
+    # -- tenant lifecycle --------------------------------------------------
+    def register_tenant(self, tenant, weight: float = 1.0,
+                        weight_fn: Optional[Callable] = None) -> None:
+        """A view's registration.  Registration counts are per tenant
+        key: a reload registers the NEW view before closing the old
+        one, and the tenant's scheduling state must survive the
+        overlap."""
+        with self._cond:
+            self._reg_counts[tenant] = self._reg_counts.get(tenant, 0) + 1
+            self._weights[tenant] = float(weight)
+            if weight_fn is not None:
+                self._weight_fns[tenant] = weight_fn
+            if tenant not in self._rr:
+                self._rr.append(tenant)
+
+    def retire_tenant(self, tenant) -> None:
+        """Drop a tenant's scheduling state once its LAST view closes
+        (eviction/removal).  Entries it already enqueued still complete
+        — they carry their own fn."""
+        with self._cond:
+            n = self._reg_counts.get(tenant, 0) - 1
+            if n > 0:
+                self._reg_counts[tenant] = n
+                return
+            self._reg_counts.pop(tenant, None)
+            self._weights.pop(tenant, None)
+            self._weight_fns.pop(tenant, None)
+            self._deficit.pop(tenant, None)
+            if tenant in self._rr:
+                self._rr.remove(tenant)
+
+    def set_weights(self, weights: dict) -> None:
+        """Push-style weight update (tests / non-registry callers; the
+        serving layer uses pull via weight_fn)."""
+        with self._cond:
+            for t, w in weights.items():
+                self._weights[t] = float(w)
+
+    def _weight_of_locked(self, tenant) -> float:
+        fn = self._weight_fns.get(tenant)
+        if fn is not None:
+            try:
+                w = float(fn())
+                if w > 0.0:
+                    return w
+            except Exception:
+                logger.exception("weight_fn for tenant %r failed", tenant)
+        w = self._weights.get(tenant, 1.0)
+        return w if w > 0.0 else 0.0
+
+    # -- claim policy ------------------------------------------------------
+    def _claim_locked(self) -> list[_Entry]:
+        pend = self._pending
+        if not pend:
+            return []
+        by_tenant: dict = {}
+        order: list = []
+        for e in pend:
+            q = by_tenant.get(e.tenant)
+            if q is None:
+                q = by_tenant[e.tenant] = []
+                order.append(e.tenant)
+            q.append(e)
+        if len(by_tenant) == 1:
+            # solo-tenant claim: plain FIFO, zero WDRR overhead (the
+            # single-tenant server and idle-hive case)
+            batch = super()._claim_locked()
+            if batch:
+                _m_tenants_per_batch.observe(1.0)
+                t0 = batch[0].tenant
+                self.tenant_claims[t0] = (
+                    self.tenant_claims.get(t0, 0) + len(batch)
+                )
+            return batch
+        # rotation order: persistent registration order, rotated one
+        # step per claim so no tenant permanently goes first; tenants
+        # that only appear in the queue (e.g. already-retired) append
+        for t in order:
+            if t not in self._rr:
+                self._rr.append(t)
+        walk = [t for t in self._rr if t in by_tenant]
+        # weights normalized to the largest ACTIVE weight, floored —
+        # the round count per claim is bounded by 1/MIN_SHARE
+        weights = {t: self._weight_of_locked(t) for t in walk}
+        wmax = max(weights.values()) or 1.0
+        share = {
+            t: max(weights[t] / wmax, self.MIN_SHARE) for t in walk
+        }
+        deficit = self._deficit
+        batch: list[_Entry] = []
+        room = self.max_batch
+        while room > 0 and any(by_tenant[t] for t in walk):
+            for t in walk:
+                q = by_tenant[t]
+                if not q:
+                    # classic DRR: an empty queue forfeits its deficit
+                    # (banked credit would burst later, not smooth)
+                    deficit.pop(t, None)
+                    continue
+                d = deficit.get(t, 0.0) + share[t]
+                while q and room > 0 and d >= 1.0:
+                    batch.append(q.pop(0))
+                    d -= 1.0
+                    room -= 1
+                deficit[t] = d
+                if room <= 0:
+                    break
+        # remove claimed entries from pending, preserving FIFO order
+        claimed = {id(e) for e in batch}
+        self._pending = [e for e in pend if id(e) not in claimed]
+        now = time.perf_counter()
+        tenants_seen = set()
+        for e in batch:
+            e.t_claim = now
+            tenants_seen.add(e.tenant)
+            self.tenant_claims[e.tenant] = (
+                self.tenant_claims.get(e.tenant, 0) + 1
+            )
+        if len(tenants_seen) > 1:
+            self.mixed_batches += 1
+        if batch:
+            _m_tenants_per_batch.observe(float(len(tenants_seen)))
+        if self._rr:
+            self._rr.append(self._rr.pop(0))
+        _m_queue_depth.set(float(len(self._pending)))
+        return batch
+
+    # -- observability -----------------------------------------------------
+    def reset_stats(self) -> None:
+        super().reset_stats()
+        with self._cond:
+            self.mixed_batches = 0
+            self.tenant_claims = {}
+
+    def stats(self) -> dict:
+        out = super().stats()
+        with self._cond:
+            out["shared"] = True
+            out["tenantsRegistered"] = len(self._reg_counts)
+            out["mixedBatches"] = self.mixed_batches
+            out["tenantClaims"] = {
+                ("/".join(str(p) for p in k) if isinstance(k, tuple)
+                 else str(k)): v
+                for k, v in self.tenant_claims.items()
+            }
+        return out
+
+
+class SharedBatcherView:
+    """One tenant's handle on the process-wide :class:`SharedBatcher`.
+
+    Exposes the exact surface the serving edges and benches already
+    use on a private ``MicroBatcher`` (``submit`` / ``submit_nowait`` /
+    ``check_admission`` / ``estimate_wait_s`` / ``stats`` /
+    ``batch_fn`` / ``close``), stamping every entry with the tenant key
+    and the tenant's own ``batch_fn``.  ``close()`` retires only THIS
+    tenant's scheduling state — in-flight entries complete on the fn
+    they carry, and the shared core (and its dispatcher) lives until
+    the server stops."""
+
+    __slots__ = ("core", "tenant", "batch_fn", "_closed")
+
+    def __init__(self, core: SharedBatcher, tenant, batch_fn: Callable,
+                 weight: float = 1.0,
+                 weight_fn: Optional[Callable] = None):
+        self.core = core
+        self.tenant = tenant
+        self.batch_fn = batch_fn
+        self._closed = False
+        core.register_tenant(tenant, weight=weight, weight_fn=weight_fn)
+
+    @property
+    def max_batch(self) -> int:
+        return self.core.max_batch
+
+    @property
+    def pad_batches(self) -> bool:
+        return self.core.pad_batches
+
+    def estimate_wait_s(self) -> float:
+        return self.core.estimate_wait_s()
+
+    def check_admission(self, deadline: Optional[Deadline]) -> None:
+        self.core.check_admission(deadline)
+
+    def stats(self) -> dict:
+        out = self.core.stats()
+        out["tenant"] = str(self.tenant)
+        return out
+
+    def reset_stats(self) -> None:
+        self.core.reset_stats()
+
+    def submit(self, item: Any,
+               deadline: Optional[Deadline] = None) -> Any:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        return self.core.submit(item, deadline=deadline,
+                                tenant=self.tenant, fn=self.batch_fn)
+
+    def submit_nowait(self, item: Any, on_done: Callable,
+                      deadline: Optional[Deadline] = None,
+                      timeline=None) -> None:
+        # closed-view submits raise the same RuntimeError a closed
+        # MicroBatcher does: the event-loop edge's reload-retry path
+        # keys on it
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        self.core.submit_nowait(item, on_done, deadline=deadline,
+                                timeline=timeline, tenant=self.tenant,
+                                fn=self.batch_fn)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.core.retire_tenant(self.tenant)

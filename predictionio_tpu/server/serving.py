@@ -87,6 +87,7 @@ class ServerConfig:
                  access_key: Optional[str] = None,
                  log_url: Optional[str] = None, log_prefix: str = "",
                  microbatch: str = "auto", microbatch_max: int = 64,
+                 shared_batcher: bool = True,
                  query_timeout_s: Optional[float] = None,
                  feedback_capacity: int = 1024,
                  delivery_attempts: int = 50,
@@ -128,6 +129,14 @@ class ServerConfig:
         # "on" forces it, "off" keeps per-request device dispatch
         self.microbatch = microbatch
         self.microbatch_max = microbatch_max
+        # pio-confluence: ONE shared continuous batcher per server —
+        # every tenant submits into a single pending queue whose
+        # dispatcher claims via weighted deficit round-robin across
+        # tenants, so cross-tenant concurrency coalesces onto the
+        # device instead of competing per-tenant dispatchers.  Off =
+        # the pre-confluence private-batcher-per-tenant layout (kept
+        # for A/B benchmarking and as an operator escape hatch).
+        self.shared_batcher = shared_batcher
         # per-request time budget (None = unbounded, the pre-resilience
         # behavior); expiry answers a structured 503 instead of queueing
         # device work for a client that already gave up
@@ -206,13 +215,54 @@ def _takes_max_batch(fn: Callable) -> bool:
     )
 
 
-def _warm_components(algorithms, models, warm_max: int) -> None:
+def _warm_signature(algo, model, warm_max: int) -> Optional[tuple]:
+    """Shape signature of one (algorithm, model) warmup obligation —
+    two tenants with equal signatures compile the SAME pow2 executable
+    ladder (jit caches key on function identity + abstract shapes), so
+    the second tenant's full-ladder warmup would be pure cache hits.
+    None (unrecognizable model) means "never share"."""
+    try:
+        fields = vars(model)
+    except TypeError:
+        return None
+    shapes = []
+    for name in sorted(fields):
+        v = fields[name]
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is not None and dtype is not None:
+            shapes.append((name, tuple(shape), str(dtype)))
+    if not shapes:
+        return None
+    try:
+        params_repr = repr(getattr(algo, "params", None))
+    except Exception:
+        params_repr = "?"
+    return (type(algo).__module__, type(algo).__qualname__,
+            params_repr, warm_max, tuple(shapes))
+
+
+def _warm_components(algorithms, models, warm_max: int,
+                     seen: Optional[set] = None) -> None:
     """Run each algorithm's warmup ladder (shared by the engine
     server's own ``_load`` and the pio-hive tenant loader — a lazily
     loaded tenant gets the exact same compile obligations a deployed
     single model does).  A warmup failure only costs the first query a
-    compile; it never fails the load."""
+    compile; it never fails the load.
+
+    ``seen`` (pio-confluence) shares the ladder across co-shaped
+    tenants: the FIRST (algo, model) with a given shape signature
+    warms the full pow2 ladder; later co-shaped ones warm only
+    ``max_batch=1`` — enough to materialize their own per-model device
+    arrays, while every batched executable comes out of the jit cache
+    the first tenant already filled.  A concurrent double-warm is a
+    benign race (both warm fully), so ``seen`` needs no lock."""
     for algo, model in zip(algorithms, models):
+        algo_max = warm_max
+        sig = _warm_signature(algo, model, warm_max) if seen is not None \
+            else None
+        if sig is not None and sig in seen:
+            algo_max = 1
         t0 = time.perf_counter()
         try:
             # pass the batcher's real maximum so the warmup ladder
@@ -220,7 +270,7 @@ def _warm_components(algorithms, models, warm_max: int) -> None:
             # with the pre-max_batch one-arg signature still work
             if _takes_max_batch(algo.warmup):
                 try:
-                    algo.warmup(model, max_batch=warm_max)
+                    algo.warmup(model, max_batch=algo_max)
                 except TypeError:
                     # a decorator-erased signature (*args/**kwargs
                     # wrapper around an old one-arg hook) can lie
@@ -236,6 +286,8 @@ def _warm_components(algorithms, models, warm_max: int) -> None:
                 type(algo).__name__,
             )
         else:
+            if sig is not None:
+                seen.add(sig)
             dt = time.perf_counter() - t0
             if dt > 0.05:
                 logger.info("%s warmed up in %.2fs",
@@ -364,6 +416,14 @@ class EngineServer(HTTPServerBase):
         # reload, /debug/profile, fold-in apply, unbatched predicts);
         # built lazily at first bind of the eventloop edge
         self._aux_pool = None
+        # pio-confluence: the process-wide shared batcher core (built
+        # lazily by the first _make_batcher call that wants one) plus
+        # the warmup-ladder signature set — co-shaped tenant models
+        # share one compile per pow2 batch shape instead of re-warming
+        # the full ladder per tenant
+        self._shared_core = None
+        self._shared_lock = threading.Lock()
+        self._warm_signatures: set = set()
         self._foldin_stop = threading.Event()
         self._load(instance_id)
         if self.config.foldin_poll_s:
@@ -459,7 +519,8 @@ class EngineServer(HTTPServerBase):
         # 0 = "no batched path at all" (empty warmup ladder); a real
         # batcher with microbatch_max=1 still needs its B=1 shapes
         warm_max = self.config.microbatch_max if batcher is not None else 0
-        _warm_components(algorithms, models, warm_max)
+        _warm_components(algorithms, models, warm_max,
+                         seen=self._warm_signatures)
         with self._lock:
             old_batcher = getattr(self, "batcher", None)
             self.engine_params = engine_params
@@ -568,9 +629,10 @@ class EngineServer(HTTPServerBase):
         algorithms, models, serving = prepare_deploy_components(
             engine, ep, iid, ctx=ctx
         )
-        batcher = self._make_batcher(algorithms, models)
+        batcher = self._make_batcher(algorithms, models, tenant=spec.key)
         warm_max = self.config.microbatch_max if batcher is not None else 0
-        _warm_components(algorithms, models, warm_max)
+        _warm_components(algorithms, models, warm_max,
+                         seen=self._warm_signatures)
         return TenantRuntime(
             spec, engine, ep, iid, algorithms, models, serving, batcher,
             _default_query_decoder(engine, ep), ctx,
@@ -588,7 +650,7 @@ class EngineServer(HTTPServerBase):
             except Exception:
                 logger.exception("online-eval refresh failed")
 
-    def _make_batcher(self, algorithms, models):
+    def _make_batcher(self, algorithms, models, tenant=None):
         """Build the query micro-batcher for this (algorithms, models)
         snapshot — or None when batching can't help.
 
@@ -639,10 +701,41 @@ class EngineServer(HTTPServerBase):
         # pad_batches: predicts are pure per-item maps, and padding
         # bounds the per-batch-size XLA executables to log2(max)+1
         # instead of compiling mid-traffic for every new size
-        return MicroBatcher(
-            batch_fn, max_batch=self.config.microbatch_max,
-            pad_batches=True,
-        )
+        if not self.config.shared_batcher:
+            return MicroBatcher(
+                batch_fn, max_batch=self.config.microbatch_max,
+                pad_batches=True,
+            )
+        # pio-confluence: every tenant (and the anchor) gets a VIEW on
+        # one process-wide SharedBatcher — single pending queue, single
+        # dispatcher, claim-time weighted deficit round-robin across
+        # tenants.  The view carries this snapshot's batch_fn, so
+        # entries group by model identity inside a claim and in-flight
+        # queries survive a reload on the model they snapshotted.
+        from .microbatch import SharedBatcher, SharedBatcherView
+
+        with self._shared_lock:
+            if self._shared_core is None:
+                self._shared_core = SharedBatcher(
+                    max_batch=self.config.microbatch_max,
+                    pad_batches=True,
+                )
+            core = self._shared_core
+        if tenant is None:
+            tenants = getattr(self, "tenants", None)
+            tenant = tenants.anchor_key if tenants is not None \
+                else "__anchor__"
+        weight_fn = None
+        if self.tenants is not None:
+            registry, key = self.tenants, tenant
+
+            def weight_fn():
+                # pulled at claim time: a hot POST /tenants/weights
+                # reshapes the very next dispatcher claim
+                return registry.deficit_weight(key)
+
+        return SharedBatcherView(core, tenant, batch_fn,
+                                 weight_fn=weight_fn)
 
     def reload(self) -> str:
         """Swap in the latest COMPLETED instance (GET /reload).
@@ -1663,6 +1756,13 @@ class EngineServer(HTTPServerBase):
             batcher = getattr(self, "batcher", None)
         if batcher is not None:
             batcher.close()
+        # pio-confluence: a view's close only retires its tenant; the
+        # shared core (and its dispatcher thread) is the server's to
+        # stop
+        with self._shared_lock:
+            core, self._shared_core = self._shared_core, None
+        if core is not None:
+            core.close()
         if self._aux_pool is not None:
             self._aux_pool.shutdown(wait=False)
             self._aux_pool = None

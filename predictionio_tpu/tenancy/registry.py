@@ -47,6 +47,7 @@ from ..obs import (
     FOLDIN_APPLIES_TOTAL,
     TENANT_LOADS_TOTAL,
     TENANT_MEMORY_BUDGET,
+    TENANT_PLACEMENT_BALANCE,
     TENANT_QUERIES_TOTAL,
     TENANT_QUERY_LATENCY,
     TENANT_QUOTA_REJECTED,
@@ -356,6 +357,11 @@ class TenantRegistry:
         self.loads = 0
         self.evictions = 0
         self.overcommits = 0
+        # pio-confluence: budget evictions performed to make room for
+        # an INCOMING tenant (the registry rebalancing placement, as
+        # opposed to an admin shrink/evict) — paired with the
+        # pio_tenant_placement_balance gauge
+        self.rebalances = 0
         self.online = OnlineEval(salt=salt)
 
     # -- spec / experiment views ------------------------------------------
@@ -387,6 +393,27 @@ class TenantRegistry:
         exp = self.experiment(app)
         exp.set_weights({str(k): float(v) for k, v in weights.items()})
         return exp.snapshot()
+
+    def deficit_weight(self, key: tuple[str, str]) -> float:
+        """One tenant's share weight for the shared batcher's claim-time
+        deficit round-robin (pio-confluence): its variant weight
+        normalized by its app's total, so an app splitting traffic
+        90/10 across variants claims device share 90/10 too, and apps
+        are peers.  Reads the LIVE experiment weights — a hot ``POST
+        /tenants/weights`` reshapes the next dispatcher claim with no
+        push plumbing.  Unknown tenants weigh 1.0 (never let a
+        scheduling lookup shed a query)."""
+        app, variant = key
+        with self._lock:
+            exp = self._experiments.get(app)
+            if exp is None:
+                return 1.0
+            weights = exp.weights()
+        w = weights.get(variant)
+        if w is None:
+            return 1.0
+        total = sum(weights.values())
+        return w / total if total > 0 else 1.0
 
     # -- lifecycle admin (POST /admin/tenants) -----------------------------
     def add_tenant(self, spec: TenantSpec) -> dict:
@@ -636,6 +663,30 @@ class TenantRegistry:
             float(rt.resident_bytes) if kind == "load" else 0.0
         )
         TENANTS_RESIDENT.child().set(float(len(self._runtimes)))
+        TENANT_PLACEMENT_BALANCE.child().set(
+            self._placement_balance_locked()
+        )
+
+    def _placement_balance_locked(self) -> float:
+        """Jain fairness index over resident tenants' accounted bytes:
+        (Σb)² / (n·Σb²).  1.0 = every resident tenant holds an equal
+        byte share, 1/n = one tenant holds everything, 0.0 = nothing
+        resident.  Zero-byte runtimes (e.g. stub models in tests)
+        count as perfectly even among themselves."""
+        sizes = [float(r.resident_bytes)
+                 for r in self._runtimes.values()]
+        n = len(sizes)
+        if n == 0:
+            return 0.0
+        total = sum(sizes)
+        if total <= 0.0:
+            return 1.0
+        sq = sum(b * b for b in sizes)
+        return (total * total) / (n * sq) if sq > 0.0 else 1.0
+
+    def placement_balance(self) -> float:
+        with self._lock:
+            return self._placement_balance_locked()
 
     def _evict_to_fit_locked(self, incoming_bytes: int,
                              exclude) -> list[TenantRuntime]:
@@ -673,6 +724,10 @@ class TenantRegistry:
             evicted.append(victim)
             logger.info("evicted tenant %s (%.1f MB) under budget",
                         victim.key_str, victim.resident_bytes / 1e6)
+        if evicted and exclude is not None:
+            # evictions that made room for an incoming tenant ARE the
+            # registry rebalancing its placement (vs an admin shrink)
+            self.rebalances += 1
         return evicted
 
     def _resident_bytes_locked(self) -> int:
@@ -831,6 +886,8 @@ class TenantRegistry:
                 "loads": self.loads,
                 "evictions": self.evictions,
                 "overcommits": self.overcommits,
+                "rebalances": self.rebalances,
+                "placementBalance": self._placement_balance_locked(),
             }
 
     def debug_payload(self) -> dict:

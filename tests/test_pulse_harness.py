@@ -175,6 +175,36 @@ def test_throughput_3x_collapse_fails_the_gate():
     assert up["status"] == "ok"
 
 
+def test_canonical_record_stamps_nproc():
+    import os
+
+    rec = bench_gate.canonical_record(_qps_rec(100.0))
+    assert rec["nproc"] == (os.cpu_count() or 1)
+    # an explicit stamp (a record replayed from another box) is kept
+    kept = bench_gate.canonical_record(_qps_rec(100.0, nproc=8))
+    assert kept["nproc"] == 8
+
+
+def test_nproc_keying_isolates_box_shapes():
+    """A 1-core run is never judged against another box shape: legacy
+    (unstamped) records key at nproc=0 and only judge each other, and
+    each stamped core count runs its own rolling baseline."""
+    legacy = [_qps_rec(v) for v in (1400.0, 1450.0, 1473.0, 1460.0)]
+    fresh = bench_gate.check_candidate(legacy, _qps_rec(480.0, nproc=1))
+    assert fresh["status"] == "insufficient"  # new lineage, no baseline
+    hist1 = [_qps_rec(v, nproc=1) for v in (470.0, 480.0, 490.0)]
+    assert bench_gate.check_candidate(
+        hist1, _qps_rec(485.0, nproc=1))["status"] == "ok"
+    assert bench_gate.check_candidate(
+        hist1, _qps_rec(100.0, nproc=1))["status"] == "regression"
+    hist8 = [_qps_rec(v, nproc=8) for v in (1400.0, 1450.0, 1473.0)]
+    assert bench_gate.check_candidate(
+        hist8, _qps_rec(480.0, nproc=1))["status"] == "insufficient"
+    # and legacy candidates still gate against legacy history
+    assert bench_gate.check_candidate(
+        legacy, _qps_rec(400.0))["status"] == "regression"
+
+
 def test_latency_direction_still_gates_upward_values():
     history = [_lat_rec(v) for v in (10.0, 10.5, 9.8, 10.2)]
     bad = bench_gate.check_candidate(history, _lat_rec(30.0))
@@ -224,8 +254,12 @@ def test_qps_records_separate_from_latency_keys(tmp_path):
     for v in (8.0, 8.5, 7.9):
         bench_gate.append_history(hist, _lat_rec(v))
     history = bench_gate.load_history(hist)
-    qps_bad = bench_gate.check_candidate(history, _qps_rec(200.0))
-    lat_bad = bench_gate.check_candidate(history, _lat_rec(30.0))
+    # candidates ride canonical_record like the CLI path, so they carry
+    # the same nproc stamp append_history gave the history records
+    qps_bad = bench_gate.check_candidate(
+        history, bench_gate.canonical_record(_qps_rec(200.0)))
+    lat_bad = bench_gate.check_candidate(
+        history, bench_gate.canonical_record(_lat_rec(30.0)))
     assert qps_bad["status"] == lat_bad["status"] == "regression"
     assert qps_bad["nSamples"] == lat_bad["nSamples"] == 3
 

@@ -7,6 +7,7 @@ tools/xray_smoke.py (tests/test_xray_smoke.py)."""
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import threading
@@ -218,6 +219,9 @@ def _mk_history(tmp_path, values, **over):
     base = {
         "metric": "t_train_seconds", "unit": "s", "vs_baseline": None,
         "platform": "tpu", "scale": 1.0, "fenced": True,
+        # stamp this box's core count: the CLI canonicalizes candidates
+        # with the live nproc, and unstamped history keys apart from it
+        "nproc": os.cpu_count() or 1,
         "recorded_at": "2026-08-01T00:00:00Z",
     }
     base.update(over)
@@ -335,7 +339,8 @@ def test_bench_gate_append_canonicalizes(tmp_path):
         hist, {"metric": "m", "value": 1.5, "platform": "tpu",
                "scale": 1.0, "fenced": True, "solver": "pallas"}
     )
-    assert list(rec)[:8] == list(bench_gate.CANONICAL_FIELDS)
+    n = len(bench_gate.CANONICAL_FIELDS)
+    assert list(rec)[:n] == list(bench_gate.CANONICAL_FIELDS)
     assert rec["solver"] == "pallas"
     again = bench_gate.load_history(hist)[0]
     assert again["value"] == 1.5 and again["fenced"] is True

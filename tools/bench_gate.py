@@ -54,7 +54,7 @@ DEFAULT_HISTORY = REPO_ROOT / "BENCH_HISTORY.jsonl"
 
 CANONICAL_FIELDS = (
     "metric", "value", "unit", "vs_baseline", "platform", "scale",
-    "recorded_at", "fenced",
+    "nproc", "recorded_at", "fenced",
 )
 
 
@@ -73,6 +73,10 @@ def canonical_record(rec: dict, fenced: Optional[bool] = None) -> dict:
         "vs_baseline": rec.get("vs_baseline"),
         "platform": rec.get("platform"),
         "scale": rec.get("scale"),
+        # the box's core count is part of the measurement identity:
+        # a multi-worker number from a 1-core box (workers time-slice
+        # one core) must never baseline a real multi-core run
+        "nproc": int(rec.get("nproc") or os.cpu_count() or 1),
         "recorded_at": rec.get("recorded_at") or time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         ),
@@ -112,13 +116,21 @@ def append_history(path: Path, rec: dict) -> dict:
 
 
 def baseline_key(rec: dict) -> tuple:
-    """Records are only comparable at the same metric, platform and
-    problem scale — a CPU-fallback number next to a TPU number is the
-    exact confusion the LOUD-fallback contract exists to prevent."""
+    """Records are only comparable at the same metric, platform,
+    problem scale and core count — a CPU-fallback number next to a TPU
+    number is the exact confusion the LOUD-fallback contract exists to
+    prevent, and a 1-core multi-worker number next to a 32-core one is
+    its ingest-side twin.  Records written before ``nproc`` existed
+    key at 0 ("unknown box"): the history shows the same metric
+    swinging 334 -> 1473 QPS across sessions, so legacy records have
+    unknowable core provenance — they keep judging each other but
+    never judge a stamped run, and each stamped core count starts its
+    own rolling baseline."""
     return (
         rec.get("metric"),
         rec.get("platform") or "",
         float(rec.get("scale") or 0.0),
+        int(rec.get("nproc") or 0),
     )
 
 

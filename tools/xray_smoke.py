@@ -87,6 +87,9 @@ def _bench_gate_checks(tmpdir: Path) -> dict:
         "metric": "smoke_train_seconds", "unit": "s",
         "vs_baseline": None, "platform": "tpu", "scale": 1.0,
         "fenced": True,
+        # the CLI stamps candidates with the live core count; history
+        # must carry the same nproc or the gate keys them apart
+        "nproc": os.cpu_count() or 1,
     }
     with open(hist, "w") as f:
         for v in (100.0, 101.0, 99.5, 100.5, 98.9, 100.2):

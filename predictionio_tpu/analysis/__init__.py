@@ -1,20 +1,34 @@
-"""piolint — JAX-aware static analysis + lock-discipline checking.
+"""piolint — JAX-aware static analysis, lock-discipline, deadlock, and
+contract-drift checking.
 
-Three AST engines over the package's own source (no imports, no jax, no
-device): the **JAX engine** (PIO1xx, `jaxlint.py`) walks functions
-reachable from ``jax.jit``/``pjit``/``shard_map`` tracing and flags
-host-device syncs, recompile hazards, donated-buffer reuse, and
-unfenced benchmark timing spans; the **concurrency engine** (PIO2xx,
-`locklint.py`) infers per-class lock discipline — which ``self._*``
-attributes are ever written under ``self._lock`` — and flags accesses
-on paths that don't hold the lock; the **clock engine** (PIO109,
+AST engines over the package's own source (no imports, no jax, no
+device).  Per-file: the **JAX engine** (PIO101–108, `jaxlint.py`) walks
+functions reachable from ``jax.jit``/``pjit``/``shard_map`` tracing and
+flags host-device syncs, recompile hazards, donated-buffer reuse, and
+unfenced benchmark timing spans; the **clock engine** (PIO109,
 `timelint.py`) flags wall-clock ``time.time()`` t0/dt subtractions in
-``predictionio_tpu/`` — durations must come from monotonic clocks.
+``predictionio_tpu/``; the **event-loop engine** (PIO110,
+`asynclint.py`) flags blocking calls inside coroutines; the **lock
+engine** (PIO201–203, `locklint.py`) infers per-class lock discipline
+and flags off-lock accesses; the **engine-isolation engine** (PIO301,
+`enginelint.py`) keeps templates off server internals.
 
-Driver: ``python -m predictionio_tpu.analysis`` (see `cli.py`).
-Findings are suppressed inline with ``# piolint: disable=PIO101`` or
-accepted wholesale in ``piolint.baseline.json`` (matched by
-path/rule/scope/snippet, so line drift doesn't churn the baseline).
+Whole-program (run once over the full analyzed set): the **deadlock
+engine** (PIO210–213, `deadlint.py`) builds a cross-class lock-order
+graph via a bounded-depth interprocedural walk and flags lock-order
+inversions (with both witness paths), callbacks invoked under a lock,
+blocking calls in lock-held regions, and condition-variable misuse;
+the **contract engine** (PIO401–403, `contractlint.py`) checks that
+``pio_*`` metric families / labels and fault-point strings referenced
+by smoke tools, dashboards, docs, and tests exist in the obs catalog
+and the resilience fault registry.
+
+Driver: ``python -m predictionio_tpu.analysis`` (see `cli.py`; also
+``--format sarif`` for annotators).  Findings are suppressed inline
+with ``# piolint: disable=PIO101`` or accepted wholesale in
+``piolint.baseline.json`` (matched by path/rule/scope/snippet, so line
+drift doesn't churn the baseline; deadlock entries additionally carry
+a written ``justification`` that ``--strict`` enforces).
 ``tools/gate.sh`` and ``tools/pre-commit`` fail on any non-baseline
 finding.
 """

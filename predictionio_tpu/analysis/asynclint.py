@@ -69,7 +69,7 @@ class AsyncEngine:
         self.sleep_names: set[str] = set()
         self.queue_ctor_names: set[str] = set()
         self.socket_ctor_names: set[str] = set()
-        for node in ast.walk(src.tree):
+        for node in src.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     alias = a.asname or a.name
@@ -154,7 +154,7 @@ class AsyncEngine:
             stack.extend(ast.iter_child_nodes(n))
 
     def _in_scope_functions(self):
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue

@@ -4,7 +4,14 @@
 the gate scope — ``predictionio_tpu/``, ``bench*.py``, ``tools/*.py``
 relative to the repo root.  Exit code is 1 iff any finding is neither
 inline-suppressed nor baselined (``--strict`` ignores the baseline, for
-periodic full-debt review).
+periodic full-debt review, and additionally requires a written
+``justification`` on every baselined PIO21x deadlock entry).
+
+Per-file engines (jax/time/async/lock/engine-import) run on each file
+independently; the whole-program engines (deadlock PIO21x, contract
+PIO4xx) run once over the full analyzed set — ``analyze_paths`` is the
+program boundary, so fixtures passed as a single path form a one-file
+program and the gate's default scope forms the real one.
 """
 
 from __future__ import annotations
@@ -12,11 +19,14 @@ from __future__ import annotations
 import argparse
 import json
 import subprocess
+import time
 from pathlib import Path
 from typing import Optional
 
 from .asynclint import AsyncEngine
+from .contractlint import ContractEngine
 from .core import RULES, Baseline, Finding, SourceFile, load_baseline
+from .deadlint import DeadlockEngine
 from .enginelint import EngineImportEngine
 from .jaxlint import JaxEngine
 from .locklint import LockEngine
@@ -25,6 +35,19 @@ from .timelint import TimeEngine
 __all__ = ["analyze_file", "analyze_paths", "repo_root", "main"]
 
 BASELINE_NAME = "piolint.baseline.json"
+
+# rule prefix -> engine bucket for the per-engine summary counts
+ENGINE_BUCKETS: tuple[tuple[str, tuple[str, ...]], ...] = (
+    ("parse", ("PIO100",)),
+    ("jax", ("PIO101", "PIO102", "PIO103", "PIO104", "PIO105",
+             "PIO106", "PIO107", "PIO108")),
+    ("time", ("PIO109",)),
+    ("async", ("PIO110",)),
+    ("lock", ("PIO201", "PIO202", "PIO203")),
+    ("deadlock", ("PIO210", "PIO211", "PIO212", "PIO213")),
+    ("engine", ("PIO301",)),
+    ("contract", ("PIO401", "PIO402", "PIO403")),
+)
 
 # deliberately-violating analyzer test inputs: never scanned implicitly
 # (tests/test_piolint.py runs the engines on them directly); passing one
@@ -91,35 +114,58 @@ def default_paths(root: Optional[Path] = None) -> list[Path]:
 
 
 def changed_paths(root: Optional[Path] = None) -> list[Path]:
-    """Python files currently staged in the git index (pre-commit scope)."""
+    """Python files currently staged in the git index (pre-commit scope).
+
+    Uses ``--name-status -z``: NUL-separated and never C-quoted, so
+    renames (``R`` status — take the DESTINATION path, the side that
+    exists in the index) and non-ASCII names survive; plain
+    ``--name-only`` output C-quotes unusual names into strings that
+    fail the existence check and silently drop the file."""
     root = root or repo_root()
     try:
         out = subprocess.run(
-            ["git", "diff", "--cached", "--name-only", "--diff-filter=ACMR"],
-            cwd=root, capture_output=True, text=True, check=True,
-        ).stdout
+            ["git", "diff", "--cached", "--name-status", "-z",
+             "--diff-filter=ACMR"],
+            cwd=root, capture_output=True, check=True,
+        ).stdout.decode("utf-8", "surrogateescape")
     except (OSError, subprocess.CalledProcessError):
         return []
     paths = []
-    for line in out.splitlines():
-        p = root / line.strip()
+    toks = out.split("\0")
+    i = 0
+    while i < len(toks):
+        status = toks[i].strip()
+        if not status:
+            i += 1
+            continue
+        if status[0] in ("R", "C"):
+            # "R<score> NUL old NUL new": the destination is staged
+            name = toks[i + 2] if i + 2 < len(toks) else ""
+            i += 3
+        else:
+            name = toks[i + 1] if i + 1 < len(toks) else ""
+            i += 2
+        p = root / name
         if p.suffix == ".py" and p.exists() and not _excluded(p):
             paths.append(p)
     return paths
 
 
-def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
-    """Run both engines over one file."""
-    root = root or repo_root()
+def _load(path: Path, root: Path):
+    """(SourceFile, None) or (None, PIO100 Finding)."""
     try:
-        src = SourceFile.load(path, root)
+        return SourceFile.load(path, root), None
     except (SyntaxError, UnicodeDecodeError, ValueError, OSError) as e:
         # a file the gate scans but can't read or parse IS a finding
-        return [Finding(
+        return None, Finding(
             rule="PIO100", path=str(path), line=getattr(e, "lineno", 1) or 1,
             col=0, message=f"file does not parse: {e}", scope="",
             snippet="",
-        )]
+        )
+
+
+def _file_findings(src: SourceFile, path: Path,
+                   root: Path) -> list[Finding]:
     findings = JaxEngine(
         src, bench_scope=_is_bench_scope(path, root)
     ).run()
@@ -132,12 +178,32 @@ def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
     return findings
 
 
+def analyze_file(path: Path, root: Optional[Path] = None) -> list[Finding]:
+    """Run the per-file engines over one file (the whole-program
+    deadlock/contract engines need the full set — use analyze_paths)."""
+    root = root or repo_root()
+    src, err = _load(path, root)
+    if src is None:
+        return [err]
+    return _file_findings(src, path, root)
+
+
 def analyze_paths(paths: list[Path],
                   root: Optional[Path] = None) -> list[Finding]:
+    """Per-file engines on each path, then the whole-program engines
+    (deadlock PIO21x, contract PIO4xx) over the parsed set."""
     root = root or repo_root()
     findings: list[Finding] = []
+    srcs: list[SourceFile] = []
     for p in paths:
-        findings += analyze_file(p, root)
+        src, err = _load(p, root)
+        if src is None:
+            findings.append(err)
+            continue
+        srcs.append(src)
+        findings += _file_findings(src, p, root)
+    findings += DeadlockEngine(srcs).run()
+    findings += ContractEngine(srcs, root).run()
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -153,25 +219,81 @@ def _report_json(findings: list[Finding], strict: bool) -> dict:
             "baselined": sum(f.baselined for f in findings),
             "active": len(active),
         },
+        "engines": _engine_counts(findings),
         "findings": [f.to_json() for f in findings],
+    }
+
+
+def _engine_counts(findings: list[Finding]) -> dict[str, int]:
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        engine: sum(by_rule.get(r, 0) for r in rules)
+        for engine, rules in ENGINE_BUCKETS
+    }
+
+
+def _report_sarif(findings: list[Finding]) -> dict:
+    """SARIF 2.1.0: one run, every finding a result; baselined ones
+    carry an external suppression so annotators can dim them."""
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "warning" if f.baselined else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        }
+        if f.baselined:
+            result["suppressions"] = [{"kind": "external"}]
+        results.append(result)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "piolint",
+                    "rules": [
+                        {"id": code,
+                         "shortDescription": {"text": RULES[code]}}
+                        for code in sorted(RULES)
+                    ],
+                },
+            },
+            "results": results,
+        }],
     }
 
 
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m predictionio_tpu.analysis",
-        description="piolint: JAX-aware static analysis + lock-discipline "
-                    "checker (rules PIO1xx/PIO2xx)",
+        description="piolint: JAX-aware static analysis, lock-discipline, "
+                    "deadlock, and contract-drift checker "
+                    "(rules PIO1xx/PIO2xx/PIO3xx/PIO4xx)",
     )
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files or directories to analyze (default: the "
                          "gate scope — predictionio_tpu/, bench*.py, "
                          "tools/*.py)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--baseline", type=Path, default=None,
                     help=f"baseline file (default: <repo>/{BASELINE_NAME})")
     ap.add_argument("--strict", action="store_true",
-                    help="ignore the baseline: every finding fails "
+                    help="ignore the baseline: every finding fails, and "
+                         "every baselined PIO21x deadlock entry must "
+                         "carry a written justification "
                          "(periodic full-debt review)")
     ap.add_argument("--changed-files", action="store_true",
                     help="analyze only .py files staged in the git index "
@@ -207,7 +329,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     else:
         paths = default_paths(root)
 
+    t0 = time.perf_counter()
     findings = analyze_paths(paths, root)
+    elapsed = time.perf_counter() - t0
 
     baseline_path = args.baseline or (root / BASELINE_NAME)
     if args.write_baseline:
@@ -217,6 +341,20 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 0
 
     baseline = load_baseline(baseline_path)
+    if args.strict:
+        # a baselined deadlock hazard without a written reason is just
+        # a muted bug: --strict refuses to review around it
+        missing = [
+            e for e in baseline.entries
+            if e.get("rule", "").startswith("PIO21")
+            and not str(e.get("justification", "")).strip()
+        ]
+        if missing:
+            for e in missing:
+                print(f"piolint: baseline entry {e.get('path')} "
+                      f"{e.get('rule')} [{e.get('scope')}] lacks the "
+                      "justification required for PIO21x entries")
+            return 1
     baseline.apply(findings)
     active = [f for f in findings if args.strict or not f.baselined]
 
@@ -227,13 +365,19 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     if args.format == "json":
         print(json.dumps(report, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(_report_sarif(findings), indent=2))
     else:
         for f in findings:
             if f.baselined and not args.strict:
                 continue
             print(f.text())
         n_base = report["counts"]["baselined"]
+        per_engine = " | ".join(
+            f"{name} {count}"
+            for name, count in report["engines"].items())
         print(f"piolint: {len(paths)} file(s), {len(active)} active "
-              f"finding(s), {n_base} baselined"
+              f"finding(s), {n_base} baselined "
+              f"[{per_engine}] in {elapsed:.1f}s"
               + (" (strict: baseline ignored)" if args.strict else ""))
     return 1 if active else 0

@@ -54,10 +54,31 @@ RULES: dict[str, str] = {
               "without holding the lock",
     "PIO203": "lock discipline: manual .acquire() without a matching "
               "try/finally release",
+    "PIO210": "deadlock hazard: lock-order inversion — two locks are "
+              "acquired in opposite orders on different interprocedural "
+              "paths (both witness paths printed)",
+    "PIO211": "callback under lock: a user-supplied callable (on_done, "
+              "weight_fn, batch_fn, fault hooks, ...) is invoked while "
+              "a lock is statically held — the callee can take any "
+              "lock or block, wedging every thread behind this one",
+    "PIO212": "blocking under lock: time.sleep, socket/file I/O, fsync, "
+              "subprocess, untimed Queue.get/put, or untimed "
+              "Event.wait() inside a lock-held region",
+    "PIO213": "condition-variable discipline: wait() outside a "
+              "predicate loop, or notify()/notify_all() without "
+              "holding the condition's lock",
     "PIO301": "engine isolation: an engine template file imports "
               "server internals (predictionio_tpu.server) — engines "
               "declare components, the platform owns serving "
               "(templates/*.py excluding _-prefixed infra)",
+    "PIO401": "contract drift: a pio_* metric family name referenced "
+              "in smoke tools/dashboards/docs is not registered in "
+              "the obs catalog",
+    "PIO402": "contract drift: a pio_* metric reference names a label "
+              "the registered family does not carry",
+    "PIO403": "contract drift: a fault-point string (faults.check/"
+              "check_shard/check_tenant/fired or a PIO_FAULT_PLAN "
+              "example) is not registered in resilience/faults.py",
 }
 
 
@@ -108,6 +129,7 @@ class SourceFile:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=str(path))
+        self._walk_cache: Optional[list] = None
         # line -> set of suppressed codes; the sentinel "*" means all
         self.suppressions: dict[int, set[str]] = {}
         for i, line in enumerate(self.lines, start=1):
@@ -129,6 +151,14 @@ class SourceFile:
         except ValueError:
             rel = path.as_posix()
         return cls(path, rel, path.read_text())
+
+    def walk(self) -> list:
+        """Cached flat preorder walk of the whole tree.  Every engine
+        iterates the full module at least once; one traversal serves
+        them all (the list is read-only by convention)."""
+        if self._walk_cache is None:
+            self._walk_cache = list(ast.walk(self.tree))
+        return self._walk_cache
 
     def snippet(self, line: int) -> str:
         if 1 <= line <= len(self.lines):

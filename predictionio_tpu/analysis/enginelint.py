@@ -58,7 +58,7 @@ class EngineImportEngine:
             self.findings.append(f)
 
     def run(self) -> list[Finding]:
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if _is_server_module(a.name):

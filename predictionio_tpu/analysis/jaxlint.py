@@ -126,7 +126,7 @@ class JaxEngine:
         self.class_methods: dict[str, dict[str, FuncInfo]] = {}
         self._collect_functions()
         self._parents: dict[int, ast.AST] = {}
-        for parent in ast.walk(src.tree):
+        for parent in src.walk():
             for child in ast.iter_child_nodes(parent):
                 self._parents[id(child)] = parent
         self.jit_apps: list[JitInfo] = []
@@ -267,7 +267,7 @@ class JaxEngine:
                     self.jit_apps.append(jit)
                     self.wrappers.setdefault(info.node.name, jit)
         # call form: jax.jit(f, ...) / functools.partial(jax.jit, ...)(f)
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if not isinstance(node, ast.Call):
                 continue
             jit_call = None
@@ -304,7 +304,7 @@ class JaxEngine:
                     if isinstance(t, ast.Name):
                         self.wrappers[t.id] = jit
         # tracing HOFs: lax.scan(step, ...), jax.vmap(f), ...
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if not isinstance(node, ast.Call):
                 continue
             parts = _dotted(node.func)
@@ -386,7 +386,7 @@ class JaxEngine:
                         jit.func.qualname,
                     )
         # call sites of jitted wrappers binding literals to static params
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if not isinstance(node, ast.Call) \
                     or not isinstance(node.func, ast.Name):
                 continue
@@ -414,7 +414,7 @@ class JaxEngine:
 
     # -- PIO107: donated-buffer reuse -------------------------------------
     def _check_donation(self) -> None:
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if not isinstance(node, ast.Call) \
                     or not isinstance(node.func, ast.Name):
                 continue

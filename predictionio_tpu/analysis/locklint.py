@@ -87,7 +87,7 @@ class LockEngine:
         self.findings: list[Finding] = []
         self.threading_aliases: set[str] = {"threading"}
         self.lock_ctor_names: set[str] = set()  # from threading import Lock
-        for node in ast.walk(src.tree):
+        for node in src.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.name == "threading":

@@ -45,7 +45,7 @@ class TimeEngine:
         # time [as now]`
         self.time_aliases: set[str] = set()
         self.wall_names: set[str] = set()
-        for node in ast.walk(src.tree):
+        for node in src.walk():
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.name == "time":
@@ -57,7 +57,7 @@ class TimeEngine:
 
     def run(self) -> list[Finding]:
         scopes: list[tuple[ast.AST, str]] = [(self.src.tree, "")]
-        for node in ast.walk(self.src.tree):
+        for node in self.src.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scopes.append((node, node.name))
         for scope, name in scopes:

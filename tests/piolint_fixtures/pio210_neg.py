@@ -1,0 +1,30 @@
+"""PIO210 negative: the same two classes, but every path agrees on
+one acquisition order (Batcher._lock, then Journal._lock)."""
+import threading
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, rec):
+        with self._lock:
+            return rec
+
+    def size(self):
+        with self._lock:
+            return 0
+
+
+class Batcher:
+    def __init__(self, journal: Journal):
+        self._lock = threading.Lock()
+        self._journal = journal
+
+    def submit(self, rec):
+        with self._lock:
+            self._journal.append(rec)
+
+    def flush_stats(self):
+        with self._lock:
+            return self._journal.size()

@@ -1,0 +1,31 @@
+"""PIO210 positive: two classes acquire each other's locks in
+opposite orders on different interprocedural paths."""
+import threading
+
+
+class Journal:
+    def __init__(self, batcher: "Batcher"):
+        self._lock = threading.Lock()
+        self._batcher = batcher
+
+    def rotate(self):
+        with self._lock:
+            self._batcher.flush_stats()
+
+    def append(self, rec):
+        with self._lock:
+            return rec
+
+
+class Batcher:
+    def __init__(self, journal: Journal):
+        self._lock = threading.Lock()
+        self._journal = journal
+
+    def submit(self, rec):
+        with self._lock:
+            self._journal.append(rec)  # EXPECT: PIO210
+
+    def flush_stats(self):
+        with self._lock:
+            return 0

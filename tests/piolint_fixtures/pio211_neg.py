@@ -1,0 +1,24 @@
+"""PIO211 negative: callbacks snapshotted under the lock but invoked
+only after release — the PR 17 end-of-dispatch-turn idiom."""
+import threading
+
+
+class Notifier:
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self._on_done = on_done
+        self._pending = []
+
+    def finish(self):
+        with self._lock:
+            done = list(self._pending)
+            self._pending.clear()
+        for item in done:
+            item.ack()
+        self._on_done()
+
+    def run(self, hook):
+        with self._lock:
+            armed = bool(self._pending)
+        if armed:
+            hook()

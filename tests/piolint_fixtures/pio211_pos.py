@@ -1,0 +1,26 @@
+"""PIO211 positive: user-supplied callables invoked while a lock is
+statically held — directly, via a stored attribute, and via a local
+bound from a callback registry."""
+import threading
+
+
+class Notifier:
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self._on_done = on_done
+        self._weight_fns = {}
+
+    def finish(self):
+        with self._lock:
+            self._on_done()  # EXPECT: PIO211
+
+    def weigh(self, tenant):
+        with self._lock:
+            fn = self._weight_fns.get(tenant)
+            if fn is not None:
+                return fn()  # EXPECT: PIO211
+        return 1.0
+
+    def run(self, hook):
+        with self._lock:
+            hook()  # EXPECT: PIO211

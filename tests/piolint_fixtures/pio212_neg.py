@@ -1,0 +1,29 @@
+"""PIO212 negative: the blocking work happens outside the lock (or is
+explicitly timed), including the release-around-the-call idiom."""
+import threading
+import time
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dirty = False
+
+    def backoff(self):
+        with self._lock:
+            want = self._dirty
+        if want:
+            time.sleep(0.2)
+
+    def release_around(self):
+        self._lock.acquire()
+        try:
+            self._dirty = True
+            self._lock.release()
+            try:
+                time.sleep(0.1)
+            finally:
+                self._lock.acquire()
+            self._dirty = False
+        finally:
+            self._lock.release()

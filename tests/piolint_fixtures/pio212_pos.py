@@ -1,0 +1,29 @@
+"""PIO212 positive: blocking calls inside lock-held regions — sleep,
+file I/O + fsync, subprocess, and an untimed queue get."""
+import os
+import queue
+import subprocess
+import threading
+import time
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.2)  # EXPECT: PIO212
+
+    def sync(self, fh):
+        with self._lock:
+            os.fsync(fh.fileno())  # EXPECT: PIO212
+
+    def shell(self):
+        with self._lock:
+            subprocess.run(["true"])  # EXPECT: PIO212
+
+    def take(self):
+        with self._lock:
+            return self._q.get()  # EXPECT: PIO212

@@ -1,0 +1,24 @@
+"""PIO213 negative: predicate-looped waits, timed waits, notify under
+the lock, and Condition(lock) aliasing."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = False
+
+    def await_ready(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+
+    def await_briefly(self):
+        with self._cv:
+            return self._cv.wait(timeout=0.5)
+
+    def signal(self):
+        with self._lock:
+            self._ready = True
+            self._cv.notify_all()

@@ -1,0 +1,20 @@
+"""PIO213 positive: single un-looped wait(), notify off-lock, and
+wait() without holding the condition's lock."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def await_once(self):
+        with self._cv:
+            self._cv.wait()  # EXPECT: PIO213
+
+    def signal(self):
+        self._ready = True
+        self._cv.notify_all()  # EXPECT: PIO213
+
+    def await_unlocked(self):
+        self._cv.wait()  # EXPECT: PIO213

@@ -1,0 +1,15 @@
+"""PIO401 negative: every referenced family is registered; exposition
+suffixes and grep-prefix references normalize to their family."""
+
+
+def register(metrics):
+    metrics.counter("pio_fixture_requests_total", labels=("tenant",))
+    metrics.histogram("pio_fixture_latency_seconds")
+
+
+def smoke(scrape: str) -> bool:
+    if "pio_fixture_requests_total" not in scrape:
+        return False
+    if "pio_fixture_latency_seconds_bucket" not in scrape:
+        return False
+    return "pio_fixture_latency" in scrape

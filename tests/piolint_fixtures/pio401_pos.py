@@ -1,0 +1,13 @@
+"""PIO401 positive: a smoke check greps for a metric family the obs
+catalog never registered (e.g. the family was renamed)."""
+
+
+def register(metrics):
+    metrics.counter("pio_fixture_requests_total", labels=("tenant",))
+    metrics.histogram("pio_fixture_latency_seconds")
+
+
+def smoke(scrape: str) -> bool:
+    if "pio_fixture_request_count" in scrape:  # EXPECT: PIO401
+        return True
+    return False

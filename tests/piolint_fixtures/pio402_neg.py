@@ -1,0 +1,12 @@
+"""PIO402 negative: selectors only use registered or exposition-level
+labels; prose globs in braces are not selectors."""
+
+
+def register(metrics):
+    metrics.counter("pio_fixture_requests_total", labels=("tenant",))
+    metrics.histogram("pio_fixture_latency_seconds")
+
+
+QUERY = 'pio_fixture_requests_total{tenant="movies"}'
+BUCKETS = 'pio_fixture_latency_seconds_bucket{le="0.1"}'
+PROSE = "pio_fixture_requests_total{one of|the other}"

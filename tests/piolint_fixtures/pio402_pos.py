@@ -1,0 +1,9 @@
+"""PIO402 positive: a selector names a label the registered family
+does not carry (dashboards select on it, exporter never stamps it)."""
+
+
+def register(metrics):
+    metrics.counter("pio_fixture_requests_total", labels=("tenant",))
+
+
+QUERY = 'pio_fixture_requests_total{engine="als"}'  # EXPECT: PIO402

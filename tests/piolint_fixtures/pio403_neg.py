@@ -1,0 +1,17 @@
+"""PIO403 negative: every consulted fault point is registered; dotless
+strings are local helper arguments, not fault references."""
+
+POINTS = (
+    "fixture.write",
+    "fixture.flush",
+)
+
+
+def hot_path(faults, stages):
+    faults.check("fixture.write")
+    faults.check_shard("fixture.flush", 0)
+    stages.check("booked")
+    return True
+
+
+PLAN = 'PIO_FAULT_PLAN=fixture.flush:nth=2;seed=7'

@@ -1,0 +1,15 @@
+"""PIO403 positive: fault points consulted by check()/a fault plan
+that the resilience registry never registered."""
+
+POINTS = (
+    "fixture.write",
+    "fixture.flush",
+)
+
+
+def hot_path(faults):
+    faults.check("fixture.wriet")  # EXPECT: PIO403
+    return True
+
+
+PLAN = 'PIO_FAULT_PLAN=fixture.fsync:nth=2'  # EXPECT: PIO403

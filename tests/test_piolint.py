@@ -29,12 +29,16 @@ from predictionio_tpu.analysis import (
     load_baseline,
 )
 from predictionio_tpu.analysis.cli import (
+    _report_sarif,
     analyze_file,
+    changed_paths,
     default_paths,
     main,
     repo_root,
 )
 from predictionio_tpu.analysis.asynclint import AsyncEngine
+from predictionio_tpu.analysis.contractlint import ContractEngine
+from predictionio_tpu.analysis.deadlint import DeadlockEngine
 from predictionio_tpu.analysis.jaxlint import JaxEngine
 from predictionio_tpu.analysis.locklint import LockEngine
 from predictionio_tpu.analysis.enginelint import EngineImportEngine
@@ -58,7 +62,10 @@ def run_fixture(path: Path):
             + LockEngine(src).run()
             + TimeEngine(src).run()
             + AsyncEngine(src).run()
-            + EngineImportEngine(src).run())
+            + EngineImportEngine(src).run()
+            + DeadlockEngine([src]).run()
+            + ContractEngine([src], path.parent,
+                             smoke_scope=True).run())
 
 
 def expected_findings(path: Path) -> set[tuple[str, int]]:
@@ -238,6 +245,159 @@ def test_cli_json_report(tmp_path, capsys):
     assert payload["counts"]["active"] == 1
     assert payload["findings"][0]["rule"] == "PIO101"
     assert json.loads(report.read_text()) == payload
+
+
+# -- deadlock + contract engines end to end --------------------------------
+
+INVERSION = (
+    "import threading\n\n\n"
+    "class Wal:\n"
+    "    def __init__(self, batcher: 'Batcher'):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._batcher = batcher\n\n"
+    "    def rotate(self):\n"
+    "        with self._lock:\n"
+    "            self._batcher.stats()\n\n"
+    "    def append(self, rec):\n"
+    "        with self._lock:\n"
+    "            return rec\n\n\n"
+    "class Batcher:\n"
+    "    def __init__(self, wal: Wal):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._wal = wal\n\n"
+    "    def submit(self, rec):\n"
+    "        with self._lock:\n"
+    "            self._wal.append(rec)\n\n"
+    "    def stats(self):\n"
+    "        with self._lock:\n"
+    "            return 0\n"
+)
+
+
+def test_seeded_inversion_caught_with_both_witness_paths(tmp_path):
+    """The headline acceptance check: a two-lock inversion seeded into
+    a scratch file fails the analyzer and prints BOTH witness paths."""
+    p = tmp_path / "scratch.py"
+    p.write_text(INVERSION)
+    findings = analyze_paths([p], tmp_path)
+    inversions = [f for f in findings if f.rule == "PIO210"]
+    assert len(inversions) == 1
+    msg = inversions[0].message
+    assert "lock-order inversion" in msg
+    assert "path 1" in msg and "path 2" in msg
+    # both class-qualified locks appear in the cycle statement
+    assert "Wal._lock" in msg and "Batcher._lock" in msg
+    # witness frames are file:line references into the scratch file
+    assert "scratch.py:" in msg
+    assert main([str(p)]) == 1
+
+
+def test_callback_under_lock_caught_end_to_end(tmp_path):
+    p = tmp_path / "scratch.py"
+    p.write_text(
+        "import threading\n\n\n"
+        "class D:\n"
+        "    def __init__(self, on_done):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._on_done = on_done\n\n"
+        "    def fire(self):\n"
+        "        with self._lock:\n"
+        "            self._on_done()\n"
+    )
+    findings = analyze_paths([p], tmp_path)
+    assert [f.rule for f in findings] == ["PIO211"]
+    assert "_on_done" in findings[0].message
+    assert main([str(p)]) == 1
+
+
+def test_strict_requires_justification_on_deadlock_baseline(
+        tmp_path, capsys):
+    """--strict refuses a baselined PIO21x entry without a written
+    reason, before reporting any analysis results."""
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"version": 1, "entries": [{
+        "path": "predictionio_tpu/server/x.py", "rule": "PIO211",
+        "scope": "X.y", "snippet": "cb()",
+    }]}) + "\n")
+    assert main([str(p), "--baseline", str(base), "--strict"]) == 1
+    out = capsys.readouterr().out
+    assert "lacks the justification" in out
+    # the same entry WITH a reason passes strict review
+    base.write_text(json.dumps({"version": 1, "entries": [{
+        "path": "predictionio_tpu/server/x.py", "rule": "PIO211",
+        "scope": "X.y", "snippet": "cb()",
+        "justification": "bounded pure read; order is one-directional",
+    }]}) + "\n")
+    assert main([str(p), "--baseline", str(base), "--strict"]) == 0
+
+
+# -- SARIF output ----------------------------------------------------------
+
+def test_sarif_output_matches_golden(capsys):
+    """`--format sarif` is a wire format for code-review annotators;
+    the golden file pins schema, rule metadata, and result shape."""
+    fix = FIXTURES / "pio211_pos.py"
+    src = SourceFile.load(fix, FIXTURES)
+    findings = sorted(DeadlockEngine([src]).run(),
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+    golden = json.loads(
+        (Path(__file__).parent / "golden"
+         / "piolint_pio211_pos.sarif.json").read_text())
+    assert _report_sarif(findings) == golden
+
+
+def test_sarif_marks_baselined_as_suppressed(tmp_path, capsys):
+    p = tmp_path / "snippet.py"
+    p.write_text(VIOLATION.format(trailer=""))
+    findings = analyze_file(p)
+    base_path = tmp_path / "base.json"
+    Baseline.from_findings(findings).save(base_path)
+    rc = main([str(p), "--baseline", str(base_path),
+               "--format", "sarif"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    (result,) = doc["runs"][0]["results"]
+    assert result["level"] == "warning"
+    assert result["suppressions"] == [{"kind": "external"}]
+
+
+# -- pre-commit scope ------------------------------------------------------
+
+def _git(cwd: Path, *argv: str) -> None:
+    subprocess.run(
+        ["git", *argv], cwd=cwd, check=True, capture_output=True,
+        env={"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+             "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+             "HOME": str(cwd), "PATH": "/usr/bin:/bin:/usr/local/bin"},
+    )
+
+
+def test_changed_paths_includes_staged_rename(tmp_path):
+    """A staged rename must analyze the DESTINATION file; --name-only
+    parsing dropped renames entirely (the R side has two paths)."""
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "old_name.py").write_text("x = 1\n")
+    _git(tmp_path, "add", "old_name.py")
+    _git(tmp_path, "commit", "-qm", "seed")
+    _git(tmp_path, "mv", "old_name.py", "new_name.py")
+    (tmp_path / "added.py").write_text("y = 2\n")
+    _git(tmp_path, "add", "added.py")
+    got = {p.name for p in changed_paths(tmp_path)}
+    assert got == {"new_name.py", "added.py"}
+
+
+def test_text_summary_reports_engines_and_time(tmp_path, capsys):
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    assert main([str(p)]) == 0
+    summary = capsys.readouterr().out.strip().splitlines()[-1]
+    for bucket in ("parse", "jax", "time", "async", "lock",
+                   "deadlock", "engine", "contract"):
+        assert f"{bucket} 0" in summary
+    assert re.search(r"in \d+\.\d+s", summary)
 
 
 def test_module_entrypoint_runs():

@@ -238,7 +238,7 @@ def test_fault_plan_pure_delay_fires_without_raising():
 
 def test_fault_plan_rejects_garbage():
     with pytest.raises(ValueError):
-        faults.FaultPlan.parse("not.a.point:nth=1")
+        faults.FaultPlan.parse("not.a.point:nth=1")  # piolint: disable=PIO403
     with pytest.raises(ValueError):
         faults.FaultPlan.parse("storage.write:wat=1")
     with pytest.raises(ValueError):

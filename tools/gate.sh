@@ -2,13 +2,16 @@
 # Full-suite gate: run before any milestone/snapshot commit.
 # Exits nonzero if ANY check fails — never snapshot red (VERDICT r3 #6).
 #
-# Order is cheap-first: static analysis (~2 s) before the test suite
-# (~6 min), so a tracer leak or lock-discipline hole fails in seconds.
+# Order is cheap-first: static analysis (~4 s, per-engine counts and
+# wall time printed in its summary line) before the test suite
+# (~6 min), so a tracer leak, deadlock hazard, or contract drift
+# fails in seconds.
 #
 #   tools/gate.sh                normal gate (baseline-tolerant)
 #   tools/gate.sh --strict       piolint ignores piolint.baseline.json —
 #                                periodic full-debt review of accepted
-#                                findings
+#                                findings; baselined PIO21x deadlock
+#                                entries must carry a justification
 #
 # Any further args pass through to pytest.
 set -euo pipefail
@@ -29,7 +32,8 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python tools/multihost_harness.py --probe >&2 \
   || echo "  (verdict unavailable — probe errored; multihost tests will skip)" >&2
 
-# 1) piolint: JAX-aware static analysis + lock discipline (PIO1xx/PIO2xx)
+# 1) piolint: JAX/lock/deadlock/contract static analysis
+#    (PIO1xx/PIO2xx incl. PIO210-213 deadlock, PIO3xx, PIO4xx contract)
 REPORT="${PIOLINT_REPORT:-/tmp/piolint_report.json}"
 echo "gate [1/15] piolint (report: $REPORT)" >&2
 if ! python -m predictionio_tpu.analysis --format text \

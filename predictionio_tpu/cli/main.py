@@ -565,6 +565,20 @@ def _build_tenant_registry(args, storage):
             raise SystemExit(1)
     if getattr(args, "memory_budget", None) is not None:
         opts["memory_budget_bytes"] = args.memory_budget
+    # pio-pilot: `--autopilot` wins over the manifest's "autopilot"
+    # block; "on"/"1" enables with defaults, anything else is a JSON
+    # knob dict (alpha/beta/minLift/minSamples/maxStep/minWeight/...)
+    ap = getattr(args, "autopilot", None)
+    if ap:
+        if ap.strip().lower() in ("1", "on", "true"):
+            opts["autopilot"] = {}
+        else:
+            try:
+                opts["autopilot"] = json.loads(ap)
+            except json.JSONDecodeError as e:
+                _out(f"Error: --autopilot is neither 'on' nor valid "
+                     f"JSON: {e}")
+                raise SystemExit(1)
     md = storage.get_metadata()
     for spec in specs:
         app = md.app_get_by_name(spec.app)
@@ -1362,6 +1376,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "(0 = unbounded): resident tenant models are "
                    "LRU-evicted to stay under it; pinned and "
                    "in-flight tenants are never evicted")
+    d.add_argument("--autopilot", metavar="ON|JSON",
+                   help="pio-pilot: run the SPRT auto-weight "
+                   "controller on this registry's experiments "
+                   "('on' for defaults, or a JSON knob dict — "
+                   "alpha/beta/minLift/minSamples/maxStep/minWeight/"
+                   "burnThreshold; requires --multi); every decision "
+                   "lands in a pio-tower manifest and at "
+                   "GET /debug/experiments")
 
     fi = sub.add_parser(
         "foldin",

@@ -426,6 +426,51 @@ STORE_SHARD_ROWS = _registry.gauge(
     labels=("shard",),
 )
 
+# pio-pilot (sessions + self-driving experiments) families: the
+# nextitem engine's transition store books every event folded through
+# the sessionizer and keeps the resident transition-pair count live,
+# the autopilot publishes its SPRT log-likelihood-ratio walk per
+# (app, variant-pair) plus a decision counter (ramp / veto / conclude /
+# hold), and the online-eval aggregator exposes how far its incremental
+# conversion cursor trails the store's high water mark.
+SESSION_EVENTS_TOTAL = _registry.counter(
+    "pio_session_events_total",
+    "Events folded through the gap-based sessionizer into a nextitem "
+    "transition store (per app id)",
+    labels=("app",),
+)
+SESSION_TRANSITIONS = _registry.gauge(
+    "pio_session_transitions",
+    "Distinct (prev-item, next-item) transition pairs resident in a "
+    "nextitem transition store (per app id)",
+    labels=("app",),
+)
+EXPERIMENT_LLR = _registry.gauge(
+    "pio_experiment_llr",
+    "Autopilot SPRT log-likelihood-ratio walk position for one app's "
+    "provisional leader vs the best challenger (crosses the upper "
+    "threshold = leader's lift is significant)",
+    labels=("app", "variant"),
+)
+EXPERIMENT_DECISIONS_TOTAL = _registry.counter(
+    "pio_experiment_decisions_total",
+    "Autopilot controller decisions per app "
+    "(decision=ramp|veto|conclude|hold)",
+    labels=("app", "decision"),
+)
+EXPERIMENT_STATE = _registry.gauge(
+    "pio_experiment_state",
+    "Autopilot experiment phase per app "
+    "(0=collecting, 1=ramping, 2=concluded, 3=frozen-by-guardrail)",
+    labels=("app",),
+)
+ONLINE_EVAL_CURSOR_LAG = _registry.gauge(
+    "pio_online_eval_cursor_lag",
+    "Event-store rows written past the online-eval conversion scan "
+    "cursor (per app) — how stale the variant outcome table is",
+    labels=("app",),
+)
+
 # pio-levee: the fault-isolated multi-process ingest edge — per-shard
 # group-commit WAL (append + fsync before 2xx, batched sqlite commits
 # off the request path) plus the router's worker-health view.

@@ -70,6 +70,7 @@ class DashboardServer(HTTPServerBase):
             "<a href='/pulse.html'>pulse</a> &middot; "
             "<a href='/train.html'>training console</a> &middot; "
             "<a href='/tenants.html'>tenants</a> &middot; "
+            "<a href='/experiments.html'>experiments</a> &middot; "
             "<a href='/fleet.html'>fleet</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
@@ -354,6 +355,170 @@ class DashboardServer(HTTPServerBase):
             "".join(ab_rows) + "</table>"
             "<p><a href='/'>index</a></p></body></html>"
         )
+
+    def experiments_html(self, server_url: str = "") -> str:
+        """pio-pilot experiment console: per-app SPRT state (LLR walk
+        vs its thresholds), live weights, guardrail vetoes, and the
+        ramp-decision tail.  Renders the in-process autopilot when one
+        exists, else fetches ``?server=http://host:port``'s
+        ``/debug/experiments``, else falls back to the newest
+        ``pilot-*`` tower manifest on disk (cross-process view)."""
+        from ..tenancy.autopilot import autopilot_payload
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        p = autopilot_payload()
+        source = "in-process autopilot"
+        if p is None and server_url:
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                    server_url.rstrip("/") + "/debug/experiments",
+                    timeout=5,
+                ) as r:
+                    p = json.loads(r.read().decode())
+                source = esc(server_url)
+            except Exception as e:
+                return (
+                    "<html><body><h1>Experiments</h1><p>could not "
+                    f"reach {esc(server_url)}/debug/experiments: "
+                    f"{esc(e)}</p></body></html>"
+                )
+        if p is None:
+            p = self._experiments_from_manifest()
+            source = "tower manifest"
+        if p is None:
+            return (
+                "<html><body><h1>Experiments</h1><p>No autopilot in "
+                "this process and no pilot manifest on disk. Point me "
+                "at a serving edge with <code>/experiments.html?"
+                "server=http://host:port</code> or curl its "
+                "<code>/debug/experiments</code>.</p></body></html>"
+            )
+        app_rows = []
+        for app, cell in sorted((p.get("apps") or {}).items()):
+            last = cell.get("last") or {}
+            llr = last.get("llr")
+            walk = (
+                f"{llr:.3f} in [{last.get('lower', 0):.3f}, "
+                f"{last.get('upper', 0):.3f}]"
+                if llr is not None else "-"
+            )
+            weights = ", ".join(
+                f"{v}={w:.3f}" for v, w in sorted(
+                    (p.get("weights", {}).get(app) or
+                     last.get("weights") or {}).items()
+                )
+            )
+            vetoes = ", ".join(
+                f"{v}:{r}" for v, r in
+                sorted((last.get("vetoes") or {}).items())
+            ) or "-"
+            app_rows.append(
+                "<tr><td>{a}</td><td>{st}</td><td>{d}</td>"
+                "<td>{lead}</td><td>{walk}</td><td>{w}</td>"
+                "<td>{veto}</td></tr>".format(
+                    a=esc(app), st=esc(cell.get("stateName", "?")),
+                    d=esc(last.get("decision", "-")),
+                    lead=esc(last.get("leader") or
+                             last.get("target") or "-"),
+                    walk=esc(walk), w=esc(weights), veto=esc(vetoes),
+                )
+            )
+        dec_rows = []
+        for app, cell in sorted((p.get("apps") or {}).items()):
+            for d in reversed(cell.get("decisions") or []):
+                dec_rows.append(
+                    "<tr><td>{a}</td><td>{dec}</td><td>{r}</td>"
+                    "<td>{llr}</td><td>{w}</td></tr>".format(
+                        a=esc(app), dec=esc(d.get("decision")),
+                        r=esc(d.get("reason") or "-"),
+                        llr=(f"{d['llr']:.3f}"
+                             if d.get("llr") is not None else "-"),
+                        w=esc(", ".join(
+                            f"{v}={w:.3f}" for v, w in
+                            sorted((d.get("weights") or {}).items())
+                        )),
+                    )
+                )
+        cfg = p.get("config") or {}
+        cfg_html = " &middot; ".join(
+            f"{k}={cfg[k]}" for k in sorted(cfg)
+        )
+        return (
+            "<!DOCTYPE html><html><head><title>experiments</title>"
+            "<meta http-equiv='refresh' content='5'>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "td,th{padding:3px 8px;font-family:monospace}</style>"
+            "</head><body><h1>Experiments (pio-pilot)</h1>"
+            f"<p>source: {source} &middot; manifest "
+            f"<code>{esc(p.get('manifestId', '?'))}</code> &middot; "
+            f"ticks {p.get('ticks', '?')}</p>"
+            f"<p>{cfg_html}</p>"
+            "<h2>Per-app SPRT state</h2>"
+            "<table border='1'><tr><th>app</th><th>state</th>"
+            "<th>last decision</th><th>leader</th>"
+            "<th>LLR walk</th><th>weights</th><th>vetoes</th></tr>"
+            + "\n".join(app_rows) + "</table>"
+            "<h2>Decision tail (newest first)</h2>"
+            "<table border='1'><tr><th>app</th><th>decision</th>"
+            "<th>reason</th><th>LLR</th><th>weights</th></tr>"
+            + "\n".join(dec_rows) + "</table>"
+            "<p>JSON at the serving edge's "
+            "<code>/debug/experiments</code>; every decision is a "
+            "pio-tower manifest event (<code>tools/runlog.py</code>)."
+            "</p><p><a href='/'>index</a></p></body></html>"
+        )
+
+    def _experiments_from_manifest(self):
+        """Newest ``pilot-*`` run manifest rebuilt into (a subset of)
+        the autopilot payload shape — the cross-process fallback."""
+        from ..obs.runlog import read_manifest, runs_root
+
+        try:
+            dirs = sorted(
+                (d for d in runs_root().iterdir()
+                 if d.name.startswith("pilot-")),
+                key=lambda d: d.stat().st_mtime, reverse=True,
+            )
+        except OSError:
+            return None
+        for d in dirs:
+            doc = read_manifest(d)
+            if doc is None:
+                continue
+            apps: dict[str, dict] = {}
+            for ev in doc.get("events", ()):
+                if ev.get("event") != "decision":
+                    continue
+                app = ev.get("app", "?")
+                cell = apps.setdefault(
+                    app, {"stateName": "?", "decisions": []}
+                )
+                cell["last"] = ev
+                cell["decisions"].append(ev)
+                del cell["decisions"][:-10]
+                state = ev.get("state")
+                cell["stateName"] = {
+                    0.0: "collecting", 1.0: "ramping",
+                    2.0: "concluded", 3.0: "frozen",
+                }.get(state, "?")
+            header = doc.get("header") or {}
+            return {
+                "enabled": True,
+                "manifestId": header.get("instanceId", d.name),
+                "ticks": len(doc.get("events", ())),
+                "config": {
+                    k: header[k]
+                    for k in ("alpha", "beta", "minLift", "minSamples",
+                              "maxStep", "minWeight")
+                    if k in header
+                },
+                "weights": {},
+                "apps": apps,
+            }
+        return None
 
     def pulse_html(self) -> str:
         """Operator view of the pio-pulse request-lifecycle layer: the
@@ -735,6 +900,18 @@ class DashboardServer(HTTPServerBase):
                 if path == "/tenants.html":
                     self._reply(200, server.tenants_html().encode(),
                                 "text/html")
+                    return
+                if path == "/experiments.html":
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    self._reply(
+                        200,
+                        server.experiments_html(
+                            q.get("server", [""])[0]
+                        ).encode(),
+                        "text/html",
+                    )
                     return
                 if path == "/fleet.html":
                     q = urllib.parse.parse_qs(

@@ -334,6 +334,30 @@ def _result_to_json(r: Any) -> Any:
     return r
 
 
+def _experiments_response(tenants) -> tuple:
+    """``GET /debug/experiments``: the autopilot's live document, a
+    disabled stub when tenancy runs without an autopilot, 404 when
+    there is no tenancy at all.  Returns ``(code, payload)``."""
+    if tenants is None:
+        from ..tenancy.autopilot import autopilot_payload
+
+        doc = autopilot_payload()
+        if doc is not None:
+            return 200, doc
+        return 404, {"message": "tenancy is not enabled (deploy --multi)"}
+    pilot = getattr(tenants, "autopilot", None)
+    if pilot is not None:
+        return 200, pilot.payload()
+    return 200, {
+        "enabled": False,
+        "weights": {
+            app: tenants.experiment(app).weights()
+            for app in tenants.apps()
+        },
+        "onlineEval": tenants.online.snapshot(),
+    }
+
+
 class EngineServer(HTTPServerBase):
     """One deployed engine instance behind an HTTP server."""
 
@@ -649,6 +673,13 @@ class EngineServer(HTTPServerBase):
                 )
             except Exception:
                 logger.exception("online-eval refresh failed")
+            # pio-pilot: the autopilot rides the same cadence — fresh
+            # conversion counts in, at most one bounded ramp step out
+            # (tick() never raises; a no-autopilot registry no-ops)
+            try:
+                self.tenants.autopilot_tick()
+            except Exception:
+                logger.exception("autopilot tick failed")
 
     def _make_batcher(self, algorithms, models, tenant=None):
         """Build the query micro-batcher for this (algorithms, models)
@@ -1211,6 +1242,9 @@ class EngineServer(HTTPServerBase):
                               "(deploy --multi)"},
                         "application/json", ())
             return (200, self.tenants.debug_payload(),
+                    "application/json", ())
+        if path == "/debug/experiments":
+            return (*_experiments_response(self.tenants),
                     "application/json", ())
         if path == "/":
             if "text/html" in accept:
@@ -1826,6 +1860,8 @@ class EngineServer(HTTPServerBase):
                                           "enabled (deploy --multi)"})
                     else:
                         self._reply(200, server.tenants.debug_payload())
+                elif self.path.startswith("/debug/experiments"):
+                    self._reply(*_experiments_response(server.tenants))
                 else:
                     self._reply(404, {"message": "not found"})
 

@@ -1,0 +1,23 @@
+"""pio-pilot sessions: gap-based sessionization + a decayed Markov
+transition store.
+
+The reference system's ``e2`` examples include a ``markov_chain``
+engine; this package is its incremental reproduction.  Two pieces:
+
+* :class:`Sessionizer` — streaming gap-based session windows over
+  (user, item, timestamp) triples, with per-user carry state so a
+  transition spanning two cursor scans still counts exactly once.
+* :class:`TransitionStore` — a sparse CSR-backed (prev-item ->
+  next-item) transition-count matrix with trending's half-life decay
+  idiom (weights live in reference-time space; the reference epoch
+  rebases before f64 exponents overflow) and top-K successor
+  extraction.
+
+Both are pure host-side data structures: no jax, no storage imports —
+``templates/nextitem.py`` owns the event-store cursor contract and
+feeds scans through them.
+"""
+
+from .store import Sessionizer, TransitionStore, sessionize
+
+__all__ = ["Sessionizer", "TransitionStore", "sessionize"]

@@ -9,6 +9,13 @@ event store, and an online-eval aggregator feeding ``/metrics`` and
 pio-tower manifests.  See ``docs/ARCHITECTURE.md`` "Multi-tenancy".
 """
 
+from .autopilot import (
+    AutoPilot,
+    AutopilotConfig,
+    autopilot_payload,
+    sprt_test,
+    step_weights,
+)
 from .errors import QuotaExceeded, TenantUnavailable, UnknownTenant
 from .experiment import Experiment, assign_bucket
 from .online_eval import OnlineEval
@@ -23,6 +30,8 @@ from .registry import (
 )
 
 __all__ = [
+    "AutoPilot",
+    "AutopilotConfig",
     "Experiment",
     "OnlineEval",
     "QuotaExceeded",
@@ -34,6 +43,9 @@ __all__ = [
     "TokenBucket",
     "UnknownTenant",
     "assign_bucket",
+    "autopilot_payload",
     "load_tenant_manifest",
     "model_resident_bytes",
+    "sprt_test",
+    "step_weights",
 ]

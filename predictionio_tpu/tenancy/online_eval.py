@@ -35,18 +35,50 @@ import uuid
 from typing import Optional
 
 from ..obs import (
+    ONLINE_EVAL_CURSOR_LAG,
     VARIANT_FEEDBACK_TOTAL,
     VARIANT_RATE,
     VARIANT_REQUESTS_TOTAL,
 )
 
-__all__ = ["OnlineEval"]
+__all__ = ["OnlineEval", "merge_cursor"]
 
 logger = logging.getLogger(__name__)
 
 # events that are impressions flowing back through the feedback loop,
 # not client conversions — counting them would make every rate ~1.0
 _FEEDBACK_EVENT = "predict"
+
+
+def merge_cursor(old, new):
+    """Component-wise monotone merge of two store cursors (the PR 13
+    vector-cursor algebra).  A sharded scan run with
+    ``tolerate_unavailable=True`` while a shard owner is mid-death can
+    hand back a component BEHIND what an earlier scan already covered;
+    adopting it verbatim would re-scan (and double-count) conversions.
+    Int cursors take the max; JSON shard-vector strings merge per
+    component over the union of shard keys.  Unparseable inputs fall
+    back to ``new`` (never block the scan on cursor cosmetics)."""
+    if old is None:
+        return new
+    if isinstance(old, int) and isinstance(new, int):
+        return max(old, new)
+    try:
+        ov = json.loads(old) if isinstance(old, str) else old
+        nv = json.loads(new) if isinstance(new, str) else new
+        if isinstance(ov, dict) and isinstance(nv, dict):
+            merged = {
+                k: max(int(ov.get(k, 0)), int(nv.get(k, 0)))
+                for k in set(ov) | set(nv)
+            }
+            return json.dumps(
+                {k: merged[k] for k in sorted(merged, key=int)}
+            )
+        if isinstance(ov, int) and isinstance(nv, int):
+            return max(ov, nv)
+    except (ValueError, TypeError):
+        pass
+    return new
 
 
 class OnlineEval:
@@ -121,13 +153,29 @@ class OnlineEval:
                         str(variant), 0
                     ) + 1
             with self._lock:
-                self._cursors[app] = new_cursor
+                # component-wise monotone: a tolerated-unavailable scan
+                # must never walk a shard component backward (that
+                # would re-count its conversions when it returns)
+                self._cursors[app] = merge_cursor(
+                    self._cursors.get(app), new_cursor,
+                )
+                merged = self._cursors[app]
                 for variant, n in counted.items():
                     self._cell(app, variant)["conversions"] += n
             for variant, n in counted.items():
                 VARIANT_FEEDBACK_TOTAL.labels(
                     app=app, variant=variant
                 ).inc(n)
+            if hasattr(event_store, "cursor_lag"):
+                try:
+                    ONLINE_EVAL_CURSOR_LAG.labels(app=app).set(
+                        float(event_store.cursor_lag(app_id, 0, merged))
+                    )
+                except Exception:
+                    logger.debug(
+                        "cursor-lag probe failed for app %s", app,
+                        exc_info=True,
+                    )
         snap = self.snapshot()
         self._export(snap)
         return snap

@@ -319,7 +319,8 @@ class TenantRegistry:
                  salt: str = "pio-hive",
                  loader: Optional[Callable[[TenantSpec], TenantRuntime]] = None,
                  default_quota_qps: Optional[float] = None,
-                 eval_interval_s: float = 5.0):
+                 eval_interval_s: float = 5.0,
+                 autopilot: Optional[dict] = None):
         specs = list(specs)
         if not specs:
             raise ValueError("tenant registry needs >= 1 tenant spec")
@@ -363,6 +364,13 @@ class TenantRegistry:
         # pio_tenant_placement_balance gauge
         self.rebalances = 0
         self.online = OnlineEval(salt=salt)
+        # pio-pilot: the self-driving experiment controller (opt-in via
+        # enable_autopilot() or the tenants.json "autopilot" block; the
+        # serving online-eval loop drives its tick right after each
+        # conversion refresh)
+        self.autopilot = None
+        if autopilot is not None:
+            self.enable_autopilot(config=autopilot)
 
     # -- spec / experiment views ------------------------------------------
     def specs(self) -> list[TenantSpec]:
@@ -874,6 +882,34 @@ class TenantRegistry:
                     app_ids[s.app] = s.app_id
         return self.online.refresh(event_store, app_ids)
 
+    # -- autopilot (pio-pilot) ---------------------------------------------
+    def enable_autopilot(self, config=None, apply_weights=None,
+                         manifest_id=None):
+        """Attach a self-driving experiment controller (see
+        :mod:`.autopilot`).  ``config`` is an :class:`AutopilotConfig`
+        or a camelCase knob dict (the tenants.json ``"autopilot"``
+        block); ``apply_weights`` overrides how ramp steps land
+        (default: in-process ``set_weights`` — the serving edge or a
+        smoke passes the real HTTP broadcast)."""
+        from .autopilot import AutoPilot, AutopilotConfig, set_autopilot
+
+        if config is not None and not isinstance(config, AutopilotConfig):
+            config = AutopilotConfig.from_doc(dict(config))
+        self.autopilot = AutoPilot(
+            self, config=config, apply_weights=apply_weights,
+            manifest_id=manifest_id,
+        )
+        set_autopilot(self.autopilot)
+        return self.autopilot
+
+    def autopilot_tick(self) -> Optional[dict]:
+        """One controller pass, or ``None`` when no autopilot is
+        attached (the serving loop calls this unconditionally)."""
+        pilot = self.autopilot
+        if pilot is None:
+            return None
+        return pilot.tick()
+
     # -- views -------------------------------------------------------------
     def summary(self) -> dict:
         """The small status-JSON block."""
@@ -917,6 +953,10 @@ class TenantRegistry:
                 for app, exp in experiments.items()
             },
             "onlineEval": self.online.snapshot(),
+            "autopilot": (
+                self.autopilot.manifest_id
+                if self.autopilot is not None else None
+            ),
         }
         try:
             from ..obs import xray
@@ -934,6 +974,12 @@ class TenantRegistry:
             if not rt.is_anchor:  # the server owns the anchor batcher
                 self._close_runtime(rt)
         self.online.close()
+        pilot = self.autopilot
+        if pilot is not None:
+            from .autopilot import set_autopilot
+
+            set_autopilot(None)
+            pilot.close()
 
 
 # -- tenants.json manifest ---------------------------------------------------
@@ -989,5 +1035,8 @@ def load_tenant_manifest(path) -> tuple[list[TenantSpec], dict]:
         "salt": doc.get("experimentSalt", "pio-hive"),
         "default_quota_qps": doc.get("defaultQuotaQps"),
         "eval_interval_s": float(doc.get("evalIntervalSec", 5.0)),
+        # pio-pilot: {"autopilot": {"alpha": .., "minLift": ..}} (any
+        # knob optional, presence alone enables the controller)
+        "autopilot": doc.get("autopilot"),
     }
     return specs, options

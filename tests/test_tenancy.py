@@ -450,6 +450,86 @@ def test_online_eval_counts_and_rates(tmp_path, monkeypatch):
     )
 
 
+def test_merge_cursor_algebra():
+    from predictionio_tpu.tenancy.online_eval import merge_cursor
+
+    # int cursors: plain max
+    assert merge_cursor(5, 3) == 5
+    assert merge_cursor(3, 5) == 5
+    assert merge_cursor(None, 7) == 7
+    # JSON shard vectors: component-wise max over the union of shards
+    old = json.dumps({"0": 10, "1": 7})
+    new = json.dumps({"0": 4, "1": 9, "2": 2})
+    assert json.loads(merge_cursor(old, new)) == {
+        "0": 10, "1": 9, "2": 2,
+    }
+    # serialization is canonical (sorted by int shard index)
+    assert merge_cursor(old, new) == merge_cursor(
+        merge_cursor(old, new), new
+    )
+    # unparseable inputs never block the scan: adopt new
+    assert merge_cursor("not json", 42) == 42
+
+
+def test_online_eval_cursor_never_regresses(tmp_path, monkeypatch):
+    """A tolerated-unavailable scan during shard-owner death can hand
+    back a vector cursor with a REGRESSED component; adopting it
+    verbatim would re-scan (double-count) that shard's conversions
+    when the owner returns.  The merged cursor must be component-wise
+    monotone, and the next scan must start from the merged cursor."""
+    monkeypatch.setenv("PIO_TPU_RUNLOG_DIR", str(tmp_path / "runs"))
+    from predictionio_tpu.obs import ONLINE_EVAL_CURSOR_LAG
+
+    def _row(variant):
+        return (1, "e", "click", "user", "u", "item", "i",
+                json.dumps({"variant": variant}), 0.0, None, None, 0.0)
+
+    class _VectorStore:
+        shards = (0, 1)  # hasattr gate -> tolerate_unavailable=True
+
+        def __init__(self, script):
+            self.script = list(script)
+            self.seen = []
+
+        def find_rows_since(self, app_id, channel, cursor=0, limit=0,
+                            tolerate_unavailable=False):
+            assert tolerate_unavailable
+            self.seen.append(cursor)
+            return self.script.pop(0)
+
+        def cursor_lag(self, app_id, channel, cursor):
+            return 3.5
+
+    store = _VectorStore([
+        # healthy scan: both shards advance
+        ([_row("a"), _row("b")], json.dumps({"0": 10, "1": 7})),
+        # shard 1's owner dies mid-scan: its component comes back
+        # regressed while shard 0 keeps feeding conversions
+        ([_row("a")], json.dumps({"0": 12, "1": 0})),
+        ([], json.dumps({"0": 12, "1": 7})),
+    ])
+    oe = OnlineEval(manifest_id="vec-test")
+    oe.impression("shop", "a")
+    oe.refresh(store, {"shop": 1})
+    assert json.loads(oe._cursors["shop"]) == {"0": 10, "1": 7}
+
+    snap = oe.refresh(store, {"shop": 1})
+    # the healthy shard's conversions counted...
+    assert snap["shop/a"]["conversions"] == 2
+    # ...and the dead shard's component held at 7, not 0
+    assert json.loads(oe._cursors["shop"]) == {"0": 12, "1": 7}
+
+    # the next scan resumes FROM the merged cursor, so shard 1's
+    # already-counted rows are never re-read
+    oe.refresh(store, {"shop": 1})
+    assert json.loads(store.seen[2]) == {"0": 12, "1": 7}
+    # the staleness gauge tracked the store's cursor-lag probe
+    assert ONLINE_EVAL_CURSOR_LAG.labels(
+        app="shop"
+    ).value() == pytest.approx(3.5)
+    oe.close()
+
+
 # ---------------------------------------------------------------------------
 # tenants.json manifest
 # ---------------------------------------------------------------------------

@@ -23,11 +23,23 @@ nests a summary into BENCH_PR<k>.json):
     the recall gate the acceptance pins at >= 0.95.  Predict results
     are host-materialized per query (device-complete timings).
 
+``--nextitem``
+    End-to-end: a REAL Markov next-item deployment (sharded sqlite
+    store, gap-sessionized transition scan, EngineServer HTTP) under
+    sequential load.  Records ``nextitem_e2e_p50_ms`` (down) and
+    ``nextitem_freshness_ms`` — wall time from a burst of brand-new
+    (anchor -> fresh-item) transitions hitting the STORE to fresh-item
+    leading the anchor's served successor list with ZERO /reload calls
+    (the cursor fold-in path; no factor model — asserted, not
+    assumed).  Host-only engine: wall time is complete by construction.
+
 Usage::
 
     python tools/bench_engines.py --itemsim --items 100000 \
         --append-history
     python tools/bench_engines.py --trending --events 100000 \
+        --append-history
+    python tools/bench_engines.py --nextitem --events 100000 \
         --append-history
 """
 
@@ -295,10 +307,176 @@ def bench_trending(args) -> list[dict]:
         reset_storage(None)
 
 
+# ---------------------------------------------------------------------------
+# nextitem: end-to-end Markov session engine + fold-in freshness
+# ---------------------------------------------------------------------------
+
+
+def bench_nextitem(args) -> list[dict]:
+    import numpy as np
+
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.engines import resolve
+    from predictionio_tpu.server.serving import (
+        EngineServer, ServerConfig,
+    )
+    from predictionio_tpu.storage import Storage, reset_storage
+    from predictionio_tpu.storage.event import new_event_ids
+    from predictionio_tpu.workflow import run_train
+
+    home = tempfile.mkdtemp(prefix="pio_bench_nextitem_")
+    storage = Storage({
+        "PIO_TPU_HOME": home,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SHARDED",
+        "PIO_STORAGE_SOURCES_SHARDED_TYPE": "sqlite-sharded",
+        "PIO_STORAGE_SOURCES_SHARDED_PATH": str(
+            Path(home) / "events-sharded"
+        ),
+        "PIO_STORAGE_SOURCES_SHARDED_SHARDS": str(args.shards),
+    })
+    reset_storage(storage)
+    srv = None
+    try:
+        md = storage.get_metadata()
+        app = md.app_insert("bench-nextitem")
+        es = storage.get_event_store()
+        es.init_channel(app.id)
+        # seed: per-user Markov walks over a ring catalog with zipf
+        # jumps — sessions are contiguous event runs, so transition
+        # rows (src -> src+1 mostly) dominate the store
+        rng = np.random.default_rng(args.seed)
+        n_users = max(args.events // 20, 1)
+        now_ms = int(time.time() * 1000)
+        rows = []
+        ids = new_event_ids(args.events)
+        j = 0
+        while j < args.events:
+            u = int(rng.integers(0, n_users))
+            start = int(rng.zipf(1.3)) % args.catalog
+            t_ms = now_ms - int(rng.integers(0, 6 * 3600 * 1000))
+            run = min(int(rng.integers(2, 8)), args.events - j)
+            for s in range(run):
+                item = (start + s) % args.catalog
+                rows.append((
+                    ids[j], "view", "user", f"u{u}", "item",
+                    f"i{item}", "{}", t_ms + s * 1000, "[]",
+                    None, now_ms,
+                ))
+                j += 1
+        es.insert_raw_rows(rows, app_id=app.id)
+
+        engine, ep, _variant = resolve("nextitem", {
+            "datasource": {"params": {
+                "appName": "bench-nextitem",
+                "eventNames": ["view"],
+                "refreshSec": args.refresh_s,
+                "sessionGapSec": 1800.0,
+            }},
+        })
+        t0 = time.perf_counter()
+        ctx = WorkflowContext(storage=storage)
+        iid = run_train(engine, ep, ctx=ctx, engine_id="nextitem",
+                        engine_variant="engine:nextitem")
+        train_s = time.perf_counter() - t0
+        srv = EngineServer(
+            engine, ep, iid, ctx=ctx,
+            config=ServerConfig(port=0, microbatch="off"),
+            engine_id="nextitem", engine_variant="engine:nextitem",
+        )
+        srv.start_background()
+        base = f"http://127.0.0.1:{srv.port}"
+        # the no-factor-model pin (host CSR rows, no device)
+        with srv._lock:
+            models = srv.models
+        assert all(not hasattr(m, "item_factors") for m in models)
+
+        def query(item, num=10):
+            req = urllib.request.Request(
+                f"{base}/queries.json",
+                data=json.dumps(
+                    {"user": "bench", "item": item, "num": num}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read().decode())
+
+        anchors = [f"i{int(a)}" for a in
+                   rng.integers(0, args.catalog, size=args.queries)]
+        for a in anchors[:10]:
+            query(a)
+        samples = []
+        for a in anchors:
+            t0 = time.perf_counter()
+            query(a)
+            samples.append(time.perf_counter() - t0)
+
+        # freshness: a burst of brand-new (anchor -> fresh-item)
+        # transitions -> time until fresh-item LEADS the anchor's
+        # successor list (store write -> cursor fold-in -> top-1), with
+        # ZERO /reload calls.  Each burst user views anchor then
+        # fresh-item 1s later; sized off the current leader's decayed
+        # weight (fresh transitions weigh ~1.0 each)
+        anchor = "i1"
+        top = query(anchor, 1)["itemScores"]
+        leader_w = top[0]["score"] if top else 0.0
+        burst_n = int(leader_w * 1.2) + 50
+        ids2 = new_event_ids(2 * burst_n)
+        now_ms = int(time.time() * 1000)
+        rows2 = []
+        for k in range(burst_n):
+            rows2.append((ids2[2 * k], "view", "user", f"b{k}", "item",
+                          anchor, "{}", now_ms, "[]", None, now_ms))
+            rows2.append((ids2[2 * k + 1], "view", "user", f"b{k}",
+                          "item", "fresh-item", "{}", now_ms + 1000,
+                          "[]", None, now_ms))
+        t0 = time.perf_counter()
+        es.insert_raw_rows(rows2, app_id=app.id)
+        fresh_s = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            out = query(anchor, 1)
+            if (out.get("itemScores")
+                    and out["itemScores"][0]["item"] == "fresh-item"):
+                fresh_s = time.perf_counter() - t0
+                break
+            time.sleep(0.02)
+        common = {
+            "unit": "ms", "platform": "cpu",
+            "scale": float(args.events), "fenced": True,
+            "events": args.events, "catalog": args.catalog,
+            "shards": args.shards, "refresh_s": args.refresh_s,
+            "seed": args.seed, "engine": "nextitem",
+            "factor_model": False, "train_s": round(train_s, 3),
+        }
+        recs = [
+            {"metric": "nextitem_e2e_p50_ms",
+             "value": round(_p50(samples), 3),
+             "direction": "down", "queries": args.queries, **common},
+        ]
+        if fresh_s is not None:
+            recs.append({
+                "metric": "nextitem_freshness_ms",
+                "value": round(fresh_s * 1e3, 1),
+                "direction": "down", "burst": burst_n, **common,
+            })
+        else:
+            print(json.dumps({"warning": "freshness burst never led "
+                              "the successor list within 30s; no "
+                              "freshness record emitted"}), flush=True)
+        return [_emit(r, args.append_history) for r in recs]
+    finally:
+        if srv is not None:
+            srv.stop()
+        reset_storage(None)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trending", action="store_true")
     ap.add_argument("--itemsim", action="store_true")
+    ap.add_argument("--nextitem", action="store_true")
     ap.add_argument("--append-history", action="store_true")
     ap.add_argument("--seed", type=int, default=7)
     # itemsim knobs
@@ -307,19 +485,21 @@ def main() -> int:
     ap.add_argument("--candidate-factor", type=int, default=10)
     ap.add_argument("--nprobe", type=int, default=8)
     ap.add_argument("--queries", type=int, default=100)
-    # trending knobs
+    # trending/nextitem knobs
     ap.add_argument("--events", type=int, default=100_000)
     ap.add_argument("--catalog", type=int, default=5000)
     ap.add_argument("--shards", type=int, default=4)
     ap.add_argument("--refresh-s", type=float, default=0.2)
     args = ap.parse_args()
-    if not (args.trending or args.itemsim):
-        ap.error("pick --trending and/or --itemsim")
+    if not (args.trending or args.itemsim or args.nextitem):
+        ap.error("pick --trending, --itemsim and/or --nextitem")
     recs = []
     if args.itemsim:
         recs += bench_itemsim(args)
     if args.trending:
         recs += bench_trending(args)
+    if args.nextitem:
+        recs += bench_nextitem(args)
     if args.append_history:
         import bench_gate
 

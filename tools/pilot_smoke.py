@@ -1,0 +1,474 @@
+"""pio-pilot end-to-end smoke: an A/B that concludes ITSELF, proven on
+one real server over sqlite.
+
+The tier-1 proof of the self-driving-experiment contract
+(`tests/test_autopilot.py` unit-tests the SPRT math; this boots the
+closed loop): ONE engine server hosting 2 apps x 2 variants plus a real
+event server, an autopilot whose ramp steps land as REAL
+``POST /tenants/weights`` calls over HTTP (not in-process shortcuts),
+and a seeded conversion gap:
+
+* ``sprt_concludes_experiment`` — app "pilot" has treatment converting
+  ~6x control; the SPRT walk crosses its upper threshold and the
+  controller ramps treatment up step by step until the experiment
+  concludes itself (state=concluded, no human in the loop).
+* ``traffic_observably_shifts``  — the registry's live weights (read
+  back through ``GET /debug/tenants``) move from 50/50 to
+  winner-heavy; every step is bounded by ``maxStep``; the loser lands
+  ON the ``minWeight`` floor — ramped down, never zeroed (the holdout
+  keeps measuring).
+* ``weights_applied_via_http``   — every ramp lands through the real
+  serving-edge admin endpoint: the smoke's apply callable records one
+  HTTP 200 per step and the server-side weights actually changed.
+* ``fast_but_broken_vetoed``     — app "blaze" has variant "turbo"
+  seeded with the BEST conversion rate, then a ``tenant.dispatch``
+  fault plan breaks it: its breaker opens (client-level 500s then
+  structured 503 sheds), and the autopilot ramps turbo DOWN on the
+  guardrail veto — a fast-but-broken variant can never win.  Evidence
+  at both levels: client response codes AND the
+  ``pio_tenant_queries_total`` error/shed counters +
+  ``pio_experiment_decisions_total`` on ``/metrics`` (breaker state
+  read from ``/debug/tenants``).
+* ``tower_manifest_decisions``   — the SPRT conclusion and EVERY ramp
+  step (and every veto step) are replayable from the pio-tower run
+  manifest (``kind="autopilot"`` decision events with the llr walk).
+* ``debug_experiments_mounted``  — ``GET /debug/experiments`` serves
+  the live controller payload, and the dashboard's
+  ``experiments.html`` renders it.
+
+Usage::
+
+    python tools/pilot_smoke.py --out pilot_smoke.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime as dt
+import json
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+UTC = dt.timezone.utc
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        try:
+            return e.code, json.loads(body)
+        except json.JSONDecodeError:
+            return e.code, {"raw": body}
+
+
+def _get(url, timeout=15, raw=False):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = r.read().decode()
+        return r.status, (body if raw else json.loads(body))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="pilot_smoke.json")
+    ap.add_argument("--seed", type=int, default=20260807)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from predictionio_tpu.controller import WorkflowContext
+    from predictionio_tpu.resilience import faults
+    from predictionio_tpu.server import EngineServer, ServerConfig
+    from predictionio_tpu.server.event_server import (
+        EventServer, EventServerConfig,
+    )
+    from predictionio_tpu.storage import AccessKey, DataMap, Event
+    from predictionio_tpu.storage.registry import Storage
+    from predictionio_tpu.tenancy import TenantRegistry, TenantSpec
+    from predictionio_tpu.tenancy.autopilot import (
+        STATE_CONCLUDED, AutopilotConfig,
+    )
+    from predictionio_tpu.templates.recommendation import (
+        recommendation_engine,
+    )
+    from predictionio_tpu.workflow import run_train
+
+    stages: dict[str, float] = {}
+    invariants: dict[str, bool] = {}
+    detail: dict = {}
+
+    def stage(name):
+        class _T:
+            def __enter__(self):
+                self.t0 = time.time()
+
+            def __exit__(self, *exc):
+                stages[name] = round(time.time() - self.t0, 3)
+
+        return _T()
+
+    home = tempfile.mkdtemp(prefix="pio_pilot_smoke_")
+    storage = Storage(env={
+        "PIO_TPU_HOME": home,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "SQLITE",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQLITEMD",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "LOCALFS",
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": f"{home}/events.db",
+        "PIO_STORAGE_SOURCES_SQLITEMD_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITEMD_PATH": f"{home}/md.db",
+        "PIO_STORAGE_SOURCES_LOCALFS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_LOCALFS_PATH": f"{home}/models",
+    })
+    md = storage.get_metadata()
+    es = storage.get_event_store()
+    rng = np.random.default_rng(args.seed)
+
+    # ---- train 2 apps x 2 variants = 4 real instances -------------------
+    # "pilot": a clean experiment with a seeded conversion gap
+    # "blaze":  variant "turbo" converts best but gets a fault plan —
+    #           the guardrail-veto fixture
+    variants = {"pilot": ("control", "treatment"),
+                "blaze": ("steady", "turbo")}
+    with stage("train"):
+        specs = []
+        keys = {}
+        app_ids = {}
+        for app_name, (va, vb) in sorted(variants.items()):
+            app = md.app_insert(app_name)
+            key = md.access_key_insert(AccessKey(key="", appid=app.id))
+            keys[app_name], app_ids[app_name] = key, app.id
+            es.init_channel(app.id)
+            evs = []
+            for u in range(8):
+                group = u % 2
+                for i in range(8):
+                    if rng.random() < (0.9 if (i % 2) == group else 0.2):
+                        evs.append(Event(
+                            event="rate", entity_type="user",
+                            entity_id=f"u{u}",
+                            target_entity_type="item",
+                            target_entity_id=f"i{i}",
+                            properties=DataMap(
+                                {"rating": 5.0 if (i % 2) == group
+                                 else 1.0}
+                            ),
+                            event_time=dt.datetime(
+                                2020, 1, 1, tzinfo=UTC
+                            ),
+                        ))
+            es.insert_batch(evs, app_id=app.id)
+            for variant, lam in ((va, 0.05), (vb, 0.2)):
+                engine = recommendation_engine()
+                ep = engine.params_from_variant({
+                    "datasource": {"params": {"appName": app_name}},
+                    "algorithms": [{"name": "als", "params": {
+                        "rank": 8, "numIterations": 4, "lambda": lam}}],
+                })
+                ctx = WorkflowContext(storage=storage)
+                iid = run_train(engine, ep, ctx=ctx,
+                                engine_variant=f"{app_name}-{variant}")
+                specs.append(TenantSpec(
+                    app_name, variant, engine=engine, engine_params=ep,
+                    instance_id=iid,
+                    ctx=WorkflowContext(storage=storage, mode="Serving"),
+                    app_id=app.id, access_key=key, weight=0.5,
+                ))
+
+    # eval_interval_s huge: the smoke drives refresh+tick MANUALLY so
+    # every ramp step is observed (the serving loop is exercised by
+    # hive_smoke; here determinism wins)
+    registry = TenantRegistry(specs, memory_budget_bytes=0,
+                              salt="pilot-smoke",
+                              eval_interval_s=3600.0)
+    ev_srv = EventServer(storage, EventServerConfig(port=0))
+    ev_srv.start_background()
+    ev_base = f"http://127.0.0.1:{ev_srv.config.port}"
+    anchor = specs[0]
+    srv = EngineServer(
+        anchor.engine, anchor.engine_params, anchor.instance_id,
+        ctx=anchor.ctx,
+        config=ServerConfig(
+            port=0, microbatch="off",
+            breaker_failures=3, breaker_reset_s=60.0,
+        ),
+        engine_variant="pilot-smoke",
+        tenants=registry,
+    )
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.config.port}"
+
+    # the closed-loop wiring under test: ramp steps land as REAL admin
+    # POSTs against the serving edge, not in-process set_weights calls
+    http_applies: list[dict] = []
+
+    def apply_over_http(app, weights):
+        code, body = _post(f"{base}/tenants/weights",
+                           {"app": app, "weights": weights})
+        http_applies.append(
+            {"app": app, "weights": dict(weights), "status": code}
+        )
+        if code != 200:
+            raise RuntimeError(f"weight POST failed: {code} {body}")
+        return body
+
+    cfg = AutopilotConfig(alpha=0.05, beta=0.20, min_lift=0.20,
+                          min_samples=60, max_step=0.10,
+                          min_weight=0.05)
+    pilot = registry.enable_autopilot(
+        config=cfg, apply_weights=apply_over_http,
+        manifest_id=f"pilot-smoke-{args.seed}-{int(time.time())}",
+    )
+
+    def query(app, user, variant=None, timeout=15):
+        payload = {"app": app, "user": user, "num": 3}
+        if variant is not None:
+            payload["variant"] = variant
+        return _post(f"{base}/queries.json", payload, timeout=timeout)
+
+    def server_weights(app):
+        _, dbg = _get(f"{base}/debug/tenants")
+        return dbg["experiments"][app]["weights"]
+
+    try:
+        # ---- seed: impressions via real queries, conversions via the
+        # event server (the variant tag echoed on client events, the
+        # quickstart contract) ------------------------------------------
+        with stage("seed"):
+            impressions = 80
+            gaps = {("pilot", "control"): 8, ("pilot", "treatment"): 48,
+                    ("blaze", "steady"): 4, ("blaze", "turbo"): 30}
+            for app_name, (va, vb) in sorted(variants.items()):
+                for variant in (va, vb):
+                    for i in range(impressions):
+                        code, _ = query(app_name, f"user{i}",
+                                        variant=variant)
+                        assert code == 200, f"seed query failed: {code}"
+            for (app_name, variant), n in sorted(gaps.items()):
+                for i in range(n):
+                    code, _ = _post(
+                        f"{ev_base}/events.json"
+                        f"?accessKey={keys[app_name]}",
+                        {
+                            "event": "click", "entityType": "user",
+                            "entityId": f"user{i}",
+                            "targetEntityType": "item",
+                            "targetEntityId": "i1",
+                            "properties": {"variant": variant},
+                        },
+                    )
+                    assert code == 201, f"conversion write failed: {code}"
+            snap = registry.refresh_online_eval(es)
+            detail["onlineEval"] = snap
+            assert snap["pilot/treatment"]["conversions"] == 48
+
+        # ---- the experiment concludes itself ---------------------------
+        with stage("autopilot_concludes"):
+            w_before = server_weights("pilot")
+            trail = [dict(w_before)]
+            for _ in range(12):
+                pilot.tick()
+                trail.append(dict(server_weights("pilot")))
+                state = pilot.payload()["apps"]["pilot"]["state"]
+                if state == STATE_CONCLUDED:
+                    break
+            w_after = trail[-1]
+            detail["pilotWeightTrail"] = trail
+            detail["httpApplies"] = http_applies
+            payload = pilot.payload()
+            invariants["sprt_concludes_experiment"] = (
+                payload["apps"]["pilot"]["state"] == STATE_CONCLUDED
+            )
+            invariants["traffic_observably_shifts"] = (
+                w_after["treatment"] > w_before["treatment"] + 0.3
+            )
+            steps = [
+                abs(b["treatment"] - a["treatment"])
+                for a, b in zip(trail, trail[1:])
+            ]
+            invariants["ramp_steps_bounded"] = all(
+                s <= cfg.max_step + 1e-6 for s in steps
+            )
+            # ramped down, never zeroed: the loser lands ON the floor
+            invariants["loser_on_min_weight_floor"] = (
+                abs(w_after["control"] - cfg.min_weight) < 1e-6
+            )
+            pilot_posts = [a for a in http_applies
+                           if a["app"] == "pilot"]
+            invariants["weights_applied_via_http"] = (
+                len(pilot_posts) >= 3
+                and all(a["status"] == 200 for a in pilot_posts)
+            )
+            last = payload["apps"]["pilot"]["decisions"][-1]
+            detail["pilotConclusion"] = last
+            invariants["sprt_llr_crossed_threshold"] = (
+                last.get("llr") is not None
+                and last["llr"] >= last["upper"]
+                and last.get("leader") == "treatment"
+            )
+
+        # ---- guardrail: fast-but-broken can never win ------------------
+        with stage("guardrail_veto"):
+            # turbo holds the best conversion rate — without the
+            # guardrail the SPRT would ramp it UP
+            snap = registry.refresh_online_eval(es)
+            assert (snap["blaze/turbo"]["rate"]
+                    > snap["blaze/steady"]["rate"])
+            faults.arm("tenant.dispatch:tenant=blaze/turbo,exc=fault")
+            try:
+                codes = [query("blaze", f"user{i}", variant="turbo")[0]
+                         for i in range(12)]
+                detail["turboCodesUnderFault"] = sorted(set(codes))
+                # client-level evidence: errors, then breaker sheds
+                invariants["veto_client_evidence"] = (
+                    codes.count(500) >= 3 and 503 in codes
+                )
+                # turbo may have legitimately ramped all the way up
+                # while it was healthy — the guardrail must claw it
+                # back from ANY height, one bounded step per tick
+                w0 = server_weights("blaze")
+                for _ in range(14):
+                    pilot.tick()
+                    if (server_weights("blaze")["turbo"]
+                            <= cfg.min_weight + 1e-6):
+                        break
+                w1 = server_weights("blaze")
+                detail["blazeWeights"] = {"before": w0, "after": w1}
+                invariants["fast_but_broken_vetoed"] = (
+                    w1["turbo"] <= cfg.min_weight + 1e-6
+                    and w1["steady"] > w1["turbo"]
+                )
+                blaze = pilot.payload()["apps"]["blaze"]
+                vetoes = [d for d in blaze["decisions"]
+                          if d["decision"] == "veto"]
+                detail["blazeVetoes"] = len(vetoes)
+                invariants["veto_decisions_recorded"] = (
+                    len(vetoes) >= 1
+                    and all("breaker" in (d["reason"] or "")
+                            for d in vetoes)
+                )
+                # /metrics-level evidence, independent of the client
+                _, metrics = _get(f"{base}/metrics", raw=True)
+
+                def _metric_val(prefix):
+                    for ln in metrics.splitlines():
+                        if ln.startswith(prefix):
+                            try:
+                                return float(ln.rsplit(" ", 1)[1])
+                            except ValueError:
+                                return None
+                    return None
+
+                turbo_err = _metric_val(
+                    'pio_tenant_queries_total'
+                    '{app="blaze",variant="turbo",status="error"}'
+                )
+                turbo_shed = _metric_val(
+                    'pio_tenant_queries_total'
+                    '{app="blaze",variant="turbo",status="shed"}'
+                )
+                veto_n = _metric_val(
+                    'pio_experiment_decisions_total'
+                    '{app="blaze",decision="veto"}'
+                )
+                ramp_n = _metric_val(
+                    'pio_experiment_decisions_total'
+                    '{app="pilot",decision="ramp"}'
+                )
+                state_g = _metric_val(
+                    'pio_experiment_state{app="pilot"}'
+                )
+                _, dbg = _get(f"{base}/debug/tenants")
+                breaker = dbg["resident_tenants"].get(
+                    "blaze/turbo", {}
+                ).get("breaker")
+                detail["metricsEvidence"] = {
+                    "turboErrors": turbo_err, "turboShed": turbo_shed,
+                    "turboBreaker": breaker, "vetoDecisions": veto_n,
+                    "rampDecisions": ramp_n, "pilotState": state_g,
+                }
+                invariants["veto_metrics_evidence"] = (
+                    (turbo_err or 0) >= 3 and (turbo_shed or 0) >= 1
+                    and breaker == "open" and (veto_n or 0) >= 1
+                )
+                invariants["experiment_families_exported"] = (
+                    (ramp_n or 0) >= 3
+                    and state_g == STATE_CONCLUDED
+                    and "pio_experiment_llr" in metrics
+                )
+            finally:
+                faults.disarm()
+
+        # ---- surfaces: /debug/experiments, dashboard, tower manifest ---
+        with stage("surfaces"):
+            _, exp = _get(f"{base}/debug/experiments")
+            invariants["debug_experiments_mounted"] = (
+                exp.get("enabled") is True
+                and exp.get("manifestId") == pilot.manifest_id
+                and exp["apps"]["pilot"]["stateName"] == "concluded"
+                and "weights" in exp
+            )
+            from predictionio_tpu.server.dashboard import DashboardServer
+
+            html = DashboardServer(storage).experiments_html()
+            invariants["dashboard_renders_experiments"] = (
+                "pilot" in html and "concluded" in html
+                and "SPRT" in html
+            )
+            from predictionio_tpu.obs.runlog import (
+                read_manifest, runs_root,
+            )
+
+            view = read_manifest(runs_root() / pilot.manifest_id)
+            events = [e for e in view["events"]
+                      if e.get("event") == "decision"]
+            ramps = [e for e in events
+                     if e.get("decision") == "ramp"]
+            vetoes = [e for e in events
+                      if e.get("decision") == "veto"]
+            concludes = [e for e in events
+                         if e.get("app") == "pilot"
+                         and e.get("decision") == "conclude"]
+            detail["manifestDecisions"] = {
+                "total": len(events), "ramps": len(ramps),
+                "vetoes": len(vetoes), "concludes": len(concludes),
+            }
+            # EVERY applied step is replayable: one manifest event per
+            # HTTP weight POST, llr walk attached to each SPRT ramp
+            invariants["tower_manifest_decisions"] = (
+                len(ramps) + len(vetoes) == len(http_applies)
+                and len(concludes) >= 1
+                and all("llr" in e and "weights" in e for e in ramps)
+            )
+    finally:
+        faults.disarm()
+        srv.stop()
+        ev_srv.stop()
+
+    ok = all(invariants.values())
+    artifact = {
+        "ok": ok,
+        "generatedAt": dt.datetime.now(UTC).isoformat(),
+        "stages": stages,
+        "invariants": invariants,
+        "detail": detail,
+    }
+    Path(args.out).write_text(json.dumps(artifact, indent=2))
+    print(json.dumps(artifact, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,12 @@ def main() -> None:
                     "keyed by tenant count (scale=N — the same "
                     "record-keying convention --items uses for "
                     "catalog size)")
+    ap.add_argument("--profile", action="store_true",
+                    help="pio-scope: run the always-on sampling "
+                    "profiler through the sweep and stamp each point "
+                    "with its per-role CPU split + dominant stacks "
+                    "(the server runs in this process, so the split "
+                    "is the exact server-side attribution)")
     ap.add_argument("--platform")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
@@ -225,6 +231,8 @@ def main() -> None:
         sys.path.insert(0, str(Path(__file__).parent / "tools"))
         import bench_gate
 
+        from predictionio_tpu.obs import scope as _scope
+
         bench_gate.write_pr_summary(
             {
                 **serving_rec,
@@ -233,6 +241,7 @@ def main() -> None:
                 "items": args.items,
                 "rank": args.rank,
                 "fenced": True,
+                "profiler_enabled": _scope.profiler_running(),
             },
             key="serving",
         )
@@ -589,10 +598,17 @@ def _bench_sweep(args, model, rng) -> None:
     import bench_gate
     import loadgen
 
-    from predictionio_tpu.obs import telemetry_home
+    from predictionio_tpu.obs import scope, telemetry_home
     from predictionio_tpu.obs.timeline import (
         SERVE_SEGMENTS, SERVE_SEGMENT_SECONDS,
     )
+
+    if args.profile:
+        # --profile forces the pio-scope sampler on for the sweep even
+        # when the environment opted out (PIO_TPU_SCOPE=0): an explicit
+        # profiling request wins over an ambient default
+        scope.set_enabled(True)
+        scope.ensure_started()
 
     points_c = (
         [int(x) for x in args.sweep.split(",")] if args.sweep
@@ -685,10 +701,12 @@ def _bench_sweep(args, model, rng) -> None:
     for c in points_c:
         before = seg_snapshot()
         ev_before = registry.evictions if registry is not None else 0
+        t_start = time.time()
         res = loadgen.run_load(
             f"{base}/queries.json", payloads, c, args.duration_s,
             mode=args.loadgen_mode, arrival_rate=args.arrival_rate,
         )
+        t_end = time.time()
         after = seg_snapshot()
         # mean per-segment share of this window's requests: the server
         # and bench share one process, so the registry deltas are the
@@ -710,6 +728,19 @@ def _bench_sweep(args, model, rng) -> None:
         }
         if srv._burn is not None:
             point["burn_rate_1m"] = round(srv._burn.rate(60.0), 4)
+        if args.profile and scope.profiler_running():
+            # the server runs IN this process, so the ring's window
+            # over [t_start, t_end] is the exact server-side CPU
+            # attribution for this point: which role burned the
+            # samples, and the stacks that dominated on-CPU time
+            prof = scope.get_profiler()
+            point["profile"] = {
+                "overhead_ratio": round(prof.overhead_ratio(), 5),
+                "roles": prof.role_totals(t_end - t_start),
+                "dominant_stacks": prof.dominant_stacks(
+                    t_start, t_end, top=5
+                ),
+            }
         if registry is not None:
             ev_delta = registry.evictions - ev_before
             missing = expected_keys - set(registry.resident_keys())
@@ -736,6 +767,7 @@ def _bench_sweep(args, model, rng) -> None:
             "scale": rec_scale,
             "nproc": os.cpu_count() or 1,
             "fenced": True,
+            "profiler_enabled": scope.profiler_running(),
             "retrieval": args.retrieval,
             "qps": point["qps"],
             "p50_ms": point["p50_ms"],
@@ -771,6 +803,7 @@ def _bench_sweep(args, model, rng) -> None:
         "items": args.items,
         "rank": args.rank,
         "retrieval": args.retrieval,
+        "profiler_enabled": scope.profiler_running(),
         **({"tenants": tenants_n} if tenants_n > 1 else {}),
         "points": points,
         **({"microbatch": mb} if mb else {}),
@@ -793,6 +826,7 @@ def _bench_sweep(args, model, rng) -> None:
             "scale": rec_scale,
             "nproc": os.cpu_count() or 1,
             "fenced": True,
+            "profiler_enabled": scope.profiler_running(),
             "retrieval": args.retrieval,
             "slo_ms": args.slo_ms,
             "concurrency": best["concurrency"],

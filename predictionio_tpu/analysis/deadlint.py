@@ -246,6 +246,11 @@ class _FileCtx:
         parts = _dotted(value.func)
         if parts is None:
             return None
+        # obs/scope.py instrumented drop-ins keep monitor semantics
+        if parts[-1] == "TimedLock":
+            return "RLock"
+        if parts[-1] == "TimedCondition":
+            return "Condition"
         if len(parts) == 1:
             if parts[0] in self.lock_ctor_names:
                 return parts[0]
@@ -323,11 +328,21 @@ class _ClassInfo:
                         self.lock_attrs.add(attr)
                         if kind == "Condition":
                             self.cond_attrs.add(attr)
-                            # Condition(self._lock): holding either is
-                            # holding both
-                            args = node.value.args
-                            if args:
-                                under = _self_attr(args[0])
+                            # Condition(self._lock) /
+                            # TimedCondition(name, lock=self._lock):
+                            # holding either is holding both
+                            cand = None
+                            for kw in node.value.keywords:
+                                if kw.arg == "lock":
+                                    cand = kw.value
+                            if cand is None:
+                                args = node.value.args
+                                idx = 1 if self.ctx.ctor_name(
+                                    node.value) == "TimedCondition" else 0
+                                if len(args) > idx:
+                                    cand = args[idx]
+                            if cand is not None:
+                                under = _self_attr(cand)
                                 if under is not None:
                                     self.alias[attr] = under
                     elif kind == "Event":

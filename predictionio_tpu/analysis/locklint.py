@@ -39,6 +39,9 @@ from .core import Finding, SourceFile
 __all__ = ["LockEngine"]
 
 LOCK_TYPES = {"Lock", "RLock", "Condition"}
+# obs/scope.py instrumented drop-ins: same monitor semantics, so an
+# attr built from one IS a lock for guard-discipline purposes
+TIMED_LOCK_TYPES = {"TimedLock", "TimedCondition"}
 
 # method calls on an attribute that mutate the underlying container
 MUTATOR_METHODS = {
@@ -116,6 +119,8 @@ class LockEngine:
         parts = _dotted(value.func)
         if parts is None:
             return False
+        if parts[-1] in TIMED_LOCK_TYPES:
+            return True
         if len(parts) == 1:
             return parts[0] in self.lock_ctor_names
         return (parts[0] in self.threading_aliases

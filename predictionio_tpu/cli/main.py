@@ -139,6 +139,13 @@ def _apply_obs_flags(args) -> None:
     flight_n = getattr(args, "flight_capacity", None)
     if flight_n is not None:
         get_flight_recorder().set_capacity(flight_n)
+    if getattr(args, "no_profiler", False):
+        # pio-scope opt-out: the servers' ensure_started() becomes a
+        # no-op; the TimedLock contention lens keeps booking (its cost
+        # is per-contended-acquire, not per-sample)
+        from ..obs import scope
+
+        scope.set_enabled(False)
 
 
 def _add_obs_args(p) -> None:
@@ -157,6 +164,11 @@ def _add_obs_args(p) -> None:
                    help="pio-xray device-memory sampler period "
                    "(default: $PIO_TPU_XRAY_SAMPLE_S or 10; <= 0 "
                    "disables the sampler)")
+    p.add_argument("--no-profiler", action="store_true",
+                   help="disable the pio-scope always-on sampling "
+                   "profiler (GET /debug/pprof then answers an empty "
+                   "profile; the lock-contention lens stays on; "
+                   "PIO_TPU_SCOPE=0 is the env equivalent)")
 
 
 # --------------------------------------------------------------------------
@@ -635,6 +647,8 @@ def _deploy_fleet(args) -> int:
             extra += [flag, str(val)]
     if getattr(args, "scan_cache", False):
         extra.append("--scan-cache")
+    if getattr(args, "no_profiler", False):
+        extra.append("--no-profiler")
     def spawner(i):
         return spawn_replica(args.engine_json, i, coord_dir,
                              extra_args=extra,
@@ -830,6 +844,7 @@ def cmd_eventserver(args, storage: Storage) -> int:
             owned_shards=owned,
             ttl_s=getattr(args, "ttl", None),
             compact_interval_s=getattr(args, "compact_interval", None),
+            slo_ms=getattr(args, "slo_ms", None),
         )
     )
     if getattr(args, "port_file", None):
@@ -873,11 +888,16 @@ def _eventserver_fleet(args, storage: Storage) -> int:
         ("--max-connections", args.max_connections),
         ("--ttl", getattr(args, "ttl", None)),
         ("--compact-interval", getattr(args, "compact_interval", None)),
+        # each worker arms its own write-SLO burn gauges; the router's
+        # merged /metrics shows them per worker
+        ("--slo-ms", getattr(args, "slo_ms", None)),
     ):
         if val is not None:
             extra += [flag, str(val)]
     if getattr(args, "no_wal_fsync", False):
         extra.append("--no-wal-fsync")
+    if getattr(args, "no_profiler", False):
+        extra.append("--no-profiler")
     router, spawned = boot_ingest_fleet(
         args.workers, n_shards, coord_dir,
         config=IngestRouterConfig(
@@ -1484,6 +1504,11 @@ def build_parser() -> argparse.ArgumentParser:
     ev.add_argument("--no-respawn", action="store_true",
                     help="with --workers: do not respawn dead workers "
                     "(the chaos suite wants corpses to stay dead)")
+    ev.add_argument("--slo-ms", type=float, default=None, metavar="MS",
+                    help="event-write latency SLO: arms the multi-"
+                    "window pio_slo_burn_rate gauges over the event-"
+                    "write histogram (with --workers, each shard owner "
+                    "arms its own)")
 
     ad = sub.add_parser("adminserver", help="run the admin API server")
     _add_obs_args(ad)

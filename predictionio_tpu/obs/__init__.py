@@ -548,7 +548,7 @@ def phase_span(name: str, attrs: Optional[dict] = None) -> Iterator[dict]:
 # ``from . import ...`` and register their metric families at import,
 # so every process's first scrape carries the full schema.  None
 # imports jax at module level — obs stays jax-free.
-from . import fleet, runlog, timeline, tower, xray  # noqa: E402
+from . import fleet, runlog, scope, timeline, tower, xray  # noqa: E402
 from .flight import FlightRecorder, get_flight_recorder  # noqa: E402
 
 __all__ += [
@@ -556,6 +556,7 @@ __all__ += [
     "fleet",
     "get_flight_recorder",
     "runlog",
+    "scope",
     "set_cluster_renderer",
     "timeline",
     "tower",

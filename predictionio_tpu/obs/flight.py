@@ -90,13 +90,31 @@ class FlightRecorder:
 
             tracer = get_tracer()
         spans = [s.to_json() for s in tracer.spans(trace_id=trace_id)]
+        # pio-scope join: the dominant CPU stacks sampled during this
+        # request's wall window — "what was the process doing while
+        # this request was slow".  Offers arrive at request end, so
+        # the window is [now - duration, now]; the profiler widens it
+        # to covering 1 s ring buckets.  Admitted requests only — an
+        # O(ring) read has no place on the healthy p50 path.
+        now = time.time()
+        stacks = None
+        try:
+            from . import scope as _scope
+
+            if _scope.profiler_running():
+                stacks = _scope.get_profiler().dominant_stacks(
+                    now - duration_s, now
+                )
+        except Exception:
+            stacks = None  # a profiler hiccup must not drop the record
         record = {
             "traceId": trace_id,
             "name": name,
             "durationSec": duration_s,
-            "at": time.time(),
+            "at": now,
             "spanCount": len(spans),
             "spans": spans,
+            **({"dominantStacks": stacks} if stacks else {}),
             **({"attrs": attrs} if attrs else {}),
         }
         with self._lock:
@@ -150,6 +168,11 @@ class FlightRecorder:
         for _, _, r in sorted(snap, reverse=True):
             item = {k: r[k] for k in
                     ("traceId", "name", "durationSec", "at", "spanCount")}
+            if "dominantStacks" in r:
+                # the pio-scope join travels with the summary: a
+                # worst-N line names its hot stacks without a second
+                # round trip for the full record
+                item["dominantStacks"] = r["dominantStacks"]
             if "attrs" in r:
                 # capture-time context (pulse segment decomposition,
                 # pio-live modelFreshnessSec/foldinSeq): a worst-N line

@@ -72,6 +72,7 @@ class DashboardServer(HTTPServerBase):
             "<a href='/tenants.html'>tenants</a> &middot; "
             "<a href='/experiments.html'>experiments</a> &middot; "
             "<a href='/fleet.html'>fleet</a> &middot; "
+            "<a href='/prof.html'>flamegraph</a> &middot; "
             "<a href='/metrics'>prometheus exposition</a></p>"
             "</body></html>"
         )
@@ -643,6 +644,50 @@ class DashboardServer(HTTPServerBase):
             "</body></html>"
         )
 
+    def prof_html(self, target_url: str = "", seconds: float = 60.0,
+                  state: str = "", baseline_url: str = "") -> str:
+        """pio-scope flamegraph console: render any hive process's
+        rolling CPU profile as a zoomable flamegraph — no external
+        assets, no tooling on the operator's box.  ``?target=http://
+        host:port`` fetches that server's ``/debug/pprof`` (router,
+        replica, eventserver, ingest router — the mount is universal);
+        no target renders THIS dashboard process's own ring.
+        ``&baseline=URL`` overlays a second profile as share deltas
+        (the profcat A/B diff, served live)."""
+        from ..obs import scope
+
+        def fetch(url: str) -> str:
+            import urllib.request
+            qs = f"/debug/pprof?seconds={seconds:g}"
+            if state:
+                qs += f"&state={urllib.parse.quote(state)}"
+            with urllib.request.urlopen(
+                url.rstrip("/") + qs, timeout=5
+            ) as r:
+                return r.read().decode()
+
+        try:
+            if target_url:
+                folded = fetch(target_url)
+                title = f"pio-scope: {target_url} (last {seconds:g}s)"
+            else:
+                folded = scope.get_profiler().collapsed(
+                    seconds, state=state or None
+                )
+                title = f"pio-scope: dashboard process (last {seconds:g}s)"
+            baseline = fetch(baseline_url) if baseline_url else None
+        except Exception as e:
+            esc = _html.escape
+            return (
+                "<html><body><h1>Profile</h1><p>could not fetch "
+                f"profile: {esc(str(e))}</p><p>Usage: <code>"
+                "/prof.html?target=http://host:port&amp;seconds=60"
+                "&amp;state=running&amp;baseline=http://other:port"
+                "</code></p></body></html>"
+            )
+        return scope.flamegraph_html(folded, title=title,
+                                     baseline=baseline)
+
     def fleet_html(self, router_url: str = "") -> str:
         """pio-lens fleet console: the per-replica tail table (p50/p99
         off each replica's scraped latency histogram, breaker/respawn/
@@ -921,6 +966,25 @@ class DashboardServer(HTTPServerBase):
                         200,
                         server.fleet_html(
                             q.get("router", [""])[0]
+                        ).encode(),
+                        "text/html",
+                    )
+                    return
+                if path == "/prof.html":
+                    q = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    try:
+                        seconds = float(q.get("seconds", ["60"])[0])
+                    except ValueError:
+                        seconds = 60.0
+                    self._reply(
+                        200,
+                        server.prof_html(
+                            q.get("target", [""])[0],
+                            seconds=seconds,
+                            state=q.get("state", [""])[0],
+                            baseline_url=q.get("baseline", [""])[0],
                         ).encode(),
                         "text/html",
                     )

@@ -32,7 +32,9 @@ from typing import Any, Optional
 from ..obs import (
     EVENT_WRITE_LATENCY,
     INGEST_SHARD_UNAVAILABLE_TOTAL,
+    fleet,
     get_tracer,
+    scope,
     timeline,
     trace_scope,
 )
@@ -75,7 +77,8 @@ class EventServerConfig:
                  owned_shards: Optional[list[int]] = None,
                  ttl_s: Optional[float] = None,
                  compact_interval_s: Optional[float] = None,
-                 maintenance_interval_s: float = 30.0):
+                 maintenance_interval_s: float = 30.0,
+                 slo_ms: Optional[float] = None):
         self.host = host
         self.port = port
         self.stats = stats
@@ -103,6 +106,10 @@ class EventServerConfig:
         self.ttl_s = ttl_s
         self.compact_interval_s = compact_interval_s
         self.maintenance_interval_s = maintenance_interval_s
+        # ingest write-latency SLO (ms): arms pio_slo_burn_rate{window}
+        # on the event-write histogram, the same multi-window burn
+        # gauges the serving edge carries (pio-sentry)
+        self.slo_ms = slo_ms
 
 
 class AuthError(Exception):
@@ -152,6 +159,14 @@ class EventServer(HTTPServerBase):
                 name="events-maintenance", daemon=True,
             )
             self._maint_thread.start()
+        # pio-sentry on the write edge: --slo-ms arms the multi-window
+        # burn-rate gauges over the event-write latency histogram
+        self._burn = None
+        if self.config.slo_ms:
+            self._burn = fleet.install_burn_rate(
+                EVENT_WRITE_LATENCY.child(), self.config.slo_ms / 1e3,
+            )
+        scope.ensure_started()
 
     def _note_retry(self, kind: str):
         def on_retry(attempt: int, exc: BaseException) -> None:
@@ -181,6 +196,7 @@ class EventServer(HTTPServerBase):
         """Time-windowed retention: TTL purge each tick, compaction on
         its own (longer) cadence — both scoped to owned shards so a
         worker never takes a sibling's writer lock."""
+        scope.register_thread_role("events_maintenance")
         next_compact = time.monotonic() + (
             self.config.compact_interval_s or float("inf")
         )

@@ -186,6 +186,12 @@ class EventLoopHTTPServer:
 
     # -- BaseServer-compatible lifecycle -----------------------------------
     def serve_forever(self) -> None:
+        from ..obs import scope
+
+        # pio-scope: the loop thread is THE suspect at router
+        # saturation — its running-share on /debug/pprof is the
+        # single-core ceiling evidence
+        scope.register_thread_role("eventloop")
         self._loop_thread = threading.current_thread()
         self._sel.register(self._lsock, selectors.EVENT_READ, "accept")
         self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")

@@ -80,7 +80,7 @@ class CappedThreadingHTTPServer(ThreadingHTTPServer):
 
 
 OBS_PATHS = ("/metrics", "/debug/xray", "/debug/train", "/debug/profile",
-             "/debug/flight", "/debug/fleet")
+             "/debug/flight", "/debug/fleet", "/debug/pprof")
 
 
 def observability_response(path: str, query: str = ""):
@@ -125,6 +125,33 @@ def observability_response(path: str, query: str = ""):
             return 404, {"message": "no router in this process "
                          "(curl the router's /debug/fleet)"}, None
         return 200, payload, None
+    if path == "/debug/pprof":
+        # pio-scope: collapsed-stack text from the always-on sampler's
+        # rolling ring — answers instantly from history (safe on the
+        # event loop, unlike /debug/profile's capture-for-S-seconds)
+        from ..obs import scope
+
+        qs = urllib.parse.parse_qs(query)
+        try:
+            seconds = float(qs.get("seconds", ["60"])[0])
+        except ValueError:
+            return 400, {"message":
+                         f"bad seconds: {qs['seconds'][0]!r}"}, None
+        state = qs.get("state", [None])[0]
+        if state in ("", "all"):
+            state = None
+        if state not in (None, "running", "waiting"):
+            return 400, {"message": f"bad state: {state!r} "
+                         "(running|waiting|all)"}, None
+        prof = scope.get_profiler()
+        text = prof.collapsed(
+            seconds, state=state, role=qs.get("role", [None])[0] or None
+        )
+        head = (
+            f"# pio-scope folded stacks seconds={seconds:g} "
+            f"hz={prof.hz:g} running={int(scope.profiler_running())}\n"
+        )
+        return 200, (head + text).encode(), "text/plain; charset=utf-8"
     if path == "/debug/profile":
         from ..obs import timeline
 

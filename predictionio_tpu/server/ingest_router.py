@@ -54,8 +54,12 @@ from ..obs import (
     INGEST_FORWARD_SECONDS,
     INGEST_SHARD_UNAVAILABLE_TOTAL,
     INGEST_WORKER_UP,
+    TRACE_HEADER,
+    get_flight_recorder,
     get_registry,
     metrics_enabled,
+    new_trace_id,
+    scope,
 )
 from ..obs.registry import merge_states, render_state
 from ..storage.sharded_events import _shard_ix
@@ -182,6 +186,13 @@ class IngestRouterServer(HTTPServerBase):
         self.request_count = 0
         self.shard_unavailable = 0
         self._m_forward = INGEST_FORWARD_SECONDS.child()
+        # pio-scope: the ingest router is its own process with no
+        # serve.query traffic, so the process-global recorder IS the
+        # ingest worst-N view — and the shared /debug/flight mount
+        # serves it with no extra routing code.  Offers carry the
+        # owning worker + shard, so a slow ingest tail line names its
+        # shard owner outright.
+        self.flight = get_flight_recorder()
         self._health_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -208,7 +219,10 @@ class IngestRouterServer(HTTPServerBase):
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=self.config.workers,
                 thread_name_prefix="ingest-fwd",
+                initializer=scope.register_thread_role,
+                initargs=("ingest_worker",),
             )
+        scope.ensure_started()
         if self._health_thread is None:
             self._health_thread = threading.Thread(
                 target=self._health_loop, daemon=True,
@@ -245,6 +259,7 @@ class IngestRouterServer(HTTPServerBase):
             return False
 
     def _health_loop(self) -> None:
+        scope.register_thread_role("health_loop")
         while not self._stop_event.wait(self.config.health_interval_s):
             for w in self.workers:
                 try:
@@ -297,7 +312,8 @@ class IngestRouterServer(HTTPServerBase):
         INGEST_SHARD_UNAVAILABLE_TOTAL.labels(shard=str(six)).inc(n)
 
     def _forward(self, w: IngestWorker, method: str, path_qs: str,
-                 body: Optional[bytes]) -> tuple[int, bytes, str]:
+                 body: Optional[bytes],
+                 trace_id: Optional[str] = None) -> tuple[int, bytes, str]:
         """One worker round trip; transport failure marks the worker
         down and re-raises (the caller answers ShardUnavailable — a
         write's owner is the ONLY process holding its shards, so there
@@ -306,6 +322,7 @@ class IngestRouterServer(HTTPServerBase):
         try:
             out = w.request(
                 method, path_qs, body,
+                headers={TRACE_HEADER: trace_id} if trace_id else None,
                 timeout_s=self.config.forward_timeout_s,
             )
         except Exception as e:
@@ -319,7 +336,26 @@ class IngestRouterServer(HTTPServerBase):
         return out
 
     # -- write path (pool side) -------------------------------------------
-    def _post_event(self, path_qs: str, body: bytes, respond) -> None:
+    def _offer_flight(self, trace_id: Optional[str], t0: float,
+                      **attrs) -> None:
+        """pio-scope: offer one finished ingest request to the worst-N
+        recorder, attributed to its shard owner.  The common (fast)
+        case is one lock + one float compare inside the recorder; an
+        admitted slow request gets its wall window joined against the
+        profiler ring (``dominantStacks``) so the flight record says
+        what the router was doing while the request crawled."""
+        try:
+            self.flight.offer(
+                trace_id, time.perf_counter() - t0,
+                name="ingest.request",
+                attrs={k: v for k, v in attrs.items() if v is not None},
+            )
+        except Exception:
+            logger.exception("ingest flight offer failed")
+
+    def _post_event(self, path_qs: str, body: bytes, respond,
+                    trace_id: Optional[str] = None) -> None:
+        t0 = time.perf_counter()
         try:
             payload = json.loads(body.decode())
             et = str(payload["entityType"])
@@ -337,10 +373,12 @@ class IngestRouterServer(HTTPServerBase):
                 respond, 503, self._unavailable_payload(w, six),
                 extra_headers=self._retry_hdr(),
             )
+            self._offer_flight(trace_id, t0, worker=w.name, shard=six,
+                               status=503, outcome="shard_unavailable")
             return
         try:
             status, data, ctype = self._forward(
-                w, "POST", path_qs, body
+                w, "POST", path_qs, body, trace_id=trace_id
             )
         except Exception:
             self._book_unavailable(six)
@@ -348,10 +386,19 @@ class IngestRouterServer(HTTPServerBase):
                 respond, 503, self._unavailable_payload(w, six),
                 extra_headers=self._retry_hdr(),
             )
+            self._offer_flight(trace_id, t0, worker=w.name, shard=six,
+                               status=503, outcome="forward_error")
             return
-        self._respond_quiet(respond, status, data, ctype=ctype)
+        self._respond_quiet(
+            respond, status, data, ctype=ctype,
+            extra_headers=[(TRACE_HEADER, trace_id)] if trace_id else (),
+        )
+        self._offer_flight(trace_id, t0, worker=w.name, shard=six,
+                           status=status, events=1)
 
-    def _post_batch(self, path_qs: str, body: bytes, respond) -> None:
+    def _post_batch(self, path_qs: str, body: bytes, respond,
+                    trace_id: Optional[str] = None) -> None:
+        t0 = time.perf_counter()
         try:
             items = json.loads(body.decode())
             if not isinstance(items, list):
@@ -394,7 +441,7 @@ class IngestRouterServer(HTTPServerBase):
                 try:
                     status, data, _ = self._forward(
                         w, "POST", f"/batch/events.json{suffix}",
-                        json.dumps(sub).encode(),
+                        json.dumps(sub).encode(), trace_id=trace_id,
                     )
                     if status == 200:
                         outcome = json.loads(data.decode())
@@ -428,10 +475,17 @@ class IngestRouterServer(HTTPServerBase):
             for p, r in zip(positions, outcome):
                 results[p] = r
         hdrs = self._retry_hdr() if any_down else []
+        if trace_id:
+            hdrs = hdrs + [(TRACE_HEADER, trace_id)]
         self._respond_quiet(respond, 200, results, extra_headers=hdrs)
+        self._offer_flight(
+            trace_id, t0, events=len(items),
+            workers=sorted(by_index[i].name for i in groups),
+            status=200, anyDown=any_down or None,
+        )
 
     def _post_webhook(self, path_qs: str, path: str, body: bytes,
-                      respond) -> None:
+                      respond, trace_id: Optional[str] = None) -> None:
         """Webhook ingestion under sharding: the CONNECTOR decides the
         entity, so the router must run it to learn the owner.  Convert
         here, then forward the derived event as a plain POST — the
@@ -463,7 +517,7 @@ class IngestRouterServer(HTTPServerBase):
         self._post_event(
             f"/events.json{suffix}",
             json.dumps(event.to_json()).encode(),
-            respond,
+            respond, trace_id=trace_id,
         )
 
     # -- read path (pool side) --------------------------------------------
@@ -598,6 +652,9 @@ class IngestRouterServer(HTTPServerBase):
             "shardUnavailable": self.shard_unavailable,
             "startTime": self.start_time,
         }
+        fs = self.flight.summary()
+        out["flight"] = {k: fs[k]
+                         for k in ("capacity", "offers", "admissions")}
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.summary()
         return out
@@ -640,17 +697,23 @@ class IngestRouterServer(HTTPServerBase):
         path = u.path
         if req.method == "POST":
             self.request_count += 1  # loop-thread only: no lock needed
+            # pio-lens discipline on the write edge too: mint a trace
+            # id when the client didn't bring one, so every routed
+            # write is flight-recordable and stitchable across the
+            # router's and the shard owner's journals
+            tid = (req.header(TRACE_HEADER) or "").strip() \
+                or new_trace_id()
             if path == "/events.json":
                 self._submit(respond, self._post_event,
-                             req.path, req.body, respond)
+                             req.path, req.body, respond, tid)
                 return
             if path == "/batch/events.json":
                 self._submit(respond, self._post_batch,
-                             req.path, req.body, respond)
+                             req.path, req.body, respond, tid)
                 return
             if path.startswith("/webhooks/"):
                 self._submit(respond, self._post_webhook,
-                             req.path, path, req.body, respond)
+                             req.path, path, req.body, respond, tid)
                 return
             if path == "/stop":
                 respond(200, {"message": "stopping"})

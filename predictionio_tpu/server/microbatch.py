@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Any, Callable, Optional, Sequence
 
+from ..obs.scope import TimedCondition, register_thread_role
 from ..obs.timeline import (
     MICROBATCH_ADMISSION_TOTAL,
     MICROBATCH_BATCH_SIZE,
@@ -228,7 +229,10 @@ class MicroBatcher:
         # batch_fn is a pure per-item map (duplicated trailing items
         # must be harmless), which predicts are.
         self.pad_batches = pad_batches
-        self._cond = threading.Condition()
+        # pio-scope: THE serving hot lock — every submit, claim, and
+        # completion passes through this monitor, so its wait
+        # histogram is the direct queueing-for-the-batcher evidence
+        self._cond = TimedCondition("microbatch")
         self._pending: list[_Entry] = []
         self._running = False
         self._closed = False
@@ -411,6 +415,7 @@ class MicroBatcher:
         """Standing leader for the continuous path: claims pending
         entries whenever the device is free.  Blocking submitters
         coalesce into its batches as followers."""
+        register_thread_role("microbatch_dispatcher")
         with self._cond:
             try:
                 while True:

@@ -60,6 +60,7 @@ from ..obs import (
     get_tracer,
     metrics_enabled,
     new_trace_id,
+    scope,
     timeline,
 )
 from ..resilience.policy import CircuitBreaker
@@ -505,8 +506,13 @@ class RouterServer(HTTPServerBase):
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=self.config.workers,
                 thread_name_prefix="router-fwd",
+                initializer=scope.register_thread_role,
+                initargs=("router_fwd",),
             )
         self._start_daemons()
+        # pio-scope: the router is THE single-event-loop suspect at
+        # fleet saturation (ROADMAP item 1) — always profile it
+        scope.ensure_started()
         return EventLoopHTTPServer(
             (self.host, self.port), self._el_handle,
             max_connections=self.config.max_connections,
@@ -568,6 +574,7 @@ class RouterServer(HTTPServerBase):
             r.scrape(self.config.health_timeout_s)
 
     def _health_loop(self) -> None:
+        scope.register_thread_role("health_loop")
         while not self._stop_event.wait(self.config.health_interval_s):
             try:
                 self.check_all()
@@ -625,6 +632,7 @@ class RouterServer(HTTPServerBase):
         return {"pushed": results}
 
     def _push_loop(self) -> None:
+        scope.register_thread_role("push_loop")
         while not self._stop_event.wait(self.config.push_foldin_s):
             try:
                 self.push_foldin()

@@ -55,6 +55,7 @@ from ..obs import (
     get_flight_recorder,
     get_tracer,
     new_trace_id,
+    scope,
     timeline,
     trace_scope,
     xray,
@@ -510,6 +511,9 @@ class EngineServer(HTTPServerBase):
         # memory gauges fresh (registered like the breaker gauges above)
         xray.install()
         xray.start_sampler()
+        # pio-scope: the always-on CPU sampler rides every serving
+        # process (no-op when --no-profiler / PIO_TPU_SCOPE=0 opted out)
+        scope.ensure_started()
 
     # -- lifecycle --------------------------------------------------------
     def _load(self, instance_id: str) -> None:
@@ -665,6 +669,7 @@ class EngineServer(HTTPServerBase):
         )
 
     def _online_eval_loop(self) -> None:
+        scope.register_thread_role("hive_eval")
         interval = max(float(self.tenants.eval_interval_s), 0.5)
         while not self._eval_stop.wait(interval):
             try:
@@ -856,6 +861,7 @@ class EngineServer(HTTPServerBase):
         """Delta-poll daemon thread (``--foldin-poll``): breaker-guarded
         and deadline-scoped so a sick storage volume degrades to a
         paused poll + stale model, never a wedged serving thread."""
+        scope.register_thread_role("foldin_runner")
         interval = float(self.config.foldin_poll_s)
         while not self._foldin_stop.wait(interval):
             if not self._foldin_breaker.allow():
@@ -1167,7 +1173,9 @@ class EngineServer(HTTPServerBase):
             # blocking routes only (status/reload/profile/fold-in and
             # unbatched predicts) — the query hot path never lands here
             self._aux_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="serve-aux"
+                max_workers=8, thread_name_prefix="serve-aux",
+                initializer=scope.register_thread_role,
+                initargs=("serve_aux",),
             )
         return EventLoopHTTPServer(
             (self.host, self.port), self._el_handle,

@@ -118,8 +118,11 @@ class ShardedSQLiteEventStore(EventStore):
                     "mis-route every entity — refusing"
                 )
         self.n_shards = n_shards
+        # pio-scope: name each shard's writer lock so one hot shard's
+        # contention is attributable on pio_lock_wait_seconds{lock=}
         self.shards = [
-            SQLiteEventStore(self._dir / f"shard-{i}.db")
+            SQLiteEventStore(self._dir / f"shard-{i}.db",
+                             lock_name=f"store_shard_{i}")
             for i in range(n_shards)
         ]
         # pio-lens satellite: per-shard instrumentation, children

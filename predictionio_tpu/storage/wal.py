@@ -58,6 +58,7 @@ from ..obs import (
     WAL_COMMIT_ROWS,
     WAL_FSYNC_SECONDS,
     WAL_REPLAYED_TOTAL,
+    scope,
 )
 from ..resilience import faults
 from .levents import ShardUnavailableError
@@ -292,9 +293,13 @@ class GroupCommitWAL:
             six: EventWAL(self.wal_dir / f"shard-{six}.wal", six)
             for six in sorted(self.owned)
         }
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        self._flush_lock = threading.Lock()
+        # pio-scope: the two ingest hot locks.  "wal_commit" is the
+        # bookkeeping monitor every submit and the committer share;
+        # "wal_flush" serializes group leaders — its wait histogram IS
+        # the follower-waiting-on-a-leader's-fsync distribution.
+        self._lock = scope.TimedLock("wal_commit")
+        self._cv = scope.TimedCondition("wal_commit", lock=self._lock)
+        self._flush_lock = scope.TimedLock("wal_flush")
         # (shard, payload bytes, (app, ch, row)) triples awaiting the
         # next leader's flush; commit queue holds flushed rows awaiting
         # the sqlite drain — both strictly FIFO so per-shard rowid
@@ -435,6 +440,7 @@ class GroupCommitWAL:
 
     # -- background sqlite drain -----------------------------------------
     def _commit_loop(self) -> None:
+        scope.register_thread_role("wal_committer")
         while True:
             with self._lock:
                 while (not self._commit_q and not self._closing):

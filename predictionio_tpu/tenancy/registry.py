@@ -54,6 +54,7 @@ from ..obs import (
     TENANT_RESIDENT_BYTES,
     TENANTS_RESIDENT,
     get_tracer,
+    scope,
 )
 from ..resilience.policy import CircuitBreaker
 from .errors import QuotaExceeded, TenantUnavailable, UnknownTenant
@@ -324,7 +325,9 @@ class TenantRegistry:
         specs = list(specs)
         if not specs:
             raise ValueError("tenant registry needs >= 1 tenant spec")
-        self._lock = threading.RLock()
+        # pio-scope: every tenant lookup/load/evict serializes here —
+        # multi-tenant p99 stalls show up as this lock's wait histogram
+        self._lock = scope.TimedLock("tenant_registry", reentrant=True)
         self._specs: dict[tuple[str, str], TenantSpec] = {}
         for s in specs:
             if s.key in self._specs:

@@ -1,0 +1,372 @@
+"""pio-scope (`predictionio_tpu/obs/scope.py`) — the always-on
+sampling profiler + lock-contention lens:
+
+* deterministic ring aggregation: synthetic ``record_samples`` with
+  pinned clocks land EXACTLY in their epoch-second bucket, and
+  ``collapsed``'s trailing window reads exactly N buckets;
+* role registration: threads register at spawn, unregistered threads
+  fold under main/other, dead idents prune, not-yet-started threads
+  are rejected;
+* TimedLock/TimedCondition: seeded contention books wait + hold with
+  the documented semantics (uncontended sampling, reentrant holds
+  timed outermost-only, Condition wait reacquisition always booked);
+* the overhead gauge and the ``/debug/pprof`` mount round-trip
+  (collapsed text -> parse_folded -> same counts).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import pytest
+
+from predictionio_tpu.obs import get_registry, scope
+from predictionio_tpu.obs.scope import (
+    ScopeProfiler,
+    TimedCondition,
+    TimedLock,
+    flamegraph_html,
+    merge_folded,
+    parse_folded,
+    register_thread_role,
+    render_folded,
+)
+
+
+def _wait_snap(name: str) -> dict:
+    return scope.LOCK_WAIT_SECONDS.labels(lock=name).snapshot()
+
+
+def _hold_snap(name: str) -> dict:
+    return scope.LOCK_HOLD_SECONDS.labels(lock=name).snapshot()
+
+
+# -- deterministic ring ------------------------------------------------------
+
+
+def test_ring_bucket_exactness():
+    """Samples recorded with pinned clocks aggregate exactly: the
+    1-second window returns only its bucket, wider windows sum."""
+    p = ScopeProfiler(window_s=120)
+    p.record_samples(
+        [("eventloop", "running", "a.py:f;a.py:g")] * 3, now=1000.2
+    )
+    p.record_samples(
+        [("eventloop", "running", "a.py:f;a.py:g")] * 2
+        + [("wal_committer", "waiting", "w.py:loop")],
+        now=1001.7,
+    )
+    one = parse_folded(p.collapsed(1, now=1001.0))
+    assert one == {
+        "eventloop;a.py:f;a.py:g": 2,
+        "wal_committer;w.py:loop": 1,
+    }
+    both = parse_folded(p.collapsed(2, now=1001.0))
+    assert both["eventloop;a.py:f;a.py:g"] == 5
+    # state / role filters
+    running = parse_folded(p.collapsed(2, state="running", now=1001.0))
+    assert "wal_committer;w.py:loop" not in running
+    only_wal = parse_folded(p.collapsed(2, role="wal_committer",
+                                        now=1001.0))
+    assert list(only_wal) == ["wal_committer;w.py:loop"]
+
+
+def test_ring_window_eviction():
+    """Buckets older than window_s fall off when new seconds open."""
+    p = ScopeProfiler(window_s=10)
+    p.record_samples([("main", "running", "x.py:a")], now=1000.0)
+    p.record_samples([("main", "running", "x.py:b")], now=1011.0)
+    assert p.stats()["buckets"] == 1
+    assert "main;x.py:a" not in parse_folded(p.collapsed(60, now=1011.0))
+
+
+def test_ring_key_truncation():
+    """A bucket past max_keys collapses new stacks into (truncated)
+    instead of growing without bound."""
+    p = ScopeProfiler(max_keys_per_bucket=2)
+    for i in range(4):
+        p.record_samples([("main", "running", f"x.py:f{i}")], now=500.0)
+    agg = parse_folded(p.collapsed(1, now=500.0))
+    assert agg["main;(truncated)"] == 2
+    assert len(agg) == 3
+
+
+def test_role_totals_and_dominant_stacks():
+    p = ScopeProfiler()
+    p.record_samples(
+        [("eventloop", "running", "a.py:f")] * 4
+        + [("eventloop", "waiting", "sel.py:select")] * 6
+        + [("microbatch_dispatcher", "running", "mb.py:claim")] * 2,
+        now=2000.0,
+    )
+    totals = p.role_totals(5, now=2002.0)
+    assert totals["eventloop"] == {"running": 4, "waiting": 6}
+    assert totals["microbatch_dispatcher"] == {"running": 2}
+    top = p.dominant_stacks(1999.0, 2001.0, top=1)
+    assert top[0]["stack"] == "eventloop;a.py:f"
+    assert top[0]["count"] == 4
+    # share is over running-state samples, rounded to 4 places
+    assert top[0]["share"] == pytest.approx(4 / 6, abs=1e-4)
+
+
+def test_folded_merge_round_trip():
+    a = parse_folded("r;x.py:f 3\nr;y.py:g 1\n")
+    b = parse_folded("# comment line skipped\nr;x.py:f 2\n")
+    merged = merge_folded([a, b])
+    assert merged == {"r;x.py:f": 5, "r;y.py:g": 1}
+    assert parse_folded(render_folded(merged)) == merged
+
+
+# -- live sampling + roles ---------------------------------------------------
+
+
+def test_sampler_folds_registered_role():
+    """A real thread that registers a role shows under it with the
+    role as the root frame; the sampler excludes itself."""
+    p = ScopeProfiler()
+    ready = threading.Event()
+    done = threading.Event()
+
+    def busy():
+        register_thread_role("test_busy_role")
+        ready.set()
+        done.wait(5.0)
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    assert ready.wait(5.0)
+    try:
+        now = time.time()
+        assert p.sample_once(now=now) >= 1
+        agg = parse_folded(p.collapsed(2, now=now))
+        mine = [s for s in agg if s.startswith("test_busy_role;")]
+        assert mine, f"role missing from {sorted(agg)[:5]}"
+        # parked on done.wait -> leaf is threading.py -> waiting state
+        waiting = parse_folded(p.collapsed(2, state="waiting", now=now))
+        assert any(s.startswith("test_busy_role;") for s in waiting)
+    finally:
+        done.set()
+        t.join(5.0)
+
+
+def test_register_requires_started_thread():
+    t = threading.Thread(target=lambda: None)
+    with pytest.raises(ValueError):
+        register_thread_role("nope", thread=t)
+
+
+def test_role_pruning_forgets_dead_idents():
+    def short():
+        register_thread_role("test_shortlived")
+
+    t = threading.Thread(target=short)
+    t.start()
+    t.join(5.0)
+    assert "test_shortlived" in scope.thread_roles().values()
+    scope._prune_roles(sys._current_frames().keys())
+    assert "test_shortlived" not in scope.thread_roles().values()
+
+
+def test_overhead_gauge_and_stats():
+    p = ScopeProfiler(hz=200)
+    assert p.overhead_ratio() == 0.0  # not started -> no claim
+    p.start()
+    try:
+        time.sleep(0.1)
+        assert p.stats()["running"]
+        assert p.stats()["samples"] >= 1
+        # self-measured: strictly positive once sampling, far below 1
+        assert 0.0 < p.overhead_ratio() < 0.5
+    finally:
+        p.stop()
+    assert not p.stats()["running"]
+    text = get_registry().render_prometheus()
+    assert "pio_profile_overhead_ratio" in text
+    assert "pio_cpu_thread_samples_total" in text
+
+
+def test_ensure_started_respects_env_and_flag(monkeypatch):
+    # an earlier test in the suite may have left the process-global
+    # sampler running (any EngineServer boot calls ensure_started);
+    # the opt-out contract is about NOT starting it, so start clean
+    scope.get_profiler().stop()
+    monkeypatch.setenv("PIO_TPU_SCOPE", "0")
+    assert scope.ensure_started() is False
+    assert not scope.profiler_running()
+    monkeypatch.delenv("PIO_TPU_SCOPE")
+    try:
+        scope.set_enabled(False)
+        assert scope.ensure_started() is False
+    finally:
+        scope.set_enabled(True)
+
+
+# -- pprof mount -------------------------------------------------------------
+
+
+def test_debug_pprof_round_trip():
+    """The shared /debug/pprof mount answers collapsed text from the
+    process profiler's ring; parse_folded skips its # header."""
+    from predictionio_tpu.server.http_base import observability_response
+
+    now = time.time()
+    scope.get_profiler().record_samples(
+        [("test_pprof_role", "running", "p.py:hot")] * 7, now=now
+    )
+    code, payload, ctype = observability_response(
+        "/debug/pprof", "seconds=30"
+    )
+    assert code == 200
+    assert ctype.startswith("text/plain")
+    text = payload.decode()
+    assert text.startswith("# pio-scope folded stacks")
+    assert parse_folded(text)["test_pprof_role;p.py:hot"] == 7
+    # state filter + validation
+    code, payload, _ = observability_response(
+        "/debug/pprof", "seconds=30&state=waiting"
+    )
+    assert code == 200
+    assert "test_pprof_role;p.py:hot" not in parse_folded(
+        payload.decode()
+    )
+    code, _, _ = observability_response("/debug/pprof", "state=bogus")
+    assert code == 400
+    code, _, _ = observability_response("/debug/pprof", "seconds=abc")
+    assert code == 400
+
+
+def test_flamegraph_renders_folded_and_baseline():
+    html = flamegraph_html("r;a.py:f 5\nr;b.py:g 3\n",
+                           title="<t>", baseline="r;a.py:f 8\n")
+    assert "&lt;t>" in html
+    assert "r;a.py:f 5" in html  # embedded via json.dumps
+    assert '"r;a.py:f 8\\n"' in html
+    assert "<script>" in html and "http" not in html.split("body")[0]
+
+
+# -- lock lens ---------------------------------------------------------------
+
+
+def test_timedlock_contended_wait_and_hold():
+    lk = TimedLock("t_contended")
+    lk.sample_every = 1  # book every hold: deterministic counts
+    w0, h0 = _wait_snap("t_contended"), _hold_snap("t_contended")
+    entered = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(5.0)
+    with lk:  # contends with the 50ms hold
+        pass
+    t.join(5.0)
+    w1, h1 = _wait_snap("t_contended"), _hold_snap("t_contended")
+    assert w1["count"] - w0["count"] == 1
+    assert w1["sum"] - w0["sum"] >= 0.03
+    assert h1["count"] - h0["count"] == 2  # both holds booked
+    assert h1["sum"] - h0["sum"] >= 0.03
+
+
+def test_timedlock_uncontended_sampling_and_misuse():
+    lk = TimedLock("t_sampled")
+    lk.sample_every = 4
+    w0, h0 = _wait_snap("t_sampled"), _hold_snap("t_sampled")
+    for _ in range(8):
+        with lk:
+            pass
+    w1, h1 = _wait_snap("t_sampled"), _hold_snap("t_sampled")
+    assert w1["count"] == w0["count"]  # never contended, no waits
+    assert h1["count"] - h0["count"] == 2  # 1-in-4 of 8 holds
+    with pytest.raises(RuntimeError):
+        lk.release()
+    assert lk.acquire(blocking=False)
+    lk.release()
+
+
+def test_timedlock_reentrant_outermost_only():
+    lk = TimedLock("t_reent", reentrant=True)
+    lk.sample_every = 1
+    h0 = _hold_snap("t_reent")
+    with lk:
+        with lk:
+            pass
+        assert lk._is_owned()
+    h1 = _hold_snap("t_reent")
+    assert h1["count"] - h0["count"] == 1  # nested with != second hold
+
+
+def test_timedcondition_wait_notify_books_reacquisition():
+    cv = TimedCondition("t_cv")
+    w0 = _wait_snap("t_cv")
+    box = []
+
+    def consumer():
+        with cv:
+            while not box:
+                cv.wait(5.0)
+            box.append("seen")
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        box.append("item")
+        cv.notify()
+    t.join(5.0)
+    assert box == ["item", "seen"]
+    # the consumer's post-notify monitor reacquisition always books
+    assert _wait_snap("t_cv")["count"] > w0["count"]
+
+
+def test_timedcondition_shares_a_plain_timedlock():
+    """The WAL pattern: one TimedLock guards state, the cv shares it —
+    wait() releases and reacquires the SAME lock."""
+    lk = TimedLock("t_shared")
+    cv = TimedCondition("t_shared", lock=lk)
+    fired = threading.Event()
+
+    def waiter():
+        with lk:
+            cv.wait(5.0)
+            fired.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with lk:
+        cv.notify()
+    t.join(5.0)
+    assert fired.is_set()
+    assert not lk._is_owned()
+
+
+def test_flight_offer_joins_dominant_stacks():
+    """An admitted flight record carries the profiler's dominant
+    stacks for its wall window when the sampler runs."""
+    from predictionio_tpu.obs.flight import FlightRecorder
+
+    prof = scope.get_profiler()
+    prof.start()
+    try:
+        now = time.time()
+        prof.record_samples(
+            [("test_flight_role", "running", "fl.py:spin")] * 500,
+            now=now,
+        )
+        fr = FlightRecorder(capacity=4)
+        assert fr.offer("t-scope-1", 2.0, name="x")
+        rec = fr.record_for("t-scope-1")
+        stacks = rec.get("dominantStacks")
+        assert stacks, "no dominantStacks joined"
+        assert any(s["stack"] == "test_flight_role;fl.py:spin"
+                   for s in stacks)
+        assert any("dominantStacks" in w
+                   for w in fr.summary()["worst"])
+    finally:
+        prof.stop()

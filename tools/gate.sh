@@ -27,7 +27,7 @@ fi
 # suite VISIBLE in CI logs (the probe verdict is disk-cached per
 # interpreter+jaxlib, so this line costs milliseconds after the first
 # run; tools/multihost_harness.py is the same arbiter the tests ride)
-echo "gate [0/16] multihost collectives verdict" >&2
+echo "gate [0/17] multihost collectives verdict" >&2
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python tools/multihost_harness.py --probe >&2 \
   || echo "  (verdict unavailable — probe errored; multihost tests will skip)" >&2
@@ -35,7 +35,7 @@ JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
 # 1) piolint: JAX/lock/deadlock/contract static analysis
 #    (PIO1xx/PIO2xx incl. PIO210-213 deadlock, PIO3xx, PIO4xx contract)
 REPORT="${PIOLINT_REPORT:-/tmp/piolint_report.json}"
-echo "gate [1/16] piolint (report: $REPORT)" >&2
+echo "gate [1/17] piolint (report: $REPORT)" >&2
 if ! python -m predictionio_tpu.analysis --format text \
        --report "$REPORT" "${PIOLINT_ARGS[@]+"${PIOLINT_ARGS[@]}"}"; then
   echo "gate FAILED: piolint found non-baseline findings" >&2
@@ -47,7 +47,7 @@ fi
 
 # 2) generic lint (ruff: pyflakes + isort per pyproject.toml) — the CI
 # image doesn't ship ruff, so absence is a skip, not a failure
-echo "gate [2/16] ruff" >&2
+echo "gate [2/17] ruff" >&2
 if command -v ruff >/dev/null 2>&1; then
   ruff check . || { echo "gate FAILED: ruff" >&2; exit 1; }
 elif python -m ruff --version >/dev/null 2>&1; then
@@ -62,7 +62,7 @@ fi
 # the measure_tpu.sh battery) plus the fused-kernel interpret parity
 # suite — cheap-first so a kernel math break fails in ~1 min, not after
 # the full suite
-echo "gate [3/16] gather probe smoke + fused interpret parity" >&2
+echo "gate [3/17] gather probe smoke + fused interpret parity" >&2
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/probe_gather.py --smoke > /tmp/probe_gather_smoke.json; then
   echo "gate FAILED: gather-form smoke (see /tmp/probe_gather_smoke.json)" >&2
@@ -79,7 +79,7 @@ fi
 # really is exact math restricted to the shortlist), stage metrics
 # booked, and one fold-in delta patching the quantized index IN PLACE
 # (no rebuild) with the appended + patched rows served immediately
-echo "gate [4/16] ann smoke" >&2
+echo "gate [4/17] ann smoke" >&2
 ANN_OUT="${ANN_SMOKE_OUT:-/tmp/ann_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/ann_smoke.py --out "$ANN_OUT"; then
@@ -92,7 +92,7 @@ fi
 # compiler-observability contract (pio_jit_compiles_total increments,
 # /debug/xray's recompile ring parses and carries the signature delta,
 # exemplar trace ids resolve to flight-recorder span trees)
-echo "gate [5/16] xray smoke" >&2
+echo "gate [5/17] xray smoke" >&2
 XRAY_OUT="${XRAY_SMOKE_OUT:-/tmp/xray_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" PIO_TPU_TRACE_ALS=1 \
      python tools/xray_smoke.py --out "$XRAY_OUT"; then
@@ -106,7 +106,7 @@ fi
 # /metrics with equal counts, segment sums reconcile with the e2e
 # latency histogram, saturation metrics move, /debug/profile produces a
 # non-empty jax.profiler artifact, flight records carry segmentsMs)
-echo "gate [6/16] pulse smoke" >&2
+echo "gate [6/17] pulse smoke" >&2
 PULSE_OUT="${PULSE_SMOKE_OUT:-/tmp/pulse_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/pulse_smoke.py --out "$PULSE_OUT"; then
@@ -118,7 +118,7 @@ fi
 # for an unseen user, one fold-in cycle, non-fallback predictions with
 # ZERO /reload calls and a stable fold-in kernel signature — the
 # event->fresh-prediction contract end to end
-echo "gate [7/16] foldin smoke" >&2
+echo "gate [7/17] foldin smoke" >&2
 FOLDIN_OUT="${FOLDIN_SMOKE_OUT:-/tmp/foldin_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/foldin_smoke.py --out "$FOLDIN_OUT"; then
@@ -131,7 +131,7 @@ fi
 # rolling across the fleet (both replicas answer fresh predictions
 # with ZERO reloads), and a SIGKILLed replica masked from clients
 # with zero failed requests
-echo "gate [8/16] surge smoke" >&2
+echo "gate [8/17] surge smoke" >&2
 SURGE_OUT="${SURGE_SMOKE_OUT:-/tmp/surge_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/surge_smoke.py --out "$SURGE_OUT"; then
@@ -145,7 +145,7 @@ fi
 # isolation, budget-driven eviction with zero failed in-flight
 # requests + lazy reload, and per-variant feedback attribution grepped
 # back out of the event store into /metrics + a pio-tower manifest
-echo "gate [9/16] hive smoke" >&2
+echo "gate [9/17] hive smoke" >&2
 HIVE_OUT="${HIVE_SMOKE_OUT:-/tmp/hive_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/hive_smoke.py --out "$HIVE_OUT"; then
@@ -159,7 +159,7 @@ fi
 # POST /tenants/weights calls, loser floored at minWeight, every
 # decision in a pio-tower manifest), and a fault-plan-broken variant
 # with the BEST conversion rate is guardrail-vetoed back down
-echo "gate [10/16] pilot smoke" >&2
+echo "gate [10/17] pilot smoke" >&2
 PILOT_OUT="${PILOT_SMOKE_OUT:-/tmp/pilot_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/pilot_smoke.py --out "$PILOT_OUT"; then
@@ -172,7 +172,7 @@ fi
 # wall time within 2%, a typed watchdog abort on an injected NaN
 # sweep (train.nan fault point), the cluster registry merge on a
 # chief's /metrics, and the runlog CLI over the produced manifests
-echo "gate [11/16] train obs smoke" >&2
+echo "gate [11/17] train obs smoke" >&2
 TOWER_OUT="${TRAIN_OBS_SMOKE_OUT:-/tmp/train_obs_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/train_obs_smoke.py --out "$TOWER_OUT"; then
@@ -186,7 +186,7 @@ fi
 # queries, and move the engine-labeled query counter — the one-file-
 # engine contract end to end (piolint's PIO301 separately guards that
 # engine files never import server internals)
-echo "gate [12/16] forge smoke" >&2
+echo "gate [12/17] forge smoke" >&2
 FORGE_OUT="${FORGE_SMOKE_OUT:-/tmp/forge_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/forge_smoke.py --out "$FORGE_OUT"; then
@@ -200,7 +200,7 @@ fi
 # by the router flight recorder while the merged counters stay
 # monotone through the stall, and tools/tracecat.py stitches one trace
 # id across the router's and a replica's span journals into ONE tree
-echo "gate [13/16] fleet smoke" >&2
+echo "gate [13/17] fleet smoke" >&2
 FLEET_OUT="${FLEET_SMOKE_OUT:-/tmp/fleet_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/fleet_smoke.py --out "$FLEET_OUT"; then
@@ -215,7 +215,7 @@ fi
 # /stats.json stays monotone through the death, and after a restart on
 # the same WAL dir every acknowledged event is readable: zero acked
 # loss
-echo "gate [14/16] ingest smoke" >&2
+echo "gate [14/17] ingest smoke" >&2
 INGEST_OUT="${INGEST_SMOKE_OUT:-/tmp/ingest_smoke.json}"
 if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
      python tools/ingest_smoke.py --out "$INGEST_OUT"; then
@@ -223,22 +223,40 @@ if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   exit 1
 fi
 
-# 15) bench trajectory gate: the newest fenced BENCH_HISTORY.jsonl
+# 15) pio-scope smoke: boots a REAL trained engine server (microbatch
+# on, eventloop edge), floods it, and asserts the always-on profiler
+# contract: /debug/pprof attributes samples to registered thread roles
+# (eventloop + microbatch dispatcher at minimum), the contention lens
+# books nonzero pio_lock_wait_seconds{lock="microbatch"} under the
+# flood, the folded text renders to the self-contained flamegraph
+# page, the worst-N flight records join dominantStacks from the ring,
+# and an interleaved profiler on/off A/B keeps the on-arm p50 within
+# the 5% budget (0.5 ms noise floor) with the self-measured overhead
+# ratio under 5%
+echo "gate [15/17] scope smoke" >&2
+SCOPE_OUT="${SCOPE_SMOKE_OUT:-/tmp/scope_smoke.json}"
+if ! JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+     python tools/scope_smoke.py --out "$SCOPE_OUT"; then
+  echo "gate FAILED: scope smoke (see $SCOPE_OUT)" >&2
+  exit 1
+fi
+
+# 16) bench trajectory gate: the newest fenced BENCH_HISTORY.jsonl
 # record must sit within the noise-aware threshold of its rolling
 # median baseline; --allow-empty keeps the gate green until the
 # trajectory is >= min-samples deep (it still fails on a judged
 # regression)
-echo "gate [15/16] bench trajectory (tools/bench_gate.py)" >&2
+echo "gate [16/17] bench trajectory (tools/bench_gate.py)" >&2
 if ! python tools/bench_gate.py --check --allow-empty; then
   echo "gate FAILED: bench trajectory regressed beyond noise" >&2
   echo "  inspect: python tools/bench_gate.py --check" >&2
   exit 1
 fi
 
-# 16) the full test suite — includes the end-to-end smokes that boot
+# 17) the full test suite — includes the end-to-end smokes that boot
 # real servers: tools/chaos_smoke.py (via tests/test_chaos_smoke.py),
 # tools/obs_smoke.py (/metrics exposition + trace propagation),
 # tools/xray_smoke.py, tools/foldin_smoke.py and
 # tools/train_obs_smoke.py again under pytest env isolation
-echo "gate [16/16] pytest" >&2
+echo "gate [17/17] pytest" >&2
 exec python -m pytest tests/ -q "$@"
